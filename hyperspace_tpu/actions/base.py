@@ -1,0 +1,151 @@
+"""The Action protocol: a transactional begin → op → end state machine over
+the operation log.
+
+Parity: com/microsoft/hyperspace/actions/Action.scala:34-104. ``run()``:
+
+  1. ``validate()`` — preconditions; may raise NoChangesException to make
+     the whole action a successful no-op (Action.scala:97-99).
+  2. ``begin()`` — write a *transient*-state entry at id ``base_id + 1``.
+     A failed write means another writer got there first → concurrency
+     error (Action.scala:48-54, 78-80).
+  3. ``op()`` — the actual work (index build, file deletes, ...).
+  4. ``end()`` — write the *final*-state entry at ``base_id + 2`` and
+     recreate ``latestStable`` (Action.scala:59-74).
+
+A crash between begin and end leaves the transient state in the log; all
+further modifying actions refuse in validate() until ``cancel()`` rolls the
+index back to its last stable state (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..exceptions import (
+    ConcurrentModificationException,
+    HyperspaceException,
+    NoChangesException,
+)
+from ..index.log_entry import IndexLogEntry, LogEntry
+from ..index.log_manager import IndexLogManager
+from ..telemetry import EventLogging, HyperspaceEvent
+from . import states
+
+
+class Action(EventLogging):
+    def __init__(self, log_manager: IndexLogManager):
+        self.log_manager = log_manager
+        self._base_id: Optional[int] = None
+
+    # -- to be provided by subclasses ---------------------------------------
+    @property
+    def transient_state(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def final_state(self) -> str:
+        raise NotImplementedError
+
+    def validate(self) -> None:
+        """Precondition check; raise HyperspaceException on invalid state,
+        NoChangesException for a no-op."""
+
+    def op(self) -> None:
+        """The action's work (may be a metadata-only no-op)."""
+
+    def log_entry(self) -> LogEntry:
+        """The entry to persist (called for both begin and end)."""
+        raise NotImplementedError
+
+    def event(self, message: str) -> Optional[HyperspaceEvent]:
+        """Telemetry event for this action; None disables emission."""
+        return None
+
+    # -- protocol ------------------------------------------------------------
+    @property
+    def base_id(self) -> int:
+        """Latest log id at action start, or -1 (Action.scala:35)."""
+        if self._base_id is None:
+            latest = self.log_manager.get_latest_id()
+            self._base_id = latest if latest is not None else -1
+        return self._base_id
+
+    def _emit(self, message: str) -> None:
+        ev = self.event(message)
+        if ev is not None and hasattr(self, "conf"):
+            self.log_event(self.conf, ev)  # type: ignore[attr-defined]
+
+    def run(self) -> None:
+        """(Action.scala:83-104)."""
+        try:
+            self.validate()
+        except NoChangesException:
+            self._emit("Operation became a no-op.")
+            return
+        self._emit("Operation started.")
+        try:
+            self._begin()
+            self.op()
+            self._end()
+        except Exception:
+            self._emit("Operation failed.")
+            raise
+        self._emit("Operation succeeded.")
+
+    def _stamp(self, entry: LogEntry, id: int, state: str) -> LogEntry:
+        entry.id = id
+        entry.state = state
+        entry.timestamp = int(time.time() * 1000)
+        return entry
+
+    def _begin(self) -> None:
+        entry = self._stamp(self.log_entry(), self.base_id + 1, self.transient_state)
+        if not self.log_manager.write_log(entry.id, entry):
+            raise ConcurrentModificationException(
+                "Could not acquire proper state for index modification; "
+                "another operation is in flight."
+            )
+
+    def _end(self) -> None:
+        entry = self._stamp(self.log_entry(), self.base_id + 2, self.final_state)
+        if not self.log_manager.write_log(entry.id, entry):
+            raise ConcurrentModificationException(
+                "Could not commit final state; log id already claimed."
+            )
+        if self.final_state in states.STABLE_STATES:
+            self.log_manager.create_latest_stable_log(entry.id)
+
+
+class IndexAction(Action):
+    """Base for actions operating on an *existing* index: loads the previous
+    entry and validates its state (pattern of RefreshActionBase.scala /
+    DeleteAction.scala etc.)."""
+
+    def __init__(self, log_manager: IndexLogManager):
+        super().__init__(log_manager)
+        self._previous: Optional[IndexLogEntry] = None
+
+    @property
+    def allowed_previous_states(self) -> tuple:
+        raise NotImplementedError
+
+    @property
+    def previous_entry(self) -> IndexLogEntry:
+        if self._previous is None:
+            entry = self.log_manager.get_latest_log()
+            if entry is None:
+                raise HyperspaceException("Index does not exist.")
+            self._previous = entry
+        return self._previous
+
+    def validate(self) -> None:
+        if self.previous_entry.state not in self.allowed_previous_states:
+            raise HyperspaceException(
+                f"{type(self).__name__} is only supported in "
+                f"{'/'.join(self.allowed_previous_states)} states; current state "
+                f"is {self.previous_entry.state}."
+            )
+
+    def log_entry(self) -> LogEntry:
+        return self.previous_entry

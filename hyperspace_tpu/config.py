@@ -1,0 +1,123 @@
+"""Session configuration: a typed key/value store with defaults.
+
+Parity: the reference stores all flags as Spark SQL confs
+(``spark.hyperspace.*``) with typed accessors in
+com/microsoft/hyperspace/util/HyperspaceConf.scala:26-109 and defaults in
+index/IndexConstants.scala. Here the store is a plain dict on the session,
+and the typed accessors live as methods so call sites read the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from . import constants as C
+
+
+class HyperspaceConf:
+    """Mutable string-keyed configuration with typed getters.
+
+    Values are stored as provided (str/int/float/bool all accepted) and
+    coerced on read, mirroring how Spark confs are strings coerced by the
+    typed accessors in HyperspaceConf.scala.
+    """
+
+    def __init__(self, values: Optional[Dict[str, Any]] = None):
+        self._values: Dict[str, Any] = dict(values or {})
+
+    # -- generic access ------------------------------------------------------
+    def set(self, key: str, value: Any) -> "HyperspaceConf":
+        self._values[key] = value
+        return self
+
+    def unset(self, key: str) -> "HyperspaceConf":
+        self._values.pop(key, None)
+        return self
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._values.get(key, default)
+
+    def contains(self, key: str) -> bool:
+        return key in self._values
+
+    def copy(self) -> "HyperspaceConf":
+        return HyperspaceConf(self._values)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    # -- coercers ------------------------------------------------------------
+    @staticmethod
+    def _to_bool(v: Any) -> bool:
+        if isinstance(v, bool):
+            return v
+        return str(v).strip().lower() in ("true", "1", "yes")
+
+    # -- typed accessors (reference: HyperspaceConf.scala) -------------------
+    def system_path(self) -> str:
+        return str(self.get(C.INDEX_SYSTEM_PATH, C.INDEX_SYSTEM_PATH_DEFAULT))
+
+    def num_buckets(self) -> int:
+        # Legacy-key fallback mirrors HyperspaceConf.numBucketsForIndex
+        # (reference: HyperspaceConf.scala:63-68).
+        v = self.get(
+            C.INDEX_NUM_BUCKETS,
+            self.get(C.INDEX_NUM_BUCKETS_LEGACY, C.INDEX_NUM_BUCKETS_DEFAULT),
+        )
+        return int(v)
+
+    def lineage_enabled(self) -> bool:
+        return self._to_bool(
+            self.get(C.INDEX_LINEAGE_ENABLED, C.INDEX_LINEAGE_ENABLED_DEFAULT)
+        )
+
+    def hybrid_scan_enabled(self) -> bool:
+        return self._to_bool(
+            self.get(C.INDEX_HYBRID_SCAN_ENABLED, C.INDEX_HYBRID_SCAN_ENABLED_DEFAULT)
+        )
+
+    def hybrid_scan_appended_ratio_threshold(self) -> float:
+        return float(
+            self.get(
+                C.INDEX_HYBRID_SCAN_APPENDED_RATIO_THRESHOLD,
+                C.INDEX_HYBRID_SCAN_APPENDED_RATIO_THRESHOLD_DEFAULT,
+            )
+        )
+
+    def hybrid_scan_deleted_ratio_threshold(self) -> float:
+        return float(
+            self.get(
+                C.INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD,
+                C.INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD_DEFAULT,
+            )
+        )
+
+    def cache_expiry_seconds(self) -> int:
+        return int(
+            self.get(
+                C.INDEX_CACHE_EXPIRY_DURATION_SECONDS,
+                C.INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT,
+            )
+        )
+
+    def optimize_file_size_threshold(self) -> int:
+        return int(
+            self.get(
+                C.OPTIMIZE_FILE_SIZE_THRESHOLD, C.OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT
+            )
+        )
+
+    def event_logger_class(self) -> Optional[str]:
+        v = self.get(C.EVENT_LOGGER_CLASS)
+        return str(v) if v else None
+
+    def signature_provider(self) -> Optional[str]:
+        v = self.get(C.SIGNATURE_PROVIDER)
+        return str(v) if v else None
+
+    def file_based_source_builders(self) -> Optional[str]:
+        v = self.get(C.FILE_BASED_SOURCE_BUILDERS)
+        return str(v) if v else None
+
+    def mesh_bucket_axis(self) -> str:
+        return str(self.get(C.TPU_MESH_BUCKET_AXIS, C.TPU_MESH_BUCKET_AXIS_DEFAULT))

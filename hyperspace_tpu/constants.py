@@ -1,0 +1,88 @@
+"""Config keys, defaults, and naming constants.
+
+Parity: com/microsoft/hyperspace/index/IndexConstants.scala:21-107 and
+actions/Constants.scala:20-33 in the reference. Keys keep the reference's
+dotted-name style but live under a ``hyperspace.`` prefix (no Spark).
+"""
+
+# --- system layout -----------------------------------------------------------
+INDEX_SYSTEM_PATH = "hyperspace.system.path"
+INDEX_SYSTEM_PATH_DEFAULT = "indexes"  # resolved relative to workspace root
+
+# Operation-log directory name inside every index directory
+# (reference: IndexConstants.scala:61, HYPERSPACE_LOG)
+HYPERSPACE_LOG = "_hyperspace_log"
+# Versioned index-data directory prefix (reference: IndexConstants.scala:62)
+INDEX_VERSION_DIRECTORY_PREFIX = "v__"
+
+# --- index build -------------------------------------------------------------
+# (reference: IndexConstants.scala:29-32; default = spark.sql.shuffle.partitions
+# = 200 there. On TPU the natural default is a multiple of the mesh size; 200
+# is kept as the parity default and the engine rounds up to the mesh when
+# executing.)
+INDEX_NUM_BUCKETS = "hyperspace.index.numBuckets"
+INDEX_NUM_BUCKETS_DEFAULT = 200
+INDEX_NUM_BUCKETS_LEGACY = "hyperspace.num.buckets"  # legacy fallback key
+
+# Lineage (reference: IndexConstants.scala:74-76)
+INDEX_LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"
+INDEX_LINEAGE_ENABLED_DEFAULT = False
+DATA_FILE_NAME_ID = "_data_file_id"
+UNKNOWN_FILE_ID = -1  # (reference: IndexConstants.scala:95)
+
+# --- hybrid scan -------------------------------------------------------------
+# (reference: IndexConstants.scala:34-48)
+INDEX_HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
+INDEX_HYBRID_SCAN_ENABLED_DEFAULT = False
+INDEX_HYBRID_SCAN_APPENDED_RATIO_THRESHOLD = (
+    "hyperspace.index.hybridscan.maxAppendedRatio"
+)
+INDEX_HYBRID_SCAN_APPENDED_RATIO_THRESHOLD_DEFAULT = 0.3
+INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD = (
+    "hyperspace.index.hybridscan.maxDeletedRatio"
+)
+INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD_DEFAULT = 0.2
+
+# --- cache -------------------------------------------------------------------
+# (reference: IndexConstants.scala:57-59)
+INDEX_CACHE_EXPIRY_DURATION_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
+INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = 300
+
+# --- optimize ----------------------------------------------------------------
+# (reference: IndexConstants.scala:86-88; OptimizeAction.scala:115-133)
+OPTIMIZE_FILE_SIZE_THRESHOLD = "hyperspace.index.optimize.fileSizeThreshold"
+OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT = 256 * 1024 * 1024  # 256 MB
+OPTIMIZE_MODE_QUICK = "quick"
+OPTIMIZE_MODE_FULL = "full"
+OPTIMIZE_MODES = (OPTIMIZE_MODE_QUICK, OPTIMIZE_MODE_FULL)
+
+# --- refresh -----------------------------------------------------------------
+# (reference: IndexConstants.scala:78-92)
+REFRESH_MODE_INCREMENTAL = "incremental"
+REFRESH_MODE_FULL = "full"
+REFRESH_MODE_QUICK = "quick"
+REFRESH_MODES = (REFRESH_MODE_INCREMENTAL, REFRESH_MODE_FULL, REFRESH_MODE_QUICK)
+
+# --- query rewrite -----------------------------------------------------------
+# Marker injected into relation options so a rewritten plan is never rewritten
+# twice (reference: IndexConstants.scala:54, INDEX_RELATION_IDENTIFIER)
+INDEX_RELATION_IDENTIFIER = ("indexhyperspace", "true")
+
+# --- sources -----------------------------------------------------------------
+# (reference: HyperspaceConf.scala:78-90)
+FILE_BASED_SOURCE_BUILDERS = "hyperspace.index.sources.fileBasedBuilders"
+DEFAULT_SUPPORTED_FORMATS = ("csv", "json", "parquet")
+
+# --- telemetry ---------------------------------------------------------------
+# (reference: telemetry/Constants.scala:20)
+EVENT_LOGGER_CLASS = "hyperspace.eventLoggerClass"
+
+# --- signature provider ------------------------------------------------------
+SIGNATURE_PROVIDER = "hyperspace.index.signatureProvider"
+
+# --- TPU execution -----------------------------------------------------------
+# TPU-specific knobs with no reference analog: mesh axis used for bucket
+# (data) parallelism, and the on-disk row-block alignment for HBM streaming.
+TPU_MESH_BUCKET_AXIS = "hyperspace.tpu.mesh.bucketAxis"
+TPU_MESH_BUCKET_AXIS_DEFAULT = "buckets"
+STORAGE_BLOCK_ALIGN = 128  # bytes; lane-friendly alignment for column buffers

@@ -1,0 +1,21 @@
+"""Exception types for the TPU-native Hyperspace framework.
+
+Parity: com/microsoft/hyperspace/HyperspaceException.scala:18 and
+com/microsoft/hyperspace/actions/NoChangesException.scala:28 in the reference.
+"""
+
+
+class HyperspaceException(Exception):
+    """Generic framework error (reference: HyperspaceException.scala:18)."""
+
+
+class NoChangesException(HyperspaceException):
+    """Marker raised by maintenance actions when there is nothing to do; the
+    action protocol treats it as a successful no-op
+    (reference: actions/NoChangesException.scala:28, Action.scala:97-99)."""
+
+
+class ConcurrentModificationException(HyperspaceException):
+    """Raised when an action loses the optimistic-concurrency race on the
+    operation log (reference: Action.scala:78-80, "Could not acquire proper
+    state" on a failed write_log of the transient entry)."""

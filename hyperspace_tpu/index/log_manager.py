@@ -1,0 +1,141 @@
+"""The operation log: versioned JSON entries with optimistic concurrency.
+
+Parity: com/microsoft/hyperspace/index/IndexLogManager.scala:33-165. Layout
+under each index directory:
+
+    <index>/_hyperspace_log/0          JSON IndexLogEntry, id 0
+    <index>/_hyperspace_log/1          ...
+    <index>/_hyperspace_log/latestStable   copy of the latest stable entry
+
+``write_log(id, entry)`` returns False if the id is already claimed — the
+temp-file + atomic-link commit in utils.file_utils.atomic_create makes the
+id claim linearizable, which is the whole concurrency-control story
+(IndexLogManager.scala:149-165; design lineage: Delta's OCC, README.md:30-33).
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Optional
+
+from .. import constants as C
+from ..exceptions import HyperspaceException
+from ..utils import file_utils, json_utils
+from .log_entry import IndexLogEntry, LogEntry
+from ..actions import states
+
+logger = logging.getLogger(__name__)
+
+LATEST_STABLE = "latestStable"
+
+
+class IndexLogManager:
+    """Abstract interface (reference trait IndexLogManager.scala:33-55)."""
+
+    def get_log(self, id: int) -> Optional[IndexLogEntry]:
+        raise NotImplementedError
+
+    def get_latest_id(self) -> Optional[int]:
+        raise NotImplementedError
+
+    def get_latest_log(self) -> Optional[IndexLogEntry]:
+        raise NotImplementedError
+
+    def get_latest_stable_log(self) -> Optional[IndexLogEntry]:
+        raise NotImplementedError
+
+    def write_log(self, id: int, entry: LogEntry) -> bool:
+        raise NotImplementedError
+
+    def create_latest_stable_log(self, id: int) -> bool:
+        raise NotImplementedError
+
+    def delete_latest_stable_log(self) -> bool:
+        raise NotImplementedError
+
+
+class IndexLogManagerImpl(IndexLogManager):
+    def __init__(self, index_path: str | Path):
+        self._index_path = Path(index_path)
+        self._log_dir = self._index_path / C.HYPERSPACE_LOG
+
+    @property
+    def log_dir(self) -> Path:
+        return self._log_dir
+
+    def _path_of(self, id: int) -> Path:
+        return self._log_dir / str(id)
+
+    def _read(self, path: Path) -> Optional[IndexLogEntry]:
+        if not path.is_file():
+            return None
+        return IndexLogEntry.from_json_dict(
+            json_utils.from_json(file_utils.read_string(path))
+        )
+
+    def get_log(self, id: int) -> Optional[IndexLogEntry]:
+        return self._read(self._path_of(id))
+
+    def get_latest_id(self) -> Optional[int]:
+        """Highest numeric filename in the log dir
+        (IndexLogManager.scala:83-92)."""
+        if not self._log_dir.is_dir():
+            return None
+        ids = [int(p.name) for p in self._log_dir.iterdir() if p.name.isdigit()]
+        return max(ids) if ids else None
+
+    def get_latest_log(self) -> Optional[IndexLogEntry]:
+        latest = self.get_latest_id()
+        return self.get_log(latest) if latest is not None else None
+
+    def get_latest_stable_log(self) -> Optional[IndexLogEntry]:
+        """Prefer the latestStable copy; fall back to a backward scan for a
+        stable-state entry (IndexLogManager.scala:94-113)."""
+        entry = self._read(self._log_dir / LATEST_STABLE)
+        if entry is not None:
+            if entry.state not in states.STABLE_STATES:
+                raise HyperspaceException(
+                    f"Corrupt latestStable with non-stable state {entry.state}"
+                )
+            return entry
+        latest = self.get_latest_id()
+        if latest is None:
+            return None
+        for id in range(latest, -1, -1):
+            e = self.get_log(id)
+            if e is not None and e.state in states.STABLE_STATES:
+                return e
+        return None
+
+    def write_log(self, id: int, entry: LogEntry) -> bool:
+        """Atomically claim log id ``id``; False if already taken
+        (IndexLogManager.scala:149-165)."""
+        if self._path_of(id).exists():
+            return False
+        return file_utils.atomic_create(
+            self._path_of(id), json_utils.to_json(entry)
+        )
+
+    def create_latest_stable_log(self, id: int) -> bool:
+        """Copy entry ``id`` to latestStable (IndexLogManager.scala:115-133).
+        Overwrites any previous latestStable."""
+        entry = self.get_log(id)
+        if entry is None:
+            logger.warning("create_latest_stable_log: no entry with id %s", id)
+            return False
+        if entry.state not in states.STABLE_STATES:
+            logger.warning(
+                "create_latest_stable_log: entry %s has unstable state %s",
+                id,
+                entry.state,
+            )
+            return False
+        self.delete_latest_stable_log()
+        return file_utils.atomic_create(
+            self._log_dir / LATEST_STABLE, json_utils.to_json(entry)
+        )
+
+    def delete_latest_stable_log(self) -> bool:
+        file_utils.delete(self._log_dir / LATEST_STABLE)
+        return True

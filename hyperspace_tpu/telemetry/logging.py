@@ -1,0 +1,63 @@
+"""Event-logger loading and dispatch.
+
+Parity: com/microsoft/hyperspace/telemetry/HyperspaceEventLogging.scala:30-68
+— the logger class is loaded reflectively from config
+(``hyperspace.eventLoggerClass``), defaulting to a no-op.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Optional
+
+from ..config import HyperspaceConf
+from ..exceptions import HyperspaceException
+from .events import HyperspaceEvent
+
+
+class EventLogger:
+    def log_event(self, event: HyperspaceEvent) -> None:
+        raise NotImplementedError
+
+
+class NoOpEventLogger(EventLogger):
+    """(HyperspaceEventLogging.scala:66-68)."""
+
+    def log_event(self, event: HyperspaceEvent) -> None:
+        pass
+
+
+def get_event_logger(conf: HyperspaceConf) -> EventLogger:
+    """Load the configured logger class (``module:ClassName`` or dotted
+    path), defaulting to NoOp (HyperspaceEventLogging.scala:42-64)."""
+    cls_name = conf.event_logger_class()
+    if not cls_name:
+        return NoOpEventLogger()
+    if ":" in cls_name:
+        mod_name, _, attr = cls_name.partition(":")
+    elif "." in cls_name:
+        mod_name, _, attr = cls_name.rpartition(".")
+    else:
+        raise HyperspaceException(
+            f"Invalid event logger class {cls_name!r}: expected "
+            "'module:ClassName' or a dotted path."
+        )
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, attr)()
+
+
+class EventLogging:
+    """Mixin giving actions a ``log_event`` (HyperspaceEventLogging.scala:30-40).
+    The logger instance is cached per conf object."""
+
+    _conf: Optional[HyperspaceConf] = None
+    _logger_cache: Optional[EventLogger] = None
+
+    def _event_logger(self, conf: HyperspaceConf) -> EventLogger:
+        if self._logger_cache is None or self._conf is not conf:
+            self._conf = conf
+            self._logger_cache = get_event_logger(conf)
+        return self._logger_cache
+
+    def log_event(self, conf: HyperspaceConf, event: HyperspaceEvent) -> None:
+        self._event_logger(conf).log_event(event)

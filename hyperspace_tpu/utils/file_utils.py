@@ -1,0 +1,87 @@
+"""Filesystem helpers over the local/POSIX filesystem.
+
+Parity: com/microsoft/hyperspace/util/FileUtils.scala:28-123. The reference
+goes through the Hadoop FileSystem API; here plain POSIX is the storage
+substrate (object-store backends slot in behind the same functions later —
+see SURVEY.md §7 "Atomic-rename OCC on object stores").
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from pathlib import Path
+from typing import Iterable, List
+
+
+def write_string(path: str | Path, content: str) -> None:
+    """Create parent dirs and write ``content`` (FileUtils.scala:28-45)."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(content, encoding="utf-8")
+
+
+def read_string(path: str | Path) -> str:
+    return Path(path).read_text(encoding="utf-8")
+
+
+def delete(path: str | Path) -> None:
+    """Recursive delete that tolerates absence (FileUtils.scala:76-90)."""
+    p = Path(path)
+    if p.is_dir() and not p.is_symlink():
+        shutil.rmtree(p, ignore_errors=True)
+    elif p.exists() or p.is_symlink():
+        p.unlink(missing_ok=True)
+
+
+def get_directory_size(path: str | Path) -> int:
+    """Total bytes under a directory (FileUtils.scala:92-123)."""
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            fp = os.path.join(root, f)
+            try:
+                total += os.path.getsize(fp)
+            except OSError:
+                pass
+    return total
+
+
+def atomic_create(path: str | Path, content: str) -> bool:
+    """Atomically create ``path`` with ``content`` iff it does not exist.
+
+    This is the optimistic-concurrency commit point: the reference writes a
+    temp file then does an atomic ``fs.rename`` which fails if the target
+    exists (IndexLogManager.scala:149-165). POSIX ``rename`` overwrites, so
+    the equivalent linearizable claim here is ``os.link(tmp, target)`` which
+    fails with EEXIST if the id was already taken.
+    """
+    target = Path(path)
+    target.parent.mkdir(parents=True, exist_ok=True)
+    tmp = target.parent / f".{target.name}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+    try:
+        tmp.write_text(content, encoding="utf-8")
+        os.link(tmp, target)
+        return True
+    except FileExistsError:
+        return False
+    finally:
+        tmp.unlink(missing_ok=True)
+
+
+def list_leaf_files(paths: Iterable[str | Path]) -> List[Path]:
+    """Recursively list data files under ``paths``, skipping hidden/underscore
+    entries the way the reference's DataPathFilter does (PathUtils.scala:22-39).
+    A path that is itself a file is returned as-is."""
+    out: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_file():
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if not d.startswith((".", "_"))]
+            for f in sorted(files):
+                if not f.startswith((".", "_")):
+                    out.append(Path(root) / f)
+    return sorted(out)

@@ -1,0 +1,175 @@
+"""Action-protocol and lifecycle state-machine tests.
+
+Mirrors the reference's mock-based action tier (actions/*Test.scala):
+validate() rules, begin/op/end log-id arithmetic, concurrent-writer
+conflict, cancel recovery.
+"""
+
+import pytest
+
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.actions.base import Action, IndexAction
+from hyperspace_tpu.actions.metadata_actions import (
+    CancelAction,
+    DeleteAction,
+    RestoreAction,
+    VacuumAction,
+)
+from hyperspace_tpu.exceptions import (
+    ConcurrentModificationException,
+    HyperspaceException,
+    NoChangesException,
+)
+from hyperspace_tpu.index.data_manager import IndexDataManagerImpl
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+from tests.test_log_entry import make_entry
+
+
+def seeded_manager(tmp_path, state=states.ACTIVE):
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    e = make_entry()
+    e.state = states.CREATING
+    assert mgr.write_log(0, e)
+    e2 = make_entry()
+    e2.state = state
+    assert mgr.write_log(1, e2)
+    if state in states.STABLE_STATES:
+        mgr.create_latest_stable_log(1)
+    return mgr
+
+
+class RecordingAction(Action):
+    transient_state = states.CREATING
+    final_state = states.ACTIVE
+
+    def __init__(self, log_manager, fail_in_op=False, no_changes=False):
+        super().__init__(log_manager)
+        self.fail_in_op = fail_in_op
+        self.no_changes = no_changes
+        self.ops = 0
+
+    def validate(self):
+        if self.no_changes:
+            raise NoChangesException("nothing to do")
+
+    def op(self):
+        self.ops += 1
+        if self.fail_in_op:
+            raise RuntimeError("boom")
+
+    def log_entry(self):
+        return make_entry()
+
+
+def test_action_begin_op_end(tmp_path):
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    action = RecordingAction(mgr)
+    action.run()
+    # ids base+1 (transient) and base+2 (final): base was -1
+    assert mgr.get_log(0).state == states.CREATING
+    assert mgr.get_log(1).state == states.ACTIVE
+    assert mgr.get_latest_stable_log().id == 1
+    assert action.ops == 1
+
+
+def test_action_failure_leaves_transient_state(tmp_path):
+    # Reference/SURVEY §5.3: a failed action leaves the transient entry.
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    with pytest.raises(RuntimeError):
+        RecordingAction(mgr, fail_in_op=True).run()
+    assert mgr.get_latest_id() == 0
+    assert mgr.get_latest_log().state == states.CREATING
+    assert mgr.get_latest_stable_log() is None
+
+
+def test_action_no_changes_is_noop(tmp_path):
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    action = RecordingAction(mgr, no_changes=True)
+    action.run()
+    assert action.ops == 0
+    assert mgr.get_latest_id() is None
+
+
+def test_concurrent_actions_conflict(tmp_path):
+    # Reference: Action.scala:78-80 — both racers compute base_id before
+    # either begins; the second begin() fails its id claim.
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    a1 = RecordingAction(mgr)
+    a2 = RecordingAction(mgr)
+    _ = a1.base_id, a2.base_id
+    a1.run()
+    with pytest.raises(ConcurrentModificationException):
+        a2.run()
+    assert a2.ops == 0
+
+
+def test_delete_restore_cycle(tmp_path):
+    mgr = seeded_manager(tmp_path)
+    DeleteAction(mgr).run()
+    assert mgr.get_latest_log().state == states.DELETED
+    assert mgr.get_latest_stable_log().state == states.DELETED
+    RestoreAction(mgr).run()
+    assert mgr.get_latest_log().state == states.ACTIVE
+    # delete requires ACTIVE
+    mgr2 = seeded_manager(tmp_path / "2", state=states.DELETED)
+    with pytest.raises(HyperspaceException):
+        DeleteAction(mgr2).run()
+    # restore requires DELETED
+    with pytest.raises(HyperspaceException):
+        RestoreAction(mgr).run()
+
+
+def test_vacuum_deletes_data_versions(tmp_path):
+    mgr = seeded_manager(tmp_path, state=states.DELETED)
+    data = IndexDataManagerImpl(tmp_path / "idx")
+    for v in (0, 1):
+        d = data.get_path(v)
+        d.mkdir(parents=True)
+        (d / "b0.tcb").write_bytes(b"x")
+    VacuumAction(mgr, data).run()
+    assert mgr.get_latest_log().state == states.DOESNOTEXIST
+    assert data.get_latest_version_id() is None
+
+
+def test_vacuum_requires_deleted(tmp_path):
+    mgr = seeded_manager(tmp_path, state=states.ACTIVE)
+    data = IndexDataManagerImpl(tmp_path / "idx")
+    with pytest.raises(HyperspaceException):
+        VacuumAction(mgr, data).run()
+
+
+def test_cancel_rolls_back_to_stable(tmp_path):
+    # Index went ACTIVE then a refresh crashed mid-flight.
+    mgr = seeded_manager(tmp_path, state=states.ACTIVE)
+    stuck = make_entry()
+    stuck.state = states.REFRESHING
+    assert mgr.write_log(2, stuck)
+    CancelAction(mgr).run()
+    assert mgr.get_latest_log().state == states.ACTIVE
+    assert mgr.get_latest_log().id == 4
+
+
+def test_cancel_refuses_stable(tmp_path):
+    mgr = seeded_manager(tmp_path, state=states.ACTIVE)
+    with pytest.raises(HyperspaceException):
+        CancelAction(mgr).run()
+
+
+def test_cancel_vacuuming_goes_doesnotexist(tmp_path):
+    # Reference: CancelAction.scala:48-64 VACUUMING special case.
+    mgr = seeded_manager(tmp_path, state=states.DELETED)
+    stuck = make_entry()
+    stuck.state = states.VACUUMING
+    assert mgr.write_log(2, stuck)
+    CancelAction(mgr).run()
+    assert mgr.get_latest_log().state == states.DOESNOTEXIST
+
+
+def test_cancel_with_no_stable_history(tmp_path):
+    # First create crashed: only a CREATING entry exists.
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    e = make_entry()
+    e.state = states.CREATING
+    mgr.write_log(0, e)
+    CancelAction(mgr).run()
+    assert mgr.get_latest_log().state == states.DOESNOTEXIST

@@ -1,0 +1,294 @@
+"""Metadata-model tests.
+
+Mirrors the reference's pure-unit tier: IndexLogEntryTest.scala (golden JSON
+spec at :75; Content/Directory builders :243-344) and FileIdTracker
+consistency assertions (IndexLogEntry.scala:647-668).
+"""
+
+import json
+
+import pytest
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.log_entry import (
+    Content,
+    CoveringIndex,
+    Directory,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Relation,
+    Signature,
+    Source,
+    Update,
+)
+from hyperspace_tpu.utils import json_utils
+
+
+def make_entry() -> IndexLogEntry:
+    content = Content(
+        Directory(
+            "/",
+            subdirs=[
+                Directory(
+                    "idx",
+                    subdirs=[
+                        Directory(
+                            "v__=0",
+                            files=[
+                                FileInfo("b0.tcb", 100, 1000, 0),
+                                FileInfo("b1.tcb", 200, 1000, 1),
+                            ],
+                        )
+                    ],
+                )
+            ],
+        )
+    )
+    src_content = Content(
+        Directory(
+            "/",
+            subdirs=[
+                Directory("data", files=[FileInfo("part-0.parquet", 500, 900, 0)])
+            ],
+        )
+    )
+    entry = IndexLogEntry(
+        "myIndex",
+        CoveringIndex(
+            indexed_columns=["orderkey"],
+            included_columns=["price"],
+            schema={"orderkey": "int64", "price": "float32"},
+            num_buckets=8,
+            properties={"lineage": "true"},
+        ),
+        content,
+        Source(
+            [
+                Relation(
+                    ["/data"],
+                    src_content,
+                    {"orderkey": "int64", "price": "float32", "comment": "string"},
+                    "parquet",
+                    {"path": "/data"},
+                )
+            ],
+            LogicalPlanFingerprint([Signature("IndexSignatureProvider", "abc123")]),
+        ),
+    )
+    entry.id = 2
+    entry.state = "ACTIVE"
+    entry.timestamp = 1234567890
+    return entry
+
+
+# Golden spec: the serialized operation-log schema is a persistence contract.
+# Mirrors IndexLogEntryTest.scala:75 — if this test breaks, existing on-disk
+# logs can no longer be read and the version must be bumped.
+GOLDEN = {
+    "version": "0.1",
+    "id": 2,
+    "state": "ACTIVE",
+    "timestamp": 1234567890,
+    "enabled": True,
+    "name": "myIndex",
+    "derivedDataset": {
+        "kind": "CoveringIndex",
+        "properties": {
+            "columns": {"indexed": ["orderkey"], "included": ["price"]},
+            "schema": {"orderkey": "int64", "price": "float32"},
+            "numBuckets": 8,
+            "properties": {"lineage": "true"},
+        },
+    },
+    "content": {
+        "root": {
+            "name": "/",
+            "files": [],
+            "subDirs": [
+                {
+                    "name": "idx",
+                    "files": [],
+                    "subDirs": [
+                        {
+                            "name": "v__=0",
+                            "files": [
+                                {"name": "b0.tcb", "size": 100, "modifiedTime": 1000, "id": 0},
+                                {"name": "b1.tcb", "size": 200, "modifiedTime": 1000, "id": 1},
+                            ],
+                            "subDirs": [],
+                        }
+                    ],
+                }
+            ],
+        }
+    },
+    "source": {
+        "plan": {
+            "kind": "Source",
+            "properties": {
+                "relations": [
+                    {
+                        "rootPaths": ["/data"],
+                        "data": {
+                            "root": {
+                                "name": "/",
+                                "files": [],
+                                "subDirs": [
+                                    {
+                                        "name": "data",
+                                        "files": [
+                                            {
+                                                "name": "part-0.parquet",
+                                                "size": 500,
+                                                "modifiedTime": 900,
+                                                "id": 0,
+                                            }
+                                        ],
+                                        "subDirs": [],
+                                    }
+                                ],
+                            }
+                        },
+                        "schema": {
+                            "orderkey": "int64",
+                            "price": "float32",
+                            "comment": "string",
+                        },
+                        "fileFormat": "parquet",
+                        "options": {"path": "/data"},
+                        "update": None,
+                    }
+                ],
+                "fingerprint": {
+                    "kind": "LogicalPlan",
+                    "properties": {
+                        "signatures": [
+                            {"provider": "IndexSignatureProvider", "value": "abc123"}
+                        ]
+                    },
+                },
+            },
+        }
+    },
+    "properties": {},
+}
+
+
+def test_golden_json_spec():
+    entry = make_entry()
+    assert entry.to_json_dict() == GOLDEN
+
+
+def test_round_trip():
+    entry = make_entry()
+    text = json_utils.to_json(entry)
+    back = IndexLogEntry.from_json_dict(json.loads(text))
+    assert back.to_json_dict() == entry.to_json_dict()
+    assert back.name == "myIndex"
+    assert back.num_buckets == 8
+    assert back.indexed_columns == ["orderkey"]
+    assert back.has_lineage_column()
+    assert back.signature().value == "abc123"
+
+
+def test_content_files_full_paths():
+    entry = make_entry()
+    assert entry.content.files() == ["/idx/v__=0/b0.tcb", "/idx/v__=0/b1.tcb"]
+    infos = entry.content.file_infos()
+    assert [f.name for f in infos] == ["/idx/v__=0/b0.tcb", "/idx/v__=0/b1.tcb"]
+    assert entry.content.total_size() == 300
+
+
+def test_file_info_equality_excludes_id():
+    # Reference: IndexLogEntry.scala:321-344
+    a = FileInfo("f", 1, 2, 10)
+    b = FileInfo("f", 1, 2, 99)
+    assert a == b
+    assert hash(a) == hash(b)
+    assert a != FileInfo("f", 1, 3, 10)
+
+
+def test_directory_merge():
+    # Reference: IndexLogEntry.scala:144-172
+    d1 = Directory(
+        "/",
+        subdirs=[Directory("a", files=[FileInfo("x", 1, 1, 0)])],
+    )
+    d2 = Directory(
+        "/",
+        subdirs=[
+            Directory("a", files=[FileInfo("y", 2, 2, 1)]),
+            Directory("b", files=[FileInfo("z", 3, 3, 2)]),
+        ],
+    )
+    m = d1.merge(d2)
+    names = {d.name for d in m.subdirs}
+    assert names == {"a", "b"}
+    a = next(d for d in m.subdirs if d.name == "a")
+    assert {f.name for f in a.files} == {"x", "y"}
+    with pytest.raises(HyperspaceException):
+        Directory("p").merge(Directory("q"))
+
+
+def test_from_leaf_files(tmp_path):
+    f1 = tmp_path / "d1" / "a.parquet"
+    f2 = tmp_path / "d1" / "b.parquet"
+    f3 = tmp_path / "d2" / "c.parquet"
+    for f in (f1, f2, f3):
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_bytes(b"x" * 10)
+    tracker = FileIdTracker()
+    content = Content.from_leaf_files([str(f1), str(f2), str(f3)], tracker)
+    assert sorted(content.files()) == sorted(str(f) for f in (f1, f2, f3))
+    assert tracker.max_id == 2
+    # ids stable on re-add
+    st = f1.stat()
+    assert tracker.add_file(str(f1), st.st_size, int(st.st_mtime * 1000)) == 0
+    assert Content.from_leaf_files([], FileIdTracker()) is None
+
+
+def test_file_id_tracker_consistency():
+    t = FileIdTracker()
+    t.add_file_info(FileInfo("/p", 1, 2, 5))
+    assert t.max_id == 5
+    t.add_file_info(FileInfo("/p", 1, 2, 5))  # idempotent
+    with pytest.raises(HyperspaceException):
+        t.add_file_info(FileInfo("/p", 1, 2, 6))  # conflicting id
+    with pytest.raises(HyperspaceException):
+        t.add_file_info(FileInfo("/q", 1, 2, -1))  # unknown id
+    assert t.get_file_id("/p", 1, 2) == 5
+    assert t.get_file_id("/nope", 1, 2) is None
+
+
+def test_copy_with_update():
+    # Reference: IndexLogEntry.copyWithUpdate (:483-505)
+    entry = make_entry()
+    appended = Content(Directory("/", subdirs=[Directory("data", files=[FileInfo("new.parquet", 50, 950, 1)])]))
+    fp = LogicalPlanFingerprint([Signature("IndexSignatureProvider", "def456")])
+    updated = entry.copy_with_update(fp, appended, None)
+    assert updated.source_update().appended_files.files() == ["/data/new.parquet"]
+    assert updated.source_update().deleted_files is None
+    assert updated.signature().value == "def456"
+    # original untouched
+    assert entry.source_update() is None
+
+
+def test_tags_keyed_by_plan_and_name():
+    entry = make_entry()
+    plan_a, plan_b = object(), object()
+    entry.set_tag_value(plan_a, "sig", True)
+    assert entry.get_tag_value(plan_a, "sig") is True
+    assert entry.get_tag_value(plan_b, "sig") is None
+    calls = []
+
+    def compute():
+        calls.append(1)
+        return 42
+
+    assert entry.with_cached_tag(plan_b, "bytes", compute) == 42
+    assert entry.with_cached_tag(plan_b, "bytes", compute) == 42
+    assert len(calls) == 1
+    entry.unset_tag_value(plan_a, "sig")
+    assert entry.get_tag_value(plan_a, "sig") is None

@@ -1,0 +1,64 @@
+"""Operation-log protocol tests.
+
+Mirrors IndexLogManagerImplTest.scala — id claiming, latestStable fallback
+scan, and the optimistic-concurrency property that a claimed id can never be
+re-claimed.
+"""
+
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+from tests.test_log_entry import make_entry
+
+
+def entry_with(id, state):
+    e = make_entry()
+    e.id = id
+    e.state = state
+    return e
+
+
+def test_write_and_read(tmp_path):
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    assert mgr.get_latest_id() is None
+    assert mgr.get_latest_log() is None
+    assert mgr.write_log(0, entry_with(0, states.CREATING))
+    assert mgr.get_latest_id() == 0
+    assert mgr.get_log(0).state == states.CREATING
+    assert mgr.get_log(7) is None
+
+
+def test_write_log_is_claim_once(tmp_path):
+    # Reference: IndexLogManager.scala:149-165 — optimistic concurrency.
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    assert mgr.write_log(0, entry_with(0, states.CREATING))
+    assert not mgr.write_log(0, entry_with(0, states.ACTIVE))
+    assert mgr.get_log(0).state == states.CREATING  # first writer wins
+
+
+def test_latest_stable_prefers_copy_then_scans(tmp_path):
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    mgr.write_log(0, entry_with(0, states.CREATING))
+    mgr.write_log(1, entry_with(1, states.ACTIVE))
+    mgr.write_log(2, entry_with(2, states.REFRESHING))
+    # no latestStable file yet -> backward scan finds id 1
+    assert mgr.get_latest_stable_log().id == 1
+    assert mgr.create_latest_stable_log(1)
+    assert mgr.get_latest_stable_log().id == 1
+    # unstable entries are not eligible for latestStable
+    assert not mgr.create_latest_stable_log(2)
+    assert not mgr.create_latest_stable_log(99)
+
+
+def test_latest_stable_none_when_no_stable(tmp_path):
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    mgr.write_log(0, entry_with(0, states.CREATING))
+    assert mgr.get_latest_stable_log() is None
+
+
+def test_delete_latest_stable(tmp_path):
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    mgr.write_log(0, entry_with(0, states.ACTIVE))
+    mgr.create_latest_stable_log(0)
+    mgr.delete_latest_stable_log()
+    # falls back to scan
+    assert mgr.get_latest_stable_log().id == 0

@@ -1,0 +1,128 @@
+"""Data manager, path resolver, config, utils tests."""
+
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.data_manager import IndexDataManagerImpl
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.path_resolver import PathResolver
+from hyperspace_tpu.utils import file_utils, resolver
+from hyperspace_tpu.utils.cache_with_transform import CacheWithTransform
+from hyperspace_tpu.utils.hashing import md5_hex
+
+
+def test_data_manager_versions(tmp_path):
+    mgr = IndexDataManagerImpl(tmp_path / "idx")
+    assert mgr.get_latest_version_id() is None
+    for v in (0, 1, 3):
+        mgr.get_path(v).mkdir(parents=True)
+    (tmp_path / "idx" / "not_a_version").mkdir()
+    assert mgr.get_latest_version_id() == 3
+    assert mgr.get_all_version_ids() == [0, 1, 3]
+    assert mgr.get_path(2).name == "v__=2"
+    mgr.delete(3)
+    assert mgr.get_latest_version_id() == 1
+
+
+def test_path_resolver_case_insensitive(tmp_path):
+    conf = HyperspaceConf({C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes")})
+    r = PathResolver(conf)
+    (tmp_path / "indexes" / "MyIndex").mkdir(parents=True)
+    assert r.get_index_path("myindex").name == "MyIndex"
+    assert r.get_index_path("other").name == "other"
+
+
+def test_conf_typed_accessors():
+    conf = HyperspaceConf()
+    assert conf.num_buckets() == 200
+    assert conf.hybrid_scan_appended_ratio_threshold() == 0.3
+    assert conf.hybrid_scan_deleted_ratio_threshold() == 0.2
+    assert conf.cache_expiry_seconds() == 300
+    assert conf.optimize_file_size_threshold() == 256 * 1024 * 1024
+    assert not conf.lineage_enabled()
+    conf.set(C.INDEX_LINEAGE_ENABLED, "true")
+    assert conf.lineage_enabled()
+    # legacy numBuckets key fallback (HyperspaceConf.scala:63-68)
+    conf2 = HyperspaceConf({C.INDEX_NUM_BUCKETS_LEGACY: "16"})
+    assert conf2.num_buckets() == 16
+    conf2.set(C.INDEX_NUM_BUCKETS, 32)
+    assert conf2.num_buckets() == 32
+
+
+def test_index_config_validation():
+    with pytest.raises(HyperspaceException):
+        IndexConfig("x", [])
+    with pytest.raises(HyperspaceException):
+        IndexConfig("x", ["A", "a"])
+    with pytest.raises(HyperspaceException):
+        IndexConfig("x", ["a"], ["A"])
+    c1 = IndexConfig("Name", ["Col1"], ["Col2", "col3"])
+    c2 = IndexConfig("name", ["col1"], ["COL3", "Col2"])
+    assert c1 == c2 and hash(c1) == hash(c2)
+    # indexed order matters
+    assert IndexConfig("n", ["a", "b"]) != IndexConfig("n", ["b", "a"])
+
+
+def test_index_config_builder():
+    c = (
+        IndexConfig.builder()
+        .index_name("idx")
+        .index_by("a", "b")
+        .include("c")
+        .create()
+    )
+    assert c.indexed_columns == ["a", "b"]
+    assert c.included_columns == ["c"]
+    with pytest.raises(HyperspaceException):
+        IndexConfig.builder().index_by("a").index_by("b")
+
+
+def test_resolver():
+    assert resolver.resolve("Query", ["query", "other"]) == "query"
+    assert resolver.resolve("Query", ["query"], case_sensitive=True) is None
+    assert resolver.resolve_all(["A", "b"], ["a", "B", "c"]) == ["a", "B"]
+    assert resolver.resolve_all(["A", "zzz"], ["a"]) is None
+
+
+def test_md5_stable():
+    assert md5_hex("abc") == "900150983cd24fb0d6963f7d28e17f72"
+
+
+def test_atomic_create(tmp_path):
+    p = tmp_path / "d" / "f"
+    assert file_utils.atomic_create(p, "one")
+    assert not file_utils.atomic_create(p, "two")
+    assert p.read_text() == "one"
+    # no stray temp files
+    assert [f.name for f in (tmp_path / "d").iterdir()] == ["f"]
+
+
+def test_list_leaf_files_skips_hidden(tmp_path):
+    (tmp_path / "a.parquet").write_text("x")
+    (tmp_path / "_SUCCESS").write_text("")
+    (tmp_path / ".hidden").write_text("")
+    (tmp_path / "_logdir").mkdir()
+    (tmp_path / "_logdir" / "b.parquet").write_text("x")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "c.parquet").write_text("x")
+    files = [p.name for p in file_utils.list_leaf_files([tmp_path])]
+    assert files == ["a.parquet", "c.parquet"]
+
+
+def test_cache_with_transform():
+    key = ["k1"]
+    calls = []
+
+    def transform(k):
+        calls.append(k)
+        return k.upper()
+
+    c = CacheWithTransform(lambda: key[0], transform)
+    assert c.load() == "K1"
+    assert c.load() == "K1"
+    assert calls == ["k1"]
+    key[0] = "k2"
+    assert c.load() == "K2"
+    assert calls == ["k1", "k2"]
