@@ -1,0 +1,10 @@
+"""hyperspace_tpu — a TPU-native indexing framework with the capabilities of
+Microsoft Hyperspace (see SURVEY.md for the reference map).
+
+Users create covering indexes over data-lake files; a rewrite layer
+transparently swaps table scans for TPU index scans on filter and equi-join
+queries. Index builds and scans execute as JAX/XLA programs over a device
+mesh; index data lives in the TCB columnar layout that streams into HBM.
+"""
+
+__version__ = "0.1.0"

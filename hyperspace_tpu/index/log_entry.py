@@ -566,11 +566,15 @@ class IndexLogEntry(LogEntry):
         return entry
 
     # -- tag system (IndexLogEntry.scala:560-602) ----------------------------
+    # Values are stored as (plan, value): keeping a strong reference to the
+    # plan pins it so CPython id() reuse cannot alias a dead plan's memo to
+    # a new object (the reference keys a Map by the plan object itself).
     def set_tag_value(self, plan: Any, tag: str, value: Any) -> None:
-        self._tags[(id(plan), tag)] = value
+        self._tags[(id(plan), tag)] = (plan, value)
 
     def get_tag_value(self, plan: Any, tag: str) -> Any:
-        return self._tags.get((id(plan), tag))
+        hit = self._tags.get((id(plan), tag))
+        return hit[1] if hit is not None else None
 
     def unset_tag_value(self, plan: Any, tag: str) -> None:
         self._tags.pop((id(plan), tag), None)
@@ -578,8 +582,8 @@ class IndexLogEntry(LogEntry):
     def with_cached_tag(self, plan: Any, tag: str, compute) -> Any:
         key = (id(plan), tag)
         if key not in self._tags:
-            self._tags[key] = compute()
-        return self._tags[key]
+            self._tags[key] = (plan, compute())
+        return self._tags[key][1]
 
     # -- serde ---------------------------------------------------------------
     def to_json_dict(self) -> Dict[str, Any]:

@@ -1,0 +1,19 @@
+"""Device compute ops. Importing anything here (or calling ensure_x64)
+switches JAX to 64-bit mode.
+
+Exact 64-bit keys are the product of an indexing framework (orderkeys, file
+ids, row counts) — silent int64→int32 downcasting is data corruption. TPU
+executes 64-bit integer ops via 32-bit emulation; value columns are cast
+down explicitly where speed matters. x64 is enabled here, at the engine
+boundary, not at package import, so metadata-only use of hyperspace_tpu
+never touches jax or mutates process-global config.
+"""
+
+
+def ensure_x64() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+
+ensure_x64()

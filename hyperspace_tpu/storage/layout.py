@@ -1,0 +1,177 @@
+"""The TPU-native on-disk index layout: TCB (tensor columnar batch) files.
+
+This replaces the reference's bucketed+sorted Parquet index data
+(DataFrameWriterExtensions.scala:49-72) with a layout designed for HBM
+streaming (BASELINE.json north star: "a TPU-native columnar (not Parquet)
+on-disk layout that streams straight into HBM"):
+
+* one file per bucket, named ``b<bucket>-<uuid>.tcb``;
+* raw little-endian fixed-width column buffers, each aligned to 128 bytes,
+  so a read is an ``np.memmap`` view handed to ``jax.device_put`` with no
+  decode step (vs parquet's decompress+decode);
+* a JSON footer (schema, row count, per-column offset/nbytes, per-column
+  min/max for numeric pruning, string vocabs, sort/bucket info) followed by
+  an 8-byte little-endian footer length and the magic ``TCB1`` — parquet-
+  style trailer so readers seek from the end.
+
+Footer min/max gives the data-skipping capability of BASELINE.md config 5.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .. import constants as C
+from ..exceptions import HyperspaceException
+from .columnar import CODE_DTYPE, Column, ColumnarBatch, is_string, numpy_dtype
+
+MAGIC = b"TCB1"
+ALIGN = C.STORAGE_BLOCK_ALIGN
+
+
+def _pad(n: int) -> int:
+    return (ALIGN - n % ALIGN) % ALIGN
+
+
+def bucket_file_name(bucket: int) -> str:
+    return f"b{bucket:05d}-{uuid.uuid4().hex[:12]}.tcb"
+
+
+def bucket_of_file(path: str | Path) -> int:
+    """Parse the bucket id back out of a data file name (the analog of
+    Spark's BucketingUtils.getBucketId used by OptimizeAction.scala:120)."""
+    name = Path(path).name
+    if not (name.startswith("b") and name.endswith(".tcb")):
+        raise HyperspaceException(f"Not an index data file: {name}")
+    return int(name[1:].split("-", 1)[0])
+
+
+def write_batch(
+    path: str | Path,
+    batch: ColumnarBatch,
+    sorted_by: Optional[List[str]] = None,
+    bucket: Optional[int] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Write one batch as a TCB file."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    columns_meta: List[Dict[str, Any]] = []
+    offset = 0
+    buffers: List[bytes] = []
+    for name, col in batch.columns.items():
+        data = np.ascontiguousarray(col.data)
+        raw = data.tobytes()
+        pad = _pad(len(raw))
+        meta: Dict[str, Any] = {
+            "name": name,
+            "dtype": col.dtype_str,
+            "offset": offset,
+            "nbytes": len(raw),
+        }
+        mm = col.min_max()
+        if mm is not None:
+            meta["min"], meta["max"] = mm
+        if is_string(col.dtype_str):
+            meta["vocab"] = [v.decode("utf-8", "surrogateescape") for v in col.vocab]
+        columns_meta.append(meta)
+        buffers.append(raw + b"\0" * pad)
+        offset += len(raw) + pad
+    footer = {
+        "version": 1,
+        "numRows": batch.num_rows,
+        "columns": columns_meta,
+        "sortedBy": sorted_by or [],
+        "bucket": bucket,
+        "extra": extra or {},
+    }
+    footer_bytes = json.dumps(footer).encode("utf-8")
+    tmp = path.parent / f".{path.name}.tmp"
+    with open(tmp, "wb") as f:
+        for buf in buffers:
+            f.write(buf)
+        f.write(footer_bytes)
+        f.write(len(footer_bytes).to_bytes(8, "little"))
+        f.write(MAGIC)
+    os.replace(tmp, path)
+
+
+def read_footer(path: str | Path) -> Dict[str, Any]:
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size < 12:
+            raise HyperspaceException(f"Truncated TCB file: {path}")
+        f.seek(size - 12)
+        trailer = f.read(12)
+        if trailer[8:] != MAGIC:
+            raise HyperspaceException(f"Bad magic in {path}; not a TCB file.")
+        flen = int.from_bytes(trailer[:8], "little")
+        f.seek(size - 12 - flen)
+        return json.loads(f.read(flen))
+
+
+def read_batch(
+    path: str | Path,
+    columns: Optional[Iterable[str]] = None,
+    mmap: bool = True,
+) -> ColumnarBatch:
+    """Read (a projection of) a TCB file. With ``mmap=True`` column buffers
+    are memory-mapped views: no copy happens until the array is handed to
+    the device."""
+    footer = read_footer(path)
+    want = list(columns) if columns is not None else None
+    by_name = {m["name"]: m for m in footer["columns"]}
+    if want is not None:
+        missing = [c for c in want if c not in by_name]
+        if missing:
+            raise HyperspaceException(f"Columns {missing} not in {path}.")
+    names = want if want is not None else [m["name"] for m in footer["columns"]]
+    n = footer["numRows"]
+    cols: Dict[str, Column] = {}
+    if mmap:
+        raw = np.memmap(path, dtype=np.uint8, mode="r")
+    else:
+        raw = np.fromfile(path, dtype=np.uint8)
+    for name in names:
+        m = by_name[name]
+        dt = CODE_DTYPE if is_string(m["dtype"]) else numpy_dtype(m["dtype"])
+        buf = raw[m["offset"] : m["offset"] + m["nbytes"]]
+        data = buf.view(dt)[:n]
+        vocab = None
+        if is_string(m["dtype"]):
+            vocab = np.array(
+                [v.encode("utf-8", "surrogateescape") for v in m["vocab"]], dtype=object
+            )
+        cols[name] = Column(m["dtype"], data, vocab)
+    return ColumnarBatch(cols)
+
+
+def prune_by_min_max(
+    paths: Iterable[str | Path],
+    column: str,
+    lo: Optional[float],
+    hi: Optional[float],
+) -> List[Path]:
+    """Data-skipping: keep only files whose footer [min,max] range for
+    ``column`` intersects [lo, hi] (BASELINE.md config 5 — sketch-based
+    skipping; min/max zone maps are the first sketch type)."""
+    out: List[Path] = []
+    for p in paths:
+        footer = read_footer(p)
+        meta = next((m for m in footer["columns"] if m["name"] == column), None)
+        if meta is None or "min" not in meta:
+            out.append(Path(p))  # cannot prune
+            continue
+        if lo is not None and meta["max"] < lo:
+            continue
+        if hi is not None and meta["min"] > hi:
+            continue
+        out.append(Path(p))
+    return out

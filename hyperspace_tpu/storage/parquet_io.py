@@ -1,0 +1,91 @@
+"""Parquet ingest: the read path for *source* data.
+
+Parity: the reference scans sources through Spark's ParquetFileFormat /
+FileSourceScanExec (RuleUtils.scala:286,400). Here pyarrow reads source
+files into ColumnarBatches that stream to the device. Index *data* is never
+parquet — it lives in the TCB layout (layout.py).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from .columnar import ColumnarBatch
+
+
+def read_parquet(
+    paths: Iterable[str | Path], columns: Optional[List[str]] = None
+) -> ColumnarBatch:
+    """Read one or more parquet files into a single ColumnarBatch."""
+    import pyarrow.parquet as pq
+
+    paths = [str(p) for p in paths]
+    if not paths:
+        raise HyperspaceException("read_parquet: no paths.")
+    batches = []
+    for p in paths:
+        table = pq.read_table(p, columns=columns)
+        batches.append(ColumnarBatch.from_arrow(table))
+    return ColumnarBatch.concat(batches)
+
+
+def read_csv(paths: Iterable[str | Path], columns: Optional[List[str]] = None) -> ColumnarBatch:
+    import pyarrow.csv as pacsv
+
+    batches = []
+    for p in paths:
+        table = pacsv.read_csv(str(p))
+        if columns:
+            table = table.select(columns)
+        batches.append(ColumnarBatch.from_arrow(table))
+    if not batches:
+        raise HyperspaceException("read_csv: no paths.")
+    return ColumnarBatch.concat(batches)
+
+
+def read_json(paths: Iterable[str | Path], columns: Optional[List[str]] = None) -> ColumnarBatch:
+    import pyarrow.json as pajson
+
+    batches = []
+    for p in paths:
+        table = pajson.read_json(str(p))
+        if columns:
+            table = table.select(columns)
+        batches.append(ColumnarBatch.from_arrow(table))
+    if not batches:
+        raise HyperspaceException("read_json: no paths.")
+    return ColumnarBatch.concat(batches)
+
+
+def write_parquet(path: str | Path, batch: ColumnarBatch) -> None:
+    """Write a batch as parquet (test-data generation and oracles)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    arrays = {}
+    for name, col in batch.columns.items():
+        vals = col.to_values()
+        if col.dtype_str == "date32":
+            arrays[name] = pa.array(vals.astype("datetime64[D]"))
+        elif vals.dtype == object:
+            arrays[name] = pa.array([None if v is None else str(v) for v in vals])
+        else:
+            arrays[name] = pa.array(np.asarray(vals))
+    table = pa.table(arrays)
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    pq.write_table(table, str(path))
+
+
+READERS = {"parquet": read_parquet, "csv": read_csv, "json": read_json}
+
+
+def read_files(file_format: str, paths: Iterable[str | Path], columns=None) -> ColumnarBatch:
+    try:
+        reader = READERS[file_format]
+    except KeyError:
+        raise HyperspaceException(f"Unsupported source format: {file_format}")
+    return reader(paths, columns)

@@ -12,6 +12,7 @@ from typing import Optional
 
 from ..config import HyperspaceConf
 from ..exceptions import HyperspaceException
+from ..utils.cache_with_transform import CacheWithTransform
 from .events import HyperspaceEvent
 
 
@@ -48,16 +49,15 @@ def get_event_logger(conf: HyperspaceConf) -> EventLogger:
 
 class EventLogging:
     """Mixin giving actions a ``log_event`` (HyperspaceEventLogging.scala:30-40).
-    The logger instance is cached per conf object."""
+    The logger reloads whenever the configured class name changes, via
+    CacheWithTransform — the same conf-keyed invalidation the reference uses."""
 
-    _conf: Optional[HyperspaceConf] = None
-    _logger_cache: Optional[EventLogger] = None
-
-    def _event_logger(self, conf: HyperspaceConf) -> EventLogger:
-        if self._logger_cache is None or self._conf is not conf:
-            self._conf = conf
-            self._logger_cache = get_event_logger(conf)
-        return self._logger_cache
+    _logger_cache: Optional[CacheWithTransform] = None
 
     def log_event(self, conf: HyperspaceConf, event: HyperspaceEvent) -> None:
-        self._event_logger(conf).log_event(event)
+        if self._logger_cache is None:
+            self._logger_cache = CacheWithTransform(
+                lambda: conf.event_logger_class(),
+                lambda _key: get_event_logger(conf),
+            )
+        self._logger_cache.load().log_event(event)
