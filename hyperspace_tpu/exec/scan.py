@@ -1,0 +1,132 @@
+"""TpuIndexScan: the physical scan over TCB index data.
+
+This is the framework's ``TpuIndexScanExec`` from the north star
+(BASELINE.json) — the replacement for Spark's FileSourceScanExec over index
+parquet (RuleUtils.scala:286,400). Pipeline per file:
+
+  1. footer min/max zone-map pruning against the predicate's bounds
+     (storage.layout.prune_by_min_max) — files whose range can't match are
+     never opened;
+  2. mmap the surviving column buffers (no decode — TCB is raw columns);
+  3. predicate mask evaluated on device (plan.expr.eval_mask over
+     jax arrays in HBM);
+  4. row compaction.
+
+The scan reads only the columns the query needs (projection pushdown is a
+footer-offset seek, not a decode).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..ops.hashing import bucket_of_values
+from ..plan.expr import Expr, bounds_for_column, eval_mask, pinned_values
+from ..storage import layout
+from ..storage.columnar import ColumnarBatch
+
+
+def buckets_for_predicate(
+    predicate: Expr,
+    indexed_columns: List[str],
+    dtypes: dict,
+    num_buckets: int,
+    max_product: int = 64,
+):
+    """The set of buckets an equality predicate can touch, or None for all.
+
+    Valid only when the predicate pins *every* indexed column to a finite
+    value set (the hash covers all indexed columns). This is the analog of
+    Spark's bucket pruning over the index's BucketSpec."""
+    per_col = []
+    total = 1
+    for c in indexed_columns:
+        vals = pinned_values(predicate, c)
+        if vals is None:
+            return None
+        per_col.append(sorted(vals, key=repr))
+        total *= len(vals)
+        if total > max_product:
+            return None
+    import itertools
+
+    buckets = set()
+    for combo in itertools.product(*per_col):
+        buckets.add(
+            bucket_of_values(combo, [dtypes[c] for c in indexed_columns], num_buckets)
+        )
+    return buckets
+
+
+def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
+    """Evaluate the predicate on device with rows padded to the next power
+    of two. Index files all have distinct row counts; without shape
+    bucketing XLA recompiles the filter once per file, which dominates the
+    scan (observed 46s → <1s on a 32-file range scan). Padding costs <2×
+    rows of bandwidth and makes the compile cache hit after the first few
+    sizes."""
+    import jax.numpy as jnp
+
+    n = batch.num_rows
+    n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
+    names = sorted(predicate.columns())
+    arrays = {}
+    for name in names:
+        data = batch.columns[name].data
+        arrays[name] = jnp.asarray(np.pad(data, (0, n_pad - n)))
+    mask = np.asarray(eval_mask(predicate, batch, arrays))
+    return mask[:n]
+
+
+def index_scan(
+    data_files: Iterable[str | Path],
+    output_columns: List[str],
+    predicate: Optional[Expr] = None,
+    device: bool = True,
+    indexed_columns: Optional[List[str]] = None,
+    dtypes: Optional[dict] = None,
+    num_buckets: Optional[int] = None,
+) -> ColumnarBatch:
+    """Scan index data files, returning the filtered projection.
+
+    When ``indexed_columns``/``dtypes``/``num_buckets`` describe the
+    index's bucketing, equality predicates prune to their hash buckets
+    before any file is opened."""
+    files = [Path(p) for p in data_files]
+    if predicate is not None and indexed_columns and dtypes and num_buckets:
+        buckets = buckets_for_predicate(predicate, indexed_columns, dtypes, num_buckets)
+        if buckets is not None:
+            files = [f for f in files if layout.bucket_of_file(f) in buckets]
+    if predicate is not None:
+        # zone-map pruning on every column the predicate bounds
+        for c in sorted(predicate.columns()):
+            lo, hi = bounds_for_column(predicate, c)
+            if lo is not None or hi is not None:
+                files = layout.prune_by_min_max(files, c, lo, hi)
+    need = list(dict.fromkeys(list(output_columns) + sorted(predicate.columns()))) if predicate else list(output_columns)
+    parts: List[ColumnarBatch] = []
+    for f in files:
+        batch = layout.read_batch(f, columns=need)
+        if batch.num_rows == 0:
+            continue
+        if predicate is not None:
+            if device:
+                mask = _device_mask_padded(predicate, batch)
+            else:
+                mask = eval_mask(predicate, batch)
+            idx = np.flatnonzero(mask)
+            if idx.size == 0:
+                continue
+            batch = batch.take(idx)
+        parts.append(batch.select(output_columns))
+    if not parts:
+        # empty result with correct schema: read schema from any file
+        if not files:
+            raise HyperspaceException("index_scan over zero files with no schema.")
+        empty = layout.read_batch(files[0], columns=output_columns)
+        return empty.take(np.array([], dtype=np.int64))
+    return ColumnarBatch.concat(parts)

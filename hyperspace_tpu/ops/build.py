@@ -1,0 +1,265 @@
+"""The index-build kernels: hash-bucketize + shuffle + per-bucket sort.
+
+These are HOT LOOPS #1 and #2 of the reference's create path
+(SURVEY.md §3.1): Spark's ``repartition(numBuckets, indexedCols)`` shuffle
+(CreateActionBase.scala:129-130) and the per-bucket sort inside
+``saveWithBuckets`` (DataFrameWriterExtensions.scala:49-72), re-expressed
+as XLA programs:
+
+* single-device: one fused ``lax.sort`` by (bucket, key...) — the bucket id
+  is the leading sort key, so partitioning and per-bucket ordering happen
+  in a single O(n log n) device sort;
+* multi-device: ``shard_map`` over the bucket mesh axis — local bucketize,
+  scatter into fixed-capacity per-destination blocks, ``all_to_all`` over
+  ICI (replacing Spark's netty shuffle service), then the same local
+  (bucket, key...) sort. Bucket b lands on device ``b % n_devices``
+  (parallel.mesh.owner_of_bucket).
+
+Static shapes throughout: the exchange uses a host-computed per-(src,dst)
+capacity so XLA sees fixed block sizes; validity is a boolean mask, and
+invalid rows sort to the end via an out-of-range bucket key.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..storage.columnar import Column, ColumnarBatch, is_string
+from . import ensure_x64
+from .hashing import bucket_ids_host, fnv1a64, hash32_device, key_repr
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# device-side key representation (twin of hashing.key_repr)
+# ---------------------------------------------------------------------------
+def vocab_hashes(col: Column) -> Optional[np.ndarray]:
+    """Per-dictionary-entry FNV hashes for a string column (host, O(vocab));
+    gathered on device through the codes."""
+    if not is_string(col.dtype_str):
+        return None
+    return np.array([fnv1a64(v) for v in col.vocab], dtype=np.uint64).astype(np.int64)
+
+
+def key_repr_device(arr, dtype_str: str, vhash=None):
+    """int64 key representation on device (twin of hashing.key_repr)."""
+    if is_string(dtype_str):
+        if vhash is None:
+            raise HyperspaceException("String key column needs vocab hashes.")
+        safe = jnp.clip(arr, 0, max(int(vhash.shape[0]) - 1, 0))
+        gathered = vhash[safe] if int(vhash.shape[0]) else jnp.zeros_like(arr, jnp.int64)
+        return jnp.where(arr >= 0, gathered, jnp.int64(-1))
+    if dtype_str in ("float32", "float64"):
+        a = jnp.where(arr == 0.0, jnp.zeros_like(arr), arr)
+        bits = lax.bitcast_convert_type(
+            a, jnp.int32 if dtype_str == "float32" else jnp.int64
+        )
+        return bits.astype(jnp.int64)
+    return arr.astype(jnp.int64)
+
+
+def device_bucket_ids(
+    arrays: Dict[str, "jax.Array"],
+    dtypes: Dict[str, str],
+    key_names: List[str],
+    vhashes: Dict[str, "jax.Array"],
+    num_buckets: int,
+):
+    reprs = [
+        key_repr_device(arrays[k], dtypes[k], vhashes.get(k)) for k in key_names
+    ]
+    return (hash32_device(reprs) % jnp.uint32(num_buckets)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# single-device build kernel
+# ---------------------------------------------------------------------------
+def _sort_by_bucket_and_keys(
+    arrays: Dict[str, "jax.Array"],
+    bucket,
+    key_names: List[str],
+    num_buckets: int,
+):
+    """Fused partition+sort: one lax.sort keyed on (bucket, keys..., iota).
+    Returns (sorted arrays incl. bucket, per-bucket counts)."""
+    n = bucket.shape[0]
+    iota = lax.iota(jnp.int32, n)
+    operands = [bucket] + [arrays[k] for k in key_names] + [iota]
+    sorted_ops = lax.sort(operands, num_keys=1 + len(key_names))
+    perm = sorted_ops[-1]
+    out = {name: arr[perm] for name, arr in arrays.items()}
+    counts = jnp.bincount(bucket, length=num_buckets)
+    return out, sorted_ops[0], counts
+
+
+def build_partition_single(
+    batch: ColumnarBatch,
+    key_names: List[str],
+    num_buckets: int,
+) -> Tuple[ColumnarBatch, np.ndarray]:
+    """Single-device HOT LOOP: returns the batch reordered so rows are
+    grouped by bucket (ascending) and sorted by the key columns within each
+    bucket, plus per-bucket row counts."""
+    dtypes = batch.schema()
+    arrays = batch.device_arrays()
+    vh = {
+        k: jnp.asarray(vocab_hashes(batch.columns[k]))
+        for k in key_names
+        if is_string(dtypes[k])
+    }
+
+    @jax.jit
+    def kernel(arrays, vh):
+        bucket = device_bucket_ids(arrays, dtypes, key_names, vh, num_buckets)
+        return _sort_by_bucket_and_keys(arrays, bucket, key_names, num_buckets)
+
+    out_arrays, _sorted_bucket, counts = kernel(arrays, vh)
+    counts = np.asarray(counts)
+    cols = {
+        name: Column(dtypes[name], np.asarray(out_arrays[name]), batch.columns[name].vocab)
+        for name in batch.column_names
+    }
+    return ColumnarBatch(cols), counts
+
+
+# ---------------------------------------------------------------------------
+# multi-device build kernel (shard_map + all_to_all over ICI)
+# ---------------------------------------------------------------------------
+def build_partition_sharded(
+    batch: ColumnarBatch,
+    key_names: List[str],
+    num_buckets: int,
+    mesh: Mesh,
+) -> Tuple[List[Tuple[ColumnarBatch, np.ndarray]], np.ndarray]:
+    """Multi-device HOT LOOP.
+
+    Returns ``(per_device, global_counts)`` where ``per_device[d]`` is the
+    (batch, bucket_ids) of valid rows that landed on device d — grouped by
+    bucket and key-sorted — and ``global_counts[b]`` is the global row
+    count of bucket b. Device d owns buckets ``{b : b % D == d}``.
+    """
+    axis = mesh.axis_names[0]
+    D = mesh.devices.size
+    n = batch.num_rows
+    dtypes = batch.schema()
+
+    # Host-side twin hash for capacity planning (static shapes for XLA).
+    host_bucket = bucket_ids_host(
+        [key_repr(batch.columns[k]) for k in key_names], num_buckets
+    )
+    host_dest = host_bucket % D
+
+    n_pad = max(((n + D - 1) // D) * D, D)
+    shard_rows = n_pad // D
+    # max rows any one src shard sends to any one dst device
+    cap = 1
+    for s in range(D):
+        seg = host_dest[s * shard_rows : min((s + 1) * shard_rows, n)]
+        if seg.size:
+            cap = max(cap, int(np.bincount(seg, minlength=D).max()))
+    cap = ((cap + 7) // 8) * 8  # modest alignment to stabilize compile shapes
+
+    def pad(a: np.ndarray) -> np.ndarray:
+        return np.pad(a, (0, n_pad - n))
+
+    valid_np = pad(np.ones(n, dtype=bool))
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+    dev_arrays = {
+        name: jax.device_put(pad(batch.columns[name].data), sharding)
+        for name in batch.column_names
+    }
+    valid = jax.device_put(valid_np, sharding)
+    vh = {
+        k: jax.device_put(
+            vocab_hashes(batch.columns[k]), NamedSharding(mesh, PartitionSpec())
+        )
+        for k in key_names
+        if is_string(dtypes[k])
+    }
+
+    def shard_fn(arrays, valid, vh):
+        # local shapes: (shard_rows,)
+        bucket = device_bucket_ids(arrays, dtypes, key_names, vh, num_buckets)
+        dest = jnp.where(valid, bucket % D, D)  # invalid rows -> out of range
+        m = dest.shape[0]
+        iota = lax.iota(jnp.int32, m)
+        sorted_dest, perm = lax.sort([dest, iota], num_keys=1)
+        counts = jnp.bincount(dest, length=D)
+        starts = jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)])[:D + 1]
+        pos = iota - starts[jnp.clip(sorted_dest, 0, D)].astype(jnp.int32)
+
+        def exchange(x):
+            buf = jnp.zeros((D, cap) + x.shape[1:], x.dtype)
+            buf = buf.at[sorted_dest, pos].set(x[perm], mode="drop")
+            return lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=False)
+
+        vmask = jnp.zeros((D, cap), jnp.bool_)
+        vmask = vmask.at[sorted_dest, pos].set(valid[perm], mode="drop")
+        vmask = lax.all_to_all(vmask, axis, split_axis=0, concat_axis=0, tiled=False)
+
+        recv = {name: exchange(x).reshape((D * cap,) + x.shape[1:]) for name, x in arrays.items()}
+        recv_bucket = exchange(bucket).reshape(D * cap)
+        vflat = vmask.reshape(D * cap)
+
+        masked_bucket = jnp.where(vflat, recv_bucket, num_buckets)
+        out, sorted_bucket, _ = _sort_by_bucket_and_keys(
+            recv, masked_bucket, key_names, num_buckets
+        )
+        local_counts = jnp.bincount(masked_bucket, length=num_buckets)
+        n_valid = vflat.sum().astype(jnp.int32)[None]  # rank-1 for out_specs
+        return out, sorted_bucket, local_counts, n_valid
+
+    from jax import shard_map
+
+    in_specs = (
+        {name: PartitionSpec(axis) for name in dev_arrays},
+        PartitionSpec(axis),
+        {k: PartitionSpec() for k in vh},
+    )
+    out_specs = (
+        {name: PartitionSpec(axis) for name in dev_arrays},
+        PartitionSpec(axis),
+        PartitionSpec(axis),
+        PartitionSpec(axis),
+    )
+    fn = jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+    out_arrays, out_bucket, counts_all, n_valid_all = fn(dev_arrays, valid, vh)
+
+    counts_all = np.asarray(counts_all).reshape(D, num_buckets)
+    n_valid_all = np.asarray(n_valid_all).reshape(D)
+    per_device: List[Tuple[ColumnarBatch, np.ndarray]] = []
+    rows_per_dev = D * cap
+    host_arrays = {name: np.asarray(a) for name, a in out_arrays.items()}
+    host_bucket_out = np.asarray(out_bucket)
+    for d in range(D):
+        nv = int(n_valid_all[d])
+        sl = slice(d * rows_per_dev, d * rows_per_dev + nv)
+        cols = {
+            name: Column(dtypes[name], host_arrays[name][sl], batch.columns[name].vocab)
+            for name in batch.column_names
+        }
+        per_device.append((ColumnarBatch(cols), host_bucket_out[sl]))
+    global_counts = counts_all.sum(axis=0)
+    # Sanity: every input row landed exactly once.
+    if int(global_counts.sum()) != n:
+        raise HyperspaceException(
+            f"Shuffle lost rows: {int(global_counts.sum())} != {n}."
+        )
+    return per_device, global_counts

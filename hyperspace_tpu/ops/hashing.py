@@ -1,0 +1,161 @@
+"""Canonical row hashing for bucket assignment — host/device parity.
+
+This replaces Spark's ``HashPartitioning`` (the engine machinery behind
+``df.repartition(numBuckets, indexedCols)``, CreateActionBase.scala:129-130).
+The contract: the bucket of a row depends only on the *values* of its
+indexed columns, is stable across processes/batches/devices, and is
+computable identically in numpy (host) and jax.numpy (device). Build-time
+and query-time shuffles must agree or bucketed joins silently break.
+
+Scheme:
+* every indexed column is first reduced to an int64 **key representation**:
+  - integers/dates: the value itself;
+  - floats: IEEE bit pattern (bitcast) with -0.0 normalized to +0.0;
+  - bools: 0/1;
+  - strings: FNV-1a 64-bit hash of the UTF-8 bytes, computed once per
+    dictionary entry and gathered through the codes (so hashing n rows
+    costs O(vocab) byte work + one gather — dictionary encoding makes the
+    string path as cheap as the numeric one);
+* the int64 reprs are mixed into one uint32 via murmur3 finalizers over
+  the two 32-bit halves, folding columns left-to-right;
+* bucket = mix mod num_buckets.
+
+All arithmetic is uint32 (wrapping), so the device path needs no 64-bit
+math beyond the initial split — TPU-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..storage.columnar import Column, is_string
+
+FNV_OFFSET = np.uint64(0xCBF29CE484222325)
+FNV_PRIME = np.uint64(0x100000001B3)
+SEED = np.uint32(0x9E3779B9)
+
+
+def fnv1a64(data: bytes) -> np.uint64:
+    """Stable 64-bit FNV-1a over bytes (vocab entries are short; this runs
+    once per dictionary entry, not per row)."""
+    h = FNV_OFFSET
+    for b in data:
+        h = np.uint64((int(h) ^ b) * int(FNV_PRIME) & 0xFFFFFFFFFFFFFFFF)
+    return h
+
+
+def key_repr(col: Column) -> np.ndarray:
+    """Reduce a column to its int64 key representation (host side)."""
+    if is_string(col.dtype_str):
+        vocab_hash = np.array(
+            [fnv1a64(v) for v in col.vocab], dtype=np.uint64
+        ).astype(np.int64)
+        out = np.full(len(col.data), -1, dtype=np.int64)  # NULL repr
+        valid = col.data >= 0
+        if vocab_hash.size:
+            out[valid] = vocab_hash[col.data[valid]]
+        return out
+    d = col.data
+    if d.dtype.kind == "f":
+        d = np.where(d == 0.0, 0.0, d)  # -0.0 -> +0.0
+        if d.dtype == np.float32:
+            return d.view(np.int32).astype(np.int64)
+        return d.view(np.int64)
+    if d.dtype == np.bool_:
+        return d.astype(np.int64)
+    if d.dtype.kind in ("i", "u"):
+        return d.astype(np.int64)
+    raise HyperspaceException(f"Cannot hash dtype {d.dtype}.")
+
+
+# -- murmur3 fmix32, expressed once for numpy and once for jax ---------------
+def scalar_key_repr(value, dtype_str: str) -> np.int64:
+    """Key representation of a single literal, matching key_repr on a
+    column holding that value (used to compute the bucket of a lookup key
+    without materializing a column)."""
+    if dtype_str == "string":
+        v = value.encode() if isinstance(value, str) else bytes(value)
+        return np.uint64(fnv1a64(v)).astype(np.int64)
+    if dtype_str == "float32":
+        f = np.float32(0.0 if value == 0.0 else value)
+        return np.int64(f.view(np.int32))
+    if dtype_str == "float64":
+        f = np.float64(0.0 if value == 0.0 else value)
+        return np.int64(f.view(np.int64))
+    if dtype_str == "bool":
+        return np.int64(bool(value))
+    return np.int64(value)
+
+
+def bucket_of_values(values, dtype_strs, num_buckets: int) -> int:
+    """Bucket id of one row of indexed-column literals."""
+    reprs = [
+        np.array([scalar_key_repr(v, dt)], dtype=np.int64)
+        for v, dt in zip(values, dtype_strs)
+    ]
+    return int(bucket_ids_host(reprs, num_buckets)[0])
+
+
+def _fmix32_np(h: np.ndarray) -> np.ndarray:
+    h = h.astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    h ^= h >> np.uint32(13)
+    h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    return h
+
+
+def hash32_host(key_reprs: Sequence[np.ndarray]) -> np.ndarray:
+    """Combine int64 key reprs into one uint32 per row (numpy)."""
+    if not key_reprs:
+        raise HyperspaceException("hash32 of zero columns.")
+    n = len(key_reprs[0])
+    h = np.full(n, SEED, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        for kr in key_reprs:
+            u = kr.view(np.uint64) if kr.dtype == np.int64 else kr.astype(np.uint64)
+            lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            hi = (u >> np.uint64(32)).astype(np.uint32)
+            h = _fmix32_np(h ^ _fmix32_np(lo ^ _fmix32_np(hi)))
+    return h
+
+
+def bucket_ids_host(key_reprs: Sequence[np.ndarray], num_buckets: int) -> np.ndarray:
+    return (hash32_host(key_reprs) % np.uint32(num_buckets)).astype(np.int32)
+
+
+def _fmix32_jnp(h):
+    import jax.numpy as jnp
+
+    h = h.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash32_device(key_reprs: List):
+    """Device twin of hash32_host: same mixing over jnp uint32 lanes.
+    Inputs are int64 jax arrays (the key reprs, pre-computed or gathered
+    on device)."""
+    import jax.numpy as jnp
+
+    h = jnp.full(key_reprs[0].shape, SEED, dtype=jnp.uint32)
+    for kr in key_reprs:
+        u = kr.astype(jnp.uint64) if kr.dtype != jnp.uint64 else kr
+        lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (u >> 32).astype(jnp.uint32)
+        h = _fmix32_jnp(h ^ _fmix32_jnp(lo ^ _fmix32_jnp(hi)))
+    return h
+
+
+def bucket_ids_device(key_reprs: List, num_buckets: int):
+    import jax.numpy as jnp
+
+    return (hash32_device(key_reprs) % jnp.uint32(num_buckets)).astype(jnp.int32)

@@ -1,0 +1,53 @@
+"""Device-mesh construction and sharding helpers.
+
+This is the framework's replacement for Spark's executor pool (SURVEY.md
+§2.2): a 1-D ``jax.sharding.Mesh`` whose single axis carries *bucket
+parallelism* — bucket b of an index lives on device ``b % n_devices``, so
+bucketed operations (per-bucket sort, bucketed sort-merge join,
+BucketUnion) are device-local and the only collective is the hash-
+repartition all_to_all that rides ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import constants as C
+from ..ops import ensure_x64
+
+ensure_x64()
+
+import jax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: E402
+
+BUCKET_AXIS = C.TPU_MESH_BUCKET_AXIS_DEFAULT
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = BUCKET_AXIS) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` local devices (all by
+    default)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"Requested {n_devices} devices but only {len(devices)} present."
+            )
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devices), (axis,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard axis 0 (rows) across the bucket axis."""
+    return NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def owner_of_bucket(bucket: int, n_devices: int) -> int:
+    """The bucket→device placement rule. Build and query must agree (the
+    analog of the reference's BucketSpec-driven task placement)."""
+    return bucket % n_devices

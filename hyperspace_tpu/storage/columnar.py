@@ -241,6 +241,16 @@ class ColumnarBatch:
                 np_arr = np.array([float(v) for v in arr.to_pylist()], dtype=np.float64)
                 cols[name] = Column("float64", np_arr)
             else:
+                if arr.null_count > 0 and (
+                    pa.types.is_integer(t) or pa.types.is_boolean(t)
+                ):
+                    # pyarrow would silently widen to float64 (NaN for null),
+                    # rounding keys above 2^53 — refuse rather than corrupt.
+                    raise HyperspaceException(
+                        f"Column {name!r} has {arr.null_count} null(s) in "
+                        f"integer/boolean type {t}; numeric NULLs are not "
+                        "supported in indexed data."
+                    )
                 np_arr = arr.to_numpy(zero_copy_only=False)
                 if np_arr.dtype == np.dtype("datetime64[ns]"):
                     np_arr = np_arr.astype("datetime64[D]").astype(np.int32)

@@ -49,7 +49,10 @@ def bucket_of_file(path: str | Path) -> int:
     name = Path(path).name
     if not (name.startswith("b") and name.endswith(".tcb")):
         raise HyperspaceException(f"Not an index data file: {name}")
-    return int(name[1:].split("-", 1)[0])
+    try:
+        return int(name[1:].split("-", 1)[0])
+    except ValueError:
+        raise HyperspaceException(f"Not an index data file: {name}")
 
 
 def write_batch(
@@ -113,8 +116,13 @@ def read_footer(path: str | Path) -> Dict[str, Any]:
         if trailer[8:] != MAGIC:
             raise HyperspaceException(f"Bad magic in {path}; not a TCB file.")
         flen = int.from_bytes(trailer[:8], "little")
+        if flen <= 0 or flen > size - 12:
+            raise HyperspaceException(f"Corrupt TCB footer length in {path}.")
         f.seek(size - 12 - flen)
-        return json.loads(f.read(flen))
+        try:
+            return json.loads(f.read(flen))
+        except json.JSONDecodeError as e:
+            raise HyperspaceException(f"Corrupt TCB footer in {path}: {e}")
 
 
 def read_batch(

@@ -17,48 +17,44 @@ from ..exceptions import HyperspaceException
 from .columnar import ColumnarBatch
 
 
+def _read_with(
+    table_reader, fmt: str, paths: Iterable[str | Path], columns: Optional[List[str]]
+) -> ColumnarBatch:
+    """Shared multi-file read: per-file table read, uniform projection
+    semantics (``columns=None`` means all; an explicit list — including
+    ``[]`` — selects exactly those), concat at the end."""
+    paths = [str(p) for p in paths]
+    if not paths:
+        raise HyperspaceException(f"read_{fmt}: no paths.")
+    batches = []
+    for p in paths:
+        table = table_reader(p)
+        if columns is not None:
+            table = table.select(columns)
+        batches.append(ColumnarBatch.from_arrow(table))
+    return ColumnarBatch.concat(batches)
+
+
 def read_parquet(
     paths: Iterable[str | Path], columns: Optional[List[str]] = None
 ) -> ColumnarBatch:
     """Read one or more parquet files into a single ColumnarBatch."""
     import pyarrow.parquet as pq
 
-    paths = [str(p) for p in paths]
-    if not paths:
-        raise HyperspaceException("read_parquet: no paths.")
-    batches = []
-    for p in paths:
-        table = pq.read_table(p, columns=columns)
-        batches.append(ColumnarBatch.from_arrow(table))
-    return ColumnarBatch.concat(batches)
+    # column pushdown at the parquet reader; projection re-applied uniformly
+    return _read_with(lambda p: pq.read_table(p, columns=columns), "parquet", paths, columns)
 
 
 def read_csv(paths: Iterable[str | Path], columns: Optional[List[str]] = None) -> ColumnarBatch:
     import pyarrow.csv as pacsv
 
-    batches = []
-    for p in paths:
-        table = pacsv.read_csv(str(p))
-        if columns:
-            table = table.select(columns)
-        batches.append(ColumnarBatch.from_arrow(table))
-    if not batches:
-        raise HyperspaceException("read_csv: no paths.")
-    return ColumnarBatch.concat(batches)
+    return _read_with(lambda p: pacsv.read_csv(p), "csv", paths, columns)
 
 
 def read_json(paths: Iterable[str | Path], columns: Optional[List[str]] = None) -> ColumnarBatch:
     import pyarrow.json as pajson
 
-    batches = []
-    for p in paths:
-        table = pajson.read_json(str(p))
-        if columns:
-            table = table.select(columns)
-        batches.append(ColumnarBatch.from_arrow(table))
-    if not batches:
-        raise HyperspaceException("read_json: no paths.")
-    return ColumnarBatch.concat(batches)
+    return _read_with(lambda p: pajson.read_json(p), "json", paths, columns)
 
 
 def write_parquet(path: str | Path, batch: ColumnarBatch) -> None:

@@ -53,11 +53,15 @@ class EventLogging:
     CacheWithTransform — the same conf-keyed invalidation the reference uses."""
 
     _logger_cache: Optional[CacheWithTransform] = None
+    _current_conf: Optional[HyperspaceConf] = None
 
     def log_event(self, conf: HyperspaceConf, event: HyperspaceEvent) -> None:
+        # The cache's key_fn reads the *latest* conf through self, so both a
+        # changed conf object and a changed class value invalidate correctly.
+        self._current_conf = conf
         if self._logger_cache is None:
             self._logger_cache = CacheWithTransform(
-                lambda: conf.event_logger_class(),
-                lambda _key: get_event_logger(conf),
+                lambda: self._current_conf.event_logger_class(),
+                lambda _key: get_event_logger(self._current_conf),
             )
         self._logger_cache.load().log_event(event)

@@ -1,0 +1,198 @@
+"""Index-build kernel tests: hash parity, partition+sort correctness vs a
+pandas oracle, multi-device == single-device, end-to-end build+scan row
+parity (the off/on oracle pattern of E2EHyperspaceRulesTest.scala:1004-1019).
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.exec.scan import index_scan
+from hyperspace_tpu.index.builder import resolve_index_columns, write_index_data
+from hyperspace_tpu.ops import hashing
+from hyperspace_tpu.ops.build import build_partition_single, build_partition_sharded
+from hyperspace_tpu.parallel.mesh import make_mesh, owner_of_bucket
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.storage import layout
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.exceptions import HyperspaceException
+
+
+def sample(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "orderkey": rng.integers(0, 10**12, n).astype(np.int64),
+            "qty": rng.integers(0, 50, n).astype(np.int32),
+            "price": rng.random(n).astype(np.float32),
+            "flag": rng.choice([b"A", b"N", b"R"], n).astype(object),
+        },
+        schema={"orderkey": "int64", "qty": "int32", "price": "float32", "flag": "string"},
+    )
+
+
+def test_hash_host_device_parity():
+    b = sample(500)
+    for cols in (["orderkey"], ["orderkey", "flag"], ["flag"], ["price", "qty"]):
+        host = hashing.bucket_ids_host([hashing.key_repr(b.columns[c]) for c in cols], 64)
+        from hyperspace_tpu.ops.build import device_bucket_ids, vocab_hashes
+        import jax.numpy as jnp
+
+        arrays = b.device_arrays(cols)
+        vh = {
+            c: jnp.asarray(vocab_hashes(b.columns[c]))
+            for c in cols
+            if b.columns[c].dtype_str == "string"
+        }
+        dev = device_bucket_ids(arrays, b.schema(), cols, vh, 64)
+        np.testing.assert_array_equal(host, np.asarray(dev))
+
+
+def test_hash_is_value_stable_across_batches():
+    # Same values in different batches (different vocab layouts) must land in
+    # the same bucket — this is what makes bucketed joins and hybrid-scan
+    # shuffles line up.
+    b1 = ColumnarBatch.from_pydict({"s": np.array(["x", "a", "q"], dtype=object)}, {"s": "string"})
+    b2 = ColumnarBatch.from_pydict({"s": np.array(["q", "zz", "x"], dtype=object)}, {"s": "string"})
+    h1 = hashing.bucket_ids_host([hashing.key_repr(b1.columns["s"])], 32)
+    h2 = hashing.bucket_ids_host([hashing.key_repr(b2.columns["s"])], 32)
+    assert h1[0] == h2[2]  # "x"
+    assert h1[2] == h2[0]  # "q"
+
+
+def test_single_device_partition_sort():
+    b = sample(2000)
+    nb = 16
+    out, counts = build_partition_single(b, ["orderkey"], nb)
+    assert counts.sum() == 2000
+    host_bucket = hashing.bucket_ids_host([hashing.key_repr(b.columns["orderkey"])], nb)
+    # bucket sizes match host hash
+    np.testing.assert_array_equal(counts, np.bincount(host_bucket, minlength=nb))
+    # within each bucket, orderkey ascending; bucket ids grouped ascending
+    out_bucket = hashing.bucket_ids_host([hashing.key_repr(out.columns["orderkey"])], nb)
+    assert (np.diff(out_bucket) >= 0).all()
+    offsets = np.concatenate([[0], np.cumsum(counts)]).astype(int)
+    keys = out.columns["orderkey"].data
+    for bkt in range(nb):
+        seg = keys[offsets[bkt] : offsets[bkt + 1]]
+        assert (np.diff(seg) >= 0).all()
+    # row multiset preserved
+    assert sorted(keys.tolist()) == sorted(b.columns["orderkey"].data.tolist())
+
+
+def test_sharded_build_matches_single(tmp_path):
+    b = sample(777)  # deliberately not divisible by 8
+    nb = 12
+    mesh = make_mesh(8)
+    per_device, global_counts = build_partition_sharded(b, ["orderkey"], nb, mesh)
+    host_bucket = hashing.bucket_ids_host([hashing.key_repr(b.columns["orderkey"])], nb)
+    np.testing.assert_array_equal(global_counts, np.bincount(host_bucket, minlength=nb))
+    # each device holds exactly its owned buckets, grouped and sorted
+    all_keys = []
+    for d, (dev_batch, bucket_ids) in enumerate(per_device):
+        if dev_batch.num_rows == 0:
+            continue
+        assert set(np.unique(bucket_ids) % 8) == {d}
+        assert all(owner_of_bucket(int(x), 8) == d for x in np.unique(bucket_ids))
+        assert (np.diff(bucket_ids) >= 0).all()
+        for bkt in np.unique(bucket_ids):
+            seg = dev_batch.columns["orderkey"].data[bucket_ids == bkt]
+            assert (np.diff(seg) >= 0).all()
+        all_keys.extend(dev_batch.columns["orderkey"].data.tolist())
+    assert sorted(all_keys) == sorted(b.columns["orderkey"].data.tolist())
+
+
+def test_write_index_data_and_scan_row_parity(tmp_path):
+    b = sample(1500, seed=3)
+    nb = 8
+    files = write_index_data(b, ["orderkey"], nb, tmp_path / "v__=0")
+    assert files
+    for f in files:
+        footer = layout.read_footer(f)
+        assert footer["sortedBy"] == ["orderkey"]
+        assert footer["bucket"] == layout.bucket_of_file(f)
+    # off/on oracle: filter through the index == filter via pandas
+    df = b.to_pandas()
+    key = int(df["orderkey"].iloc[42])
+    expected = df[df["orderkey"] == key].sort_values(["orderkey", "qty"]).reset_index(drop=True)
+    got = index_scan(files, ["orderkey", "qty", "flag"], col("orderkey") == key)
+    got_df = got.to_pandas().sort_values(["orderkey", "qty"]).reset_index(drop=True)
+    assert len(got_df) == len(expected)
+    assert got_df["orderkey"].tolist() == expected["orderkey"].tolist()
+    assert got_df["qty"].tolist() == expected["qty"].tolist()
+    assert got_df["flag"].tolist() == expected["flag"].tolist()
+    # range query parity
+    lo, hi = np.percentile(df["orderkey"], [30, 60]).astype(np.int64)
+    expected = df[(df["orderkey"] > lo) & (df["orderkey"] <= hi)]
+    got = index_scan(files, ["orderkey"], (col("orderkey") > int(lo)) & (col("orderkey") <= int(hi)))
+    assert sorted(got.columns["orderkey"].data.tolist()) == sorted(expected["orderkey"].tolist())
+
+
+def test_scan_bucket_pruning(tmp_path):
+    from hyperspace_tpu.exec.scan import buckets_for_predicate
+    from hyperspace_tpu.plan.expr import is_in
+
+    b = ColumnarBatch.from_pydict({"k": np.arange(1000, dtype=np.int64)})
+    files = write_index_data(b, ["k"], 10, tmp_path / "v__=0")
+    dtypes = {"k": "int64"}
+    # equality predicate pins the hash bucket: exactly one bucket read
+    bkts = buckets_for_predicate(col("k") == 500, ["k"], dtypes, 10)
+    assert len(bkts) == 1
+    got = index_scan(
+        files, ["k"], col("k") == 500,
+        indexed_columns=["k"], dtypes=dtypes, num_buckets=10,
+    )
+    assert got.columns["k"].data.tolist() == [500]
+    # IN-list prunes to its buckets; range predicates don't pin
+    assert buckets_for_predicate(is_in(col("k"), [1, 2, 3]), ["k"], dtypes, 10)
+    assert buckets_for_predicate(col("k") > 5, ["k"], dtypes, 10) is None
+    # parity with an unpruned scan
+    got2 = index_scan(files, ["k"], col("k") == 500)
+    assert got2.columns["k"].data.tolist() == [500]
+
+
+def test_string_predicates_through_index(tmp_path):
+    b = sample(800, seed=5)
+    files = write_index_data(b, ["flag"], 4, tmp_path / "v__=0")
+    df = b.to_pandas()
+    got = index_scan(files, ["orderkey", "flag"], col("flag") == "N")
+    expected = df[df["flag"] == "N"]
+    assert sorted(got.columns["orderkey"].data.tolist()) == sorted(
+        expected["orderkey"].tolist()
+    )
+    got = index_scan(files, ["flag"], col("flag") > "A")
+    expected = df[df["flag"] > "A"]
+    assert len(got.columns["flag"].data) == len(expected)
+
+
+def test_resolve_index_columns():
+    assert resolve_index_columns(["Query", "qty"], ["query"], ["QTY"]) == (
+        ["Query"],
+        ["qty"],
+    )
+    with pytest.raises(HyperspaceException):
+        resolve_index_columns(["a"], ["zzz"], [])
+
+
+def test_sharded_mesh_single_device_path(tmp_path):
+    # mesh of 1 device falls back to the single kernel inside write_index_data
+    b = sample(100)
+    mesh = make_mesh(1)
+    files = write_index_data(b, ["orderkey"], 4, tmp_path / "v", mesh=mesh)
+    total = sum(layout.read_footer(f)["numRows"] for f in files)
+    assert total == 100
+
+
+def test_sharded_write_index_data(tmp_path):
+    b = sample(500, seed=9)
+    mesh = make_mesh(8)
+    files = write_index_data(b, ["orderkey"], 16, tmp_path / "v", mesh=mesh)
+    single = write_index_data(b, ["orderkey"], 16, tmp_path / "v1")
+    # same buckets, same per-bucket contents
+    def contents(fs):
+        out = {}
+        for f in fs:
+            fb = layout.read_batch(f)
+            out.setdefault(layout.bucket_of_file(f), []).append(fb.columns["orderkey"].data)
+        return {k: np.sort(np.concatenate(v)).tolist() for k, v in out.items()}
+
+    assert contents(files) == contents(single)
