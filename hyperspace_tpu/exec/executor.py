@@ -1,0 +1,218 @@
+"""The plan executor: interprets a logical plan into columnar execution.
+
+This layer replaces Spark's physical planning + task execution at the
+altitude this framework needs (SURVEY.md §2.2 "process boundaries"): plans
+are small, data is columnar, kernels run under jit. Physical strategies:
+
+* ``Filter(IndexScan)`` fuses into one TpuIndexScan call — predicate
+  pushdown with hash-bucket pruning + zone maps + device mask eval
+  (exec.scan.index_scan);
+* ``Join(IndexScan, IndexScan)`` with matching bucket specs executes as the
+  shuffle-free per-bucket sort-merge join (exec.joins.bucketed_join_pairs)
+  — the BucketUnionStrategy/SMJ analog;
+* everything else evaluates bottom-up over ColumnarBatches.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import HyperspaceConf
+from ..exceptions import HyperspaceException
+from ..plan.expr import Expr, eval_mask
+from ..plan.ir import (
+    BucketUnion,
+    Filter,
+    IndexScan,
+    Join,
+    LogicalPlan,
+    Project,
+    Repartition,
+    Scan,
+    Union,
+)
+from ..plan.rules.join_rule import align_condition_sides, extract_equi_condition
+from ..storage import layout, parquet_io
+from ..storage.columnar import ColumnarBatch
+from .joins import bucketed_join_pairs, inner_join
+from .scan import index_scan
+
+
+class Executor:
+    def __init__(self, conf: Optional[HyperspaceConf] = None, device: bool = True):
+        self.conf = conf or HyperspaceConf()
+        self.device = device
+
+    # -- public --------------------------------------------------------------
+    def execute(self, plan: LogicalPlan) -> ColumnarBatch:
+        return self._exec(plan, predicate=None)
+
+    # -- dispatch ------------------------------------------------------------
+    def _exec(self, plan: LogicalPlan, predicate: Optional[Expr]) -> ColumnarBatch:
+        if isinstance(plan, Filter):
+            # push the predicate into the child scan where profitable
+            child = plan.child
+            if isinstance(child, (IndexScan, Scan)):
+                return self._exec(child, predicate=self._conjoin(predicate, plan.condition))
+            batch = self._exec(child, None)
+            return self._apply_predicate(batch, self._conjoin(predicate, plan.condition))
+        if isinstance(plan, Project):
+            batch = self._exec(plan.child, predicate)
+            return batch.select(list(plan.columns))
+        if isinstance(plan, Scan):
+            batch = parquet_io.read_files(
+                plan.relation.file_format,
+                [f.name for f in plan.relation.files],
+            )
+            return self._apply_predicate(batch, predicate)
+        if isinstance(plan, IndexScan):
+            return self._exec_index_scan(plan, predicate)
+        if isinstance(plan, Join):
+            if predicate is not None:
+                batch = self._exec_join(plan)
+                return self._apply_predicate(batch, predicate)
+            return self._exec_join(plan)
+        if isinstance(plan, Union):
+            parts = [self._exec(c, predicate) for c in plan.children]
+            return ColumnarBatch.concat(parts)
+        if isinstance(plan, (BucketUnion, Repartition)):
+            # executed via the bucket-aware path below; standalone execution
+            # falls back to plain row semantics
+            if isinstance(plan, Repartition):
+                return self._exec(plan.child, predicate)
+            parts = [self._exec(c, predicate) for c in plan.children]
+            return ColumnarBatch.concat(parts)
+        raise HyperspaceException(f"Cannot execute node {plan.node_name}.")
+
+    @staticmethod
+    def _conjoin(a: Optional[Expr], b: Expr) -> Expr:
+        return b if a is None else (a & b)
+
+    def _apply_predicate(
+        self, batch: ColumnarBatch, predicate: Optional[Expr]
+    ) -> ColumnarBatch:
+        if predicate is None or batch.num_rows == 0:
+            return batch
+        mask = np.asarray(eval_mask(predicate, batch))
+        return batch.take(np.flatnonzero(mask))
+
+    # -- scans ---------------------------------------------------------------
+    def _index_files(self, node: IndexScan) -> List[str]:
+        return node.entry.content.files()
+
+    def _exec_index_scan(
+        self, node: IndexScan, predicate: Optional[Expr]
+    ) -> ColumnarBatch:
+        entry = node.entry
+        return index_scan(
+            self._index_files(node),
+            list(node.required_columns),
+            predicate,
+            device=self.device,
+            indexed_columns=entry.indexed_columns,
+            dtypes=entry.schema,
+            num_buckets=entry.num_buckets,
+        )
+
+    # -- joins ---------------------------------------------------------------
+    def _exec_join(self, join: Join) -> ColumnarBatch:
+        pairs = extract_equi_condition(join.condition)
+        if pairs is None:
+            raise HyperspaceException("Only equi-joins are executable.")
+        oriented = align_condition_sides(
+            pairs, join.left.output_columns(), join.right.output_columns()
+        )
+        if oriented is None:
+            raise HyperspaceException("Join condition references unknown columns.")
+        l_keys = [l for l, _ in oriented]
+        r_keys = [r for _, r in oriented]
+
+        bucketed = self._try_bucketed_join(join, l_keys, r_keys)
+        if bucketed is not None:
+            return bucketed
+        left = self._exec(join.left, None)
+        right = self._exec(join.right, None)
+        return inner_join(left, right, l_keys, r_keys)
+
+    def _scan_side_by_bucket(
+        self, plan: LogicalPlan
+    ) -> Optional[Tuple[Dict[int, ColumnarBatch], "IndexScan", Optional[Expr], Optional[Project]]]:
+        """Recognize [Project?][Filter?]IndexScan(use_bucket_spec) and load
+        its data grouped by bucket id."""
+        project: Optional[Project] = None
+        predicate: Optional[Expr] = None
+        node = plan
+        if isinstance(node, Project):
+            project, node = node, node.child
+        if isinstance(node, Filter):
+            predicate, node = node.condition, node.child
+        if not (isinstance(node, IndexScan) and node.use_bucket_spec):
+            return None
+        by_bucket: Dict[int, ColumnarBatch] = {}
+        for f in self._index_files(node):
+            b = layout.bucket_of_file(f)
+            batch = layout.read_batch(f, columns=list(node.required_columns))
+            if predicate is not None:
+                batch = self._apply_predicate(batch, predicate)
+            if batch.num_rows == 0:
+                continue
+            if b in by_bucket:
+                by_bucket[b] = ColumnarBatch.concat([by_bucket[b], batch])
+            else:
+                by_bucket[b] = batch
+        return by_bucket, node, predicate, project
+
+    def _try_bucketed_join(
+        self, join: Join, l_keys: List[str], r_keys: List[str]
+    ) -> Optional[ColumnarBatch]:
+        """The shuffle-free bucketed SMJ: both sides are bucket-spec index
+        scans with the same numBuckets, and the join keys are exactly the
+        indexed (bucketing) columns — so equal keys share a bucket id on
+        both sides (the hash is value-stable, ops.hashing)."""
+        left = self._scan_side_by_bucket(join.left)
+        right = self._scan_side_by_bucket(join.right)
+        if left is None or right is None:
+            return None
+        l_by_bucket, l_node, _, l_project = left
+        r_by_bucket, r_node, _, r_project = right
+        if l_node.entry.num_buckets != r_node.entry.num_buckets:
+            return None
+        # Keys must equal the bucketing (indexed) columns as a set; the merge
+        # itself runs in *index order* so both sides hash and compare the
+        # same tuple order (compatible_pairs guarantees the right index's
+        # order aligns under the l↔r mapping).
+        if {c.lower() for c in l_node.entry.indexed_columns} != {
+            k.lower() for k in l_keys
+        } or {c.lower() for c in r_node.entry.indexed_columns} != {
+            k.lower() for k in r_keys
+        }:
+            return None
+        l2r = {l.lower(): r for l, r in zip(l_keys, r_keys)}
+        l_keys = list(l_node.entry.indexed_columns)
+        r_keys = [l2r[k.lower()] for k in l_keys]
+        if l_project is not None:
+            l_by_bucket = {
+                b: v.select(list(l_project.columns)) for b, v in l_by_bucket.items()
+            }
+        if r_project is not None:
+            r_by_bucket = {
+                b: v.select(list(r_project.columns)) for b, v in r_by_bucket.items()
+            }
+        parts = bucketed_join_pairs(l_by_bucket, r_by_bucket, l_keys, r_keys)
+        if not parts:
+            # empty join result with the combined schema
+            l_any = next(iter(l_by_bucket.values()), None)
+            r_any = next(iter(r_by_bucket.values()), None)
+            if l_any is None or r_any is None:
+                raise HyperspaceException("Bucketed join over empty sides.")
+            empty = inner_join(
+                l_any.take(np.array([], dtype=np.int64)),
+                r_any.take(np.array([], dtype=np.int64)),
+                l_keys,
+                r_keys,
+            )
+            return empty
+        return ColumnarBatch.concat(parts)

@@ -27,7 +27,7 @@ from ..exceptions import HyperspaceException
 from ..ops.hashing import bucket_of_values
 from ..plan.expr import Expr, bounds_for_column, eval_mask, pinned_values
 from ..storage import layout
-from ..storage.columnar import ColumnarBatch
+from ..storage.columnar import Column, ColumnarBatch
 
 
 def buckets_for_predicate(
@@ -62,24 +62,78 @@ def buckets_for_predicate(
     return buckets
 
 
+_mask_fn_cache: dict = {}
+
+
 def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
     """Evaluate the predicate on device with rows padded to the next power
-    of two. Index files all have distinct row counts; without shape
-    bucketing XLA recompiles the filter once per file, which dominates the
-    scan (observed 46s → <1s on a 32-file range scan). Padding costs <2×
-    rows of bandwidth and makes the compile cache hit after the first few
-    sizes."""
-    import jax.numpy as jnp
+    of two, under one jitted call.
+
+    Two latency killers handled here: (1) index files all have distinct row
+    counts — without shape bucketing XLA recompiles the filter once per
+    file (observed 46s → 3s on a 32-file range scan); (2) op-by-op eager
+    dispatch pays per-op device latency — jitting the whole mask into one
+    executable collapses it to a single round trip."""
+    names = sorted(predicate.columns())
+    # float64 never transits the device raw (lossy on TPU; see
+    # ops.floatbits) — predicates touching f64 evaluate on host, exactly.
+    if any(batch.columns[n_].dtype_str == "float64" for n_ in names):
+        return np.asarray(eval_mask(predicate, batch))
+
+    import hashlib
+
+    import jax
 
     n = batch.num_rows
     n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
-    names = sorted(predicate.columns())
-    arrays = {}
-    for name in names:
-        data = batch.columns[name].data
-        arrays[name] = jnp.asarray(np.pad(data, (0, n_pad - n)))
-    mask = np.asarray(eval_mask(predicate, batch, arrays))
+    host_arrays = {
+        name: np.pad(batch.columns[name].data, (0, n_pad - n)) for name in names
+    }
+    # Cache key: expression + array signature + dictionary CONTENT (string
+    # literals are resolved against the batch's dictionary at trace time, so
+    # two files with identical vocabs share a compiled fn; id()-based keys
+    # would miss on every file).
+    dict_key = tuple(
+        (
+            name,
+            hashlib.md5(b"\0".join(batch.columns[name].vocab)).hexdigest(),
+        )
+        for name in names
+        if batch.columns[name].vocab is not None
+    )
+    key = (
+        repr(predicate),
+        n_pad,
+        tuple((name, str(a.dtype)) for name, a in host_arrays.items()),
+        dict_key,
+    )
+    fn = _mask_fn_cache.get(key)
+    if fn is None:
+        # Close over a rows-free schema shim, not the batch — caching the
+        # closure must not pin file-sized column data.
+        shim = ColumnarBatch(
+            {
+                name: Column(
+                    c.dtype_str,
+                    np.empty(0, dtype=c.data.dtype),
+                    c.vocab,
+                )
+                for name, c in batch.columns.items()
+                if name in names
+            }
+        )
+        fn = jax.jit(lambda arrays: eval_mask(predicate, shim, arrays))
+        if len(_mask_fn_cache) >= 512:
+            _mask_fn_cache.pop(next(iter(_mask_fn_cache)))  # evict oldest
+        _mask_fn_cache[key] = fn
+    mask = np.asarray(fn(host_arrays))
     return mask[:n]
+
+
+# Below this row count the fixed device-call latency (dispatch + transfer
+# sync; ~70ms observed through the tunneled TPU) exceeds any compute win —
+# the mask runs on host numpy instead. Tunable per deployment.
+MIN_DEVICE_ROWS = 1_000_000
 
 
 def index_scan(
@@ -90,6 +144,7 @@ def index_scan(
     indexed_columns: Optional[List[str]] = None,
     dtypes: Optional[dict] = None,
     num_buckets: Optional[int] = None,
+    min_device_rows: int = MIN_DEVICE_ROWS,
 ) -> ColumnarBatch:
     """Scan index data files, returning the filtered projection.
 
@@ -114,7 +169,7 @@ def index_scan(
         if batch.num_rows == 0:
             continue
         if predicate is not None:
-            if device:
+            if device and batch.num_rows >= min_device_rows:
                 mask = _device_mask_padded(predicate, batch)
             else:
                 mask = eval_mask(predicate, batch)

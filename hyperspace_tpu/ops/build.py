@@ -51,20 +51,44 @@ def vocab_hashes(col: Column) -> Optional[np.ndarray]:
 
 
 def key_repr_device(arr, dtype_str: str, vhash=None):
-    """int64 key representation on device (twin of hashing.key_repr)."""
+    """int64 key representation on device (twin of hashing.key_repr).
+
+    float64 columns arrive already encoded as ordered int64 (the device
+    transport format, ops.floatbits) — their repr is the identity, matching
+    the host key_repr which applies the same encoding."""
     if is_string(dtype_str):
         if vhash is None:
             raise HyperspaceException("String key column needs vocab hashes.")
         safe = jnp.clip(arr, 0, max(int(vhash.shape[0]) - 1, 0))
         gathered = vhash[safe] if int(vhash.shape[0]) else jnp.zeros_like(arr, jnp.int64)
         return jnp.where(arr >= 0, gathered, jnp.int64(-1))
-    if dtype_str in ("float32", "float64"):
+    if dtype_str == "float64":
+        if arr.dtype != jnp.int64:
+            raise HyperspaceException(
+                "float64 must be pre-encoded to ordered int64 before device "
+                "transport (ops.floatbits)."
+            )
+        return arr
+    if dtype_str == "float32":
         a = jnp.where(arr == 0.0, jnp.zeros_like(arr), arr)
-        bits = lax.bitcast_convert_type(
-            a, jnp.int32 if dtype_str == "float32" else jnp.int64
-        )
-        return bits.astype(jnp.int64)
+        return lax.bitcast_convert_type(a, jnp.int32).astype(jnp.int64)
     return arr.astype(jnp.int64)
+
+
+def encode_for_device(col: Column) -> np.ndarray:
+    """Host buffer in device transport encoding (float64 → ordered int64;
+    everything else raw). Same encoding ColumnarBatch.device_arrays applies."""
+    if col.dtype_str == "float64":
+        from .floatbits import f64_to_ordered_i64
+
+        return f64_to_ordered_i64(col.data)
+    return col.data
+
+
+def decode_from_device(dtype_str: str, arr: np.ndarray) -> np.ndarray:
+    from ..storage.columnar import decode_device_array
+
+    return decode_device_array(dtype_str, arr)
 
 
 def device_bucket_ids(
@@ -110,7 +134,7 @@ def build_partition_single(
     grouped by bucket (ascending) and sorted by the key columns within each
     bucket, plus per-bucket row counts."""
     dtypes = batch.schema()
-    arrays = batch.device_arrays()
+    arrays = batch.device_arrays()  # f64 arrives ordered-int64 encoded
     vh = {
         k: jnp.asarray(vocab_hashes(batch.columns[k]))
         for k in key_names
@@ -125,7 +149,11 @@ def build_partition_single(
     out_arrays, _sorted_bucket, counts = kernel(arrays, vh)
     counts = np.asarray(counts)
     cols = {
-        name: Column(dtypes[name], np.asarray(out_arrays[name]), batch.columns[name].vocab)
+        name: Column(
+            dtypes[name],
+            decode_from_device(dtypes[name], np.asarray(out_arrays[name])),
+            batch.columns[name].vocab,
+        )
         for name in batch.column_names
     }
     return ColumnarBatch(cols), counts
@@ -174,7 +202,7 @@ def build_partition_sharded(
     valid_np = pad(np.ones(n, dtype=bool))
     sharding = NamedSharding(mesh, PartitionSpec(axis))
     dev_arrays = {
-        name: jax.device_put(pad(batch.columns[name].data), sharding)
+        name: jax.device_put(pad(encode_for_device(batch.columns[name])), sharding)
         for name in batch.column_names
     }
     valid = jax.device_put(valid_np, sharding)
@@ -246,7 +274,10 @@ def build_partition_sharded(
     n_valid_all = np.asarray(n_valid_all).reshape(D)
     per_device: List[Tuple[ColumnarBatch, np.ndarray]] = []
     rows_per_dev = D * cap
-    host_arrays = {name: np.asarray(a) for name, a in out_arrays.items()}
+    host_arrays = {
+        name: decode_from_device(dtypes[name], np.asarray(a))
+        for name, a in out_arrays.items()
+    }
     host_bucket_out = np.asarray(out_bucket)
     for d in range(D):
         nv = int(n_valid_all[d])

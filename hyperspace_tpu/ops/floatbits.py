@@ -1,0 +1,37 @@
+"""Order-preserving int64 encoding of float64 — the device transport format.
+
+float64 does not survive a round trip through the TPU bit-exactly (v5e
+emulates f64; even a plain transfer perturbs low bits — observed
+3421.33 → 3421.3300000000017). An indexing framework cannot tolerate lossy
+value columns, so float64 NEVER crosses the device boundary as float:
+columns are encoded host-side into int64 whose *signed integer order equals
+the float order* (IEEE total-order trick: negatives bit-flipped, positives
+kept), moved/sorted/hashed as integers, and decoded after.
+
+-0.0 normalizes to +0.0; NaNs sort above +inf and are preserved bit-wise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_TOP = np.int64(np.uint64(0x8000000000000000).astype(np.int64))
+
+
+def f64_to_ordered_i64(a: np.ndarray) -> np.ndarray:
+    """Encode float64 -> int64 with order preserved (exact, invertible)."""
+    a = np.asarray(a, dtype=np.float64)
+    a = np.where(a == 0.0, 0.0, a)  # -0.0 -> +0.0
+    bits = a.view(np.int64)
+    return np.where(bits < 0, np.bitwise_xor(~bits, _TOP), bits)
+
+
+def ordered_i64_to_f64(o: np.ndarray) -> np.ndarray:
+    """Invert f64_to_ordered_i64."""
+    o = np.asarray(o, dtype=np.int64)
+    bits = np.where(o < 0, ~np.bitwise_xor(o, _TOP), o)
+    return bits.view(np.float64)
+
+
+def f64_scalar_to_ordered(v: float) -> np.int64:
+    return f64_to_ordered_i64(np.array([v], dtype=np.float64))[0]

@@ -10,7 +10,9 @@ and query-time shuffles must agree or bucketed joins silently break.
 Scheme:
 * every indexed column is first reduced to an int64 **key representation**:
   - integers/dates: the value itself;
-  - floats: IEEE bit pattern (bitcast) with -0.0 normalized to +0.0;
+  - float32: IEEE bit pattern (bitcast) with -0.0 normalized to +0.0;
+  - float64: the order-preserving int64 encoding of ops.floatbits (also the
+    device transport format — raw f64 is lossy on TPU), -0.0 normalized;
   - bools: 0/1;
   - strings: FNV-1a 64-bit hash of the UTF-8 bytes, computed once per
     dictionary entry and gathered through the codes (so hashing n rows
@@ -59,11 +61,14 @@ def key_repr(col: Column) -> np.ndarray:
             out[valid] = vocab_hash[col.data[valid]]
         return out
     d = col.data
-    if d.dtype.kind == "f":
+    if d.dtype == np.float64:
+        # order-preserving encoding: doubles as device transport format
+        from .floatbits import f64_to_ordered_i64
+
+        return f64_to_ordered_i64(d)
+    if d.dtype == np.float32:
         d = np.where(d == 0.0, 0.0, d)  # -0.0 -> +0.0
-        if d.dtype == np.float32:
-            return d.view(np.int32).astype(np.int64)
-        return d.view(np.int64)
+        return d.view(np.int32).astype(np.int64)
     if d.dtype == np.bool_:
         return d.astype(np.int64)
     if d.dtype.kind in ("i", "u"):
@@ -83,8 +88,9 @@ def scalar_key_repr(value, dtype_str: str) -> np.int64:
         f = np.float32(0.0 if value == 0.0 else value)
         return np.int64(f.view(np.int32))
     if dtype_str == "float64":
-        f = np.float64(0.0 if value == 0.0 else value)
-        return np.int64(f.view(np.int64))
+        from .floatbits import f64_scalar_to_ordered
+
+        return f64_scalar_to_ordered(value)
     if dtype_str == "bool":
         return np.int64(bool(value))
     return np.int64(value)

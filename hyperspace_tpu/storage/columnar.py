@@ -328,11 +328,35 @@ class ColumnarBatch:
     def device_arrays(self, names: Optional[Iterable[str]] = None):
         """Transfer columns to the default JAX device as a dict of
         jax.Arrays (codes for strings). The numeric-only, static-shape
-        design makes this a straight dma of each buffer into HBM."""
+        design makes this a straight dma of each buffer into HBM.
+
+        float64 columns are transferred in the order-preserving int64
+        encoding (ops.floatbits) — raw f64 does not survive the TPU
+        bit-exactly. Decode results with ``decode_device_array``."""
         from ..ops import ensure_x64
 
         ensure_x64()
         import jax.numpy as jnp
 
+        from ..ops.floatbits import f64_to_ordered_i64
+
         names = list(names) if names is not None else self.column_names
-        return {n: jnp.asarray(self.columns[n].data) for n in names}
+        out = {}
+        for n in names:
+            col = self.columns[n]
+            data = (
+                f64_to_ordered_i64(col.data)
+                if col.dtype_str == "float64"
+                else col.data
+            )
+            out[n] = jnp.asarray(data)
+        return out
+
+
+def decode_device_array(dtype_str: str, host_array: np.ndarray) -> np.ndarray:
+    """Invert the device transport encoding applied by ``device_arrays``."""
+    if dtype_str == "float64":
+        from ..ops.floatbits import ordered_i64_to_f64
+
+        return ordered_i64_to_f64(host_array)
+    return host_array

@@ -1,0 +1,378 @@
+"""Rewrite-rule tests over fabricated index metadata — the analog of the
+reference's rule tier (FilterIndexRuleTest.scala, JoinIndexRuleTest.scala,
+RuleUtilsTest.scala) using HyperspaceRuleSuite-style fabricated entries: no
+index data on disk, signatures computed from the relation's file snapshot.
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.index.log_entry import (
+    Content,
+    CoveringIndex,
+    Directory,
+    FileInfo,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Relation,
+    Signature,
+    Source,
+)
+from hyperspace_tpu.index.signatures import (
+    FileBasedSignatureProvider,
+    IndexSignatureProvider,
+    PlanSignatureProvider,
+    create_signature_provider,
+)
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.ir import Filter, IndexScan, Join, Project, Scan
+from hyperspace_tpu.plan.rules import apply_hyperspace_rules
+from hyperspace_tpu.plan.rules.filter_rule import FilterIndexRule, extract_filter_node
+from hyperspace_tpu.plan.rules.join_rule import (
+    JoinIndexRule,
+    align_condition_sides,
+    ensure_one_to_one,
+    extract_equi_condition,
+)
+from hyperspace_tpu.plan.rules.rule_utils import get_candidate_indexes, is_index_applied
+from hyperspace_tpu.sources.relation import FileRelation
+
+
+def file_infos(prefix, n=2, start_id=0):
+    return [
+        FileInfo(f"/data/{prefix}/part-{i}.parquet", 1000 + i, 111, start_id + i)
+        for i in range(n)
+    ]
+
+
+def relation(prefix, schema, n_files=2):
+    return FileRelation(
+        root_paths=[f"/data/{prefix}"],
+        file_format="parquet",
+        schema=schema,
+        files=file_infos(prefix, n_files),
+    )
+
+
+LINEITEM = {"l_orderkey": "int64", "l_partkey": "int64", "l_qty": "int32", "l_price": "float64"}
+ORDERS = {"o_orderkey": "int64", "o_date": "date32", "o_total": "float64"}
+
+
+def fabricate_entry(
+    name,
+    rel: FileRelation,
+    indexed,
+    included,
+    plan_for_sig=None,
+    num_buckets=8,
+    lineage=False,
+):
+    """HyperspaceRuleSuite.createIndexLogEntry analog: entry whose signature
+    matches ``plan_for_sig`` (default: Scan(rel))."""
+    plan = plan_for_sig if plan_for_sig is not None else Scan(rel)
+    sig = IndexSignatureProvider().signature(plan)
+    content = Content(
+        Directory(
+            "/",
+            subdirs=[
+                Directory(
+                    "indexes",
+                    subdirs=[
+                        Directory(
+                            name,
+                            subdirs=[
+                                Directory(
+                                    "v__=0",
+                                    files=[FileInfo("b00000-x.tcb", 10, 1, 0)],
+                                )
+                            ],
+                        )
+                    ],
+                )
+            ],
+        )
+    )
+    src_root = Directory("/", files=[])
+    for fi in rel.files:
+        parts = fi.name.strip("/").split("/")
+        node = src_root
+        for p in parts[:-1]:
+            nxt = next((d for d in node.subdirs if d.name == p), None)
+            if nxt is None:
+                nxt = Directory(p)
+                node.subdirs.append(nxt)
+            node = nxt
+        node.files.append(FileInfo(parts[-1], fi.size, fi.modified_time, fi.id))
+    schema = {c: rel.schema[c] for c in list(indexed) + list(included)}
+    entry = IndexLogEntry(
+        name,
+        CoveringIndex(
+            list(indexed),
+            list(included),
+            schema,
+            num_buckets,
+            {"lineage": "true"} if lineage else {},
+        ),
+        content,
+        Source(
+            [
+                Relation(
+                    rel.root_paths,
+                    Content(src_root),
+                    dict(rel.schema),
+                    rel.file_format,
+                    dict(rel.options),
+                )
+            ],
+            LogicalPlanFingerprint([Signature("IndexSignatureProvider", sig)]),
+        ),
+    )
+    entry.state = states.ACTIVE
+    entry.id = 1
+    return entry
+
+
+@pytest.fixture
+def conf():
+    return HyperspaceConf()
+
+
+# ---------------------------------------------------------------------------
+# signatures
+# ---------------------------------------------------------------------------
+def test_signature_providers_deterministic():
+    rel = relation("t1", LINEITEM)
+    plan = Filter(col("l_orderkey") == 5, Scan(rel))
+    for provider in (FileBasedSignatureProvider(), PlanSignatureProvider(), IndexSignatureProvider()):
+        s1 = provider.signature(plan)
+        s2 = provider.signature(Filter(col("l_orderkey") == 5, Scan(relation("t1", LINEITEM))))
+        assert s1 == s2
+
+
+def test_file_signature_changes_with_files():
+    r1 = relation("t1", LINEITEM, n_files=2)
+    r2 = relation("t1", LINEITEM, n_files=3)
+    p = FileBasedSignatureProvider()
+    assert p.signature(Scan(r1)) != p.signature(Scan(r2))
+    # mtime change
+    r3 = relation("t1", LINEITEM, n_files=2)
+    r3.files[0] = FileInfo(r3.files[0].name, r3.files[0].size, 999, 0)
+    assert p.signature(Scan(r1)) != p.signature(Scan(r3))
+
+
+def test_plan_signature_depends_on_shape():
+    rel = relation("t1", LINEITEM)
+    p = PlanSignatureProvider()
+    assert p.signature(Scan(rel)) != p.signature(Filter(col("l_qty") > 1, Scan(rel)))
+
+
+def test_signature_provider_factory():
+    assert isinstance(create_signature_provider(), IndexSignatureProvider)
+    assert isinstance(
+        create_signature_provider("PlanSignatureProvider"), PlanSignatureProvider
+    )
+    with pytest.raises(Exception):
+        create_signature_provider("NopeProvider")
+
+
+# ---------------------------------------------------------------------------
+# candidate selection
+# ---------------------------------------------------------------------------
+def test_candidate_by_signature(conf):
+    rel = relation("t1", LINEITEM)
+    plan = Filter(col("l_orderkey") == 1, Scan(rel))
+    entry = fabricate_entry("i1", rel, ["l_orderkey"], ["l_qty"], plan_for_sig=plan)
+    assert get_candidate_indexes([entry], plan, conf) == [entry]
+    # different file set -> no match
+    plan2 = Filter(col("l_orderkey") == 1, Scan(relation("t1", LINEITEM, n_files=3)))
+    assert get_candidate_indexes([entry], plan2, conf) == []
+
+
+# ---------------------------------------------------------------------------
+# FilterIndexRule
+# ---------------------------------------------------------------------------
+def test_extract_filter_node():
+    rel = relation("t1", LINEITEM)
+    f = Filter(col("l_orderkey") == 1, Scan(rel))
+    e = extract_filter_node(f)
+    assert e is not None and e.project is None
+    p = Project(("l_qty",), f)
+    e = extract_filter_node(p)
+    assert e is not None and e.project is p
+    assert extract_filter_node(Scan(rel)) is None
+
+
+def test_filter_rule_rewrites_covering_query(conf):
+    rel = relation("t1", LINEITEM)
+    plan = Project(("l_qty",), Filter(col("l_orderkey") == 42, Scan(rel)))
+    entry = fabricate_entry("i1", rel, ["l_orderkey"], ["l_qty"], plan_for_sig=plan)
+    new_plan, applied = FilterIndexRule().apply(plan, [entry], conf)
+    assert applied == [entry]
+    assert is_index_applied(new_plan)
+    scans = new_plan.collect(lambda n: isinstance(n, IndexScan))
+    assert len(scans) == 1
+    assert not scans[0].use_bucket_spec  # filter path drops bucket spec
+    # structure above the swap is preserved
+    assert isinstance(new_plan, Project) and new_plan.columns == ("l_qty",)
+    assert "Hyperspace(Type: CI, Name: i1" in new_plan.tree_string()
+
+
+def test_filter_rule_requires_head_indexed_column(conf):
+    rel = relation("t1", LINEITEM)
+    plan = Project(("l_qty",), Filter(col("l_qty") > 5, Scan(rel)))
+    # index on l_orderkey: filter doesn't touch the head indexed column
+    entry = fabricate_entry("i1", rel, ["l_orderkey"], ["l_qty"], plan_for_sig=plan)
+    new_plan, applied = FilterIndexRule().apply(plan, [entry], conf)
+    assert applied == []
+    assert not is_index_applied(new_plan)
+
+
+def test_filter_rule_requires_coverage(conf):
+    rel = relation("t1", LINEITEM)
+    plan = Project(("l_price",), Filter(col("l_orderkey") == 1, Scan(rel)))
+    entry = fabricate_entry("i1", rel, ["l_orderkey"], ["l_qty"], plan_for_sig=plan)
+    _, applied = FilterIndexRule().apply(plan, [entry], conf)
+    assert applied == []  # l_price not covered
+
+
+def test_filter_rule_no_rewrite_on_signature_mismatch(conf):
+    rel = relation("t1", LINEITEM)
+    plan = Filter(col("l_orderkey") == 1, Scan(rel))
+    other = relation("other", LINEITEM)
+    entry = fabricate_entry("i1", other, ["l_orderkey"], ["l_qty"])  # sig of other
+    _, applied = FilterIndexRule().apply(plan, [entry], conf)
+    assert applied == []
+
+
+def test_filter_rule_case_insensitive(conf):
+    rel = relation("t1", LINEITEM)
+    plan = Project(("L_QTY",), Filter(col("L_ORDERKEY") == 1, Scan(rel)))
+    entry = fabricate_entry("i1", rel, ["l_orderkey"], ["l_qty"], plan_for_sig=plan)
+    _, applied = FilterIndexRule().apply(plan, [entry], conf)
+    assert applied == [entry]
+
+
+def test_filter_rule_never_rewrites_twice(conf):
+    rel = relation("t1", LINEITEM)
+    plan = Filter(col("l_orderkey") == 1, Scan(rel))
+    # no Project above: the index must cover every source column
+    entry = fabricate_entry(
+        "i1", rel, ["l_orderkey"], ["l_partkey", "l_qty", "l_price"],
+        plan_for_sig=plan,
+    )
+    once, applied = FilterIndexRule().apply(plan, [entry], conf)
+    assert len(applied) == 1
+    twice, applied2 = FilterIndexRule().apply(once, [entry], conf)
+    assert applied2 == []
+    assert twice.tree_string() == once.tree_string()
+
+
+# ---------------------------------------------------------------------------
+# JoinIndexRule
+# ---------------------------------------------------------------------------
+def join_fixture(conf, l_buckets=8, r_buckets=8):
+    l_rel = relation("lineitem", LINEITEM)
+    r_rel = relation("orders", ORDERS)
+    left = Scan(l_rel)
+    right = Scan(r_rel)
+    join = Join(left, right, col("l_orderkey") == col("o_orderkey"))
+    l_entry = fabricate_entry(
+        "l_idx", l_rel, ["l_orderkey"], ["l_qty", "l_partkey", "l_price"],
+        plan_for_sig=left, num_buckets=l_buckets,
+    )
+    r_entry = fabricate_entry(
+        "r_idx", r_rel, ["o_orderkey"], ["o_total", "o_date"],
+        plan_for_sig=right, num_buckets=r_buckets,
+    )
+    return join, l_entry, r_entry
+
+
+def test_extract_equi_condition():
+    c = (col("a") == col("b")) & (col("c") == col("d"))
+    assert extract_equi_condition(c) == [("a", "b"), ("c", "d")]
+    assert extract_equi_condition(col("a") == 5) is None
+    assert extract_equi_condition((col("a") == col("b")) | (col("c") == col("d"))) is None
+
+
+def test_align_and_one_to_one():
+    pairs = align_condition_sides([("o_orderkey", "l_orderkey")], ["l_orderkey"], ["o_orderkey"])
+    assert pairs == [("l_orderkey", "o_orderkey")]
+    assert align_condition_sides([("x", "y")], ["a"], ["b"]) is None
+    assert ensure_one_to_one([("a", "b"), ("a", "c")]) is None
+    assert ensure_one_to_one([("a", "b"), ("d", "b")]) is None
+    assert ensure_one_to_one([("a", "b"), ("a", "b")]) == {"a": "b"}
+
+
+def test_join_rule_rewrites_both_sides(conf):
+    join, le, re_ = join_fixture(conf)
+    new_plan, applied = JoinIndexRule().apply(join, [le, re_], conf)
+    assert set(e.name for e in applied) == {"l_idx", "r_idx"}
+    idx_scans = new_plan.collect(lambda n: isinstance(n, IndexScan))
+    assert len(idx_scans) == 2
+    assert all(s.use_bucket_spec for s in idx_scans)
+
+
+def test_join_rule_requires_indexes_on_both_sides(conf):
+    join, le, _ = join_fixture(conf)
+    _, applied = JoinIndexRule().apply(join, [le], conf)
+    assert applied == []
+
+
+def test_join_rule_indexed_cols_must_equal_keys(conf):
+    join, le, re_ = join_fixture(conf)
+    # left index indexed on the wrong column
+    l_rel = join.left.relation
+    wrong = fabricate_entry(
+        "wrong", l_rel, ["l_partkey"], ["l_orderkey", "l_qty", "l_price"],
+        plan_for_sig=join.left,
+    )
+    _, applied = JoinIndexRule().apply(join, [wrong, re_], conf)
+    assert applied == []
+
+
+def test_join_ranker_prefers_equal_buckets(conf):
+    join, le8, re8 = join_fixture(conf, 8, 8)
+    _, le16, _ = join_fixture(conf, 16, 8)
+    le16.name = "l_idx16"
+    # both left indexes usable; equal-bucket pair (8,8) must win over (16,8)
+    new_plan, applied = JoinIndexRule().apply(join, [le16, le8, re8], conf)
+    assert {e.name for e in applied} == {"l_idx", "r_idx"}
+
+
+def test_rule_batch_join_then_filter(conf):
+    join, le, re_ = join_fixture(conf)
+    plan, applied = apply_hyperspace_rules(join, [le, re_], conf)
+    assert len(applied) == 2
+    # a filter query still matches via FilterIndexRule in the same batch
+    rel = relation("t9", LINEITEM)
+    fplan = Filter(col("l_orderkey") == 1, Scan(rel))
+    fentry = fabricate_entry(
+        "f_idx", rel, ["l_orderkey"], ["l_partkey", "l_qty", "l_price"],
+        plan_for_sig=fplan,
+    )
+    out, applied2 = apply_hyperspace_rules(fplan, [fentry], conf)
+    assert applied2 == [fentry]
+
+
+def test_join_with_filter_below(conf):
+    # Filter under join side: linear plan, still rewritable
+    l_rel = relation("lineitem", LINEITEM)
+    r_rel = relation("orders", ORDERS)
+    left = Filter(col("l_qty") > 0, Scan(l_rel))
+    right = Scan(r_rel)
+    join = Join(left, right, col("l_orderkey") == col("o_orderkey"))
+    le = fabricate_entry(
+        "l_idx", l_rel, ["l_orderkey"], ["l_qty", "l_partkey", "l_price"],
+        plan_for_sig=left,
+    )
+    re_ = fabricate_entry(
+        "r_idx", r_rel, ["o_orderkey"], ["o_total", "o_date"], plan_for_sig=right
+    )
+    new_plan, applied = JoinIndexRule().apply(join, [le, re_], conf)
+    assert len(applied) == 2
+    # the filter survives above the index scan
+    filters = new_plan.collect(lambda n: isinstance(n, Filter))
+    assert len(filters) == 1
