@@ -8,3 +8,29 @@ mesh; index data lives in the TCB columnar layout that streams into HBM.
 """
 
 __version__ = "0.1.0"
+
+from .config import HyperspaceConf  # noqa: E402,F401
+from .exceptions import HyperspaceException  # noqa: E402,F401
+from .index.index_config import IndexConfig  # noqa: E402,F401
+
+
+def __getattr__(name):
+    # Heavier entry points load lazily so `import hyperspace_tpu` stays
+    # metadata-light (no jax import until the engine is touched).
+    if name == "HyperspaceSession":
+        from .session import HyperspaceSession
+
+        return HyperspaceSession
+    if name == "Hyperspace":
+        from .hyperspace import Hyperspace
+
+        return Hyperspace
+    if name == "DataFrame":
+        from .dataframe import DataFrame
+
+        return DataFrame
+    if name == "col":
+        from .plan.expr import col
+
+        return col
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

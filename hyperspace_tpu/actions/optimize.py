@@ -1,0 +1,145 @@
+"""OptimizeAction: bucket-wise compaction of small index files.
+
+Parity: com/microsoft/hyperspace/actions/OptimizeAction.scala (160 LoC).
+Incremental refreshes append one file per bucket per refresh; optimize
+merges each bucket's small files into one, writing a new version dir.
+``quick`` mode compacts only files under the size threshold (256 MB
+default); ``full`` compacts everything. Single-file buckets are skipped
+(:126-131); untouched files carry over into the new Content (:135-155).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import constants as C
+from ..exceptions import HyperspaceException, NoChangesException
+from ..index.data_manager import IndexDataManager
+from ..index.log_entry import Content, FileIdTracker, IndexLogEntry, LogEntry
+from ..index.log_manager import IndexLogManager
+from ..ops.hashing import key_repr
+from ..storage import layout
+from ..storage.columnar import ColumnarBatch, is_string
+from ..telemetry import OptimizeActionEvent
+from . import states
+from .base import Action
+from .create import CreateActionBase
+
+
+class OptimizeAction(Action, CreateActionBase):
+    transient_state = states.OPTIMIZING
+    final_state = states.ACTIVE
+
+    def __init__(
+        self,
+        session,
+        log_manager: IndexLogManager,
+        data_manager: IndexDataManager,
+        mode: str = C.OPTIMIZE_MODE_QUICK,
+    ):
+        Action.__init__(self, log_manager)
+        CreateActionBase.__init__(self, session)
+        self.data_manager = data_manager
+        self.mode = mode.lower()
+        self._previous: Optional[IndexLogEntry] = None
+        self._entry: Optional[IndexLogEntry] = None
+
+    @property
+    def previous_entry(self) -> IndexLogEntry:
+        if self._previous is None:
+            entry = self.log_manager.get_latest_stable_log()
+            if entry is None:
+                raise HyperspaceException("Index does not exist.")
+            self._previous = entry
+        return self._previous
+
+    def _partition_files(self):
+        """(files to optimize, untouched files) by bucket and threshold
+        (OptimizeAction.scala:115-133)."""
+        threshold = self.conf.optimize_file_size_threshold()
+        by_bucket: Dict[int, List] = {}
+        for fi in self.previous_entry.content.file_infos():
+            by_bucket.setdefault(layout.bucket_of_file(fi.name), []).append(fi)
+        to_optimize: Dict[int, List] = {}
+        untouched: List = []
+        for b, files in by_bucket.items():
+            if self.mode == C.OPTIMIZE_MODE_QUICK:
+                small = [f for f in files if f.size < threshold]
+                big = [f for f in files if f.size >= threshold]
+            else:
+                small, big = list(files), []
+            if len(small) < 2:  # nothing to merge in this bucket (:126-131)
+                untouched.extend(files)
+                continue
+            to_optimize[b] = small
+            untouched.extend(big)
+        return to_optimize, untouched
+
+    def validate(self) -> None:
+        if self.mode not in C.OPTIMIZE_MODES:
+            raise HyperspaceException(
+                f"Unsupported optimize mode {self.mode!r}; supported modes "
+                f"are {C.OPTIMIZE_MODES}."
+            )
+        if self.previous_entry.state != states.ACTIVE:
+            raise HyperspaceException(
+                "Optimize is only supported in ACTIVE state."
+            )
+        to_optimize, _ = self._partition_files()
+        if not to_optimize:
+            raise NoChangesException(
+                "No index files eligible for compaction "
+                f"(mode={self.mode})."
+            )
+
+    def op(self) -> None:
+        prev = self.previous_entry
+        to_optimize, untouched = self._partition_files()
+        version_dir = self.data_manager.get_path(
+            (self.data_manager.get_latest_version_id() or 0) + 1
+        )
+        indexed = prev.indexed_columns
+        new_paths: List[str] = []
+        for b, files in sorted(to_optimize.items()):
+            merged = ColumnarBatch.concat(
+                [layout.read_batch(f.name) for f in files]
+            )
+            # restore per-bucket sort order on the indexed columns; strings
+            # sort by their (unified, order-preserving) dictionary codes —
+            # key_repr would sort by FNV hash, which is not an order
+            reprs = [
+                merged.columns[c].data
+                if is_string(merged.columns[c].dtype_str)
+                else key_repr(merged.columns[c])
+                for c in indexed
+            ]
+            order = np.lexsort(list(reversed(reprs)))
+            merged = merged.take(order)
+            p = version_dir / layout.bucket_file_name(b)
+            layout.write_batch(p, merged, sorted_by=list(indexed), bucket=b)
+            new_paths.append(str(p))
+
+        tracker = FileIdTracker()
+        new_content = Content.from_leaf_files(new_paths, tracker)
+        entry = IndexLogEntry(
+            prev.name,
+            prev.derived_dataset,
+            new_content,
+            prev.source,
+            dict(prev.properties),
+        )
+        if untouched:
+            from .create import _content_from_file_infos
+
+            entry.content = entry.content.merge(_content_from_file_infos(untouched))
+        self._entry = entry
+
+    def log_entry(self) -> LogEntry:
+        return self._entry if self._entry is not None else self.previous_entry
+
+    def event(self, message: str):
+        return OptimizeActionEvent(
+            index=self.previous_entry.name, state=self.final_state, message=message
+        )

@@ -1,0 +1,285 @@
+"""The refresh family: full rebuild, incremental, and quick (metadata-only).
+
+Parity:
+  RefreshActionBase.scala:57-147 — source reconstruction from the logged
+    Relation via the provider, appended/deleted set-diff, inherited
+    numBuckets/lineage;
+  RefreshAction.scala:41-53 — full rebuild, no-op when unchanged;
+  RefreshIncrementalAction.scala:58-144 — index only appended files; on
+    deletes rewrite the index dropping lineage ids; merge Content trees;
+  RefreshQuickAction.scala:37-79 — metadata-only copyWithUpdate delta for
+    query-time Hybrid Scan.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Set
+
+import numpy as np
+
+from .. import constants as C
+from ..exceptions import HyperspaceException, NoChangesException
+from ..index.builder import write_index_data
+from ..index.data_manager import IndexDataManager
+from ..index.index_config import IndexConfig
+from ..index.log_entry import (
+    Content,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    LogEntry,
+    LogicalPlanFingerprint,
+    Signature,
+)
+from ..index.log_manager import IndexLogManager
+from ..index.signatures import create_signature_provider
+from ..plan.ir import Scan
+from ..sources.relation import FileRelation
+from ..storage import layout, parquet_io
+from ..storage.columnar import Column, ColumnarBatch
+from ..telemetry import (
+    RefreshActionEvent,
+    RefreshIncrementalActionEvent,
+    RefreshQuickActionEvent,
+)
+from . import states
+from .base import Action
+from .create import CreateActionBase, _content_from_file_infos
+
+
+class RefreshActionBase(Action, CreateActionBase):
+    transient_state = states.REFRESHING
+    final_state = states.ACTIVE
+
+    def __init__(
+        self,
+        session,
+        log_manager: IndexLogManager,
+        data_manager: IndexDataManager,
+    ):
+        Action.__init__(self, log_manager)
+        CreateActionBase.__init__(self, session)
+        self.data_manager = data_manager
+        self._previous: Optional[IndexLogEntry] = None
+        self._relation: Optional[FileRelation] = None
+        self._entry: Optional[IndexLogEntry] = None
+
+    # -- previous state -------------------------------------------------------
+    @property
+    def previous_entry(self) -> IndexLogEntry:
+        if self._previous is None:
+            entry = self.log_manager.get_latest_stable_log()
+            if entry is None:
+                raise HyperspaceException("Index does not exist.")
+            self._previous = entry
+        return self._previous
+
+    @property
+    def index_config(self) -> IndexConfig:
+        prev = self.previous_entry
+        return IndexConfig(prev.name, prev.indexed_columns, prev.included_columns)
+
+    @property
+    def num_buckets(self) -> int:
+        # Inherited from the previous version (RefreshActionBase.scala:57-65)
+        return self.previous_entry.num_buckets
+
+    @property
+    def lineage(self) -> bool:
+        return self.previous_entry.has_lineage_column()
+
+    # -- current source snapshot (RefreshActionBase.scala:68-86) -------------
+    @property
+    def relation(self) -> FileRelation:
+        if self._relation is None:
+            self._relation = self.session.sources.refresh_relation(
+                self.previous_entry.relation
+            )
+        return self._relation
+
+    # -- set-diff (RefreshActionBase.scala:112-147) --------------------------
+    @property
+    def current_files(self) -> Set[FileInfo]:
+        return set(self.relation.files)
+
+    @property
+    def logged_files(self) -> Set[FileInfo]:
+        return set(self.previous_entry.source_file_infos())
+
+    @property
+    def appended_files(self) -> List[FileInfo]:
+        return sorted(self.current_files - self.logged_files, key=lambda f: f.name)
+
+    @property
+    def deleted_files(self) -> List[FileInfo]:
+        return sorted(self.logged_files - self.current_files, key=lambda f: f.name)
+
+    def validate(self) -> None:
+        if self.previous_entry.state != states.ACTIVE:
+            raise HyperspaceException(
+                f"Refresh is only supported in ACTIVE state; current is "
+                f"{self.previous_entry.state}."
+            )
+        if not self.appended_files and not self.deleted_files:
+            raise NoChangesException("Source data did not change; refresh is a no-op.")
+
+    def _seeded_tracker(self) -> FileIdTracker:
+        """Tracker seeded with the previous snapshot's ids, so existing
+        files keep their lineage ids across refreshes."""
+        tracker = FileIdTracker()
+        for fi in self.previous_entry.source_file_infos():
+            tracker.add_file_info(fi)
+        return tracker
+
+    def _fingerprint(self) -> LogicalPlanFingerprint:
+        provider = create_signature_provider(self.conf.signature_provider())
+        sig = provider.signature(Scan(self.relation))
+        return LogicalPlanFingerprint([Signature(provider.name, sig)])
+
+    def log_entry(self) -> LogEntry:
+        return self._entry if self._entry is not None else self.previous_entry
+
+
+class RefreshAction(RefreshActionBase):
+    """Full rebuild from the current snapshot (RefreshAction.scala:41-53)."""
+
+    def op(self) -> None:
+        rel = self.relation
+        version = (self.data_manager.get_latest_version_id() or 0) + 1
+        tracker = self._seeded_tracker()
+        files = self.write(
+            rel,
+            self.index_config,
+            self.data_manager.get_path(version),
+            self.num_buckets,
+            self.lineage,
+            tracker,
+        )
+        indexed, included = self.resolved_columns(rel, self.index_config)
+        self._entry = self.build_log_entry(
+            self.previous_entry.name,
+            rel,
+            Scan(rel),
+            indexed,
+            included,
+            self.num_buckets,
+            self.lineage,
+            files,
+            tracker,
+        )
+
+    def event(self, message: str):
+        return RefreshActionEvent(
+            index=self.previous_entry.name, state=self.final_state, message=message
+        )
+
+
+class RefreshIncrementalAction(RefreshActionBase):
+    """(RefreshIncrementalAction.scala:58-144)."""
+
+    def validate(self) -> None:
+        super().validate()
+        if self.deleted_files and not self.lineage:
+            raise HyperspaceException(
+                "Index refresh to handle deleted source files requires lineage "
+                "(RefreshIncrementalAction.scala:110-114)."
+            )
+
+    def op(self) -> None:
+        prev = self.previous_entry
+        version = (self.data_manager.get_latest_version_id() or 0) + 1
+        version_dir = self.data_manager.get_path(version)
+        tracker = self._seeded_tracker()
+        deleted_ids = {
+            tracker.get_file_id(f.name, f.size, f.modified_time)
+            for f in self.deleted_files
+        }
+        new_files: List = []
+        indexed, included = self.resolved_columns(self.relation, self.index_config)
+
+        if self.appended_files:
+            # Index only the appended files (:58-71) — a fresh bucketed write
+            appended_rel = FileRelation(
+                self.relation.root_paths,
+                self.relation.file_format,
+                self.relation.schema,
+                self.appended_files,
+                self.relation.options,
+            )
+            batch = self.prepare_index_batch(
+                appended_rel, indexed, included, self.lineage, tracker
+            )
+            new_files.extend(
+                write_index_data(
+                    batch, indexed, self.num_buckets, version_dir,
+                    mesh=self.session.mesh,
+                )
+            )
+
+        if self.deleted_files:
+            # Rewrite existing data excluding deleted lineage ids (:73-95);
+            # per-file filtering preserves each file's bucket and order.
+            for f in prev.content.files():
+                b = layout.bucket_of_file(f)
+                batch = layout.read_batch(f)
+                ids = batch.columns[C.DATA_FILE_NAME_ID].data
+                keep = ~np.isin(ids, np.array(sorted(deleted_ids), dtype=np.int64))
+                kept = batch.take(np.flatnonzero(keep))
+                if kept.num_rows == 0:
+                    continue
+                p = version_dir / layout.bucket_file_name(b)
+                layout.write_batch(p, kept, sorted_by=indexed, bucket=b)
+                new_files.append(p)
+
+        self._entry = self.build_log_entry(
+            prev.name,
+            self.relation,
+            Scan(self.relation),
+            indexed,
+            included,
+            self.num_buckets,
+            self.lineage,
+            new_files,
+            tracker,
+        )
+        if not self.deleted_files:
+            # Appended-only: new content merges with the previous tree
+            # (:129-144).
+            self._entry.content = prev.content.merge(self._entry.content)
+
+    def event(self, message: str):
+        return RefreshIncrementalActionEvent(
+            index=self.previous_entry.name, state=self.final_state, message=message
+        )
+
+
+class RefreshQuickAction(RefreshActionBase):
+    """Metadata-only refresh (RefreshQuickAction.scala:37-79): record the
+    appended/deleted delta in the log; Hybrid Scan handles it at query
+    time."""
+
+    def validate(self) -> None:
+        super().validate()
+        if self.deleted_files and not self.lineage:
+            raise HyperspaceException(
+                "Quick refresh with deleted files requires lineage."
+            )
+
+    def op(self) -> None:
+        prev = self.previous_entry
+        appended = (
+            _content_from_file_infos(self.appended_files)
+            if self.appended_files
+            else None
+        )
+        deleted = (
+            _content_from_file_infos(self.deleted_files)
+            if self.deleted_files
+            else None
+        )
+        self._entry = prev.copy_with_update(self._fingerprint(), appended, deleted)
+
+    def event(self, message: str):
+        return RefreshQuickActionEvent(
+            index=self.previous_entry.name, state=self.final_state, message=message
+        )

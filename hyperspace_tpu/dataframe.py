@@ -1,0 +1,90 @@
+"""DataFrame: the user-facing lazy query handle over a logical plan.
+
+The reference piggybacks on Spark's DataFrame; here the framework owns it.
+``collect()`` runs the optimizer batch (when the session has Hyperspace
+enabled) and executes on the session's mesh/device. Index usage telemetry
+is emitted exactly when a rewrite fired (HyperspaceEvent.scala:150-156).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .exceptions import HyperspaceException
+from .plan.expr import Expr, col
+from .plan.ir import Filter, Join, LogicalPlan, Project
+from .session import HyperspaceSession
+from .storage.columnar import ColumnarBatch
+from .telemetry import HyperspaceIndexUsageEvent
+from .telemetry.logging import EventLogging
+
+
+class DataFrame(EventLogging):
+    def __init__(self, session: HyperspaceSession, plan: LogicalPlan):
+        self.session = session
+        self.plan = plan
+
+    # -- transformations -----------------------------------------------------
+    def filter(self, condition: Expr) -> "DataFrame":
+        return DataFrame(self.session, Filter(condition, self.plan))
+
+    where = filter
+
+    def select(self, *columns: str) -> "DataFrame":
+        missing = [
+            c for c in columns
+            if c.lower() not in {o.lower() for o in self.plan.output_columns()}
+        ]
+        if missing:
+            raise HyperspaceException(f"Unknown columns: {missing}.")
+        resolved = []
+        out = self.plan.output_columns()
+        for c in columns:
+            resolved.append(next(o for o in out if o.lower() == c.lower()))
+        return DataFrame(self.session, Project(tuple(resolved), self.plan))
+
+    def join(self, other: "DataFrame", condition: Expr, how: str = "inner") -> "DataFrame":
+        if self.session is not other.session:
+            raise HyperspaceException("Cannot join DataFrames from different sessions.")
+        return DataFrame(self.session, Join(self.plan, other.plan, condition, how))
+
+    # -- actions -------------------------------------------------------------
+    def optimized_plan(self) -> LogicalPlan:
+        """The plan after the Hyperspace rule batch (identity when
+        disabled)."""
+        if not self.session.is_hyperspace_enabled():
+            return self.plan
+        from .actions import states
+        from .plan.rules import apply_hyperspace_rules
+
+        indexes = self.session.collection_manager.get_indexes([states.ACTIVE])
+        new_plan, applied = apply_hyperspace_rules(self.plan, indexes, self.session.conf)
+        if applied:
+            self.log_event(
+                self.session.conf,
+                HyperspaceIndexUsageEvent(
+                    indexes=[e.name for e in applied],
+                    plan_before=self.plan.tree_string(),
+                    plan_after=new_plan.tree_string(),
+                ),
+            )
+        return new_plan
+
+    def collect(self) -> ColumnarBatch:
+        from .exec.executor import Executor
+
+        return Executor(self.session.conf).execute(self.optimized_plan())
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    def count(self) -> int:
+        return self.collect().num_rows
+
+    def columns(self) -> List[str]:
+        return self.plan.output_columns()
+
+    def explain(self, verbose: bool = False) -> str:
+        from .plananalysis.plan_analyzer import explain_string
+
+        return explain_string(self, verbose=verbose)

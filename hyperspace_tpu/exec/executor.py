@@ -53,9 +53,11 @@ class Executor:
     # -- dispatch ------------------------------------------------------------
     def _exec(self, plan: LogicalPlan, predicate: Optional[Expr]) -> ColumnarBatch:
         if isinstance(plan, Filter):
-            # push the predicate into the child scan where profitable
+            # push the predicate into the child scan where profitable;
+            # row-wise predicates also distribute over unions, keeping
+            # bucket/zone pruning alive on the hybrid index side
             child = plan.child
-            if isinstance(child, (IndexScan, Scan)):
+            if isinstance(child, (IndexScan, Scan, Union, BucketUnion)):
                 return self._exec(child, predicate=self._conjoin(predicate, plan.condition))
             batch = self._exec(child, None)
             return self._apply_predicate(batch, self._conjoin(predicate, plan.condition))
@@ -137,20 +139,9 @@ class Executor:
         right = self._exec(join.right, None)
         return inner_join(left, right, l_keys, r_keys)
 
-    def _scan_side_by_bucket(
-        self, plan: LogicalPlan
-    ) -> Optional[Tuple[Dict[int, ColumnarBatch], "IndexScan", Optional[Expr], Optional[Project]]]:
-        """Recognize [Project?][Filter?]IndexScan(use_bucket_spec) and load
-        its data grouped by bucket id."""
-        project: Optional[Project] = None
-        predicate: Optional[Expr] = None
-        node = plan
-        if isinstance(node, Project):
-            project, node = node, node.child
-        if isinstance(node, Filter):
-            predicate, node = node.condition, node.child
-        if not (isinstance(node, IndexScan) and node.use_bucket_spec):
-            return None
+    def _load_index_by_bucket(
+        self, node: IndexScan, predicate: Optional[Expr]
+    ) -> Dict[int, ColumnarBatch]:
         by_bucket: Dict[int, ColumnarBatch] = {}
         for f in self._index_files(node):
             b = layout.bucket_of_file(f)
@@ -163,7 +154,98 @@ class Executor:
                 by_bucket[b] = ColumnarBatch.concat([by_bucket[b], batch])
             else:
                 by_bucket[b] = batch
-        return by_bucket, node, predicate, project
+        return by_bucket
+
+    def _repartition_by_bucket(
+        self, node: Repartition, predicate: Optional[Expr]
+    ) -> Dict[int, ColumnarBatch]:
+        """Execute the child and hash its rows into the index's buckets —
+        the on-the-fly shuffle of the (small) appended side under Hybrid
+        Scan (RuleUtils.scala:519-578)."""
+        from ..ops.hashing import bucket_ids_host, key_repr
+
+        batch = self._exec(node.child, predicate)
+        if batch.num_rows == 0:
+            return {}
+        buckets = bucket_ids_host(
+            [key_repr(batch.columns[c]) for c in node.columns], node.num_buckets
+        )
+        out: Dict[int, ColumnarBatch] = {}
+        for b in np.unique(buckets):
+            out[int(b)] = batch.take(np.flatnonzero(buckets == b))
+        return out
+
+    def _bucketed_source(
+        self, plan: LogicalPlan, predicate: Optional[Expr]
+    ) -> Optional[Tuple[Dict[int, ColumnarBatch], IndexScan]]:
+        """Recognize the bucket-aligned shapes and load data grouped by
+        bucket: [Filter?]IndexScan(bucketed), Repartition(plan), or
+        BucketUnion of such (the Hybrid Scan merge)."""
+        node = plan
+        if isinstance(node, Filter):
+            predicate = self._conjoin(predicate, node.condition)
+            node = node.child
+        if isinstance(node, IndexScan) and node.use_bucket_spec:
+            return self._load_index_by_bucket(node, predicate), node
+        if isinstance(node, Project):
+            inner = self._bucketed_source(node.child, predicate)
+            if inner is None:
+                return None
+            by_bucket, idx = inner
+            return (
+                {b: v.select(list(node.columns)) for b, v in by_bucket.items()},
+                idx,
+            )
+        if isinstance(node, Repartition):
+            inner_idx = None
+            by_bucket = self._repartition_by_bucket(node, predicate)
+            return by_bucket, inner_idx
+        if isinstance(node, BucketUnion):
+            merged: Dict[int, ColumnarBatch] = {}
+            idx: Optional[IndexScan] = None
+            for c in node.children:
+                part = self._bucketed_source(c, predicate)
+                if part is None:
+                    return None
+                child_buckets, child_idx = part
+                idx = idx or child_idx
+                for b, v in child_buckets.items():
+                    if b in merged:
+                        merged[b] = ColumnarBatch.concat([merged[b], v])
+                    else:
+                        merged[b] = v
+            if idx is None:
+                return None
+            return merged, idx
+
+        return None
+
+    def _bucketed_meta(self, plan: LogicalPlan) -> Optional[IndexScan]:
+        """The bucketed IndexScan a side would load — metadata only, no
+        I/O. None when the shape isn't bucket-aligned."""
+        node = plan
+        while isinstance(node, (Project, Filter)):
+            node = node.children[0]
+        if isinstance(node, IndexScan) and node.use_bucket_spec:
+            return node
+        if isinstance(node, BucketUnion):
+            for c in node.children:
+                idx = self._bucketed_meta(c)
+                if idx is not None:
+                    return idx
+        return None
+
+    def _scan_side_by_bucket(self, plan: LogicalPlan):
+        """[Project?] over a bucketed source (index scan / hybrid union)."""
+        project: Optional[Project] = None
+        node = plan
+        if isinstance(node, Project):
+            project, node = node, node.child
+        inner = self._bucketed_source(node, None)
+        if inner is None or inner[1] is None:
+            return None
+        by_bucket, idx_node = inner
+        return by_bucket, idx_node, project
 
     def _try_bucketed_join(
         self, join: Join, l_keys: List[str], r_keys: List[str]
@@ -172,24 +254,29 @@ class Executor:
         scans with the same numBuckets, and the join keys are exactly the
         indexed (bucketing) columns — so equal keys share a bucket id on
         both sides (the hash is value-stable, ops.hashing)."""
-        left = self._scan_side_by_bucket(join.left)
-        right = self._scan_side_by_bucket(join.right)
-        if left is None or right is None:
+        # Cheap metadata compatibility first — only then pay the I/O.
+        l_meta = self._bucketed_meta(join.left)
+        r_meta = self._bucketed_meta(join.right)
+        if l_meta is None or r_meta is None:
             return None
-        l_by_bucket, l_node, _, l_project = left
-        r_by_bucket, r_node, _, r_project = right
-        if l_node.entry.num_buckets != r_node.entry.num_buckets:
+        if l_meta.entry.num_buckets != r_meta.entry.num_buckets:
             return None
         # Keys must equal the bucketing (indexed) columns as a set; the merge
         # itself runs in *index order* so both sides hash and compare the
         # same tuple order (compatible_pairs guarantees the right index's
         # order aligns under the l↔r mapping).
-        if {c.lower() for c in l_node.entry.indexed_columns} != {
+        if {c.lower() for c in l_meta.entry.indexed_columns} != {
             k.lower() for k in l_keys
-        } or {c.lower() for c in r_node.entry.indexed_columns} != {
+        } or {c.lower() for c in r_meta.entry.indexed_columns} != {
             k.lower() for k in r_keys
         }:
             return None
+        left = self._scan_side_by_bucket(join.left)
+        right = self._scan_side_by_bucket(join.right)
+        if left is None or right is None:
+            return None
+        l_by_bucket, l_node, l_project = left
+        r_by_bucket, r_node, r_project = right
         l2r = {l.lower(): r for l, r in zip(l_keys, r_keys)}
         l_keys = list(l_node.entry.indexed_columns)
         r_keys = [l2r[k.lower()] for k in l_keys]
@@ -203,16 +290,7 @@ class Executor:
             }
         parts = bucketed_join_pairs(l_by_bucket, r_by_bucket, l_keys, r_keys)
         if not parts:
-            # empty join result with the combined schema
-            l_any = next(iter(l_by_bucket.values()), None)
-            r_any = next(iter(r_by_bucket.values()), None)
-            if l_any is None or r_any is None:
-                raise HyperspaceException("Bucketed join over empty sides.")
-            empty = inner_join(
-                l_any.take(np.array([], dtype=np.int64)),
-                r_any.take(np.array([], dtype=np.int64)),
-                l_keys,
-                r_keys,
-            )
-            return empty
+            # no matching buckets (or an empty side): fall back to the
+            # general path, which produces the correctly-shaped empty result
+            return None
         return ColumnarBatch.concat(parts)

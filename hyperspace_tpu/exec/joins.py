@@ -32,11 +32,16 @@ def _exact_codes(l_col: Column, r_col: Column) -> Tuple[np.ndarray, np.ndarray]:
         lu, ru = unify_dictionaries([l_col, r_col])
         return lu.data.astype(np.int64), ru.data.astype(np.int64)
     l, r = l_col.data, r_col.data
-    if l.dtype.kind == "f" or r.dtype.kind == "f":
-        lf = l.astype(np.float64)
-        rf = r.astype(np.float64)
-        lf = np.where(lf == 0.0, 0.0, lf)
-        rf = np.where(rf == 0.0, 0.0, rf)
+    if (l.dtype.kind == "f") != (r.dtype.kind == "f"):
+        # int64↔float64 cannot be compared exactly above 2^53; refusing
+        # beats silently collapsing distinct keys into spurious matches
+        raise HyperspaceException(
+            f"Join key dtype mismatch ({l.dtype} vs {r.dtype}): exact "
+            "comparison between integer and float keys is not supported."
+        )
+    if l.dtype.kind == "f":
+        lf = np.where(l == 0.0, 0.0, l.astype(np.float64))
+        rf = np.where(r == 0.0, 0.0, r.astype(np.float64))
         return lf.view(np.int64), rf.view(np.int64)
     return l.astype(np.int64), r.astype(np.int64)
 

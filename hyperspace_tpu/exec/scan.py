@@ -80,49 +80,41 @@ def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
     if any(batch.columns[n_].dtype_str == "float64" for n_ in names):
         return np.asarray(eval_mask(predicate, batch))
 
-    import hashlib
-
     import jax
+
+    from ..plan.expr import bind_string_literals
 
     n = batch.num_rows
     n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
     host_arrays = {
         name: np.pad(batch.columns[name].data, (0, n_pad - n)) for name in names
     }
-    # Cache key: expression + array signature + dictionary CONTENT (string
-    # literals are resolved against the batch's dictionary at trace time, so
-    # two files with identical vocabs share a compiled fn; id()-based keys
-    # would miss on every file).
-    dict_key = tuple(
-        (
-            name,
-            hashlib.md5(b"\0".join(batch.columns[name].vocab)).hexdigest(),
-        )
-        for name in names
-        if batch.columns[name].vocab is not None
-    )
+    # String literals are pre-bound to this batch's dictionary codes, so the
+    # bound expression is pure int arithmetic: the cache key is just the
+    # bound expression + array signature, and the cached closure pins no
+    # vocabulary (files with identical dictionaries — or none — share a
+    # compiled fn through the identical bound repr).
+    bound = bind_string_literals(predicate, batch)
     key = (
-        repr(predicate),
+        repr(bound),
         n_pad,
         tuple((name, str(a.dtype)) for name, a in host_arrays.items()),
-        dict_key,
     )
     fn = _mask_fn_cache.get(key)
     if fn is None:
-        # Close over a rows-free schema shim, not the batch — caching the
-        # closure must not pin file-sized column data.
+        # rows-free, vocab-free schema shim: code columns act as int32
         shim = ColumnarBatch(
             {
-                name: Column(
-                    c.dtype_str,
-                    np.empty(0, dtype=c.data.dtype),
-                    c.vocab,
+                name: Column("int32", np.empty(0, dtype=np.int32))
+                if batch.columns[name].vocab is not None
+                else Column(
+                    batch.columns[name].dtype_str,
+                    np.empty(0, dtype=batch.columns[name].data.dtype),
                 )
-                for name, c in batch.columns.items()
-                if name in names
+                for name in names
             }
         )
-        fn = jax.jit(lambda arrays: eval_mask(predicate, shim, arrays))
+        fn = jax.jit(lambda arrays: eval_mask(bound, shim, arrays))
         if len(_mask_fn_cache) >= 512:
             _mask_fn_cache.pop(next(iter(_mask_fn_cache)))  # evict oldest
         _mask_fn_cache[key] = fn

@@ -1,0 +1,64 @@
+"""The Hyperspace facade: the ten management verbs plus explain.
+
+Parity: com/microsoft/hyperspace/Hyperspace.scala:34-165 — a thin facade
+over the (caching) IndexCollectionManager bound to a session. This is the
+object a reference user lands on; verb names keep their camelCase aliases
+so reference code ports line-for-line.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import constants as C
+from .dataframe import DataFrame
+from .index.index_config import IndexConfig
+from .index.stats import IndexStatistics
+from .session import HyperspaceSession
+
+
+class Hyperspace:
+    def __init__(self, session: HyperspaceSession):
+        self.session = session
+        self._manager = session.collection_manager
+
+    # -- lifecycle verbs (Hyperspace.scala:34-141) ---------------------------
+    def indexes(self) -> List[IndexStatistics]:
+        return self._manager.indexes()
+
+    def create_index(self, df: DataFrame, config: IndexConfig) -> None:
+        self._manager.create(df, config)
+
+    def delete_index(self, name: str) -> None:
+        self._manager.delete(name)
+
+    def restore_index(self, name: str) -> None:
+        self._manager.restore(name)
+
+    def vacuum_index(self, name: str) -> None:
+        self._manager.vacuum(name)
+
+    def refresh_index(self, name: str, mode: str = C.REFRESH_MODE_FULL) -> None:
+        self._manager.refresh(name, mode)
+
+    def optimize_index(self, name: str, mode: str = C.OPTIMIZE_MODE_QUICK) -> None:
+        self._manager.optimize(name, mode)
+
+    def cancel(self, name: str) -> None:
+        self._manager.cancel(name)
+
+    def index(self, name: str) -> IndexStatistics:
+        return self._manager.index(name)
+
+    def explain(self, df: DataFrame, verbose: bool = False) -> str:
+        from .plananalysis.plan_analyzer import explain_string
+
+        return explain_string(df, verbose=verbose)
+
+    # camelCase aliases for reference-API parity
+    createIndex = create_index
+    deleteIndex = delete_index
+    restoreIndex = restore_index
+    vacuumIndex = vacuum_index
+    refreshIndex = refresh_index
+    optimizeIndex = optimize_index

@@ -1,0 +1,190 @@
+"""IndexCollectionManager: dispatches every management verb to the right
+action with per-index log/data managers, and enumerates indexes.
+
+Parity: com/microsoft/hyperspace/index/IndexCollectionManager.scala:36-152
+and CachingIndexCollectionManager.scala:38-106 (TTL cache over getIndexes;
+every mutating verb clears it).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional
+
+from .. import constants as C
+from ..actions import states
+from ..actions.create import CreateAction
+from ..actions.metadata_actions import (
+    CancelAction,
+    DeleteAction,
+    RestoreAction,
+    VacuumAction,
+)
+from ..actions.optimize import OptimizeAction
+from ..actions.refresh import (
+    RefreshAction,
+    RefreshIncrementalAction,
+    RefreshQuickAction,
+)
+from ..exceptions import HyperspaceException
+from ..index.index_config import IndexConfig
+from ..index.log_entry import IndexLogEntry
+from .cache import CreationTimeBasedCache
+from .data_manager import IndexDataManagerImpl
+from .log_manager import IndexLogManagerImpl
+from .path_resolver import PathResolver
+from .stats import IndexStatistics
+
+
+class IndexCollectionManager:
+    def __init__(self, session):
+        self.session = session
+        self.conf = session.conf
+        self.path_resolver = PathResolver(self.conf)
+
+    # -- per-index managers ---------------------------------------------------
+    def _log_manager(self, name: str) -> IndexLogManagerImpl:
+        return IndexLogManagerImpl(self.path_resolver.get_index_path(name))
+
+    def _data_manager(self, name: str) -> IndexDataManagerImpl:
+        return IndexDataManagerImpl(self.path_resolver.get_index_path(name))
+
+    def _existing_log_manager(self, name: str) -> IndexLogManagerImpl:
+        mgr = self._log_manager(name)
+        if mgr.get_latest_id() is None:
+            raise HyperspaceException(f"Index with name {name} could not be found.")
+        return mgr
+
+    # -- verbs (IndexCollectionManager.scala:36-107) --------------------------
+    def create(self, df, config: IndexConfig) -> None:
+        CreateAction(
+            self.session,
+            df,
+            config,
+            self._log_manager(config.index_name),
+            self._data_manager(config.index_name),
+        ).run()
+
+    def delete(self, name: str) -> None:
+        DeleteAction(self._existing_log_manager(name), self.conf).run()
+
+    def restore(self, name: str) -> None:
+        RestoreAction(self._existing_log_manager(name), self.conf).run()
+
+    def vacuum(self, name: str) -> None:
+        VacuumAction(
+            self._existing_log_manager(name), self._data_manager(name), self.conf
+        ).run()
+
+    def refresh(self, name: str, mode: str = C.REFRESH_MODE_FULL) -> None:
+        mgr = self._existing_log_manager(name)
+        data = self._data_manager(name)
+        mode = mode.lower()
+        if mode == C.REFRESH_MODE_FULL:
+            RefreshAction(self.session, mgr, data).run()
+        elif mode == C.REFRESH_MODE_INCREMENTAL:
+            RefreshIncrementalAction(self.session, mgr, data).run()
+        elif mode == C.REFRESH_MODE_QUICK:
+            RefreshQuickAction(self.session, mgr, data).run()
+        else:
+            raise HyperspaceException(
+                f"Unsupported refresh mode {mode!r}; supported modes are "
+                f"{C.REFRESH_MODES}."
+            )
+
+    def optimize(self, name: str, mode: str = C.OPTIMIZE_MODE_QUICK) -> None:
+        OptimizeAction(
+            self.session, self._existing_log_manager(name), self._data_manager(name), mode
+        ).run()
+
+    def cancel(self, name: str) -> None:
+        CancelAction(self._existing_log_manager(name), self.conf).run()
+
+    # -- enumeration (IndexCollectionManager.scala:109-152) -------------------
+    def get_indexes(
+        self, states_filter: Optional[List[str]] = None
+    ) -> List[IndexLogEntry]:
+        out: List[IndexLogEntry] = []
+        root = self.path_resolver.system_path
+        if not root.is_dir():
+            return out
+        for d in sorted(root.iterdir()):
+            if not d.is_dir():
+                continue
+            entry = IndexLogManagerImpl(d).get_latest_log()
+            if entry is None:
+                continue
+            if states_filter is None or entry.state in states_filter:
+                out.append(entry)
+        return out
+
+    def indexes(self) -> List[IndexStatistics]:
+        """Summary rows of non-DOESNOTEXIST indexes
+        (IndexCollectionManager.scala:109-118)."""
+        return [
+            IndexStatistics.from_entry(e)
+            for e in self.get_indexes()
+            if e.state != states.DOESNOTEXIST
+        ]
+
+    def index(self, name: str) -> IndexStatistics:
+        entry = self._existing_log_manager(name).get_latest_log()
+        return IndexStatistics.from_entry(entry, extended=True)
+
+
+class CachingIndexCollectionManager(IndexCollectionManager):
+    """TTL cache over get_indexes; mutating verbs clear it
+    (CachingIndexCollectionManager.scala:38-106)."""
+
+    def __init__(self, session):
+        super().__init__(session)
+        self._cache: CreationTimeBasedCache[List[IndexLogEntry]] = (
+            CreationTimeBasedCache(self.conf.cache_expiry_seconds)
+        )
+
+    def clear_cache(self) -> None:
+        self._cache.clear()
+
+    def get_indexes(self, states_filter=None):
+        cached = self._cache.get()
+        if cached is None:
+            cached = super().get_indexes(None)
+            self._cache.set(cached)
+        if states_filter is None:
+            return list(cached)
+        return [e for e in cached if e.state in states_filter]
+
+    def create(self, df, config):
+        self.clear_cache()
+        super().create(df, config)
+        self.clear_cache()
+
+    def delete(self, name):
+        self.clear_cache()
+        super().delete(name)
+        self.clear_cache()
+
+    def restore(self, name):
+        self.clear_cache()
+        super().restore(name)
+        self.clear_cache()
+
+    def vacuum(self, name):
+        self.clear_cache()
+        super().vacuum(name)
+        self.clear_cache()
+
+    def refresh(self, name, mode=C.REFRESH_MODE_FULL):
+        self.clear_cache()
+        super().refresh(name, mode)
+        self.clear_cache()
+
+    def optimize(self, name, mode=C.OPTIMIZE_MODE_QUICK):
+        self.clear_cache()
+        super().optimize(name, mode)
+        self.clear_cache()
+
+    def cancel(self, name):
+        self.clear_cache()
+        super().cancel(name)
+        self.clear_cache()

@@ -17,7 +17,7 @@ NULL semantics: string NULLs are code -1; every comparison excludes them
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, FrozenSet, List
+from typing import Any, FrozenSet, List, Optional
 
 import numpy as np
 
@@ -291,6 +291,72 @@ def eval_mask(expr: Expr, batch: ColumnarBatch, arrays=None):
         return m
 
     return ev(expr)
+
+
+def bind_string_literals(expr: Expr, batch: ColumnarBatch) -> Expr:
+    """Rewrite ``expr`` so every string comparison becomes a pure code-space
+    (int32) comparison against this batch's dictionary.
+
+    The result references no vocabulary at evaluation time — string columns
+    act as plain int32 code columns — which lets a jitted evaluator close
+    over only the bound expression, not the (potentially file-sized) vocab.
+    NULL codes (-1) are excluded exactly as eval_mask does."""
+
+    def is_str_col(e: Expr) -> bool:
+        return (
+            isinstance(e, Col)
+            and e.name in batch.columns
+            and is_string(batch.columns[e.name].dtype_str)
+        )
+
+    def never(c: Col) -> Expr:
+        return Cmp("lt", c, Lit(-1))  # codes are >= -1: always False
+
+    def walk(e: Expr) -> Expr:
+        if isinstance(e, And):
+            return And(walk(e.left), walk(e.right))
+        if isinstance(e, Or):
+            return Or(walk(e.left), walk(e.right))
+        if isinstance(e, Not):
+            return Not(walk(e.child))
+        if isinstance(e, Cmp):
+            left, right, op = e.left, e.right, e.op
+            if isinstance(left, Lit) and isinstance(right, Col):
+                left, right, op = right, left, _SWAP[op]
+            if is_str_col(left) and isinstance(right, Lit):
+                vocab = batch.columns[left.name].vocab
+                cop, bound, always = _string_cmp_codes(op, vocab, right.value)
+                if always is False:
+                    return never(left)
+                if always is True:
+                    return Cmp("ge", left, Lit(0))  # any non-NULL
+                return And(Cmp(cop, left, Lit(bound)), Cmp("ge", left, Lit(0)))
+            if is_str_col(left) and is_str_col(right):
+                lc, rc = batch.columns[left.name], batch.columns[right.name]
+                if lc.vocab is not rc.vocab and not np.array_equal(lc.vocab, rc.vocab):
+                    raise HyperspaceException(
+                        "String col-col comparison requires a unified dictionary."
+                    )
+                return And(
+                    And(Cmp(op, left, right), Cmp("ge", left, Lit(0))),
+                    Cmp("ge", right, Lit(0)),
+                )
+            return e
+        if isinstance(e, In) and is_str_col(e.child):
+            vocab = batch.columns[e.child.name].vocab
+            out: Optional[Expr] = None
+            for v in e.values:
+                cop, bound, always = _string_cmp_codes("eq", vocab, v)
+                if always is not None:
+                    continue
+                term = Cmp(cop, e.child, Lit(bound))
+                out = term if out is None else Or(out, term)
+            if out is None:
+                return never(e.child)
+            return And(out, Cmp("ge", e.child, Lit(0)))
+        return e
+
+    return walk(expr)
 
 
 def pinned_values(expr: Expr, column: str):

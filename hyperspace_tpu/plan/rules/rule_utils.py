@@ -52,16 +52,22 @@ def is_linear(plan: LogicalPlan) -> bool:
 def _signature_valid(
     entry: IndexLogEntry, plan: LogicalPlan, conf: HyperspaceConf
 ) -> bool:
-    """Recompute the plan signature and compare with the stored fingerprint
-    (RuleUtils.scala:61-76), memoized per (entry, plan) via tags."""
+    """Recompute the signature over the plan's *relation* (its Scan node)
+    and compare with the stored fingerprint (RuleUtils.scala:61-76 — the
+    reference fingerprints the relation's logical plan, which is why an
+    index created over ``read.parquet(...)`` matches any Filter/Project
+    above the same relation). Memoized per (entry, scan) via tags."""
+    scan = single_scan(plan)
+    if scan is None:
+        return False
 
     def compute() -> bool:
         stored = entry.signature()
         provider = create_signature_provider(stored.provider)
-        current = provider.signature(plan)
+        current = provider.signature(scan)
         return current is not None and current == stored.value
 
-    return entry.with_cached_tag(plan, TAG_SIGNATURE_MATCHED, compute)
+    return entry.with_cached_tag(scan, TAG_SIGNATURE_MATCHED, compute)
 
 
 def _hybrid_scan_candidate(
@@ -143,7 +149,20 @@ def transform_plan_to_use_index(
     hybrid_required = (
         scan is not None and entry.get_tag_value(scan, TAG_HYBRIDSCAN_REQUIRED)
     ) or entry.get_tag_value(plan, TAG_HYBRIDSCAN_REQUIRED)
-    if conf.hybrid_scan_enabled() and hybrid_required:
+    # A quick-refreshed entry carries a recorded source Update: its
+    # fingerprint matches the *current* files, so it is selected via the
+    # signature path even with Hybrid Scan disabled — but using it without
+    # the hybrid transformation would drop appended rows / resurrect
+    # deleted ones (RefreshQuickAction.scala:70-79 semantics).
+    has_recorded_update = False
+    if scan is not None:
+        upd = entry.source_update()
+        if upd is not None and (upd.appended_files or upd.deleted_files):
+            from .hybrid_scan import source_delta
+
+            appended, deleted = source_delta(entry, scan)
+            has_recorded_update = bool(appended or deleted)
+    if (conf.hybrid_scan_enabled() and hybrid_required) or has_recorded_update:
         from .hybrid_scan import transform_plan_to_use_hybrid_scan
 
         return transform_plan_to_use_hybrid_scan(entry, plan, use_bucket_spec, conf)

@@ -1,0 +1,104 @@
+"""Explain engine: show a query's plan with and without Hyperspace, which
+indexes fire, and (verbose) an operator-count diff.
+
+Parity: com/microsoft/hyperspace/index/plananalysis/PlanAnalyzer.scala
+(412 LoC): the plan is built twice — Hyperspace disabled / enabled
+(:46-130) — differing subtrees are highlighted with ``<---->`` markers
+(PlainText display mode, DisplayMode.scala:24-88), an "Indexes used"
+section lists applied indexes, and verbose mode appends the physical-
+operator comparison of PhysicalOperatorAnalyzer.scala:30-57.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import List, Tuple
+
+from ..plan.ir import IndexScan, LogicalPlan
+from ..plan.rules import apply_hyperspace_rules
+from ..actions import states
+
+HIGHLIGHT_BEGIN = "<----"
+HIGHLIGHT_END = "---->"
+
+
+def _plan_lines(plan: LogicalPlan, other: LogicalPlan) -> List[str]:
+    """Tree lines of ``plan``, highlighting subtrees that differ from
+    ``other`` (queue-walk diff of PlanAnalyzer.scala:60-105)."""
+    other_subtrees = set()
+
+    def collect(node: LogicalPlan) -> None:
+        other_subtrees.add(node.tree_string())
+        for c in node.children:
+            collect(c)
+
+    collect(other)
+
+    lines: List[str] = []
+
+    def walk(node: LogicalPlan, indent: int) -> None:
+        subtree = node.tree_string()
+        line = "  " * indent + node.describe()
+        if subtree not in other_subtrees:
+            line = f"{HIGHLIGHT_BEGIN}{line}{HIGHLIGHT_END}"
+        lines.append(line)
+        for c in node.children:
+            walk(c, indent + 1)
+
+    walk(plan, 0)
+    return lines
+
+
+def _operator_counts(plan: LogicalPlan) -> Counter:
+    counts: Counter = Counter()
+
+    def walk(node: LogicalPlan) -> None:
+        counts[node.node_name] += 1
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return counts
+
+
+def explain_string(df, verbose: bool = False) -> str:
+    """(PlanAnalyzer.explainString). Works whether or not the session has
+    Hyperspace enabled — both plans are compiled here."""
+    session = df.session
+    indexes = session.collection_manager.get_indexes([states.ACTIVE])
+    plan_off = df.plan
+    plan_on, applied = apply_hyperspace_rules(plan_off, indexes, session.conf)
+
+    buf: List[str] = []
+    buf.append("=============================================================")
+    buf.append("Plan with indexes:")
+    buf.append("=============================================================")
+    buf.extend(_plan_lines(plan_on, plan_off))
+    buf.append("")
+    buf.append("=============================================================")
+    buf.append("Plan without indexes:")
+    buf.append("=============================================================")
+    buf.extend(_plan_lines(plan_off, plan_on))
+    buf.append("")
+    buf.append("=============================================================")
+    buf.append("Indexes used:")
+    buf.append("=============================================================")
+    for e in applied:
+        loc = e.content.files()
+        loc_str = loc[0].rsplit("/", 1)[0] if loc else ""
+        buf.append(f"{e.name}:{loc_str}")
+    buf.append("")
+
+    if verbose:
+        on_counts = _operator_counts(plan_on)
+        off_counts = _operator_counts(plan_off)
+        buf.append("=============================================================")
+        buf.append("Physical operator stats:")
+        buf.append("=============================================================")
+        header = f"{'Physical Operator':<30}{'Hyperspace(On)':>15}{'Hyperspace(Off)':>16}{'Difference':>11}"
+        buf.append(header)
+        for op in sorted(set(on_counts) | set(off_counts)):
+            on_c, off_c = on_counts.get(op, 0), off_counts.get(op, 0)
+            buf.append(f"{op:<30}{on_c:>15}{off_c:>16}{on_c - off_c:>11}")
+        buf.append("")
+    return "\n".join(buf)

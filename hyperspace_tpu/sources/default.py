@@ -1,0 +1,97 @@
+"""Default file-based source provider: parquet / csv / json directories.
+
+Parity: com/microsoft/hyperspace/index/sources/default/
+DefaultFileBasedSource.scala (325 LoC) — the allowlisted-format provider
+that snapshots plain file directories. Schema inference reads one file's
+footer via pyarrow (the analog of Spark's format inference).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from .. import constants as C
+from ..exceptions import HyperspaceException
+from ..index.log_entry import Content, FileIdTracker, FileInfo, Relation
+from ..utils import file_utils
+from .interfaces import FileBasedSourceProvider
+from .relation import FileRelation
+
+
+def _infer_schema(file_format: str, sample_path: str) -> Dict[str, str]:
+    from ..storage import parquet_io
+
+    batch = parquet_io.read_files(file_format, [sample_path])
+    return batch.schema()
+
+
+def _snapshot_files(root_paths: List[str]) -> List[FileInfo]:
+    tracker = FileIdTracker()
+    paths = [str(p) for p in file_utils.list_leaf_files(root_paths)]
+    content = Content.from_leaf_files(paths, tracker)
+    return content.file_infos() if content else []
+
+
+class DefaultFileBasedSource(FileBasedSourceProvider):
+    """Formats in the allowlist (DefaultFileBasedSource.scala:42-48; ours
+    is constants.DEFAULT_SUPPORTED_FORMATS since only pyarrow-readable
+    formats execute)."""
+
+    def supports_format(self, file_format: str) -> bool:
+        return file_format.lower() in C.DEFAULT_SUPPORTED_FORMATS
+
+    def create_relation(
+        self,
+        root_paths: List[str],
+        file_format: str,
+        options: Optional[Dict[str, str]] = None,
+        schema: Optional[Dict[str, str]] = None,
+    ) -> Optional[FileRelation]:
+        if not self.supports_format(file_format):
+            return None
+        files = _snapshot_files(root_paths)
+        if schema is None:
+            if not files:
+                raise HyperspaceException(
+                    f"Cannot infer schema: no files under {root_paths}."
+                )
+            schema = _infer_schema(file_format, files[0].name)
+        return FileRelation(
+            root_paths=[str(Path(p).absolute()) for p in root_paths],
+            file_format=file_format,
+            schema=schema,
+            files=files,
+            options=dict(options or {}),
+        )
+
+    def refresh_relation(self, relation: Relation) -> Optional[FileRelation]:
+        """(DefaultFileBasedSource.scala:156-163): re-list the logged root
+        paths with the logged schema/options."""
+        if not self.supports_format(relation.file_format):
+            return None
+        return FileRelation(
+            root_paths=list(relation.root_paths),
+            file_format=relation.file_format,
+            schema=dict(relation.schema),
+            files=_snapshot_files(relation.root_paths),
+            options=dict(relation.options),
+        )
+
+    def all_files(self, relation: FileRelation) -> Optional[List[FileInfo]]:
+        if not self.supports_format(relation.file_format):
+            return None
+        return _snapshot_files(relation.root_paths)
+
+    def lineage_pairs(
+        self, relation: FileRelation, tracker: FileIdTracker
+    ) -> Optional[List[Tuple[str, int]]]:
+        """(DefaultFileBasedSource.scala:263-275): ids from the shared
+        FileIdTracker, one per current leaf file."""
+        if not self.supports_format(relation.file_format):
+            return None
+        out = []
+        for f in relation.files:
+            fid = tracker.add_file(f.name, f.size, f.modified_time)
+            out.append((f.name, fid))
+        return out

@@ -78,8 +78,7 @@ def build_index(
     content = Content.from_leaf_files([str(f) for f in files], tracker)
     src_tracker = FileIdTracker()
     src_content = Content.from_leaf_files([f.name for f in rel.files], src_tracker)
-    plan = plan_for_sig if plan_for_sig is not None else Scan(rel)
-    sig = IndexSignatureProvider().signature(plan)
+    sig = IndexSignatureProvider().signature(scan_for_signature(plan_for_sig, rel))
     schema = {c: rel.schema[c] for c in indexed + included}
     entry = IndexLogEntry(
         name,
@@ -101,6 +100,17 @@ def build_index(
     entry.state = states.ACTIVE
     entry.id = 1
     return entry
+
+
+def scan_for_signature(plan_for_sig, rel: FileRelation) -> Scan:
+    """Signatures cover the relation's Scan only (rules re-derive the scan
+    from any Filter/Project shape above it) — shared by the rule-tier and
+    e2e-tier fabricators."""
+    if plan_for_sig is not None:
+        scans = plan_for_sig.collect(lambda n: isinstance(n, Scan))
+        if scans:
+            return scans[0]
+    return Scan(rel)
 
 
 def rows_sorted(batch: ColumnarBatch) -> List[tuple]:

@@ -1,0 +1,11 @@
+"""MockEventLogger: captures telemetry events for assertions (the analog of
+TestUtils.MockEventLogger, TestUtils.scala:108-126)."""
+
+from hyperspace_tpu.telemetry.logging import EventLogger
+
+EVENTS = []
+
+
+class MockEventLogger(EventLogger):
+    def log_event(self, event):
+        EVENTS.append(event)

@@ -1,0 +1,181 @@
+"""Hybrid Scan matrix — the analog of the reference's HybridScanSuite (663
+LoC): append-only vs append+delete × filter vs join × quick-refresh
+recorded deltas, with `checkAnswer`-style row parity throughout.
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.ir import BucketUnion, IndexScan, Union
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from tests.e2e_utils import assert_row_parity
+from tests.test_lifecycle import sample_batch
+
+
+@pytest.fixture
+def env(tmp_path):
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.INDEX_NUM_BUCKETS: 4,
+            C.INDEX_HYBRID_SCAN_ENABLED: True,
+            C.INDEX_LINEAGE_ENABLED: True,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", sample_batch(300, 1))
+    parquet_io.write_parquet(src / "part-1.parquet", sample_batch(300, 2))
+    return session, hs, src, tmp_path
+
+
+def fquery(session, src):
+    return (
+        session.read.parquet(str(src))
+        .filter(col("orderkey") == 7)
+        .select("orderkey", "qty")
+    )
+
+
+def test_hybrid_scan_append_only_filter(env):
+    session, hs, src, _ = env
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("idx", ["orderkey"], ["qty"]))
+    # append within the 0.3 byte-ratio threshold
+    parquet_io.write_parquet(src / "part-9.parquet", sample_batch(60, 9))
+    q = fquery(session, src)
+    session.enable_hyperspace()
+    plan = q.optimized_plan()
+    assert plan.collect(lambda n: isinstance(n, IndexScan))
+    assert plan.collect(lambda n: isinstance(n, Union))  # hybrid union shape
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    assert_row_parity(off, q.collect())
+
+
+def test_hybrid_scan_respects_appended_ratio_threshold(env):
+    session, hs, src, _ = env
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("idx", ["orderkey"], ["qty"]))
+    # append far beyond the byte-ratio threshold: no rewrite at all
+    parquet_io.write_parquet(src / "part-9.parquet", sample_batch(3000, 9))
+    session.enable_hyperspace()
+    plan = fquery(session, src).optimized_plan()
+    assert not plan.collect(lambda n: isinstance(n, IndexScan))
+
+
+def test_hybrid_scan_append_and_delete_filter(env):
+    session, hs, src, _ = env
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("idx", ["orderkey"], ["qty"]))
+    parquet_io.write_parquet(src / "part-9.parquet", sample_batch(50, 9))
+    (src / "part-1.parquet").unlink()  # delete within 0.2 ratio? 300/600 bytes = 0.5 -> over!
+    session.enable_hyperspace()
+    plan = fquery(session, src).optimized_plan()
+    # deleted ratio 0.5 > 0.2 -> not a candidate
+    assert not plan.collect(lambda n: isinstance(n, IndexScan))
+
+
+def test_hybrid_scan_small_delete_filter(env):
+    session, hs, src, tmp = env
+    # three files so deleting one stays under the 0.2... 1/3=0.33 still over.
+    # use an explicitly raised threshold to exercise the delete path.
+    session.conf.set(C.INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD, 0.6)
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("idx", ["orderkey"], ["qty"]))
+    (src / "part-1.parquet").unlink()
+    q = fquery(session, src)
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    plan = q.optimized_plan()
+    assert plan.collect(lambda n: isinstance(n, IndexScan))
+    on = q.collect()
+    assert_row_parity(off, on)
+    # deleted rows are actually gone (compare against full original)
+    full = parquet_io.read_parquet([src / "part-0.parquet"])
+    exp = int((full.columns["orderkey"].data == 7).sum())
+    assert on.num_rows == exp
+
+
+def test_hybrid_scan_delete_requires_lineage(env):
+    session, hs, src, _ = env
+    session.conf.set(C.INDEX_LINEAGE_ENABLED, False)
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("idx", ["orderkey"], ["qty"]))
+    session.conf.set(C.INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD, 0.9)
+    (src / "part-1.parquet").unlink()
+    session.enable_hyperspace()
+    plan = fquery(session, src).optimized_plan()
+    assert not plan.collect(lambda n: isinstance(n, IndexScan))
+
+
+def test_hybrid_scan_join_bucket_union(env):
+    session, hs, src, tmp = env
+    od_src = tmp / "orders"
+    od_src.mkdir()
+    rng = np.random.default_rng(5)
+    orders = ColumnarBatch.from_pydict(
+        {
+            "o_orderkey": rng.permutation(100).astype(np.int64),
+            "o_total": (rng.random(100) * 100).round(2),
+        },
+        schema={"o_orderkey": "int64", "o_total": "float64"},
+    )
+    parquet_io.write_parquet(od_src / "part-0.parquet", orders)
+    li_df = session.read.parquet(str(src))
+    od_df = session.read.parquet(str(od_src))
+    hs.create_index(li_df, IndexConfig("li_idx", ["orderkey"], ["qty"]))
+    hs.create_index(od_df, IndexConfig("od_idx", ["o_orderkey"], ["o_total"]))
+    # append to lineitem only
+    parquet_io.write_parquet(src / "part-9.parquet", sample_batch(60, 10))
+    q = (
+        session.read.parquet(str(src))
+        .select("orderkey", "qty")
+        .join(
+            session.read.parquet(str(od_src)).select("o_orderkey", "o_total"),
+            col("orderkey") == col("o_orderkey"),
+        )
+    )
+    session.enable_hyperspace()
+    plan = q.optimized_plan()
+    idx_scans = plan.collect(lambda n: isinstance(n, IndexScan))
+    assert len(idx_scans) == 2
+    assert plan.collect(lambda n: isinstance(n, BucketUnion))  # appended side shuffled in
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    assert_row_parity(off, q.collect())
+
+
+def test_quick_refresh_then_hybrid_query(env):
+    session, hs, src, tmp = env
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("idx", ["orderkey"], ["qty"]))
+    parquet_io.write_parquet(src / "part-9.parquet", sample_batch(60, 12))
+    hs.refresh_index("idx", "quick")
+    # even with hybrid scan DISABLED, the recorded update must produce
+    # correct (hybrid) results via the signature path
+    session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, False)
+    q = fquery(session, src)
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    plan = q.optimized_plan()
+    assert plan.collect(lambda n: isinstance(n, IndexScan))
+    assert_row_parity(off, q.collect())
+
+
+def test_hybrid_scan_no_common_files_no_candidate(env):
+    session, hs, src, tmp = env
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("idx", ["orderkey"], ["qty"]))
+    other = tmp / "other"
+    other.mkdir()
+    parquet_io.write_parquet(other / "part-0.parquet", sample_batch(100, 3))
+    session.enable_hyperspace()
+    plan = fquery(session, other).optimized_plan()
+    assert not plan.collect(lambda n: isinstance(n, IndexScan))

@@ -71,9 +71,10 @@ def fabricate_entry(
     lineage=False,
 ):
     """HyperspaceRuleSuite.createIndexLogEntry analog: entry whose signature
-    matches ``plan_for_sig`` (default: Scan(rel))."""
-    plan = plan_for_sig if plan_for_sig is not None else Scan(rel)
-    sig = IndexSignatureProvider().signature(plan)
+    matches the relation Scan inside ``plan_for_sig`` (default: Scan(rel))."""
+    from tests.e2e_utils import scan_for_signature
+
+    sig = IndexSignatureProvider().signature(scan_for_signature(plan_for_sig, rel))
     content = Content(
         Directory(
             "/",
