@@ -1,0 +1,161 @@
+"""Benchmark: index-accelerated point-lookup vs full scan, at row parity.
+
+Implements config 2 of BASELINE.md (FilterIndexRule single-predicate
+lookup on the indexed column): build a covering index on a synthetic
+TPC-H-like lineitem, run the same filter query with Hyperspace off (full
+parquet scan) and on (bucket-pruned, zone-mapped TCB index scan), assert
+row parity, and report the wall-clock speedup.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Env knobs: BENCH_ROWS (default 2_000_000), BENCH_BUCKETS (default 64),
+BENCH_REPEATS (default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent
+WORKDIR = REPO / ".bench_workspace"
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
+N_BUCKETS = int(os.environ.get("BENCH_BUCKETS", 64))
+REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
+N_SOURCE_FILES = 8
+
+
+def _make_lineitem(n: int):
+    from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+    rng = np.random.default_rng(42)
+    ship_modes = np.array(
+        [b"AIR", b"SHIP", b"RAIL", b"MAIL", b"TRUCK", b"FOB", b"REG AIR"],
+        dtype=object,
+    )
+    return ColumnarBatch(
+        {
+            "l_orderkey": Column.from_values(
+                rng.integers(1, max(n // 4, 2), n).astype(np.int64)
+            ),
+            "l_partkey": Column.from_values(
+                rng.integers(1, 200_000, n).astype(np.int64)
+            ),
+            "l_suppkey": Column.from_values(rng.integers(1, 10_000, n).astype(np.int64)),
+            "l_quantity": Column.from_values(rng.integers(1, 51, n).astype(np.int64)),
+            "l_extendedprice": Column.from_values(
+                np.round(rng.uniform(900.0, 105_000.0, n), 2)
+            ),
+            "l_shipmode": Column.from_values(ship_modes[rng.integers(0, 7, n)]),
+        }
+    )
+
+
+def _time(fn, repeats: int) -> float:
+    fn()  # warm-up (compile caches, file caches)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    if WORKDIR.exists():
+        shutil.rmtree(WORKDIR)
+    (WORKDIR / "source").mkdir(parents=True)
+
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.plan.expr import col
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+
+    batch = _make_lineitem(N_ROWS)
+    per = (N_ROWS + N_SOURCE_FILES - 1) // N_SOURCE_FILES
+    paths = []
+    for i in range(N_SOURCE_FILES):
+        part = batch.take(np.arange(i * per, min((i + 1) * per, N_ROWS)))
+        p = WORKDIR / "source" / f"part-{i:03d}.parquet"
+        parquet_io.write_parquet(p, part)
+        paths.append(str(p))
+
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(WORKDIR / "indexes"),
+            C.INDEX_NUM_BUCKETS: N_BUCKETS,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    df = session.read.parquet(*paths)
+
+    t0 = time.perf_counter()
+    hs.create_index(
+        df,
+        IndexConfig("bench_idx", ["l_orderkey"], ["l_partkey", "l_extendedprice"]),
+    )
+    build_s = time.perf_counter() - t0
+
+    lookup_key = int(batch.columns["l_orderkey"].data[N_ROWS // 2])
+    query = lambda: (  # noqa: E731
+        session.read.parquet(*paths)
+        .filter(col("l_orderkey") == lookup_key)
+        .select("l_orderkey", "l_partkey", "l_extendedprice")
+    )
+
+    session.disable_hyperspace()
+    rows_off = query().to_pandas().sort_values(list(query().columns())).reset_index(drop=True)
+    off_s = _time(lambda: query().collect(), REPEATS)
+
+    session.enable_hyperspace()
+    rows_on = query().to_pandas().sort_values(list(query().columns())).reset_index(drop=True)
+    on_s = _time(lambda: query().collect(), REPEATS)
+
+    if not rows_off.equals(rows_on):
+        print(
+            json.dumps(
+                {
+                    "metric": "filter_point_lookup_speedup",
+                    "value": 0.0,
+                    "unit": "x",
+                    "vs_baseline": 0.0,
+                    "error": "row parity violated",
+                }
+            )
+        )
+        sys.exit(1)
+
+    speedup = off_s / on_s if on_s > 0 else float("inf")
+    print(
+        json.dumps(
+            {
+                "metric": "filter_point_lookup_speedup",
+                "value": round(speedup, 3),
+                "unit": "x",
+                "vs_baseline": round(speedup, 3),
+                "rows": N_ROWS,
+                "num_buckets": N_BUCKETS,
+                "build_s": round(build_s, 3),
+                "fullscan_s": round(off_s, 4),
+                "index_scan_s": round(on_s, 4),
+                "result_rows": int(len(rows_on)),
+            }
+        )
+    )
+    shutil.rmtree(WORKDIR, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

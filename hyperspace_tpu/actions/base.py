@@ -117,6 +117,31 @@ class Action(EventLogging):
             self.log_manager.create_latest_stable_log(entry.id)
 
 
+class MaintenanceActionBase:
+    """Shared by actions that rebuild index *data* from an existing stable
+    entry (the refresh family, optimize): the previous stable entry plus
+    the next data-version directory."""
+
+    log_manager: IndexLogManager
+    _previous: Optional[IndexLogEntry]
+
+    @property
+    def previous_entry(self) -> IndexLogEntry:
+        if self._previous is None:
+            entry = self.log_manager.get_latest_stable_log()
+            if entry is None:
+                raise HyperspaceException("Index does not exist.")
+            self._previous = entry
+        return self._previous
+
+    def next_version_dir(self):
+        """Path of the next ``v__=<k>`` data directory (a new immutable
+        snapshot per rebuild, CreateActionBase.scala:33-38)."""
+        return self.data_manager.get_path(  # type: ignore[attr-defined]
+            (self.data_manager.get_latest_version_id() or 0) + 1  # type: ignore[attr-defined]
+        )
+
+
 class IndexAction(Action):
     """Base for actions operating on an *existing* index: loads the previous
     entry and validates its state (pattern of RefreshActionBase.scala /

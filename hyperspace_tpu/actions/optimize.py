@@ -24,11 +24,11 @@ from ..storage import layout
 from ..storage.columnar import ColumnarBatch, is_string
 from ..telemetry import OptimizeActionEvent
 from . import states
-from .base import Action
+from .base import Action, MaintenanceActionBase
 from .create import CreateActionBase
 
 
-class OptimizeAction(Action, CreateActionBase):
+class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
     transient_state = states.OPTIMIZING
     final_state = states.ACTIVE
 
@@ -45,19 +45,14 @@ class OptimizeAction(Action, CreateActionBase):
         self.mode = mode.lower()
         self._previous: Optional[IndexLogEntry] = None
         self._entry: Optional[IndexLogEntry] = None
-
-    @property
-    def previous_entry(self) -> IndexLogEntry:
-        if self._previous is None:
-            entry = self.log_manager.get_latest_stable_log()
-            if entry is None:
-                raise HyperspaceException("Index does not exist.")
-            self._previous = entry
-        return self._previous
+        self._partition = None
 
     def _partition_files(self):
         """(files to optimize, untouched files) by bucket and threshold
-        (OptimizeAction.scala:115-133)."""
+        (OptimizeAction.scala:115-133). Cached: validate() and op() share
+        one content-tree walk."""
+        if self._partition is not None:
+            return self._partition
         threshold = self.conf.optimize_file_size_threshold()
         by_bucket: Dict[int, List] = {}
         for fi in self.previous_entry.content.file_infos():
@@ -75,7 +70,8 @@ class OptimizeAction(Action, CreateActionBase):
                 continue
             to_optimize[b] = small
             untouched.extend(big)
-        return to_optimize, untouched
+        self._partition = (to_optimize, untouched)
+        return self._partition
 
     def validate(self) -> None:
         if self.mode not in C.OPTIMIZE_MODES:
@@ -97,9 +93,7 @@ class OptimizeAction(Action, CreateActionBase):
     def op(self) -> None:
         prev = self.previous_entry
         to_optimize, untouched = self._partition_files()
-        version_dir = self.data_manager.get_path(
-            (self.data_manager.get_latest_version_id() or 0) + 1
-        )
+        version_dir = self.next_version_dir()
         indexed = prev.indexed_columns
         new_paths: List[str] = []
         for b, files in sorted(to_optimize.items()):

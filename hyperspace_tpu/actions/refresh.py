@@ -43,11 +43,11 @@ from ..telemetry import (
     RefreshQuickActionEvent,
 )
 from . import states
-from .base import Action
+from .base import Action, MaintenanceActionBase
 from .create import CreateActionBase, _content_from_file_infos
 
 
-class RefreshActionBase(Action, CreateActionBase):
+class RefreshActionBase(Action, CreateActionBase, MaintenanceActionBase):
     transient_state = states.REFRESHING
     final_state = states.ACTIVE
 
@@ -64,15 +64,7 @@ class RefreshActionBase(Action, CreateActionBase):
         self._relation: Optional[FileRelation] = None
         self._entry: Optional[IndexLogEntry] = None
 
-    # -- previous state -------------------------------------------------------
-    @property
-    def previous_entry(self) -> IndexLogEntry:
-        if self._previous is None:
-            entry = self.log_manager.get_latest_stable_log()
-            if entry is None:
-                raise HyperspaceException("Index does not exist.")
-            self._previous = entry
-        return self._previous
+    # previous_entry / next_version_dir come from MaintenanceActionBase
 
     @property
     def index_config(self) -> IndexConfig:
@@ -145,12 +137,11 @@ class RefreshAction(RefreshActionBase):
 
     def op(self) -> None:
         rel = self.relation
-        version = (self.data_manager.get_latest_version_id() or 0) + 1
         tracker = self._seeded_tracker()
         files = self.write(
             rel,
             self.index_config,
-            self.data_manager.get_path(version),
+            self.next_version_dir(),
             self.num_buckets,
             self.lineage,
             tracker,
@@ -187,8 +178,7 @@ class RefreshIncrementalAction(RefreshActionBase):
 
     def op(self) -> None:
         prev = self.previous_entry
-        version = (self.data_manager.get_latest_version_id() or 0) + 1
-        version_dir = self.data_manager.get_path(version)
+        version_dir = self.next_version_dir()
         tracker = self._seeded_tracker()
         deleted_ids = {
             tracker.get_file_id(f.name, f.size, f.modified_time)

@@ -49,9 +49,11 @@ class DataFrame(EventLogging):
         return DataFrame(self.session, Join(self.plan, other.plan, condition, how))
 
     # -- actions -------------------------------------------------------------
-    def optimized_plan(self) -> LogicalPlan:
+    def optimized_plan(self, log_usage: bool = False) -> LogicalPlan:
         """The plan after the Hyperspace rule batch (identity when
-        disabled)."""
+        disabled). Usage telemetry is emitted only from executed queries
+        (``log_usage=True``, set by collect()) — one event per execution,
+        as in HyperspaceEvent.scala:150-156."""
         if not self.session.is_hyperspace_enabled():
             return self.plan
         from .actions import states
@@ -59,7 +61,7 @@ class DataFrame(EventLogging):
 
         indexes = self.session.collection_manager.get_indexes([states.ACTIVE])
         new_plan, applied = apply_hyperspace_rules(self.plan, indexes, self.session.conf)
-        if applied:
+        if applied and log_usage:
             self.log_event(
                 self.session.conf,
                 HyperspaceIndexUsageEvent(
@@ -73,7 +75,7 @@ class DataFrame(EventLogging):
     def collect(self) -> ColumnarBatch:
         from .exec.executor import Executor
 
-        return Executor(self.session.conf).execute(self.optimized_plan())
+        return Executor(self.session.conf).execute(self.optimized_plan(log_usage=True))
 
     def to_pandas(self):
         return self.collect().to_pandas()

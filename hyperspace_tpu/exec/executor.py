@@ -290,7 +290,39 @@ class Executor:
             }
         parts = bucketed_join_pairs(l_by_bucket, r_by_bucket, l_keys, r_keys)
         if not parts:
-            # no matching buckets (or an empty side): fall back to the
-            # general path, which produces the correctly-shaped empty result
-            return None
+            # no matching buckets (or an empty side): both sides' index
+            # data is already loaded, so produce the correctly-shaped empty
+            # result here instead of re-executing everything from disk
+            return inner_join(
+                self._empty_side(join.left, l_by_bucket, l_node),
+                self._empty_side(join.right, r_by_bucket, r_node),
+                l_keys,
+                r_keys,
+            )
         return ColumnarBatch.concat(parts)
+
+    @staticmethod
+    def _empty_side(
+        side_plan: LogicalPlan,
+        by_bucket: Dict[int, ColumnarBatch],
+        idx_node: IndexScan,
+    ) -> ColumnarBatch:
+        """A 0-row batch with a join side's output schema, derived from the
+        already-loaded bucket data when any exists, else from the index
+        entry's logged schema."""
+        if by_bucket:
+            any_batch = next(iter(by_bucket.values()))
+            return any_batch.take(np.array([], dtype=np.int64))
+        from ..storage.columnar import Column, is_string, numpy_dtype
+
+        schema = idx_node.entry.schema()
+        resolved = {k.lower(): (k, v) for k, v in schema.items()}
+        cols = {}
+        for c in side_plan.output_columns():
+            _name, dt = resolved[c.lower()]
+            cols[c] = Column(
+                dt,
+                np.empty(0, dtype=numpy_dtype(dt)),
+                np.array([], dtype=object) if is_string(dt) else None,
+            )
+        return ColumnarBatch(cols)

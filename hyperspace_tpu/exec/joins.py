@@ -33,12 +33,17 @@ def _exact_codes(l_col: Column, r_col: Column) -> Tuple[np.ndarray, np.ndarray]:
         return lu.data.astype(np.int64), ru.data.astype(np.int64)
     l, r = l_col.data, r_col.data
     if (l.dtype.kind == "f") != (r.dtype.kind == "f"):
-        # int64↔float64 cannot be compared exactly above 2^53; refusing
-        # beats silently collapsing distinct keys into spurious matches
-        raise HyperspaceException(
-            f"Join key dtype mismatch ({l.dtype} vs {r.dtype}): exact "
-            "comparison between integer and float keys is not supported."
-        )
+        int_side = r if l.dtype.kind == "f" else l
+        if int_side.dtype.itemsize > 4:
+            # 64-bit ints above 2^53 are not exactly representable in
+            # float64; refusing beats silently collapsing distinct keys
+            raise HyperspaceException(
+                f"Join key dtype mismatch ({l.dtype} vs {r.dtype}): exact "
+                "comparison between 64-bit integer and float keys is not "
+                "supported."
+            )
+        # ints up to 32 bits embed exactly in float64
+        l, r = l.astype(np.float64), r.astype(np.float64)
     if l.dtype.kind == "f":
         lf = np.where(l == 0.0, 0.0, l.astype(np.float64))
         rf = np.where(r == 0.0, 0.0, r.astype(np.float64))

@@ -21,7 +21,14 @@ from .relation import FileRelation
 
 def _infer_schema(file_format: str, sample_path: str) -> Dict[str, str]:
     from ..storage import parquet_io
+    from ..storage.columnar import ColumnarBatch
 
+    if file_format.lower() == "parquet":
+        # footer-only read: no row data is decoded just to learn the schema
+        import pyarrow.parquet as pq
+
+        arrow_schema = pq.ParquetFile(sample_path).schema_arrow
+        return ColumnarBatch.from_arrow(arrow_schema.empty_table()).schema()
     batch = parquet_io.read_files(file_format, [sample_path])
     return batch.schema()
 
