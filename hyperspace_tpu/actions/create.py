@@ -32,6 +32,7 @@ from ..index.log_entry import (
     Content,
     CoveringIndex,
     FileIdTracker,
+    FileInfo,
     IndexLogEntry,
     LogEntry,
     LogicalPlanFingerprint,
@@ -77,12 +78,12 @@ class CreateActionBase:
         cols = list(indexed) + list(included)
         if not lineage:
             return parquet_io.read_files(
-                relation.file_format, [f.name for f in relation.files], columns=cols
+                relation.read_format, [f.name for f in relation.files], columns=cols
             )
         pairs = self.session.sources.lineage_pairs(relation, tracker)
         parts = []
         for path, fid in pairs:
-            part = parquet_io.read_files(relation.file_format, [path], columns=cols)
+            part = parquet_io.read_files(relation.read_format, [path], columns=cols)
             part = part.with_column(
                 C.DATA_FILE_NAME_ID,
                 Column("int64", np.full(part.num_rows, fid, dtype=np.int64)),
@@ -134,7 +135,21 @@ class CreateActionBase:
         content = Content.from_leaf_files([str(f) for f in index_files], content_tracker)
         if content is None:
             content = Content(Directory("/"))  # begin() entry: no data yet
-        src_root = _content_from_file_infos(relation.files)
+        # Source file ids MUST be the lineage tracker's ids, not the
+        # snapshot's transient ids: Hybrid Scan's delete filter resolves
+        # deleted files to ids through this logged tree, and index rows
+        # carry the tracker's ids (IndexLogEntry.scala:617-686).
+        src_root = _content_from_file_infos(
+            [
+                FileInfo(
+                    f.name,
+                    f.size,
+                    f.modified_time,
+                    tracker.add_file(f.name, f.size, f.modified_time),
+                )
+                for f in relation.files
+            ]
+        )
         schema = {c: relation.schema[c] for c in indexed + included}
         props = {}
         if lineage:

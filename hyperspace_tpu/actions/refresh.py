@@ -195,6 +195,7 @@ class RefreshIncrementalAction(RefreshActionBase):
                 self.relation.schema,
                 self.appended_files,
                 self.relation.options,
+                internal_format=self.relation.internal_format,
             )
             batch = self.prepare_index_batch(
                 appended_rel, indexed, included, self.lineage, tracker

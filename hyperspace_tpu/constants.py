@@ -68,10 +68,22 @@ REFRESH_MODES = (REFRESH_MODE_INCREMENTAL, REFRESH_MODE_FULL, REFRESH_MODE_QUICK
 # twice (reference: IndexConstants.scala:54, INDEX_RELATION_IDENTIFIER)
 INDEX_RELATION_IDENTIFIER = ("indexhyperspace", "true")
 
+# --- explain display ---------------------------------------------------------
+# (reference: IndexConstants.scala:65-72, DisplayMode.scala:24-88)
+DISPLAY_MODE = "hyperspace.explain.displayMode"
+HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
+HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
+DISPLAY_MODE_PLAIN_TEXT = "plaintext"
+DISPLAY_MODE_HTML = "html"
+DISPLAY_MODE_CONSOLE = "console"
+DISPLAY_MODE_DEFAULT = DISPLAY_MODE_PLAIN_TEXT
+
 # --- sources -----------------------------------------------------------------
 # (reference: HyperspaceConf.scala:78-90)
 FILE_BASED_SOURCE_BUILDERS = "hyperspace.index.sources.fileBasedBuilders"
 DEFAULT_SUPPORTED_FORMATS = ("csv", "json", "parquet")
+# Globbing patterns for index sources (reference: IndexConstants.scala:101-106)
+GLOBBING_PATTERN_KEY = "hyperspace.source.globbingPattern"
 
 # --- telemetry ---------------------------------------------------------------
 # (reference: telemetry/Constants.scala:20)

@@ -66,7 +66,7 @@ class Executor:
             return batch.select(list(plan.columns))
         if isinstance(plan, Scan):
             batch = parquet_io.read_files(
-                plan.relation.file_format,
+                plan.relation.read_format,
                 [f.name for f in plan.relation.files],
             )
             return self._apply_predicate(batch, predicate)

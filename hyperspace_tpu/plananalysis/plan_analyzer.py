@@ -3,28 +3,30 @@ indexes fire, and (verbose) an operator-count diff.
 
 Parity: com/microsoft/hyperspace/index/plananalysis/PlanAnalyzer.scala
 (412 LoC): the plan is built twice — Hyperspace disabled / enabled
-(:46-130) — differing subtrees are highlighted with ``<---->`` markers
-(PlainText display mode, DisplayMode.scala:24-88), an "Indexes used"
-section lists applied indexes, and verbose mode appends the physical-
-operator comparison of PhysicalOperatorAnalyzer.scala:30-57.
+(:46-130) — differing subtrees are highlighted in the session's display
+mode (DisplayMode.scala:24-88), an "Indexes used" section lists applied
+indexes (:212-223), and verbose mode appends the physical-operator
+comparison of PhysicalOperatorAnalyzer.scala:30-57.
 """
 
 from __future__ import annotations
 
 from collections import Counter
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
-from ..plan.ir import IndexScan, LogicalPlan
-from ..plan.rules import apply_hyperspace_rules
 from ..actions import states
+from ..plan.ir import LogicalPlan
+from ..plan.rules import apply_hyperspace_rules
+from .buffer_stream import BufferStream
+from .display_mode import DisplayMode, display_mode_from_conf
 
-HIGHLIGHT_BEGIN = "<----"
-HIGHLIGHT_END = "---->"
+_BANNER = "============================================================="
 
 
-def _plan_lines(plan: LogicalPlan, other: LogicalPlan) -> List[str]:
-    """Tree lines of ``plan``, highlighting subtrees that differ from
-    ``other`` (queue-walk diff of PlanAnalyzer.scala:60-105)."""
+def _plan_lines(plan: LogicalPlan, other: LogicalPlan) -> List[Tuple[str, bool]]:
+    """``(line, differs)`` tree lines of ``plan``; a line differs when its
+    subtree does not appear in ``other`` (queue-walk diff of
+    PlanAnalyzer.scala:60-105)."""
     other_subtrees = set()
 
     def collect(node: LogicalPlan) -> None:
@@ -34,14 +36,11 @@ def _plan_lines(plan: LogicalPlan, other: LogicalPlan) -> List[str]:
 
     collect(other)
 
-    lines: List[str] = []
+    lines: List[Tuple[str, bool]] = []
 
     def walk(node: LogicalPlan, indent: int) -> None:
         subtree = node.tree_string()
-        line = "  " * indent + node.describe()
-        if subtree not in other_subtrees:
-            line = f"{HIGHLIGHT_BEGIN}{line}{HIGHLIGHT_END}"
-        lines.append(line)
+        lines.append(("  " * indent + node.describe(), subtree not in other_subtrees))
         for c in node.children:
             walk(c, indent + 1)
 
@@ -61,44 +60,54 @@ def _operator_counts(plan: LogicalPlan) -> Counter:
     return counts
 
 
-def explain_string(df, verbose: bool = False) -> str:
+def _write_plan(buf: BufferStream, title: str, lines: List[Tuple[str, bool]]) -> None:
+    buf.write_line(_BANNER)
+    buf.write_line(title)
+    buf.write_line(_BANNER)
+    for line, differs in lines:
+        if differs:
+            buf.highlight_line(line)
+        else:
+            buf.write_line(line)
+    buf.write_line()
+
+
+def explain_string(
+    df, verbose: bool = False, display_mode: Optional[DisplayMode] = None
+) -> str:
     """(PlanAnalyzer.explainString). Works whether or not the session has
     Hyperspace enabled — both plans are compiled here."""
     session = df.session
+    mode = display_mode or display_mode_from_conf(session.conf)
     indexes = session.collection_manager.get_indexes([states.ACTIVE])
     plan_off = df.plan
     plan_on, applied = apply_hyperspace_rules(plan_off, indexes, session.conf)
 
-    buf: List[str] = []
-    buf.append("=============================================================")
-    buf.append("Plan with indexes:")
-    buf.append("=============================================================")
-    buf.extend(_plan_lines(plan_on, plan_off))
-    buf.append("")
-    buf.append("=============================================================")
-    buf.append("Plan without indexes:")
-    buf.append("=============================================================")
-    buf.extend(_plan_lines(plan_off, plan_on))
-    buf.append("")
-    buf.append("=============================================================")
-    buf.append("Indexes used:")
-    buf.append("=============================================================")
+    buf = BufferStream(mode)
+    _write_plan(buf, "Plan with indexes:", _plan_lines(plan_on, plan_off))
+    _write_plan(buf, "Plan without indexes:", _plan_lines(plan_off, plan_on))
+
+    buf.write_line(_BANNER)
+    buf.write_line("Indexes used:")
+    buf.write_line(_BANNER)
     for e in applied:
         loc = e.content.files()
         loc_str = loc[0].rsplit("/", 1)[0] if loc else ""
-        buf.append(f"{e.name}:{loc_str}")
-    buf.append("")
+        buf.write_line(f"{e.name}:{loc_str}")
+    buf.write_line()
 
     if verbose:
         on_counts = _operator_counts(plan_on)
         off_counts = _operator_counts(plan_off)
-        buf.append("=============================================================")
-        buf.append("Physical operator stats:")
-        buf.append("=============================================================")
-        header = f"{'Physical Operator':<30}{'Hyperspace(On)':>15}{'Hyperspace(Off)':>16}{'Difference':>11}"
-        buf.append(header)
+        buf.write_line(_BANNER)
+        buf.write_line("Physical operator stats:")
+        buf.write_line(_BANNER)
+        buf.write_line(
+            f"{'Physical Operator':<30}{'Hyperspace(On)':>15}"
+            f"{'Hyperspace(Off)':>16}{'Difference':>11}"
+        )
         for op in sorted(set(on_counts) | set(off_counts)):
             on_c, off_c = on_counts.get(op, 0), off_counts.get(op, 0)
-            buf.append(f"{op:<30}{on_c:>15}{off_c:>16}{on_c - off_c:>11}")
-        buf.append("")
-    return "\n".join(buf)
+            buf.write_line(f"{op:<30}{on_c:>15}{off_c:>16}{on_c - off_c:>11}")
+        buf.write_line()
+    return buf.with_tag()

@@ -57,6 +57,24 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
     ) -> Optional[FileRelation]:
         if not self.supports_format(file_format):
             return None
+        logged_roots = [str(Path(p).absolute()) for p in root_paths]
+        pattern = (options or {}).get(C.GLOBBING_PATTERN_KEY)
+        if pattern:
+            # Validate the pattern covers every actual root path, then log
+            # the *pattern* as the relation's roots so later snapshots pick
+            # up new matches (DefaultFileBasedSource.scala:90-118).
+            patterns = [p.strip() for p in pattern.split(",") if p.strip()]
+            expanded = {
+                str(p.absolute()) for p in file_utils.expand_globs(patterns)
+            }
+            unmatched = [r for r in logged_roots if r not in expanded]
+            if unmatched:
+                raise HyperspaceException(
+                    "Some glob patterns do not match with available root "
+                    f"paths of the source data. Please check if {pattern} "
+                    f"matches all of {unmatched}."
+                )
+            logged_roots = patterns
         files = _snapshot_files(root_paths)
         if schema is None:
             if not files:
@@ -65,7 +83,7 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
                 )
             schema = _infer_schema(file_format, files[0].name)
         return FileRelation(
-            root_paths=[str(Path(p).absolute()) for p in root_paths],
+            root_paths=logged_roots,
             file_format=file_format,
             schema=schema,
             files=files,

@@ -23,6 +23,15 @@ class FileRelation:
     schema: Dict[str, str]
     files: List[FileInfo]  # full-path FileInfos (the current snapshot)
     options: Dict[str, str] = field(default_factory=dict)
+    # Physical format of the data files when it differs from the logical
+    # source format — e.g. a versioned-lake table is format "vlt" but its
+    # files are parquet (the analog of DeltaLakeFileBasedSource.
+    # internalFileFormatName, DeltaLakeFileBasedSource.scala:120-126).
+    internal_format: Optional[str] = None
+
+    @property
+    def read_format(self) -> str:
+        return self.internal_format or self.file_format
 
     @property
     def column_names(self) -> List[str]:

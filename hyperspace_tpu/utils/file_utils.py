@@ -69,13 +69,32 @@ def atomic_create(path: str | Path, content: str) -> bool:
         tmp.unlink(missing_ok=True)
 
 
+def expand_globs(paths: Iterable[str | Path]) -> List[Path]:
+    """Expand glob wildcards in paths; non-pattern paths pass through
+    (the analog of Spark's globPathIfNecessary used by the reference's
+    globbing support, DefaultFileBasedSource.scala:90-118)."""
+    import glob as _glob
+
+    out: List[Path] = []
+    for p in paths:
+        s = str(p)
+        # A path that exists literally is never treated as a pattern, so
+        # directories with glob metacharacters in their names (legal on
+        # POSIX) keep working for non-globbing callers.
+        if _glob.has_magic(s) and not os.path.exists(s):
+            out.extend(Path(m) for m in sorted(_glob.glob(s)))
+        else:
+            out.append(Path(p))
+    return out
+
+
 def list_leaf_files(paths: Iterable[str | Path]) -> List[Path]:
     """Recursively list data files under ``paths``, skipping hidden/underscore
     entries the way the reference's DataPathFilter does (PathUtils.scala:22-39).
-    A path that is itself a file is returned as-is."""
+    A path that is itself a file is returned as-is; glob patterns are
+    expanded first."""
     out: List[Path] = []
-    for p in paths:
-        p = Path(p)
+    for p in expand_globs(paths):
         if p.is_file():
             out.append(p)
             continue

@@ -179,3 +179,25 @@ def test_hybrid_scan_no_common_files_no_candidate(env):
     session.enable_hyperspace()
     plan = fquery(session, other).optimized_plan()
     assert not plan.collect(lambda n: isinstance(n, IndexScan))
+
+
+def test_lineage_ids_stable_across_refresh_with_shifted_sort_order(env):
+    # Regression: logged source-file ids must be the lineage tracker's ids.
+    # An appended file sorting *before* the originals used to shift the
+    # snapshot's transient ids on refresh; a later delete then filtered the
+    # wrong rows' lineage ids out of the index (silent wrong results).
+    session, hs, src, _ = env
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("idx", ["orderkey"], ["qty"])
+    )
+    # 'aaa-' sorts before 'part-'
+    parquet_io.write_parquet(src / "aaa-append.parquet", sample_batch(60, 9))
+    hs.refresh_index("idx", "incremental")
+    # now delete one of the original files and query under hybrid scan
+    (src / "part-1.parquet").unlink()
+    q = fquery(session, src)
+    session.disable_hyperspace()
+    off = q.to_pandas().sort_values(["orderkey", "qty"]).reset_index(drop=True)
+    session.enable_hyperspace()
+    on = q.to_pandas().sort_values(["orderkey", "qty"]).reset_index(drop=True)
+    assert off.equals(on)

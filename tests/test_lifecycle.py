@@ -283,3 +283,34 @@ def test_mock_event_logger(env, tmp_path):
     kinds = [type(e).__name__ for e in ml.EVENTS]
     assert "CreateActionEvent" in kinds
     assert "DeleteActionEvent" in kinds
+
+
+def test_globbing_pattern_create_and_refresh(env):
+    # (DefaultFileBasedSource.scala:90-118; IndexConstants.scala:101-106):
+    # index created over a glob pattern picks up new matching dirs on refresh
+    session, hs, src, root = env
+    pattern = str(root / "data*")
+    df = (
+        session.read.option(C.GLOBBING_PATTERN_KEY, pattern).parquet(str(src))
+    )
+    hs.create_index(df, IndexConfig("gidx", ["orderkey"], ["qty"]))
+    entry = session.collection_manager.get_indexes([states.ACTIVE])[0]
+    assert entry.relation.root_paths == [pattern]
+
+    src2 = root / "data2"
+    src2.mkdir()
+    parquet_io.write_parquet(src2 / "part-0.parquet", sample_batch(100, 7))
+    hs.refresh_index("gidx", "incremental")
+    s = hs.index("gidx")
+    assert s.source_files == 3  # 2 original + 1 appended via glob
+
+
+def test_globbing_pattern_mismatch_raises(env):
+    session, hs, src, root = env
+    other = root / "elsewhere"
+    other.mkdir()
+    parquet_io.write_parquet(other / "p.parquet", sample_batch(10, 3))
+    with pytest.raises(HyperspaceException, match="glob patterns do not match"):
+        session.read.option(
+            C.GLOBBING_PATTERN_KEY, str(root / "data*")
+        ).parquet(str(other))

@@ -1,0 +1,105 @@
+"""Display-mode / BufferStream / explain parity tests — the analog of the
+reference's plananalysis/{BufferStream,DisplayMode}Test and ExplainTest
+(golden explain strings per display mode, SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plananalysis.buffer_stream import BufferStream
+from hyperspace_tpu.plananalysis.display_mode import (
+    ConsoleMode,
+    HTMLMode,
+    PlainTextMode,
+    display_mode_from_conf,
+)
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+
+
+def test_buffer_stream_highlight_preserves_whitespace():
+    buf = BufferStream(PlainTextMode({}))
+    buf.highlight("   indented text   ")
+    assert str(buf) == "   <----indented text---->   "
+
+
+def test_buffer_stream_write_line_and_tag():
+    buf = BufferStream(HTMLMode({}))
+    buf.write_line("a").write("b")
+    assert buf.with_tag() == "<pre>a<br>b</pre>"
+
+
+def test_display_mode_defaults_and_overrides():
+    assert PlainTextMode({}).highlight_tag.open == "<----"
+    assert HTMLMode({}).highlight_tag.open == '<b style="background:LightGreen">'
+    assert ConsoleMode({}).highlight_tag.open == "\x1b[42m"
+    custom = PlainTextMode(
+        {C.HIGHLIGHT_BEGIN_TAG: ">>", C.HIGHLIGHT_END_TAG: "<<"}
+    )
+    assert custom.highlight_tag.open == ">>"
+    assert custom.highlight_tag.close == "<<"
+
+
+def test_display_mode_from_conf():
+    conf = HyperspaceConf({C.DISPLAY_MODE: "html"})
+    assert isinstance(display_mode_from_conf(conf), HTMLMode)
+    conf = HyperspaceConf({C.DISPLAY_MODE: "console"})
+    assert isinstance(display_mode_from_conf(conf), ConsoleMode)
+    assert isinstance(display_mode_from_conf(HyperspaceConf()), PlainTextMode)
+    with pytest.raises(HyperspaceException):
+        display_mode_from_conf(HyperspaceConf({C.DISPLAY_MODE: "bogus"}))
+
+
+@pytest.fixture
+def env(tmp_path):
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"), C.INDEX_NUM_BUCKETS: 4}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    src = tmp_path / "data"
+    src.mkdir()
+    rng = np.random.default_rng(0)
+    batch = ColumnarBatch.from_pydict(
+        {
+            "orderkey": rng.integers(0, 100, 300).astype(np.int64),
+            "qty": rng.integers(1, 51, 300).astype(np.int32),
+        },
+        schema={"orderkey": "int64", "qty": "int32"},
+    )
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+    return session, hs, src
+
+
+def test_explain_html_mode(env):
+    session, hs, src = env
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("hidx", ["orderkey"], ["qty"]))
+    q = session.read.parquet(str(src)).filter(col("orderkey") == 5).select(
+        "orderkey", "qty"
+    )
+    session.conf.set(C.DISPLAY_MODE, "html")
+    text = hs.explain(q)
+    assert text.startswith("<pre>") and text.endswith("</pre>")
+    assert '<b style="background:LightGreen">' in text
+    assert "<br>" in text
+    assert "<----" not in text
+
+
+def test_explain_console_mode(env):
+    session, hs, src = env
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("cidx", ["orderkey"], ["qty"]))
+    q = session.read.parquet(str(src)).filter(col("orderkey") == 5).select(
+        "orderkey", "qty"
+    )
+    session.conf.set(C.DISPLAY_MODE, "console")
+    text = hs.explain(q)
+    assert "\x1b[42m" in text and "\x1b[0m" in text
