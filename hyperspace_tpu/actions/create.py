@@ -58,6 +58,24 @@ class CreateActionBase:
         self.session = session
         self.conf = conf or session.conf
 
+    @staticmethod
+    def source_content(relation: FileRelation, tracker: FileIdTracker) -> Content:
+        """The logged source-file tree. Ids MUST be the lineage tracker's
+        ids, not the snapshot's transient ids: Hybrid Scan's delete filter
+        resolves deleted files to ids through this tree, and index rows
+        carry the tracker's ids (IndexLogEntry.scala:617-686)."""
+        return _content_from_file_infos(
+            [
+                FileInfo(
+                    f.name,
+                    f.size,
+                    f.modified_time,
+                    tracker.add_file(f.name, f.size, f.modified_time),
+                )
+                for f in relation.files
+            ]
+        )
+
     # -- column resolution (CreateActionBase.scala:142-162) ------------------
     def resolved_columns(
         self, relation: FileRelation, config: IndexConfig
@@ -135,21 +153,7 @@ class CreateActionBase:
         content = Content.from_leaf_files([str(f) for f in index_files], content_tracker)
         if content is None:
             content = Content(Directory("/"))  # begin() entry: no data yet
-        # Source file ids MUST be the lineage tracker's ids, not the
-        # snapshot's transient ids: Hybrid Scan's delete filter resolves
-        # deleted files to ids through this logged tree, and index rows
-        # carry the tracker's ids (IndexLogEntry.scala:617-686).
-        src_root = _content_from_file_infos(
-            [
-                FileInfo(
-                    f.name,
-                    f.size,
-                    f.modified_time,
-                    tracker.add_file(f.name, f.size, f.modified_time),
-                )
-                for f in relation.files
-            ]
-        )
+        src_root = self.source_content(relation, tracker)
         schema = {c: relation.schema[c] for c in indexed + included}
         props = {}
         if lineage:

@@ -65,6 +65,10 @@ class Executor:
             batch = self._exec(plan.child, predicate)
             return batch.select(list(plan.columns))
         if isinstance(plan, Scan):
+            if not plan.relation.files:
+                # zero-file scan (e.g. every file sketch-pruned): empty
+                # result with the relation's schema
+                return ColumnarBatch.empty(dict(plan.relation.schema))
             batch = parquet_io.read_files(
                 plan.relation.read_format,
                 [f.name for f in plan.relation.files],
@@ -313,16 +317,8 @@ class Executor:
         if by_bucket:
             any_batch = next(iter(by_bucket.values()))
             return any_batch.take(np.array([], dtype=np.int64))
-        from ..storage.columnar import Column, is_string, numpy_dtype
-
         schema = idx_node.entry.schema()
-        resolved = {k.lower(): (k, v) for k, v in schema.items()}
-        cols = {}
-        for c in side_plan.output_columns():
-            _name, dt = resolved[c.lower()]
-            cols[c] = Column(
-                dt,
-                np.empty(0, dtype=numpy_dtype(dt)),
-                np.array([], dtype=object) if is_string(dt) else None,
-            )
-        return ColumnarBatch(cols)
+        resolved = {k.lower(): v for k, v in schema.items()}
+        return ColumnarBatch.empty(
+            {c: resolved[c.lower()] for c in side_plan.output_columns()}
+        )

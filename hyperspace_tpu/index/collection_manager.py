@@ -56,7 +56,20 @@ class IndexCollectionManager:
         return mgr
 
     # -- verbs (IndexCollectionManager.scala:36-107) --------------------------
-    def create(self, df, config: IndexConfig) -> None:
+    def create(self, df, config) -> None:
+        from ..index.index_config import DataSkippingIndexConfig
+
+        if isinstance(config, DataSkippingIndexConfig):
+            from ..actions.create_skipping import DataSkippingCreateAction
+
+            DataSkippingCreateAction(
+                self.session,
+                df,
+                config,
+                self._log_manager(config.index_name),
+                self._data_manager(config.index_name),
+            ).run()
+            return
         CreateAction(
             self.session,
             df,
@@ -80,6 +93,24 @@ class IndexCollectionManager:
         mgr = self._existing_log_manager(name)
         data = self._data_manager(name)
         mode = mode.lower()
+        latest = mgr.get_latest_stable_log()
+        if latest is not None and latest.derived_dataset.kind == "DataSkippingIndex":
+            from ..actions.create_skipping import DataSkippingRefreshAction
+
+            if mode == C.REFRESH_MODE_QUICK:
+                raise HyperspaceException(
+                    "Quick refresh is not supported for data-skipping indexes "
+                    "(no hybrid-scan path exists for sketch tables)."
+                )
+            if mode not in C.REFRESH_MODES:
+                raise HyperspaceException(
+                    f"Unsupported refresh mode {mode!r}; supported modes are "
+                    f"{C.REFRESH_MODES}."
+                )
+            DataSkippingRefreshAction(
+                self.session, mgr, data, incremental=mode == C.REFRESH_MODE_INCREMENTAL
+            ).run()
+            return
         if mode == C.REFRESH_MODE_FULL:
             RefreshAction(self.session, mgr, data).run()
         elif mode == C.REFRESH_MODE_INCREMENTAL:
@@ -93,6 +124,12 @@ class IndexCollectionManager:
             )
 
     def optimize(self, name: str, mode: str = C.OPTIMIZE_MODE_QUICK) -> None:
+        latest = self._existing_log_manager(name).get_latest_stable_log()
+        if latest is not None and latest.derived_dataset.kind == "DataSkippingIndex":
+            raise HyperspaceException(
+                "Optimize is not supported for data-skipping indexes (the "
+                "sketch table is a single metadata file, nothing to compact)."
+            )
         OptimizeAction(
             self.session, self._existing_log_manager(name), self._data_manager(name), mode
         ).run()

@@ -315,6 +315,68 @@ class CoveringIndex:
         )
 
 
+@dataclass
+class DataSkippingIndex:
+    """Derived-dataset spec for a data-skipping (sketch) index — the
+    BASELINE.md config-5 index kind. No data copy exists; the index's
+    content is one sketch table (sketches.json) summarizing every source
+    file per sketched column. Duck-types CoveringIndex's accessor surface
+    so IndexLogEntry stays kind-agnostic."""
+
+    sketches: List[Dict[str, Any]]  # serialized SketchSpecs (index/sketches.py)
+    schema: Dict[str, str]  # sketched column -> dtype
+    properties: Dict[str, str] = field(default_factory=dict)
+
+    kind: str = "DataSkippingIndex"
+
+    @property
+    def indexed_columns(self) -> List[str]:
+        # preserve sketch order, dedupe repeated columns
+        return list(dict.fromkeys(s["column"] for s in self.sketches))
+
+    @property
+    def included_columns(self) -> List[str]:
+        return []
+
+    @property
+    def num_buckets(self) -> int:
+        return 1  # no bucketing: the index is a metadata table
+
+    def all_columns(self) -> List[str]:
+        return self.indexed_columns
+
+    def has_lineage(self) -> bool:
+        return False
+
+    def to_json_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "properties": {
+                "sketches": [dict(s) for s in self.sketches],
+                "schema": dict(self.schema),
+                "properties": dict(self.properties),
+            },
+        }
+
+    @staticmethod
+    def from_json_dict(d: Dict[str, Any]) -> "DataSkippingIndex":
+        p = d["properties"]
+        return DataSkippingIndex(
+            sketches=[dict(s) for s in p["sketches"]],
+            schema=dict(p["schema"]),
+            properties=dict(p.get("properties", {})),
+        )
+
+
+def derived_dataset_from_json_dict(d: Dict[str, Any]):
+    """Kind dispatch for the derivedDataset field (the reference's Jackson
+    polymorphic deserialization of CoveringIndex, IndexLogEntry.scala:347)."""
+    kind = d.get("kind", "CoveringIndex")
+    if kind == "DataSkippingIndex":
+        return DataSkippingIndex.from_json_dict(d)
+    return CoveringIndex.from_json_dict(d)
+
+
 # ---------------------------------------------------------------------------
 # Signature / fingerprint
 # ---------------------------------------------------------------------------
@@ -608,7 +670,7 @@ class IndexLogEntry(LogEntry):
             raise HyperspaceException(f"Unsupported log entry version: {version}")
         e = IndexLogEntry(
             d["name"],
-            CoveringIndex.from_json_dict(d["derivedDataset"]),
+            derived_dataset_from_json_dict(d["derivedDataset"]),
             Content.from_json_dict(d["content"]),
             Source.from_json_dict(d["source"]),
             dict(d.get("properties", {})),

