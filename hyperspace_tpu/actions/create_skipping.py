@@ -21,6 +21,7 @@ from ..index.log_entry import (
     Content,
     DataSkippingIndex,
     FileIdTracker,
+    FileInfo,
     IndexLogEntry,
     LogEntry,
     LogicalPlanFingerprint,
@@ -92,7 +93,6 @@ class SkippingActionBase:
 
     def write_sketches(
         self,
-        relation: FileRelation,
         sketches: List[SketchSpec],
         version_dir: Path,
         table: Dict[str, Dict[str, Dict]],
@@ -194,7 +194,7 @@ class DataSkippingCreateAction(Action, CreateActionBase, SkippingActionBase):
         sketches = _resolve_sketch_columns(rel, self.config.sketches)
         table = build_sketch_table(rel, sketches)
         sketch_file = self.write_sketches(
-            rel, sketches, self.data_manager.get_path(0), table
+            sketches, self.data_manager.get_path(0), table
         )
         # Fingerprint the bare relation Scan — the rules re-derive it from
         # the query's scan node, never from the creating DataFrame's full
@@ -282,7 +282,7 @@ class DataSkippingRefreshAction(
         else:
             table = build_sketch_table(rel, sketches)
         sketch_file = self.write_sketches(
-            rel, sketches, self.next_version_dir(), table
+            sketches, self.next_version_dir(), table
         )
         self._entry = self.build_skipping_entry(
             prev.name, rel, Scan(rel), sketches, sketch_file, self.conf

@@ -74,17 +74,38 @@ def join_codes(
     return inverse[:n_l].astype(np.int64), inverse[n_l:].astype(np.int64)
 
 
+# Device SMJ kernel pays one host→HBM round trip; below this many keys on
+# the smaller side the VPU win cannot cover it (tuned for co-located HBM;
+# a tunneled/remote TPU wants this far higher or kernels off).
+MIN_DEVICE_JOIN_ROWS = 1 << 20
+
+
 def merge_join_indices(
-    l_codes: np.ndarray, r_codes: np.ndarray
+    l_codes: np.ndarray, r_codes: np.ndarray, device: bool | None = None
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Inner-join row indices for two (unsorted) code arrays, vectorized:
     sort the right side, locate each left code's run via searchsorted, and
-    expand the (left row × right run) pairs."""
+    expand the (left row × right run) pairs.
+
+    ``device=None`` auto-routes the range-lookup step to the Pallas
+    sorted-intersection kernel (ops.kernels) for large inputs on TPU."""
+    from ..ops import kernels as _k
+
     r_order = np.argsort(r_codes, kind="stable")
     r_sorted = r_codes[r_order]
-    lo = np.searchsorted(r_sorted, l_codes, side="left")
-    hi = np.searchsorted(r_sorted, l_codes, side="right")
-    counts = hi - lo
+    if device is None:
+        device = (
+            _k.kernels_mode() == "tpu"
+            and min(len(l_codes), len(r_codes)) >= MIN_DEVICE_JOIN_ROWS
+        )
+    lo = counts = None
+    if device and _k.kernels_mode() != "off":
+        res = _k.sorted_intersect_counts(l_codes, r_sorted)
+        if res is not None:
+            lo, counts = res
+    if lo is None:
+        lo = np.searchsorted(r_sorted, l_codes, side="left")
+        counts = np.searchsorted(r_sorted, l_codes, side="right") - lo
     total = int(counts.sum())
     if total == 0:
         return np.array([], dtype=np.int64), np.array([], dtype=np.int64)

@@ -85,16 +85,28 @@ def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
     from ..plan.expr import bind_string_literals
 
     n = batch.num_rows
+    # String literals are pre-bound to this batch's dictionary codes, so the
+    # bound expression is pure int arithmetic (shared by both device paths).
+    bound = bind_string_literals(predicate, batch)
+
+    # Pallas path first: one streamed HBM→VMEM pass, int32-narrowed
+    # (ops.kernels). Ineligible predicates/dtypes fall through to XLA.
+    from ..ops import kernels as _k
+
+    if _k.kernels_mode() != "off":
+        mask = _k.predicate_mask(
+            bound, {name: batch.columns[name].data for name in names}, n
+        )
+        if mask is not None:
+            return mask
+
     n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
     host_arrays = {
         name: np.pad(batch.columns[name].data, (0, n_pad - n)) for name in names
     }
-    # String literals are pre-bound to this batch's dictionary codes, so the
-    # bound expression is pure int arithmetic: the cache key is just the
-    # bound expression + array signature, and the cached closure pins no
-    # vocabulary (files with identical dictionaries — or none — share a
-    # compiled fn through the identical bound repr).
-    bound = bind_string_literals(predicate, batch)
+    # The cache key is just the bound expression + array signature, and the
+    # cached closure pins no vocabulary (files with identical dictionaries —
+    # or none — share a compiled fn through the identical bound repr).
     key = (
         repr(bound),
         n_pad,

@@ -119,7 +119,9 @@ class DataSkippingFilterRule:
                     applied.append(entry)
                     return new_node
                 return None
-            except HyperspaceException as e:  # never break the query
+            except Exception as e:  # never break the query (the reference
+                # rules swallow everything, FilterIndexRule.scala:79-83 —
+                # e.g. a vacuumed/corrupt sketches.json must not fail scans)
                 logger.warning("DataSkippingFilterRule skipped: %s", e)
                 return None
 

@@ -115,7 +115,7 @@ def test_skipping_create_and_prune(env):
     hs.create_index(df, skipping_config())
     entry = hs.index("sk")
     assert entry.state == "ACTIVE"
-    assert entry.kind == "DataSkippingIndex" or True  # stats may not expose kind
+    assert entry.kind == "DataSkippingIndex"
 
     q = session.read.parquet(str(src)).filter(col("k") == 150).select("k", "v")
     session.enable_hyperspace()

@@ -1,0 +1,132 @@
+"""Pallas kernel tests (ops.kernels), run under the Pallas interpreter on
+the CPU mesh (HYPERSPACE_TPU_KERNELS=interpret) — the kernel bodies are
+identical on real TPU; Mosaic-lowering specifics (int32-only, tile shapes)
+are exercised by the same code paths.
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.ops import kernels
+from hyperspace_tpu.plan.expr import col, eval_mask, is_in, lit
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_KERNELS", "interpret")
+
+
+def test_predicate_mask_matches_numpy():
+    rng = np.random.default_rng(1)
+    n = 4321
+    a = rng.integers(-500, 500, n).astype(np.int64)
+    b = rng.integers(0, 50, n).astype(np.int32)
+    expr = (col("a") >= lit(-100)) & (
+        ~(col("b") == lit(9)) | is_in(col("b"), [1, 2, 3])
+    )
+    got = kernels.predicate_mask(expr, {"a": a, "b": b}, n)
+    want = (a >= -100) & (~(b == 9) | np.isin(b, [1, 2, 3]))
+    assert got is not None
+    assert np.array_equal(got, want)
+
+
+def test_predicate_mask_col_col_and_bool():
+    rng = np.random.default_rng(2)
+    n = 100
+    a = rng.integers(0, 10, n).astype(np.int64)
+    b = rng.integers(0, 10, n).astype(np.int64)
+    flag = rng.integers(0, 2, n).astype(bool)
+    expr = (col("a") < col("b")) & (col("flag") == lit(1))
+    got = kernels.predicate_mask(expr, {"a": a, "b": b, "flag": flag}, n)
+    assert got is not None
+    assert np.array_equal(got, (a < b) & flag)
+
+
+def test_predicate_mask_ineligible_falls_back():
+    n = 10
+    a = np.arange(n, dtype=np.float64)
+    # float column → not int32-narrowable
+    assert kernels.predicate_mask(col("a") < lit(3), {"a": a}, n) is None
+    # int64 out of int32 range → not narrowable
+    big = np.array([2**40] * n, dtype=np.int64)
+    assert kernels.predicate_mask(col("a") < lit(3), {"a": big}, n) is None
+    # literal out of int32 range → not narrowable
+    small = np.arange(n, dtype=np.int64)
+    assert (
+        kernels.predicate_mask(col("a") < lit(2**40), {"a": small}, n) is None
+    )
+
+
+def test_narrow_expr_in_becomes_or_chain():
+    e = kernels.narrow_expr_to_i32(is_in(col("x"), [5, 6]))
+    assert e is not None
+    small = np.array([4, 5, 6, 7], dtype=np.int64)
+    batch = ColumnarBatch({"x": Column.from_values(small)})
+    assert np.array_equal(
+        np.asarray(eval_mask(e, batch)), np.isin(small, [5, 6])
+    )
+
+
+@pytest.mark.parametrize(
+    "nl,nr", [(0, 5), (5, 0), (7, 5), (1000, 3000), (1025, 1024), (2048, 1030)]
+)
+def test_sorted_intersect_counts(nl, nr):
+    rng = np.random.default_rng(nl * 31 + nr)
+    l = rng.integers(-1000, 1000, nl).astype(np.int64)
+    r = np.sort(rng.integers(-1000, 1000, nr).astype(np.int64))
+    res = kernels.sorted_intersect_counts(l, r)
+    assert res is not None
+    lt, eq = res
+    assert np.array_equal(lt, np.searchsorted(r, l, "left"))
+    assert np.array_equal(eq, np.searchsorted(r, l, "right") - lt)
+
+
+def test_sorted_intersect_counts_range_overflow_fallback():
+    l = np.array([0, 2**40], dtype=np.int64)
+    r = np.array([0, 2**40], dtype=np.int64)
+    assert kernels.sorted_intersect_counts(l, r) is None
+
+
+def test_merge_join_device_parity():
+    from hyperspace_tpu.exec.joins import merge_join_indices
+
+    rng = np.random.default_rng(7)
+    l = rng.integers(0, 200, 500).astype(np.int64)
+    r = rng.integers(0, 200, 700).astype(np.int64)
+    li_h, ri_h = merge_join_indices(l, r, device=False)
+    li_d, ri_d = merge_join_indices(l, r, device=True)
+    # same multiset of (l_code, r_code) pairs
+    ph = sorted(zip(l[li_h], r[ri_h], li_h, ri_h))
+    pd = sorted(zip(l[li_d], r[ri_d], li_d, ri_d))
+    assert ph == pd
+
+
+def test_index_scan_uses_kernel_path(tmp_path):
+    from hyperspace_tpu.exec.scan import index_scan
+    from hyperspace_tpu.storage import layout
+
+    rng = np.random.default_rng(3)
+    n = 2000
+    batch = ColumnarBatch(
+        {
+            "k": Column.from_values(rng.integers(0, 100, n).astype(np.int64)),
+            "v": Column.from_values(rng.integers(0, 10**6, n).astype(np.int64)),
+            "s": Column.from_values(
+                np.array([b"aa", b"bb", b"cc"], dtype=object)[
+                    rng.integers(0, 3, n)
+                ]
+            ),
+        }
+    )
+    f = tmp_path / "b00000-test.tcb"
+    layout.write_batch(f, batch, bucket=0)
+    pred = (col("k") < lit(50)) & (col("s") == lit(b"bb"))
+    # min_device_rows=1 forces the device path → Pallas interpret kernel
+    got = index_scan([f], ["k", "v"], pred, device=True, min_device_rows=1)
+    want_mask = np.asarray(eval_mask(pred, batch))
+    assert got.num_rows == int(want_mask.sum())
+    assert np.array_equal(
+        np.sort(got.columns["v"].data),
+        np.sort(batch.columns["v"].data[want_mask]),
+    )
