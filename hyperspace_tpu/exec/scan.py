@@ -168,8 +168,10 @@ def index_scan(
                 files = layout.prune_by_min_max(files, c, lo, hi)
     need = list(dict.fromkeys(list(output_columns) + sorted(predicate.columns()))) if predicate else list(output_columns)
     parts: List[ColumnarBatch] = []
-    for f in files:
-        batch = layout.read_batch(f, columns=need)
+    # all surviving files' column buffers load concurrently via the native
+    # IO runtime (file-grained task parallelism; sequential mmap fallback)
+    batches = layout.read_batches(files, columns=need)
+    for f, batch in zip(files, batches):
         if batch.num_rows == 0:
             continue
         if predicate is not None:

@@ -1,0 +1,195 @@
+"""ctypes bindings for the native IO runtime (native/tcb_io.cc).
+
+The shared library is built on demand with g++ (no pip deps); when no
+toolchain or prebuilt .so is available every entry point degrades to a
+pure-Python fallback, so the package works everywhere and merely gets
+faster where a compiler exists. Threading model: the C++ side releases
+Python entirely (ctypes drops the GIL around foreign calls), so a scan
+over many bucket files loads all column buffers with true parallelism —
+the framework's stand-in for Spark's file/partition task parallelism
+(SURVEY.md §2.0).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "tcb_io.cc"
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_FAILED = False
+
+
+def _build_dir() -> Path:
+    d = os.environ.get("HYPERSPACE_TPU_NATIVE_DIR")
+    if d:
+        return Path(d)
+    if os.access(_SRC.parent, os.W_OK):
+        return _SRC.parent / "build"
+    return Path.home() / ".cache" / "hyperspace_tpu"
+
+
+def _compile() -> Optional[Path]:
+    if not _SRC.exists():
+        return None
+    out_dir = _build_dir()
+    out = out_dir / "libtcb_io.so"
+    if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
+        return out
+    try:
+        out_dir.mkdir(parents=True, exist_ok=True)
+        tmp = out_dir / f".libtcb_io.{os.getpid()}.so"
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-pthread",
+             str(_SRC), "-o", str(tmp)],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, out)
+        return out
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_FAILED
+    if _LIB is not None or _LIB_FAILED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        if os.environ.get("HYPERSPACE_TPU_NATIVE", "auto").lower() == "off":
+            _LIB_FAILED = True
+            return None
+        so = _compile()
+        if so is None:
+            _LIB_FAILED = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(so))
+        except OSError:
+            _LIB_FAILED = True
+            return None
+        lib.hs_pread_many.restype = ctypes.c_int32
+        lib.hs_pread_many.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.hs_write_file_atomic.restype = ctypes.c_int32
+        lib.hs_write_file_atomic.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def pread_many(
+    tasks: Sequence[Tuple[str, int, int, np.ndarray]],
+    n_threads: int = 0,
+) -> bool:
+    """Concurrently read byte ranges into caller arrays.
+
+    Each task is (path, offset, nbytes, dest) where dest is a contiguous
+    uint8 array of at least nbytes. Returns False when the native library
+    is unavailable (caller must fall back); raises OSError when any
+    individual read fails.
+    """
+    lib = _load()
+    if lib is None:
+        return False
+    n = len(tasks)
+    if n == 0:
+        return True
+    paths = (ctypes.c_char_p * n)(
+        *[os.fsencode(t[0]) for t in tasks]
+    )
+    offsets = (ctypes.c_int64 * n)(*[int(t[1]) for t in tasks])
+    nbytes = (ctypes.c_int64 * n)(*[int(t[2]) for t in tasks])
+    dests = (ctypes.c_void_p * n)()
+    for i, t in enumerate(tasks):
+        a = t[3]
+        if not (a.flags["C_CONTIGUOUS"] and a.flags["WRITEABLE"]):
+            raise ValueError("pread_many dest must be a writable C buffer.")
+        if a.nbytes < int(t[2]):
+            raise ValueError("pread_many dest smaller than requested range.")
+        dests[i] = a.ctypes.data_as(ctypes.c_void_p)
+    statuses = (ctypes.c_int32 * n)()
+    failed = lib.hs_pread_many(
+        paths, offsets, nbytes, dests, n, int(n_threads), statuses
+    )
+    if failed:
+        for i in range(n):
+            if statuses[i]:
+                path, rc = tasks[i][0], statuses[i]
+                if rc == -2:
+                    raise OSError(f"Truncated read from {path}.")
+                raise OSError(rc, os.strerror(rc) if rc > 0 else "IO error",
+                              path)
+    return True
+
+
+def write_file_atomic(path: str, data: bytes | np.ndarray) -> bool:
+    """Durable write (tmp + fsync + rename) through the native runtime.
+    Returns False when unavailable (caller falls back to Python IO)."""
+    lib = _load()
+    if lib is None:
+        return False
+    p = Path(path)
+    tmp = p.parent / f".{p.name}.{os.getpid()}.ntmp"
+    if isinstance(data, np.ndarray):
+        buf = np.ascontiguousarray(data).view(np.uint8)
+        ptr = buf.ctypes.data_as(ctypes.c_void_p)
+        nb = buf.nbytes
+    else:
+        ptr = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p)
+        nb = len(data)
+    rc = lib.hs_write_file_atomic(
+        os.fsencode(str(tmp)), os.fsencode(str(p)), ptr, nb
+    )
+    if rc != 0:
+        try:
+            tmp.unlink(missing_ok=True)
+        finally:
+            raise OSError(rc, os.strerror(rc) if rc > 0 else "IO error", path)
+    return True
+
+
+def load_columns(
+    specs: List[Tuple[str, List[Tuple[int, int]]]],
+    n_threads: int = 0,
+) -> Optional[List[List[np.ndarray]]]:
+    """Parallel-load many column buffers: specs is a list of
+    (path, [(offset, nbytes), ...]) per file. Returns per-file lists of
+    uint8 arrays in spec order, or None when native IO is unavailable."""
+    if _load() is None:
+        return None
+    tasks: List[Tuple[str, int, int, np.ndarray]] = []
+    out: List[List[np.ndarray]] = []
+    for path, ranges in specs:
+        bufs = []
+        for off, nb in ranges:
+            dest = np.empty(nb, dtype=np.uint8)
+            bufs.append(dest)
+            tasks.append((path, off, nb, dest))
+        out.append(bufs)
+    if not pread_many(tasks, n_threads):
+        return None
+    return out

@@ -125,6 +125,30 @@ def read_footer(path: str | Path) -> Dict[str, Any]:
             raise HyperspaceException(f"Corrupt TCB footer in {path}: {e}")
 
 
+def _resolve_names(
+    footer: Dict[str, Any], columns: Optional[Iterable[str]], path
+) -> List[str]:
+    want = list(columns) if columns is not None else None
+    by_name = {m["name"]: m for m in footer["columns"]}
+    if want is not None:
+        missing = [c for c in want if c not in by_name]
+        if missing:
+            raise HyperspaceException(f"Columns {missing} not in {path}.")
+    return want if want is not None else [m["name"] for m in footer["columns"]]
+
+
+def _column_from_buffer(meta: Dict[str, Any], buf: np.ndarray, n: int) -> Column:
+    dt = CODE_DTYPE if is_string(meta["dtype"]) else numpy_dtype(meta["dtype"])
+    data = buf.view(dt)[:n]
+    vocab = None
+    if is_string(meta["dtype"]):
+        vocab = np.array(
+            [v.encode("utf-8", "surrogateescape") for v in meta["vocab"]],
+            dtype=object,
+        )
+    return Column(meta["dtype"], data, vocab)
+
+
 def read_batch(
     path: str | Path,
     columns: Optional[Iterable[str]] = None,
@@ -134,13 +158,8 @@ def read_batch(
     are memory-mapped views: no copy happens until the array is handed to
     the device."""
     footer = read_footer(path)
-    want = list(columns) if columns is not None else None
+    names = _resolve_names(footer, columns, path)
     by_name = {m["name"]: m for m in footer["columns"]}
-    if want is not None:
-        missing = [c for c in want if c not in by_name]
-        if missing:
-            raise HyperspaceException(f"Columns {missing} not in {path}.")
-    names = want if want is not None else [m["name"] for m in footer["columns"]]
     n = footer["numRows"]
     cols: Dict[str, Column] = {}
     if mmap:
@@ -149,16 +168,53 @@ def read_batch(
         raw = np.fromfile(path, dtype=np.uint8)
     for name in names:
         m = by_name[name]
-        dt = CODE_DTYPE if is_string(m["dtype"]) else numpy_dtype(m["dtype"])
         buf = raw[m["offset"] : m["offset"] + m["nbytes"]]
-        data = buf.view(dt)[:n]
-        vocab = None
-        if is_string(m["dtype"]):
-            vocab = np.array(
-                [v.encode("utf-8", "surrogateescape") for v in m["vocab"]], dtype=object
-            )
-        cols[name] = Column(m["dtype"], data, vocab)
+        cols[name] = _column_from_buffer(m, buf, n)
     return ColumnarBatch(cols)
+
+
+def read_batches(
+    paths: List[str | Path],
+    columns: Optional[Iterable[str]] = None,
+    n_threads: int = 0,
+) -> List[ColumnarBatch]:
+    """Read (projections of) many TCB files, loading all column buffers
+    concurrently through the native IO runtime (hyperspace_tpu.native) when
+    it is available — the file-grained task parallelism the reference got
+    from Spark's executor pool. Falls back to sequential mmap reads."""
+    from .. import native
+
+    paths = [Path(p) for p in paths]
+    # eager parallel loads only pay off with real cores to run them; on a
+    # single-CPU host the lazy sequential mmap path wins (pages fault in
+    # during compute). HYPERSPACE_TPU_NATIVE=force overrides (tests).
+    multi_core = (os.cpu_count() or 1) > 1 or (
+        os.environ.get("HYPERSPACE_TPU_NATIVE", "").lower() == "force"
+    )
+    if len(paths) > 1 and multi_core and native.available():
+        footers = [read_footer(p) for p in paths]
+        want = list(columns) if columns is not None else None
+        specs = []
+        per_file_meta = []
+        for p, footer in zip(paths, footers):
+            names = _resolve_names(footer, want, p)
+            by_name = {m["name"]: m for m in footer["columns"]}
+            metas = [by_name[nm] for nm in names]
+            specs.append(
+                (str(p), [(m["offset"], m["nbytes"]) for m in metas])
+            )
+            per_file_meta.append((names, metas, footer["numRows"]))
+        loaded = native.load_columns(specs, n_threads)
+        if loaded is not None:
+            out = []
+            for (names, metas, n), bufs in zip(per_file_meta, loaded):
+                cols = {
+                    nm: _column_from_buffer(m, buf, n)
+                    for nm, m, buf in zip(names, metas, bufs)
+                }
+                out.append(ColumnarBatch(cols))
+            return out
+    return [read_batch(p, columns) for p in paths]
 
 
 def prune_by_min_max(

@@ -1,0 +1,142 @@
+// Native IO runtime for hyperspace_tpu: parallel columnar buffer loading.
+//
+// The reference delegates scan IO to Spark's executor pool (file/partition
+// task parallelism, SURVEY.md §2.0); here the equivalent is a small C++
+// thread pool that preads many TCB column buffers concurrently into
+// caller-owned (numpy) memory, releasing Python entirely during the IO.
+// Exposed as a plain C ABI consumed via ctypes (hyperspace_tpu/native).
+//
+// Build: g++ -O3 -march=native -shared -fPIC -pthread tcb_io.cc -o libtcb_io.so
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct LoadTask {
+  const char *path;
+  int64_t offset;
+  int64_t nbytes;
+  void *dest;
+};
+
+// pread the byte range [offset, offset+nbytes) of path into dest.
+// Returns 0 on success, errno on failure.
+int load_one(const LoadTask &t) {
+  int fd = ::open(t.path, O_RDONLY);
+  if (fd < 0)
+    return errno ? errno : -1;
+  int64_t done = 0;
+  int rc = 0;
+  while (done < t.nbytes) {
+    ssize_t got = ::pread(fd, static_cast<char *>(t.dest) + done,
+                          static_cast<size_t>(t.nbytes - done),
+                          static_cast<off_t>(t.offset + done));
+    if (got < 0) {
+      if (errno == EINTR)
+        continue;
+      rc = errno ? errno : -1;
+      break;
+    }
+    if (got == 0) { // truncated file
+      rc = -2;
+      break;
+    }
+    done += got;
+  }
+  ::close(fd);
+  return rc;
+}
+
+} // namespace
+
+extern "C" {
+
+// Load n byte ranges concurrently with up to n_threads workers.
+// statuses[i] receives 0 on success, errno / -2 (truncation) otherwise.
+// Returns the number of failed tasks.
+int hs_pread_many(const char **paths, const int64_t *offsets,
+                  const int64_t *nbytes, void **dests, int32_t n,
+                  int32_t n_threads, int32_t *statuses) {
+  if (n <= 0)
+    return 0;
+  std::vector<LoadTask> tasks(static_cast<size_t>(n));
+  for (int32_t i = 0; i < n; ++i)
+    tasks[static_cast<size_t>(i)] = {paths[i], offsets[i], nbytes[i], dests[i]};
+
+  int32_t workers = n_threads;
+  int32_t hw = static_cast<int32_t>(std::thread::hardware_concurrency());
+  if (workers <= 0)
+    workers = hw > 0 ? hw : 4;
+  if (hw > 0 && workers > hw)
+    workers = hw; // oversubscription only adds contention
+  if (workers > n)
+    workers = n;
+
+  std::atomic<int32_t> next(0);
+  std::atomic<int32_t> failures(0);
+  auto body = [&]() {
+    for (;;) {
+      int32_t i = next.fetch_add(1);
+      if (i >= n)
+        return;
+      int rc = load_one(tasks[static_cast<size_t>(i)]);
+      statuses[i] = rc;
+      if (rc != 0)
+        failures.fetch_add(1);
+    }
+  };
+  if (workers <= 1) {
+    body();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(static_cast<size_t>(workers));
+    for (int32_t w = 0; w < workers; ++w)
+      pool.emplace_back(body);
+    for (auto &t : pool)
+      t.join();
+  }
+  return failures.load();
+}
+
+// Durable single-buffer write: write tmp_path, fsync, rename() to path.
+// Returns 0 on success, errno otherwise. (The operation-log claim itself
+// stays in Python — link(2) semantics there are part of the OCC protocol;
+// this is for bulk index data.)
+int hs_write_file_atomic(const char *tmp_path, const char *path,
+                         const void *data, int64_t nbytes) {
+  int fd = ::open(tmp_path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0)
+    return errno ? errno : -1;
+  int64_t done = 0;
+  while (done < nbytes) {
+    ssize_t put = ::write(fd, static_cast<const char *>(data) + done,
+                          static_cast<size_t>(nbytes - done));
+    if (put < 0) {
+      if (errno == EINTR)
+        continue;
+      int rc = errno;
+      ::close(fd);
+      return rc ? rc : -1;
+    }
+    done += put;
+  }
+  if (::fsync(fd) != 0) {
+    int rc = errno;
+    ::close(fd);
+    return rc ? rc : -1;
+  }
+  ::close(fd);
+  if (std::rename(tmp_path, path) != 0)
+    return errno ? errno : -1;
+  return 0;
+}
+
+} // extern "C"

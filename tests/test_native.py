@@ -1,0 +1,95 @@
+"""Native IO runtime tests (hyperspace_tpu.native + native/tcb_io.cc):
+on-demand g++ build, parallel pread parity with the Python reader, durable
+atomic write, and clean fallback when the library is disabled.
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import native
+from hyperspace_tpu.storage import layout
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+
+@pytest.fixture(scope="module")
+def lib_available():
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+
+
+def _write_files(tmp_path, n_files=4, rows=500):
+    rng = np.random.default_rng(5)
+    paths, batches = [], []
+    for i in range(n_files):
+        batch = ColumnarBatch(
+            {
+                "k": Column.from_values(
+                    rng.integers(0, 1000, rows).astype(np.int64)
+                ),
+                "v": Column.from_values(rng.uniform(0, 1, rows)),
+                "s": Column.from_values(
+                    np.array([b"x", b"yy", b"zzz"], dtype=object)[
+                        rng.integers(0, 3, rows)
+                    ]
+                ),
+            }
+        )
+        p = tmp_path / f"b{i:05d}-n.tcb"
+        layout.write_batch(p, batch, bucket=i)
+        paths.append(p)
+        batches.append(batch)
+    return paths, batches
+
+
+def test_read_batches_parity(tmp_path, lib_available, monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_NATIVE", "force")
+    paths, batches = _write_files(tmp_path)
+    got = layout.read_batches(paths, columns=["k", "s"])
+    assert len(got) == len(paths)
+    for g, want in zip(got, batches):
+        assert list(g.columns) == ["k", "s"]
+        assert np.array_equal(g.columns["k"].data, want.columns["k"].data)
+        assert np.array_equal(
+            g.columns["s"].to_values(), want.columns["s"].to_values()
+        )
+
+
+def test_read_batches_fallback_matches(tmp_path, monkeypatch):
+    paths, _ = _write_files(tmp_path, n_files=2)
+    monkeypatch.setenv("HYPERSPACE_TPU_NATIVE", "force")
+    native_res = layout.read_batches(paths)
+    monkeypatch.setenv("HYPERSPACE_TPU_NATIVE", "off")
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_LIB_FAILED", False)
+    assert not native.available()
+    py_res = layout.read_batches(paths)
+    for a, b in zip(native_res, py_res):
+        for name in a.columns:
+            assert np.array_equal(
+                a.columns[name].to_values(), b.columns[name].to_values()
+            )
+
+
+def test_pread_many_range_and_errors(tmp_path, lib_available):
+    p = tmp_path / "blob.bin"
+    payload = bytes(range(256)) * 8
+    p.write_bytes(payload)
+    dest = np.zeros(100, dtype=np.uint8)
+    assert native.pread_many([(str(p), 50, 100, dest)])
+    assert bytes(dest) == payload[50:150]
+    with pytest.raises(OSError):
+        native.pread_many(
+            [(str(tmp_path / "missing.bin"), 0, 10, np.zeros(10, np.uint8))]
+        )
+    with pytest.raises(OSError):  # truncated range
+        native.pread_many(
+            [(str(p), len(payload) - 10, 100, np.zeros(100, np.uint8))]
+        )
+
+
+def test_write_file_atomic(tmp_path, lib_available):
+    p = tmp_path / "out.bin"
+    data = np.arange(1000, dtype=np.int64)
+    assert native.write_file_atomic(str(p), data)
+    assert np.array_equal(np.fromfile(p, dtype=np.int64), data)
+    assert not list(tmp_path.glob(".out.bin.*"))  # no tmp litter
