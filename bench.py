@@ -1,13 +1,21 @@
-"""Benchmark: index-accelerated point-lookup vs full scan, at row parity.
+"""Benchmark: the five BASELINE.md configs, one composite JSON line.
 
-Implements config 2 of BASELINE.md (FilterIndexRule single-predicate
-lookup on the indexed column): build a covering index on a synthetic
-TPC-H-like lineitem, run the same filter query with Hyperspace off (full
-parquet scan) and on (bucket-pruned, zone-mapped TCB index scan), assert
-row parity, and report the wall-clock speedup.
+Configs (BASELINE.md "Benchmark configs to implement"):
+  1. CoveringIndex build on a TPC-H-like lineitem (l_orderkey; include
+     l_partkey, l_extendedprice) — build wall-clock.
+  2. FilterIndexRule point lookup on the indexed column — speedup vs full
+     parquet scan at row parity.
+  3. JoinIndexRule lineitem⋈orders over two covering indexes (bucket-
+     aligned, shuffle-free SMJ) — speedup vs non-indexed join at
+     row-count parity.
+  4. Hybrid Scan: same filter after appending source files the index has
+     not seen — speedup at row parity (appended rows must appear).
+  5. Data-skipping sketch index (min/max + bloom) range lookup — speedup
+     vs full scan at row parity.
 
+Primary metric: geometric mean of the four query-side speedups (2-5).
 Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+    {"metric": ..., "value": N, "unit": "x", "vs_baseline": N, ...}
 
 Env knobs: BENCH_ROWS (default 2_000_000), BENCH_BUCKETS (default 64),
 BENCH_REPEATS (default 3).
@@ -16,6 +24,7 @@ BENCH_REPEATS (default 3).
 from __future__ import annotations
 
 import json
+import math
 import os
 import shutil
 import sys
@@ -59,6 +68,25 @@ def _make_lineitem(n: int):
     )
 
 
+def _make_orders(n_orders: int):
+    from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+    rng = np.random.default_rng(7)
+    return ColumnarBatch(
+        {
+            "o_orderkey": Column.from_values(
+                np.arange(1, n_orders + 1).astype(np.int64)
+            ),
+            "o_custkey": Column.from_values(
+                rng.integers(1, 150_000, n_orders).astype(np.int64)
+            ),
+            "o_totalprice": Column.from_values(
+                np.round(rng.uniform(1_000.0, 500_000.0, n_orders), 2)
+            ),
+        }
+    )
+
+
 def _time(fn, repeats: int) -> float:
     fn()  # warm-up (compile caches, file caches)
     best = float("inf")
@@ -69,27 +97,61 @@ def _time(fn, repeats: int) -> float:
     return best
 
 
+def _write_source(dir_path: Path, batch, n_files: int):
+    from hyperspace_tpu.storage import parquet_io
+
+    dir_path.mkdir(parents=True, exist_ok=True)
+    n = batch.num_rows
+    per = (n + n_files - 1) // n_files
+    paths = []
+    for i in range(n_files):
+        part = batch.take(np.arange(i * per, min((i + 1) * per, n)))
+        p = dir_path / f"part-{i:03d}.parquet"
+        parquet_io.write_parquet(p, part)
+        paths.append(str(p))
+    return paths
+
+
+def _fail(reason: str):
+    print(
+        json.dumps(
+            {
+                "metric": "index_query_speedup_geomean",
+                "value": 0.0,
+                "unit": "x",
+                "vs_baseline": 0.0,
+                "error": reason,
+            }
+        )
+    )
+    sys.exit(1)
+
+
 def main() -> None:
     if WORKDIR.exists():
         shutil.rmtree(WORKDIR)
-    (WORKDIR / "source").mkdir(parents=True)
 
     from hyperspace_tpu import constants as C
     from hyperspace_tpu.config import HyperspaceConf
     from hyperspace_tpu.hyperspace import Hyperspace
-    from hyperspace_tpu.index.index_config import IndexConfig
-    from hyperspace_tpu.plan.expr import col
+    from hyperspace_tpu.index.index_config import (
+        DataSkippingIndexConfig,
+        IndexConfig,
+    )
+    from hyperspace_tpu.index.sketches import BloomFilterSketch, MinMaxSketch
+    from hyperspace_tpu.plan.expr import col, lit
     from hyperspace_tpu.session import HyperspaceSession
     from hyperspace_tpu.storage import parquet_io
 
-    batch = _make_lineitem(N_ROWS)
-    per = (N_ROWS + N_SOURCE_FILES - 1) // N_SOURCE_FILES
-    paths = []
-    for i in range(N_SOURCE_FILES):
-        part = batch.take(np.arange(i * per, min((i + 1) * per, N_ROWS)))
-        p = WORKDIR / "source" / f"part-{i:03d}.parquet"
-        parquet_io.write_parquet(p, part)
-        paths.append(str(p))
+    lineitem = _make_lineitem(N_ROWS)
+    orders = _make_orders(max(N_ROWS // 4, 2))
+    _write_source(WORKDIR / "lineitem", lineitem, N_SOURCE_FILES)
+    _write_source(WORKDIR / "orders", orders, max(N_SOURCE_FILES // 2, 1))
+    # config-5 source: the same lineitem clustered on l_partkey (sketch
+    # indexes prune files only when values are clustered per file — the
+    # standard data-skipping benchmark layout)
+    clustered = lineitem.take(np.argsort(lineitem.columns["l_partkey"].data))
+    _write_source(WORKDIR / "lineitem_clustered", clustered, N_SOURCE_FILES)
 
     conf = HyperspaceConf(
         {
@@ -99,61 +161,140 @@ def main() -> None:
     )
     session = HyperspaceSession(conf)
     hs = Hyperspace(session)
-    df = session.read.parquet(*paths)
+    df_li = session.read.parquet(str(WORKDIR / "lineitem"))
+    df_or = session.read.parquet(str(WORKDIR / "orders"))
 
+    # ---- config 1: covering index build ------------------------------------
     t0 = time.perf_counter()
     hs.create_index(
-        df,
-        IndexConfig("bench_idx", ["l_orderkey"], ["l_partkey", "l_extendedprice"]),
+        df_li,
+        IndexConfig("li_idx", ["l_orderkey"], ["l_partkey", "l_extendedprice"]),
     )
     build_s = time.perf_counter() - t0
+    hs.create_index(
+        df_or, IndexConfig("or_idx", ["o_orderkey"], ["o_totalprice"])
+    )
+    hs.create_index(
+        session.read.parquet(str(WORKDIR / "lineitem_clustered")),
+        DataSkippingIndexConfig(
+            "li_skip",
+            sketches=[
+                MinMaxSketch("l_partkey"),
+                BloomFilterSketch("l_orderkey"),
+            ],
+        ),
+    )
 
-    lookup_key = int(batch.columns["l_orderkey"].data[N_ROWS // 2])
-    query = lambda: (  # noqa: E731
-        session.read.parquet(*paths)
+    speedups = {}
+    extras = {}
+
+    # ---- config 2: filter point lookup -------------------------------------
+    lookup_key = int(lineitem.columns["l_orderkey"].data[N_ROWS // 2])
+    q2 = lambda: (  # noqa: E731
+        session.read.parquet(str(WORKDIR / "lineitem"))
         .filter(col("l_orderkey") == lookup_key)
         .select("l_orderkey", "l_partkey", "l_extendedprice")
     )
-
     session.disable_hyperspace()
-    rows_off = query().to_pandas().sort_values(list(query().columns())).reset_index(drop=True)
-    off_s = _time(lambda: query().collect(), REPEATS)
-
+    off = q2().to_pandas().sort_values("l_partkey").reset_index(drop=True)
+    off_s = _time(lambda: q2().collect(), REPEATS)
     session.enable_hyperspace()
-    rows_on = query().to_pandas().sort_values(list(query().columns())).reset_index(drop=True)
-    on_s = _time(lambda: query().collect(), REPEATS)
+    on = q2().to_pandas().sort_values("l_partkey").reset_index(drop=True)
+    on_s = _time(lambda: q2().collect(), REPEATS)
+    if not off.equals(on):
+        _fail("config2 row parity violated")
+    speedups["filter_point_lookup"] = off_s / on_s
+    extras["filter_fullscan_s"] = round(off_s, 4)
+    extras["filter_index_s"] = round(on_s, 4)
 
-    if not rows_off.equals(rows_on):
-        print(
-            json.dumps(
-                {
-                    "metric": "filter_point_lookup_speedup",
-                    "value": 0.0,
-                    "unit": "x",
-                    "vs_baseline": 0.0,
-                    "error": "row parity violated",
-                }
-            )
+    # ---- config 3: bucketed SMJ via two indexes ----------------------------
+    q3 = lambda: (  # noqa: E731
+        session.read.parquet(str(WORKDIR / "lineitem"))
+        .join(
+            session.read.parquet(str(WORKDIR / "orders")),
+            col("l_orderkey") == col("o_orderkey"),
         )
-        sys.exit(1)
-
-    speedup = off_s / on_s if on_s > 0 else float("inf")
-    print(
-        json.dumps(
-            {
-                "metric": "filter_point_lookup_speedup",
-                "value": round(speedup, 3),
-                "unit": "x",
-                "vs_baseline": round(speedup, 3),
-                "rows": N_ROWS,
-                "num_buckets": N_BUCKETS,
-                "build_s": round(build_s, 3),
-                "fullscan_s": round(off_s, 4),
-                "index_scan_s": round(on_s, 4),
-                "result_rows": int(len(rows_on)),
-            }
-        )
+        .select("l_partkey", "o_totalprice")
     )
+    session.disable_hyperspace()
+    j_off = q3().collect()
+    joff_s = _time(lambda: q3().collect(), REPEATS)
+    session.enable_hyperspace()
+    j_on = q3().collect()
+    jon_s = _time(lambda: q3().collect(), REPEATS)
+    if j_off.num_rows != j_on.num_rows:
+        _fail("config3 row-count parity violated")
+    if int(j_off.columns["l_partkey"].data.sum()) != int(
+        j_on.columns["l_partkey"].data.sum()
+    ):
+        _fail("config3 checksum parity violated")
+    speedups["join_two_indexes"] = joff_s / jon_s
+    extras["join_rows"] = int(j_on.num_rows)
+    extras["join_fullscan_s"] = round(joff_s, 4)
+    extras["join_index_s"] = round(jon_s, 4)
+
+    # ---- config 4: hybrid scan after appends -------------------------------
+    appended = lineitem.take(
+        np.arange(0, max(N_ROWS // 50, 1))
+    )  # ~2% appended rows, below the 0.3 ratio threshold
+    parquet_io.write_parquet(
+        WORKDIR / "lineitem" / "part-appended.parquet", appended
+    )
+    session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, "true")
+    q4 = lambda: (  # noqa: E731
+        session.read.parquet(str(WORKDIR / "lineitem"))
+        .filter(col("l_orderkey") == lookup_key)
+        .select("l_orderkey", "l_partkey", "l_extendedprice")
+    )
+    session.disable_hyperspace()
+    h_off = q4().to_pandas().sort_values("l_partkey").reset_index(drop=True)
+    hoff_s = _time(lambda: q4().collect(), REPEATS)
+    session.enable_hyperspace()
+    h_on = q4().to_pandas().sort_values("l_partkey").reset_index(drop=True)
+    hon_s = _time(lambda: q4().collect(), REPEATS)
+    if not h_off.equals(h_on):
+        _fail("config4 hybrid-scan row parity violated")
+    if len(h_on) < len(on):
+        _fail("config4 hybrid scan dropped appended rows")
+    speedups["hybrid_scan_lookup"] = hoff_s / hon_s
+    extras["hybrid_fullscan_s"] = round(hoff_s, 4)
+    extras["hybrid_index_s"] = round(hon_s, 4)
+
+    # ---- config 5: data-skipping range lookup ------------------------------
+    # narrow l_partkey range over the clustered copy: the min/max sketch
+    # prunes all but one source file
+    q5 = lambda: (  # noqa: E731
+        session.read.parquet(str(WORKDIR / "lineitem_clustered"))
+        .filter((col("l_partkey") >= lit(777)) & (col("l_partkey") <= lit(779)))
+        .select("l_partkey", "l_suppkey")
+    )
+    session.disable_hyperspace()
+    s_off = q5().to_pandas().sort_values(["l_partkey", "l_suppkey"]).reset_index(drop=True)
+    soff_s = _time(lambda: q5().collect(), REPEATS)
+    session.enable_hyperspace()
+    s_on = q5().to_pandas().sort_values(["l_partkey", "l_suppkey"]).reset_index(drop=True)
+    son_s = _time(lambda: q5().collect(), REPEATS)
+    if not s_off.equals(s_on):
+        _fail("config5 row parity violated")
+    speedups["data_skipping_range"] = soff_s / son_s
+    extras["skipping_fullscan_s"] = round(soff_s, 4)
+    extras["skipping_index_s"] = round(son_s, 4)
+
+    geomean = math.exp(
+        sum(math.log(max(v, 1e-9)) for v in speedups.values()) / len(speedups)
+    )
+    out = {
+        "metric": "index_query_speedup_geomean",
+        "value": round(geomean, 3),
+        "unit": "x",
+        "vs_baseline": round(geomean, 3),
+        "rows": N_ROWS,
+        "num_buckets": N_BUCKETS,
+        "build_s": round(build_s, 3),
+        **{f"speedup_{k}": round(v, 3) for k, v in speedups.items()},
+        **extras,
+    }
+    print(json.dumps(out))
     shutil.rmtree(WORKDIR, ignore_errors=True)
 
 

@@ -54,13 +54,19 @@ class DataFrame(EventLogging):
         disabled). Usage telemetry is emitted only from executed queries
         (``log_usage=True``, set by collect()) — one event per execution,
         as in HyperspaceEvent.scala:150-156."""
+        from .plan.rules.column_pruning import prune_columns
+
+        # column pruning always runs (Catalyst runs its ColumnPruning batch
+        # before extraOptimizations, so the reference's rules see pruned
+        # plans; ours must too — and plain scans read fewer columns).
+        pruned = prune_columns(self.plan)
         if not self.session.is_hyperspace_enabled():
-            return self.plan
+            return pruned
         from .actions import states
         from .plan.rules import apply_hyperspace_rules
 
         indexes = self.session.collection_manager.get_indexes([states.ACTIVE])
-        new_plan, applied = apply_hyperspace_rules(self.plan, indexes, self.session.conf)
+        new_plan, applied = apply_hyperspace_rules(pruned, indexes, self.session.conf)
         if applied and log_usage:
             self.log_event(
                 self.session.conf,

@@ -51,27 +51,53 @@ class Executor:
         return self._exec(plan, predicate=None)
 
     # -- dispatch ------------------------------------------------------------
-    def _exec(self, plan: LogicalPlan, predicate: Optional[Expr]) -> ColumnarBatch:
+    def _exec(
+        self,
+        plan: LogicalPlan,
+        predicate: Optional[Expr],
+        columns: Optional[List[str]] = None,
+    ) -> ColumnarBatch:
+        """``columns``: projection pushed down from an enclosing Project —
+        leaf scans read only these (plus predicate columns)."""
         if isinstance(plan, Filter):
             # push the predicate into the child scan where profitable;
             # row-wise predicates also distribute over unions, keeping
             # bucket/zone pruning alive on the hybrid index side
             child = plan.child
             if isinstance(child, (IndexScan, Scan, Union, BucketUnion)):
-                return self._exec(child, predicate=self._conjoin(predicate, plan.condition))
-            batch = self._exec(child, None)
+                return self._exec(
+                    child,
+                    predicate=self._conjoin(predicate, plan.condition),
+                    columns=columns,
+                )
+            need = None
+            if columns is not None:
+                need = list(
+                    dict.fromkeys(columns + sorted(plan.condition.columns()))
+                )
+            batch = self._exec(child, None, need)
             return self._apply_predicate(batch, self._conjoin(predicate, plan.condition))
         if isinstance(plan, Project):
-            batch = self._exec(plan.child, predicate)
+            batch = self._exec(plan.child, predicate, list(plan.columns))
             return batch.select(list(plan.columns))
         if isinstance(plan, Scan):
             if not plan.relation.files:
                 # zero-file scan (e.g. every file sketch-pruned): empty
                 # result with the relation's schema
                 return ColumnarBatch.empty(dict(plan.relation.schema))
+            need = None
+            if columns is not None:
+                need = list(dict.fromkeys(columns))
+                if predicate is not None:
+                    need = list(
+                        dict.fromkeys(need + sorted(predicate.columns()))
+                    )
+                avail = set(plan.relation.schema)
+                need = [c for c in need if c in avail]
             batch = parquet_io.read_files(
                 plan.relation.read_format,
                 [f.name for f in plan.relation.files],
+                columns=need,
             )
             return self._apply_predicate(batch, predicate)
         if isinstance(plan, IndexScan):
@@ -82,14 +108,14 @@ class Executor:
                 return self._apply_predicate(batch, predicate)
             return self._exec_join(plan)
         if isinstance(plan, Union):
-            parts = [self._exec(c, predicate) for c in plan.children]
+            parts = [self._exec(c, predicate, columns) for c in plan.children]
             return ColumnarBatch.concat(parts)
         if isinstance(plan, (BucketUnion, Repartition)):
             # executed via the bucket-aware path below; standalone execution
             # falls back to plain row semantics
             if isinstance(plan, Repartition):
-                return self._exec(plan.child, predicate)
-            parts = [self._exec(c, predicate) for c in plan.children]
+                return self._exec(plan.child, predicate, columns)
+            parts = [self._exec(c, predicate, columns) for c in plan.children]
             return ColumnarBatch.concat(parts)
         raise HyperspaceException(f"Cannot execute node {plan.node_name}.")
 

@@ -14,6 +14,29 @@ def ensure_x64() -> None:
     import jax
 
     jax.config.update("jax_enable_x64", True)
+    _enable_persistent_compile_cache(jax)
+
+
+def _enable_persistent_compile_cache(jax) -> None:
+    """TPU compiles of the build/query kernels cost tens of seconds (AOT
+    through the runtime helper); the persistent cache makes every process
+    after the first reuse the serialized executable. Opt out with
+    HYPERSPACE_TPU_COMPILE_CACHE=off; relocate with ..._DIR."""
+    import os
+
+    if os.environ.get("HYPERSPACE_TPU_COMPILE_CACHE", "on").lower() == "off":
+        return
+    cache_dir = os.environ.get("HYPERSPACE_TPU_COMPILE_CACHE_DIR")
+    if not cache_dir:
+        from pathlib import Path
+
+        cache_dir = str(Path(__file__).resolve().parent.parent.parent / ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # older jax without these flags: cold compiles only
 
 
 ensure_x64()

@@ -132,26 +132,45 @@ def build_partition_single(
 ) -> Tuple[ColumnarBatch, np.ndarray]:
     """Single-device HOT LOOP: returns the batch reordered so rows are
     grouped by bucket (ascending) and sorted by the key columns within each
-    bucket, plus per-bucket row counts."""
+    bucket, plus per-bucket row counts.
+
+    Rows are padded to the next power of two and the true row count enters
+    the kernel as a *device scalar*, so one compiled executable (tens of
+    seconds of TPU compile through the AOT helper) serves every dataset
+    size in a 2x band — only (schema, keys, num_buckets, padded size)
+    recompile. Pad rows get bucket id ``num_buckets`` and sort to the tail,
+    where the host slice drops them."""
     dtypes = batch.schema()
-    arrays = batch.device_arrays()  # f64 arrives ordered-int64 encoded
+    n = batch.num_rows
+    n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
+    arrays = {
+        name: jnp.asarray(
+            np.pad(encode_for_device(batch.columns[name]), (0, n_pad - n))
+        )
+        for name in batch.column_names
+    }
     vh = {
         k: jnp.asarray(vocab_hashes(batch.columns[k]))
         for k in key_names
         if is_string(dtypes[k])
     }
+    n_dev = jnp.asarray(n, dtype=jnp.int32)
 
     @jax.jit
-    def kernel(arrays, vh):
+    def kernel(arrays, vh, n_valid):
         bucket = device_bucket_ids(arrays, dtypes, key_names, vh, num_buckets)
+        m = bucket.shape[0]
+        bucket = jnp.where(
+            lax.iota(jnp.int32, m) < n_valid, bucket, num_buckets
+        )
         return _sort_by_bucket_and_keys(arrays, bucket, key_names, num_buckets)
 
-    out_arrays, _sorted_bucket, counts = kernel(arrays, vh)
-    counts = np.asarray(counts)
+    out_arrays, _sorted_bucket, counts = kernel(arrays, vh, n_dev)
+    counts = np.asarray(counts)[:num_buckets]
     cols = {
         name: Column(
             dtypes[name],
-            decode_from_device(dtypes[name], np.asarray(out_arrays[name])),
+            decode_from_device(dtypes[name], np.asarray(out_arrays[name])[:n]),
             batch.columns[name].vocab,
         )
         for name in batch.column_names

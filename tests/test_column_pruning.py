@@ -1,0 +1,107 @@
+"""Column-pruning rule tests: join children are narrowed to referenced
+columns + join keys (the Catalyst-ColumnPruning precondition the index
+rules rely on), and execution results are unchanged.
+"""
+
+import numpy as np
+
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.plan.ir import Filter, Join, Project, Scan
+from hyperspace_tpu.plan.rules.column_pruning import prune_columns
+from hyperspace_tpu.sources.relation import FileRelation
+
+
+def _rel(name, schema):
+    return FileRelation(
+        root_paths=[f"/tmp/{name}"], file_format="parquet",
+        schema=schema, files=[],
+    )
+
+
+def _li_scan():
+    return Scan(_rel("li", {
+        "l_orderkey": "int64", "l_partkey": "int64",
+        "l_suppkey": "int64", "l_ship": "string",
+    }))
+
+
+def _or_scan():
+    return Scan(_rel("od", {"o_orderkey": "int64", "o_totalprice": "float64"}))
+
+
+def test_join_children_get_pruned():
+    plan = Project(
+        ("l_partkey", "o_totalprice"),
+        Join(_li_scan(), _or_scan(),
+             col("l_orderkey") == col("o_orderkey"), "inner"),
+    )
+    pruned = prune_columns(plan)
+    join = pruned.child
+    assert isinstance(join.left, Project)
+    assert sorted(join.left.columns) == ["l_orderkey", "l_partkey"]
+    # right side already minimal: no wrapper
+    assert isinstance(join.right, Scan)
+    assert pruned.output_columns() == ["l_partkey", "o_totalprice"]
+
+
+def test_filter_below_join_keeps_condition_columns():
+    plan = Project(
+        ("l_partkey",),
+        Join(
+            Filter(col("l_ship") == lit(b"AIR"), _li_scan()),
+            _or_scan(),
+            col("l_orderkey") == col("o_orderkey"),
+            "inner",
+        ),
+    )
+    pruned = prune_columns(plan)
+    left = pruned.child.left
+    # shape Project(Filter(Scan)) with l_ship preserved for the filter
+    assert isinstance(left, Project)
+    assert sorted(left.columns) == ["l_orderkey", "l_partkey"]
+    assert isinstance(left.child, Filter)
+    assert "l_ship" not in left.columns  # projected away above the filter
+
+
+def test_no_project_when_all_columns_needed():
+    plan = Join(_li_scan(), _or_scan(),
+                col("l_orderkey") == col("o_orderkey"), "inner")
+    pruned = prune_columns(plan)
+    assert pruned is plan  # nothing referenced above: full outputs needed
+
+
+def test_pruned_execution_parity(tmp_path):
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+    from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+    rng = np.random.default_rng(0)
+    n = 2000
+    li = ColumnarBatch({
+        "l_orderkey": Column.from_values(rng.integers(1, 500, n).astype(np.int64)),
+        "l_partkey": Column.from_values(rng.integers(1, 100, n).astype(np.int64)),
+        "l_junk": Column.from_values(rng.integers(0, 9, n).astype(np.int64)),
+    })
+    od = ColumnarBatch({
+        "o_orderkey": Column.from_values(np.arange(1, 501).astype(np.int64)),
+        "o_total": Column.from_values(rng.uniform(1, 10, 500).round(2)),
+    })
+    (tmp_path / "li").mkdir(); (tmp_path / "od").mkdir()
+    parquet_io.write_parquet(tmp_path / "li" / "p0.parquet", li)
+    parquet_io.write_parquet(tmp_path / "od" / "p0.parquet", od)
+    conf = HyperspaceConf({C.INDEX_SYSTEM_PATH: str(tmp_path / "idx")})
+    session = HyperspaceSession(conf)
+    q = (session.read.parquet(str(tmp_path / "li"))
+         .join(session.read.parquet(str(tmp_path / "od")),
+               col("l_orderkey") == col("o_orderkey"))
+         .select("l_partkey", "o_total"))
+    out = q.to_pandas().sort_values(["l_partkey", "o_total"]).reset_index(drop=True)
+    # reference join via pandas
+    import pandas as pd
+    want = (li.to_pandas().merge(
+        od.to_pandas(), left_on="l_orderkey", right_on="o_orderkey")
+        [["l_partkey", "o_total"]]
+        .sort_values(["l_partkey", "o_total"]).reset_index(drop=True))
+    pd.testing.assert_frame_equal(out, want)
