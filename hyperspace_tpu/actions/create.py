@@ -109,6 +109,51 @@ class CreateActionBase:
             parts.append(part)
         return ColumnarBatch.concat(parts)
 
+    # -- streamed data preparation (out-of-core path) ------------------------
+    def prepare_index_chunks(
+        self,
+        relation: FileRelation,
+        indexed: List[str],
+        included: List[str],
+        lineage: bool,
+        tracker: FileIdTracker,
+        chunk_rows: int,
+    ):
+        """Generator twin of prepare_index_batch: yields chunks of at most
+        ``chunk_rows`` rows so the build never materializes the source.
+        Lineage stays per-file (each source file's rows get its id), which
+        the chunk boundary preserves because chunks never span files."""
+        cols = list(indexed) + list(included)
+        if not lineage:
+            for f in relation.files:
+                yield from parquet_io.iter_file_batches(
+                    relation.read_format, f.name, columns=cols, chunk_rows=chunk_rows
+                )
+            return
+        pairs = self.session.sources.lineage_pairs(relation, tracker)
+        for path, fid in pairs:
+            for chunk in parquet_io.iter_file_batches(
+                relation.read_format, path, columns=cols, chunk_rows=chunk_rows
+            ):
+                yield chunk.with_column(
+                    C.DATA_FILE_NAME_ID,
+                    Column("int64", np.full(chunk.num_rows, fid, dtype=np.int64)),
+                )
+
+    def _streaming_build(self, relation: FileRelation) -> bool:
+        """Build-mode policy: 'streaming' forces the out-of-core path,
+        'inmemory' forces the materialized path, 'auto' streams when the
+        source bytes exceed the threshold (the reference never chooses —
+        Spark streams always; 'auto' keeps tiny builds on the lower-latency
+        single-sort kernel)."""
+        mode = self.conf.build_mode()
+        if mode == C.BUILD_MODE_STREAMING:
+            return True
+        if mode == C.BUILD_MODE_INMEMORY:
+            return False
+        total = sum(f.size for f in relation.files)
+        return total > self.conf.build_streaming_threshold_bytes()
+
     # -- build (CreateActionBase.scala:122-140) ------------------------------
     def write(
         self,
@@ -120,6 +165,22 @@ class CreateActionBase:
         tracker: FileIdTracker,
     ) -> List[Path]:
         indexed, included = self.resolved_columns(relation, config)
+        extra_meta = {"indexName": config.index_name}
+        if self._streaming_build(relation):
+            from ..index.stream_builder import write_index_data_streaming
+
+            chunk_rows = self.conf.build_chunk_rows()
+            return write_index_data_streaming(
+                self.prepare_index_chunks(
+                    relation, indexed, included, lineage, tracker, chunk_rows
+                ),
+                indexed,
+                num_buckets,
+                version_dir,
+                chunk_rows,
+                extra_meta=extra_meta,
+                mesh=self.session.mesh,
+            )
         batch = self.prepare_index_batch(relation, indexed, included, lineage, tracker)
         return write_index_data(
             batch,
@@ -127,7 +188,7 @@ class CreateActionBase:
             num_buckets,
             version_dir,
             mesh=self.session.mesh,
-            extra_meta={"indexName": config.index_name},
+            extra_meta=extra_meta,
         )
 
     # -- metadata (CreateActionBase.scala:50-95) -----------------------------

@@ -121,3 +121,24 @@ class HyperspaceConf:
 
     def mesh_bucket_axis(self) -> str:
         return str(self.get(C.TPU_MESH_BUCKET_AXIS, C.TPU_MESH_BUCKET_AXIS_DEFAULT))
+
+    def build_mode(self) -> str:
+        v = str(self.get(C.BUILD_MODE, C.BUILD_MODE_DEFAULT)).lower()
+        if v not in C.BUILD_MODES:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"Unknown build mode {v!r}; expected one of {C.BUILD_MODES}."
+            )
+        return v
+
+    def build_chunk_rows(self) -> int:
+        return int(self.get(C.BUILD_CHUNK_ROWS, C.BUILD_CHUNK_ROWS_DEFAULT))
+
+    def build_streaming_threshold_bytes(self) -> int:
+        return int(
+            self.get(
+                C.BUILD_STREAMING_THRESHOLD_BYTES,
+                C.BUILD_STREAMING_THRESHOLD_BYTES_DEFAULT,
+            )
+        )

@@ -24,6 +24,24 @@ INDEX_NUM_BUCKETS = "hyperspace.index.numBuckets"
 INDEX_NUM_BUCKETS_DEFAULT = 200
 INDEX_NUM_BUCKETS_LEGACY = "hyperspace.num.buckets"  # legacy fallback key
 
+# Out-of-core streaming build (no direct reference analog: Spark streams
+# splits through executors for free — CreateActionBase.scala:122-140 delegates
+# to a distributed scan+shuffle+write. Here the streamed pipeline is explicit:
+# fixed-capacity chunks through one compiled bucketize+sort executable, spill
+# runs grouped by bucket, per-bucket merge at write time. Bounded host RAM and
+# HBM regardless of dataset size.)
+BUILD_MODE = "hyperspace.index.build.mode"
+BUILD_MODE_AUTO = "auto"
+BUILD_MODE_INMEMORY = "inmemory"
+BUILD_MODE_STREAMING = "streaming"
+BUILD_MODES = (BUILD_MODE_AUTO, BUILD_MODE_INMEMORY, BUILD_MODE_STREAMING)
+BUILD_MODE_DEFAULT = BUILD_MODE_AUTO
+BUILD_CHUNK_ROWS = "hyperspace.index.build.chunkRows"
+BUILD_CHUNK_ROWS_DEFAULT = 1 << 21  # 2M rows per streamed chunk
+# auto mode streams when the source files exceed this many bytes on disk
+BUILD_STREAMING_THRESHOLD_BYTES = "hyperspace.index.build.streamingThresholdBytes"
+BUILD_STREAMING_THRESHOLD_BYTES_DEFAULT = 256 * 1024 * 1024
+
 # Lineage (reference: IndexConstants.scala:74-76)
 INDEX_LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"
 INDEX_LINEAGE_ENABLED_DEFAULT = False

@@ -129,6 +129,7 @@ def build_partition_single(
     batch: ColumnarBatch,
     key_names: List[str],
     num_buckets: int,
+    pad_to: Optional[int] = None,
 ) -> Tuple[ColumnarBatch, np.ndarray]:
     """Single-device HOT LOOP: returns the batch reordered so rows are
     grouped by bucket (ascending) and sorted by the key columns within each
@@ -139,10 +140,16 @@ def build_partition_single(
     seconds of TPU compile through the AOT helper) serves every dataset
     size in a 2x band — only (schema, keys, num_buckets, padded size)
     recompile. Pad rows get bucket id ``num_buckets`` and sort to the tail,
-    where the host slice drops them."""
+    where the host slice drops them.
+
+    ``pad_to`` pins the padded size explicitly: the streaming build feeds
+    fixed-capacity chunks so EVERY chunk (including the short tail) reuses
+    one compiled executable — the steady-state throughput path."""
     dtypes = batch.schema()
     n = batch.num_rows
-    n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
+    n_pad = pad_to if pad_to is not None else (1 << (n - 1).bit_length() if n > 1 else 1)
+    if n_pad < n:
+        raise HyperspaceException(f"pad_to={n_pad} smaller than batch rows {n}.")
     arrays = {
         name: jnp.asarray(
             np.pad(encode_for_device(batch.columns[name]), (0, n_pad - n))

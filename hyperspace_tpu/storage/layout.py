@@ -153,14 +153,24 @@ def read_batch(
     path: str | Path,
     columns: Optional[Iterable[str]] = None,
     mmap: bool = True,
+    row_range: Optional[tuple] = None,
 ) -> ColumnarBatch:
     """Read (a projection of) a TCB file. With ``mmap=True`` column buffers
     are memory-mapped views: no copy happens until the array is handed to
-    the device."""
+    the device.
+
+    ``row_range=(start, stop)`` reads only that row slice of each column —
+    columns are fixed-width raw buffers, so a row slice is a byte-range per
+    column. The streaming build's finalize step uses this to pull one
+    bucket's contiguous segment out of every spill run without touching the
+    rest of the file (mmap makes it page-granular IO)."""
     footer = read_footer(path)
     names = _resolve_names(footer, columns, path)
     by_name = {m["name"]: m for m in footer["columns"]}
     n = footer["numRows"]
+    s, e = (0, n) if row_range is None else row_range
+    if not (0 <= s <= e <= n):
+        raise HyperspaceException(f"row_range {row_range} out of [0, {n}] in {path}.")
     cols: Dict[str, Column] = {}
     if mmap:
         raw = np.memmap(path, dtype=np.uint8, mode="r")
@@ -168,8 +178,11 @@ def read_batch(
         raw = np.fromfile(path, dtype=np.uint8)
     for name in names:
         m = by_name[name]
-        buf = raw[m["offset"] : m["offset"] + m["nbytes"]]
-        cols[name] = _column_from_buffer(m, buf, n)
+        dt = CODE_DTYPE if is_string(m["dtype"]) else numpy_dtype(m["dtype"])
+        lo = m["offset"] + s * dt.itemsize
+        hi = m["offset"] + e * dt.itemsize
+        buf = raw[lo:hi]
+        cols[name] = _column_from_buffer(m, buf, e - s)
     return ColumnarBatch(cols)
 
 

@@ -85,3 +85,38 @@ def read_files(file_format: str, paths: Iterable[str | Path], columns=None) -> C
     except KeyError:
         raise HyperspaceException(f"Unsupported source format: {file_format}")
     return reader(paths, columns)
+
+
+def iter_file_batches(
+    file_format: str,
+    path: str | Path,
+    columns: Optional[List[str]] = None,
+    chunk_rows: int = 1 << 21,
+):
+    """Yield ColumnarBatches of at most ``chunk_rows`` rows from one source
+    file — the streamed ingest path of the out-of-core build (the role
+    Spark's split-grained scan plays in CreateActionBase.scala:122-140).
+
+    Parquet streams row-group batches through pyarrow's iterator so host
+    RAM holds one chunk at a time; the textual formats (csv/json) are read
+    whole-file (pyarrow has no row-level streaming for them) and re-sliced,
+    which still bounds memory at file granularity."""
+    path = str(path)
+    if file_format == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        pf = pq.ParquetFile(path)
+        for rb in pf.iter_batches(batch_size=chunk_rows, columns=columns):
+            if rb.num_rows == 0:
+                continue
+            yield ColumnarBatch.from_arrow(pa.Table.from_batches([rb]))
+        return
+    whole = read_files(file_format, [path], columns=columns)
+    n = whole.num_rows
+    if n == 0:
+        return
+    import numpy as np
+
+    for s in range(0, n, chunk_rows):
+        yield whole.take(np.arange(s, min(s + chunk_rows, n)))
