@@ -108,6 +108,8 @@ class StreamingIndexWriter:
         self._spill_dir = self.out_dir / SPILL_DIR_NAME
         self._spills: List[Path] = []
         self._spill_counts: List[np.ndarray] = []
+        self._pending: List[ColumnarBatch] = []
+        self._pending_rows = 0
         self._rows = 0
         self._chunk_times: List[float] = []
         self._finalized = False
@@ -127,15 +129,31 @@ class StreamingIndexWriter:
 
     # -- ingest ---------------------------------------------------------------
     def add_chunk(self, batch: ColumnarBatch) -> None:
+        """Buffer rows and run capacity-sized chunks through the device
+        kernel. Coalescing across add_chunk calls matters for small-file
+        sources: every kernel run pads to the full chunk capacity, so
+        feeding a 100-row file its own run would pay the whole padded sort
+        for 100 rows — buffering makes cost proportional to total rows, not
+        file count. Oversized batches are split."""
         if self._finalized:
             raise HyperspaceException("Writer already finalized.")
         if batch.num_rows == 0:
             return
-        if batch.num_rows > self.chunk_capacity:
-            raise HyperspaceException(
-                f"Chunk of {batch.num_rows} rows exceeds capacity "
-                f"{self.chunk_capacity}."
+        self._pending.append(batch)
+        self._pending_rows += batch.num_rows
+        while self._pending_rows >= self.chunk_capacity:
+            merged = (
+                self._pending[0]
+                if len(self._pending) == 1
+                else ColumnarBatch.concat(self._pending)
             )
+            emit = merged.take(np.arange(self.chunk_capacity))
+            rest = merged.take(np.arange(self.chunk_capacity, merged.num_rows))
+            self._pending = [rest] if rest.num_rows else []
+            self._pending_rows = rest.num_rows
+            self._process_chunk(emit)
+
+    def _process_chunk(self, batch: ColumnarBatch) -> None:
         t0 = time.perf_counter()
         if self.mesh is not None and self.mesh.devices.size > 1:
             # multi-chip chunk: shard_map bucketize + ICI all_to_all, then
@@ -169,24 +187,36 @@ class StreamingIndexWriter:
         files. Returns the written paths (sorted)."""
         if self._finalized:
             raise HyperspaceException("Writer already finalized.")
+        if self._pending:
+            tail = (
+                self._pending[0]
+                if len(self._pending) == 1
+                else ColumnarBatch.concat(self._pending)
+            )
+            self._pending = []
+            self._pending_rows = 0
+            self._process_chunk(tail)
         self._finalized = True
         t0 = time.perf_counter()
         written: List[Path] = []
         if self._spills:
-            # per-spill cumulative row offsets of each bucket segment
+            # per-spill cumulative row offsets of each bucket segment; one
+            # reader per spill (footer parsed + vocab decoded once, not per
+            # (bucket, run) pair)
             offsets = [
                 np.concatenate([[0], np.cumsum(c)]) for c in self._spill_counts
             ]
+            readers = [layout.TcbReader(p) for p in self._spills]
             totals = np.sum(self._spill_counts, axis=0)
             self.out_dir.mkdir(parents=True, exist_ok=True)
             for b in range(self.num_buckets):
                 if totals[b] == 0:
                     continue
                 runs = []
-                for path, off in zip(self._spills, offsets):
+                for reader, off in zip(readers, offsets):
                     s, e = int(off[b]), int(off[b + 1])
                     if e > s:
-                        runs.append(layout.read_batch(path, row_range=(s, e)))
+                        runs.append(reader.read(row_range=(s, e)))
                 merged = merge_sorted_runs(runs, self.indexed_cols)
                 p = self.out_dir / layout.bucket_file_name(b)
                 layout.write_batch(

@@ -188,6 +188,93 @@ def build_partition_single(
 # ---------------------------------------------------------------------------
 # multi-device build kernel (shard_map + all_to_all over ICI)
 # ---------------------------------------------------------------------------
+_sharded_build_cache: dict = {}
+
+
+def _sharded_build_fn(
+    mesh: Mesh,
+    axis: str,
+    dtypes_sig: tuple,
+    key_names: tuple,
+    vh_names: tuple,
+    num_buckets: int,
+    cap: int,
+):
+    """Build (and cache) the jitted shard_map program for one
+    (mesh, schema, keys, num_buckets, capacity) signature. The streaming
+    build calls this per chunk; without the cache every chunk would
+    re-trace and re-compile, forfeiting the fixed-executable steady state
+    the chunked design exists for. ``cap`` and the shard row count are
+    quantized to powers of two by the caller so per-chunk skew variation
+    doesn't mint new executables."""
+    key = (mesh, axis, dtypes_sig, key_names, vh_names, num_buckets, cap)
+    fn = _sharded_build_cache.get(key)
+    if fn is not None:
+        return fn
+    dtypes = dict(dtypes_sig)
+    D = mesh.devices.size
+
+    def shard_fn(arrays, valid, vh):
+        # local shapes: (shard_rows,)
+        bucket = device_bucket_ids(arrays, dtypes, list(key_names), vh, num_buckets)
+        dest = jnp.where(valid, bucket % D, D)  # invalid rows -> out of range
+        m = dest.shape[0]
+        iota = lax.iota(jnp.int32, m)
+        sorted_dest, perm = lax.sort([dest, iota], num_keys=1)
+        counts = jnp.bincount(dest, length=D)
+        starts = jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)])[:D + 1]
+        pos = iota - starts[jnp.clip(sorted_dest, 0, D)].astype(jnp.int32)
+
+        def exchange(x):
+            buf = jnp.zeros((D, cap) + x.shape[1:], x.dtype)
+            buf = buf.at[sorted_dest, pos].set(x[perm], mode="drop")
+            return lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=False)
+
+        vmask = jnp.zeros((D, cap), jnp.bool_)
+        vmask = vmask.at[sorted_dest, pos].set(valid[perm], mode="drop")
+        vmask = lax.all_to_all(vmask, axis, split_axis=0, concat_axis=0, tiled=False)
+
+        recv = {name: exchange(x).reshape((D * cap,) + x.shape[1:]) for name, x in arrays.items()}
+        recv_bucket = exchange(bucket).reshape(D * cap)
+        vflat = vmask.reshape(D * cap)
+
+        masked_bucket = jnp.where(vflat, recv_bucket, num_buckets)
+        out, sorted_bucket, _ = _sort_by_bucket_and_keys(
+            recv, masked_bucket, list(key_names), num_buckets
+        )
+        local_counts = jnp.bincount(masked_bucket, length=num_buckets)
+        n_valid = vflat.sum().astype(jnp.int32)[None]  # rank-1 for out_specs
+        return out, sorted_bucket, local_counts, n_valid
+
+    from jax import shard_map
+
+    names = [name for name, _ in dtypes_sig]
+    in_specs = (
+        {name: PartitionSpec(axis) for name in names},
+        PartitionSpec(axis),
+        {k: PartitionSpec() for k in vh_names},
+    )
+    out_specs = (
+        {name: PartitionSpec(axis) for name in names},
+        PartitionSpec(axis),
+        PartitionSpec(axis),
+        PartitionSpec(axis),
+    )
+    fn = jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+    if len(_sharded_build_cache) >= 64:
+        _sharded_build_cache.pop(next(iter(_sharded_build_cache)))
+    _sharded_build_cache[key] = fn
+    return fn
+
+
 def build_partition_sharded(
     batch: ColumnarBatch,
     key_names: List[str],
@@ -212,15 +299,19 @@ def build_partition_sharded(
     )
     host_dest = host_bucket % D
 
-    n_pad = max(((n + D - 1) // D) * D, D)
-    shard_rows = n_pad // D
-    # max rows any one src shard sends to any one dst device
+    # shard rows quantized to a power of two so repeated chunked calls of
+    # similar sizes share one executable
+    shard_rows = max(-(-n // D), 1)
+    shard_rows = 1 << (shard_rows - 1).bit_length()
+    n_pad = shard_rows * D
+    # max rows any one src shard sends to any one dst device, power-of-two
+    # quantized for the same reason (skew varies chunk to chunk)
     cap = 1
     for s in range(D):
         seg = host_dest[s * shard_rows : min((s + 1) * shard_rows, n)]
         if seg.size:
             cap = max(cap, int(np.bincount(seg, minlength=D).max()))
-    cap = ((cap + 7) // 8) * 8  # modest alignment to stabilize compile shapes
+    cap = 1 << (cap - 1).bit_length()
 
     def pad(a: np.ndarray) -> np.ndarray:
         return np.pad(a, (0, n_pad - n))
@@ -240,59 +331,14 @@ def build_partition_sharded(
         if is_string(dtypes[k])
     }
 
-    def shard_fn(arrays, valid, vh):
-        # local shapes: (shard_rows,)
-        bucket = device_bucket_ids(arrays, dtypes, key_names, vh, num_buckets)
-        dest = jnp.where(valid, bucket % D, D)  # invalid rows -> out of range
-        m = dest.shape[0]
-        iota = lax.iota(jnp.int32, m)
-        sorted_dest, perm = lax.sort([dest, iota], num_keys=1)
-        counts = jnp.bincount(dest, length=D)
-        starts = jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)])[:D + 1]
-        pos = iota - starts[jnp.clip(sorted_dest, 0, D)].astype(jnp.int32)
-
-        def exchange(x):
-            buf = jnp.zeros((D, cap) + x.shape[1:], x.dtype)
-            buf = buf.at[sorted_dest, pos].set(x[perm], mode="drop")
-            return lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=False)
-
-        vmask = jnp.zeros((D, cap), jnp.bool_)
-        vmask = vmask.at[sorted_dest, pos].set(valid[perm], mode="drop")
-        vmask = lax.all_to_all(vmask, axis, split_axis=0, concat_axis=0, tiled=False)
-
-        recv = {name: exchange(x).reshape((D * cap,) + x.shape[1:]) for name, x in arrays.items()}
-        recv_bucket = exchange(bucket).reshape(D * cap)
-        vflat = vmask.reshape(D * cap)
-
-        masked_bucket = jnp.where(vflat, recv_bucket, num_buckets)
-        out, sorted_bucket, _ = _sort_by_bucket_and_keys(
-            recv, masked_bucket, key_names, num_buckets
-        )
-        local_counts = jnp.bincount(masked_bucket, length=num_buckets)
-        n_valid = vflat.sum().astype(jnp.int32)[None]  # rank-1 for out_specs
-        return out, sorted_bucket, local_counts, n_valid
-
-    from jax import shard_map
-
-    in_specs = (
-        {name: PartitionSpec(axis) for name in dev_arrays},
-        PartitionSpec(axis),
-        {k: PartitionSpec() for k in vh},
-    )
-    out_specs = (
-        {name: PartitionSpec(axis) for name in dev_arrays},
-        PartitionSpec(axis),
-        PartitionSpec(axis),
-        PartitionSpec(axis),
-    )
-    fn = jax.jit(
-        shard_map(
-            shard_fn,
-            mesh=mesh,
-            in_specs=in_specs,
-            out_specs=out_specs,
-            check_vma=False,
-        )
+    fn = _sharded_build_fn(
+        mesh,
+        axis,
+        tuple(dtypes.items()),
+        tuple(key_names),
+        tuple(sorted(vh)),
+        num_buckets,
+        cap,
     )
     out_arrays, out_bucket, counts_all, n_valid_all = fn(dev_arrays, valid, vh)
 

@@ -14,14 +14,20 @@ sort-merge-join, RuleUtils.scala:286,400, JoinIndexRule.scala:39-50):
 2. **Sorted-intersection join counts** (`sorted_intersect_counts`) — the
    inner kernel of the bucketed sort-merge join. For each left key, counts
    how many sorted right keys are (a) smaller and (b) equal, giving the
-   [lo, lo+cnt) match range directly. The kernel is a 2-D grid over
-   (left tile × right tile) with *zone pruning*: per-tile min/max
-   (scalar-prefetched into SMEM) let a grid step either skip entirely
-   (disjoint ranges), add a constant (right tile wholly below left tile),
-   or do the dense VPU compare only where ranges overlap. For sorted
-   inputs that makes the work O(n · overlap) — a merge — while staying
-   branch-free and gather-free (Mosaic has no vector gather; binary search
-   is the wrong shape for the VPU).
+   [lo, lo+cnt) match range directly. The host precomputes, per left tile,
+   the span of right tiles its key range [tile_min, tile_max] intersects
+   (a handful of binary searches — O(n_tiles log n_r)) plus a tile-aligned
+   *base* count of right tiles wholly below the span. The kernel is then a
+   (left tile × max_span) grid — NOT (left × right): scalar-prefetched
+   span starts drive the right operand's block index map, so each grid
+   step loads exactly the overlapping right tile and does the dense VPU
+   compare there; steps beyond a tile's span are predicated off. For
+   locally-clustered left keys (index data is key-sorted per bucket) the
+   span is 1–3 tiles and the work is a true merge, with none of the
+   grid-bubble overhead a zone-pruned full cross grid pays on its skipped
+   steps. Wide spans (heavily skewed overlap) fall back to the host path,
+   where binary search wins anyway. Gather-free by construction (Mosaic
+   has no vector gather; binary search is the wrong shape for the VPU).
 
 Mosaic does not lower 64-bit integers (observed: recursion blow-up in the
 i64 legalization pass), so both kernels are int32-only; callers narrow
@@ -242,38 +248,39 @@ def _tile_min_max(a32: np.ndarray, tile: int, n_tiles: int):
     return lo, hi
 
 
-def _build_smj_call(n_l_sub: int, n_r_tiles: int):
+# A left tile whose key range overlaps more right tiles than this falls
+# back to the host path: the dense compare would be O(span) per key while
+# binary search stays O(log n) — heavy skew is binary search's home turf.
+SMJ_MAX_SPAN_TILES = 64
+
+
+def _build_smj_call(n_l_sub: int, n_r_tiles: int, max_span: int):
     """n_l_sub: left rows-of-128 (multiple of SMJ_L_SUBLANES);
-    n_r_tiles: right tiles of 128 keys."""
+    n_r_tiles: right tiles of SMJ_R_SUBLANES*128 keys;
+    max_span: grid extent of the per-left-tile right-tile span."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    grid = (n_l_sub // SMJ_L_SUBLANES, n_r_tiles)
+    grid = (n_l_sub // SMJ_L_SUBLANES, max_span)
 
-    def kern(l_lo, l_hi, r_lo, r_hi, r_cnt, l_ref, r_ref, lt_ref, eq_ref):
+    def kern(s_tile, span, base, l_ref, r_ref, lt_ref, eq_ref):
         i = pl.program_id(0)
         j = pl.program_id(1)
 
         @pl.when(j == 0)
         def _():
-            lt_ref[:] = jnp.zeros_like(lt_ref[:])
+            # right tiles wholly below this left tile's span: every valid
+            # key there is < every key here — host-counted constant.
+            lt_ref[:] = jnp.zeros_like(lt_ref[:]) + base[i]
             eq_ref[:] = jnp.zeros_like(eq_ref[:])
 
-        llo, lhi = l_lo[i], l_hi[i]
-        rlo, rhi = r_lo[j], r_hi[j]
-
-        # right tile wholly below the left tile: every valid right key is
-        # < every left key — constant contribution, no compare.
-        @pl.when(rhi < llo)
-        def _():
-            lt_ref[:] = lt_ref[:] + r_cnt[j]
-
-        # overlapping ranges: dense VPU compare of 1024 × 1024 keys,
-        # 128 right keys at a time (pads are INT32_MAX: never < or ==
-        # any real normalized key).
-        @pl.when((rhi >= llo) & (rlo <= lhi))
+        # dense VPU compare against the j-th right tile of this left
+        # tile's span (the block index map already loaded it), 128 right
+        # keys at a time (pads are INT32_MAX: never < or == any real
+        # normalized key).
+        @pl.when(j < span[i])
         def _():
             l3 = l_ref[:][:, :, None]  # (SMJ_SUB, 128, 1)
 
@@ -291,13 +298,18 @@ def _build_smj_call(n_l_sub: int, n_r_tiles: int):
             lt_ref[:] = lt_ref[:] + lt
             eq_ref[:] = eq_ref[:] + eq
 
+    def r_index(i, j, s_tile, span, base):
+        # scalar-prefetch-driven block index: the j-th tile of left tile
+        # i's span, clamped in-bounds (predicated off when j >= span[i])
+        return (jnp.minimum(s_tile[i] + j, n_r_tiles - 1), 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
+        num_scalar_prefetch=3,
         grid=grid,
         in_specs=[
             pl.BlockSpec((SMJ_L_SUBLANES, LANES), lambda i, j, *_: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((SMJ_R_SUBLANES, LANES), lambda i, j, *_: (j, 0),
+            pl.BlockSpec((SMJ_R_SUBLANES, LANES), r_index,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -327,7 +339,11 @@ def sorted_intersect_counts(
     searchsorted-left positions and run lengths, computed on the VPU.
 
     Keys must be int64/int32; int64 is jointly range-narrowed to int32
-    (None on overflow → caller falls back to numpy searchsorted).
+    (None on overflow → caller falls back to numpy searchsorted). Left
+    tiles whose key range spans too many right tiles (scattered or
+    heavily-skewed keys) also return None — the dense-compare merge only
+    wins when left keys are locally clustered, which bucketed index data
+    (key-sorted per bucket) always is.
     """
     n_l, n_r = len(l_keys), len(r_sorted)
     if n_l == 0 or n_r == 0:
@@ -345,30 +361,59 @@ def sorted_intersect_counts(
     r_tile = SMJ_R_SUBLANES * LANES
     n_l_pad = -(-n_l // l_tile) * l_tile
     n_r_pad = -(-n_r // r_tile) * r_tile
+    n_l_tiles = n_l_pad // l_tile
+    n_r_tiles = n_r_pad // r_tile
+
+    # Host span planning: per left tile, the right tiles its [min, max]
+    # range intersects. O(n_l_tiles log n_r) binary searches — noise next
+    # to the O(n_l · span) device compare they unlock.
+    l_lo, l_hi = _tile_min_max(l32, l_tile, n_l_tiles)
+    start_pos = np.searchsorted(r32, l_lo, side="left")
+    end_pos = np.searchsorted(r32, l_hi, side="right")
+    s_tile = (start_pos // r_tile).astype(np.int32)
+    e_tile_excl = np.maximum(-(-end_pos // r_tile), s_tile).astype(np.int32)
+    span = (e_tile_excl - s_tile).astype(np.int32)
+    # Wide tiles (key range covering many right tiles — run boundaries in
+    # piecewise-sorted input, or skew) are predicated out of the kernel and
+    # fixed up on host; if they dominate, the input is scattered and binary
+    # search wins outright.
+    wide = span > SMJ_MAX_SPAN_TILES
+    if wide.mean() > 0.25:
+        return None
+    if wide.any():
+        span = np.where(wide, 0, span).astype(np.int32)
+        s_tile = np.where(wide, 0, s_tile).astype(np.int32)
+    max_span = int(span.max()) if len(span) else 0
+    # round the grid extent up to a power of two: steps beyond span[i] are
+    # predicated off and the r block index is clamped, so over-provisioning
+    # is free — and the executable cache stops keying on the data's exact
+    # overlap profile (7 variants instead of one per distinct max_span)
+    if max_span > 1:
+        max_span = 1 << (max_span - 1).bit_length()
+    base = (s_tile.astype(np.int64) * r_tile).astype(np.int32)
+
     l_p = np.full(n_l_pad, _I32_MAX, dtype=np.int32)
     l_p[:n_l] = l32
     r_p = np.full(n_r_pad, _I32_MAX, dtype=np.int32)
     r_p[:n_r] = r32
-
     l2 = l_p.reshape(-1, LANES)
     r2 = r_p.reshape(-1, LANES)
-    # per-tile zone metadata over VALID keys only
-    n_l_tiles = n_l_pad // l_tile
-    n_r_tiles = n_r_pad // r_tile
-    l_lo, l_hi = _tile_min_max(l32, l_tile, n_l_tiles)
-    r_lo, r_hi = _tile_min_max(r32, r_tile, n_r_tiles)
-    r_cnt = np.full(n_r_tiles, r_tile, dtype=np.int32)
-    r_cnt[-1] = n_r - (n_r_tiles - 1) * r_tile
 
-    key = (n_l_pad // LANES, n_r_tiles, kernels_mode())
+    key = (n_l_pad // LANES, n_r_tiles, max(max_span, 1), kernels_mode())
     with _x32():
         fn = _smj_call_cache.get(key)
         if fn is None:
-            fn = _build_smj_call(n_l_pad // LANES, n_r_tiles)
+            fn = _build_smj_call(n_l_pad // LANES, n_r_tiles, max(max_span, 1))
             if len(_smj_call_cache) >= 256:
                 _smj_call_cache.pop(next(iter(_smj_call_cache)))
             _smj_call_cache[key] = fn
-        lt, eq = fn(l_lo, l_hi, r_lo, r_hi, r_cnt, l2, r2)
+        lt, eq = fn(s_tile, span, base, l2, r2)
     lt = np.asarray(lt).reshape(-1)[:n_l].astype(np.int64)
     eq = np.asarray(eq).reshape(-1)[:n_l].astype(np.int64)
+    if wide.any():
+        for t in np.flatnonzero(wide):
+            s, e = int(t) * l_tile, min((int(t) + 1) * l_tile, n_l)
+            q = l32[s:e]
+            lt[s:e] = np.searchsorted(r32, q, side="left")
+            eq[s:e] = np.searchsorted(r32, q, side="right") - lt[s:e]
     return lt, eq

@@ -149,6 +149,64 @@ def _column_from_buffer(meta: Dict[str, Any], buf: np.ndarray, n: int) -> Column
     return Column(meta["dtype"], data, vocab)
 
 
+class TcbReader:
+    """A handle over one TCB file: footer parsed once, buffer mapped once,
+    string vocabs decoded once — then any number of (projection, row-range)
+    reads. The streaming build's finalize step does num_buckets reads per
+    spill run; without this handle each read would re-parse the JSON footer
+    (which embeds the full vocab for string columns) per (bucket, run)."""
+
+    def __init__(self, path: str | Path, mmap: bool = True):
+        self.path = Path(path)
+        self.footer = read_footer(path)
+        self._by_name = {m["name"]: m for m in self.footer["columns"]}
+        if mmap:
+            self._raw = np.memmap(self.path, dtype=np.uint8, mode="r")
+        else:
+            self._raw = np.fromfile(self.path, dtype=np.uint8)
+        self._vocabs: Dict[str, np.ndarray] = {}
+
+    @property
+    def num_rows(self) -> int:
+        return self.footer["numRows"]
+
+    def _vocab(self, name: str) -> np.ndarray:
+        v = self._vocabs.get(name)
+        if v is None:
+            v = np.array(
+                [
+                    x.encode("utf-8", "surrogateescape")
+                    for x in self._by_name[name]["vocab"]
+                ],
+                dtype=object,
+            )
+            self._vocabs[name] = v
+        return v
+
+    def read(
+        self,
+        columns: Optional[Iterable[str]] = None,
+        row_range: Optional[tuple] = None,
+    ) -> ColumnarBatch:
+        names = _resolve_names(self.footer, columns, self.path)
+        n = self.num_rows
+        s, e = (0, n) if row_range is None else row_range
+        if not (0 <= s <= e <= n):
+            raise HyperspaceException(
+                f"row_range {row_range} out of [0, {n}] in {self.path}."
+            )
+        cols: Dict[str, Column] = {}
+        for name in names:
+            m = self._by_name[name]
+            dt = CODE_DTYPE if is_string(m["dtype"]) else numpy_dtype(m["dtype"])
+            lo = m["offset"] + s * dt.itemsize
+            hi = m["offset"] + e * dt.itemsize
+            data = self._raw[lo:hi].view(dt)
+            vocab = self._vocab(name) if is_string(m["dtype"]) else None
+            cols[name] = Column(m["dtype"], data, vocab)
+        return ColumnarBatch(cols)
+
+
 def read_batch(
     path: str | Path,
     columns: Optional[Iterable[str]] = None,
@@ -161,29 +219,9 @@ def read_batch(
 
     ``row_range=(start, stop)`` reads only that row slice of each column —
     columns are fixed-width raw buffers, so a row slice is a byte-range per
-    column. The streaming build's finalize step uses this to pull one
-    bucket's contiguous segment out of every spill run without touching the
-    rest of the file (mmap makes it page-granular IO)."""
-    footer = read_footer(path)
-    names = _resolve_names(footer, columns, path)
-    by_name = {m["name"]: m for m in footer["columns"]}
-    n = footer["numRows"]
-    s, e = (0, n) if row_range is None else row_range
-    if not (0 <= s <= e <= n):
-        raise HyperspaceException(f"row_range {row_range} out of [0, {n}] in {path}.")
-    cols: Dict[str, Column] = {}
-    if mmap:
-        raw = np.memmap(path, dtype=np.uint8, mode="r")
-    else:
-        raw = np.fromfile(path, dtype=np.uint8)
-    for name in names:
-        m = by_name[name]
-        dt = CODE_DTYPE if is_string(m["dtype"]) else numpy_dtype(m["dtype"])
-        lo = m["offset"] + s * dt.itemsize
-        hi = m["offset"] + e * dt.itemsize
-        buf = raw[lo:hi]
-        cols[name] = _column_from_buffer(m, buf, e - s)
-    return ColumnarBatch(cols)
+    column (mmap makes it page-granular IO). For repeated range reads of
+    the same file use ``TcbReader`` directly."""
+    return TcbReader(path, mmap=mmap).read(columns, row_range)
 
 
 def read_batches(

