@@ -81,7 +81,9 @@ class DataFrame(EventLogging):
     def collect(self) -> ColumnarBatch:
         from .exec.executor import Executor
 
-        return Executor(self.session.conf).execute(self.optimized_plan(log_usage=True))
+        return Executor(self.session.conf, mesh=self.session.mesh).execute(
+            self.optimized_plan(log_usage=True)
+        )
 
     def to_pandas(self):
         return self.collect().to_pandas()

@@ -1,0 +1,353 @@
+"""Multi-device query execution: bucket-parallel scan and join under
+shard_map.
+
+This is the query-side half of the mesh story (the build half lives in
+ops/build.py). The reference distributes query work through Spark's
+executor pool with partitioning preserved — bucketed scans run as one task
+per bucket and the exchange-free SMJ joins co-partitioned buckets in place
+(BucketUnionExec.scala:104-121, JoinIndexRule.scala:39-50). Here the mesh
+replaces the executor pool and the placement rule is physical:
+
+* bucket b of every index lives on device ``owner_of_bucket(b, D) = b % D``
+  (parallel.mesh) — the same rule the sharded build writes with, so a
+  bucketed query touches no collective at all;
+* **filter**: each device evaluates the predicate mask over its own
+  buckets' rows in one shard_map call (rows packed to a static per-device
+  capacity); the host compacts each shard with its returned mask;
+* **join**: each device joins its own buckets of the two sides locally —
+  the shuffle-free SMJ. The match-range lookup is sort-based: two
+  ``lax.sort`` passes over the concatenated (key, side-tag) arrays yield
+  "count of right keys < / <= each left key" without gather or binary
+  search (both are wrong shapes for the TPU; sort is XLA's fastest
+  primitive here and already the build's workhorse). Expansion of the
+  ragged match ranges stays on host — dynamic result shapes cannot live
+  under jit.
+
+Static shapes throughout: per-device row counts are padded to the max
+across devices (power-of-two quantized) with INT64_MAX sentinels that sort
+to the tail and never compare equal to real keys.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..ops import ensure_x64
+from ..parallel.mesh import owner_of_bucket
+from ..plan.expr import Expr, bind_string_literals, eval_mask
+from ..storage.columnar import Column, ColumnarBatch
+from ..telemetry.metrics import metrics
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax, shard_map  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: E402
+
+_I64_PAD = np.iinfo(np.int64).max
+
+
+def _pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+def group_by_owner(
+    by_bucket: Dict[int, ColumnarBatch], n_devices: int
+) -> List[List[int]]:
+    """Owned bucket ids per device, ascending — the placement rule shared
+    with the sharded build."""
+    owned: List[List[int]] = [[] for _ in range(n_devices)]
+    for b in sorted(by_bucket):
+        owned[owner_of_bucket(b, n_devices)].append(b)
+    return owned
+
+
+# ---------------------------------------------------------------------------
+# distributed filter
+# ---------------------------------------------------------------------------
+_dist_mask_cache: dict = {}
+
+
+def _dist_mask_fn(mesh: Mesh, bound_repr: str, bound: Expr, shim: ColumnarBatch,
+                  sig: tuple):
+    key = (mesh, bound_repr, sig)
+    fn = _dist_mask_cache.get(key)
+    if fn is not None:
+        return fn
+    axis = mesh.axis_names[0]
+    spec = {name: PartitionSpec(axis, None) for name, _ in sig}
+
+    def shard_fn(arrays):
+        return eval_mask(bound, shim, arrays)
+
+    fn = jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=PartitionSpec(axis, None),
+            check_vma=False,
+        )
+    )
+    if len(_dist_mask_cache) >= 128:
+        _dist_mask_cache.pop(next(iter(_dist_mask_cache)))
+    _dist_mask_cache[key] = fn
+    return fn
+
+
+def distributed_filter(
+    by_bucket: Dict[int, ColumnarBatch],
+    predicate: Optional[Expr],
+    output_columns: List[str],
+    mesh: Mesh,
+) -> ColumnarBatch:
+    """Filter bucket-grouped rows with per-device mask evaluation. Buckets
+    are packed onto their owner device's shard; one shard_map call masks
+    every device's rows in parallel; the host compacts survivors.
+
+    float64 predicates evaluate on host (f64 never transits the device
+    raw — ops.floatbits); so do empty inputs."""
+    batches = [by_bucket[b] for b in sorted(by_bucket)]
+    if not batches:
+        raise HyperspaceException("distributed_filter over zero buckets.")
+    whole = ColumnarBatch.concat(batches) if len(batches) > 1 else batches[0]
+    if predicate is None:
+        return whole.select(output_columns)
+    D = mesh.devices.size
+    names = sorted(predicate.columns())
+    if any(whole.columns[n].dtype_str == "float64" for n in names):
+        mask = np.asarray(eval_mask(predicate, whole))
+        metrics.incr("scan.path.host_f64")
+        return whole.take(np.flatnonzero(mask)).select(output_columns)
+
+    # re-split the (dictionary-unified) concat by owner device
+    owned = group_by_owner(by_bucket, D)
+    sizes = {b: by_bucket[b].num_rows for b in by_bucket}
+    order = [b for dev in owned for b in dev]
+    offsets = {}
+    pos = 0
+    for b in sorted(by_bucket):
+        offsets[b] = pos
+        pos += sizes[b]
+    dev_rows = [sum(sizes[b] for b in dev) for dev in owned]
+    cap = _pow2(max(dev_rows) if dev_rows else 1)
+
+    bound = bind_string_literals(predicate, whole)
+    packed: Dict[str, np.ndarray] = {}
+    take_idx = np.concatenate(
+        [np.arange(offsets[b], offsets[b] + sizes[b]) for b in order]
+    ) if order else np.array([], dtype=np.int64)
+    for name in names:
+        col = whole.columns[name]
+        data = col.data[take_idx]
+        out = np.zeros((D, cap), dtype=data.dtype)
+        p = 0
+        for d, rows in enumerate(dev_rows):
+            out[d, :rows] = data[p : p + rows]
+            p += rows
+        packed[name] = out
+
+    shim = ColumnarBatch(
+        {
+            name: Column(
+                "int32" if whole.columns[name].vocab is not None
+                else whole.columns[name].dtype_str,
+                np.empty(0, dtype=np.int32 if whole.columns[name].vocab is not None
+                         else whole.columns[name].data.dtype),
+            )
+            for name in names
+        }
+    )
+    sig = tuple((name, str(packed[name].dtype)) for name in names)
+    fn = _dist_mask_fn(mesh, repr(bound), bound, shim, sig)
+    sharding = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0], None))
+    dev_arrays = {n: jax.device_put(a, sharding) for n, a in packed.items()}
+    mask2d = np.asarray(fn(dev_arrays))
+    metrics.incr("scan.path.distributed")
+
+    # compact per device shard, then map back to concat-order rows
+    keep_parts = []
+    p = 0
+    for d, rows in enumerate(dev_rows):
+        local = np.flatnonzero(mask2d[d, :rows])
+        keep_parts.append(take_idx[p + local])
+        p += rows
+    keep = np.concatenate(keep_parts) if keep_parts else np.array([], dtype=np.int64)
+    return whole.take(keep).select(output_columns)
+
+
+# ---------------------------------------------------------------------------
+# distributed bucketed join
+# ---------------------------------------------------------------------------
+_dist_join_cache: dict = {}
+
+
+def _dist_join_fn(mesh: Mesh, cap_l: int, cap_r: int):
+    """Per-device sort-based match-range computation.
+
+    For each device shard (one row of the packed (D, cap) arrays):
+    locally sort both sides' codes, then for every valid left code compute
+    (count of right codes < it, count == it) via the tagged-merge trick:
+    position of an element in the stable sort of concat(left, right) keyed
+    by (code, tag) minus its rank among its own side = count of the other
+    side's elements ordered before it. Two tag polarities give < and <=.
+    Everything is lax.sort + scatter — no gather, no binary search."""
+    key = (mesh, cap_l, cap_r)
+    fn = _dist_join_cache.get(key)
+    if fn is not None:
+        return fn
+    axis = mesh.axis_names[0]
+    N = cap_l + cap_r
+
+    def per_shard(l_codes, r_codes):
+        # shapes (cap_l,), (cap_r,) — pads are INT64_MAX
+        iota_l = lax.iota(jnp.int64, cap_l)
+        iota_r = lax.iota(jnp.int64, cap_r)
+        l_sorted, l_order = lax.sort([l_codes, iota_l], num_keys=1)
+        r_sorted, r_order = lax.sort([r_codes, iota_r], num_keys=1)
+
+        merged = jnp.concatenate([l_sorted, r_sorted])
+        carried = lax.iota(jnp.int64, N)
+
+        def counts(tag_l: int):
+            tags = jnp.concatenate(
+                [jnp.full(cap_l, tag_l, jnp.int32),
+                 jnp.full(cap_r, 1 - tag_l, jnp.int32)]
+            )
+            _, _, pos_of = lax.sort([merged, tags, carried], num_keys=2)
+            inv = jnp.zeros(N, jnp.int64).at[pos_of].set(lax.iota(jnp.int64, N))
+            return inv[:cap_l] - iota_l  # count of r ordered before l[i]
+
+        lt_sorted = counts(0)   # l before equal r  -> # r <  l
+        le_sorted = counts(1)   # r before equal l  -> # r <= l
+        eq_sorted = le_sorted - lt_sorted
+        # map back to original left row order
+        lt = jnp.zeros(cap_l, jnp.int64).at[l_order].set(lt_sorted)
+        eq = jnp.zeros(cap_l, jnp.int64).at[l_order].set(eq_sorted)
+        return lt, eq, r_order
+
+    def shard_fn(l2, r2):
+        lt, eq, r_order = per_shard(l2.reshape(-1), r2.reshape(-1))
+        return lt[None, :], eq[None, :], r_order[None, :]
+
+    fn = jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
+            out_specs=(
+                PartitionSpec(axis, None),
+                PartitionSpec(axis, None),
+                PartitionSpec(axis, None),
+            ),
+            check_vma=False,
+        )
+    )
+    if len(_dist_join_cache) >= 64:
+        _dist_join_cache.pop(next(iter(_dist_join_cache)))
+    _dist_join_cache[key] = fn
+    return fn
+
+
+def distributed_bucketed_join(
+    left_by_bucket: Dict[int, ColumnarBatch],
+    right_by_bucket: Dict[int, ColumnarBatch],
+    l_keys: List[str],
+    r_keys: List[str],
+    mesh: Mesh,
+) -> List[ColumnarBatch]:
+    """The shuffle-free SMJ across the mesh: device d joins the buckets it
+    owns (b % D == d) with no data movement between devices. Equal join
+    codes cannot span buckets (value-stable hash), so per-device joins over
+    concatenated owned buckets introduce no false or missing pairs."""
+    from .joins import _expand_ranges, join_codes
+
+    common = sorted(set(left_by_bucket) & set(right_by_bucket))
+    if not common:
+        return []
+    D = mesh.devices.size
+    lb = {b: left_by_bucket[b] for b in common}
+    rb = {b: right_by_bucket[b] for b in common}
+    owned = group_by_owner(lb, D)
+
+    # codes once over each side's full concat (dictionary unification is
+    # global); then re-pack rows into owner-device order
+    l_batches = [lb[b] for b in common]
+    r_batches = [rb[b] for b in common]
+    l_all = ColumnarBatch.concat(l_batches)
+    r_all = ColumnarBatch.concat(r_batches)
+    overlap = set(l_all.column_names) & set(r_all.column_names)
+    if overlap:
+        raise HyperspaceException(
+            f"Join output would duplicate columns {sorted(overlap)}."
+        )
+    l_codes, r_codes = join_codes(l_all, r_all, l_keys, r_keys)
+    if (l_codes == _I64_PAD).any() or (r_codes == _I64_PAD).any():
+        # a real code equals the pad sentinel (INT64_MAX key): the packed
+        # representation can't distinguish it — host path is exact
+        from .joins import bucketed_join_pairs
+
+        return bucketed_join_pairs(left_by_bucket, right_by_bucket, l_keys, r_keys)
+
+    def offsets_of(batches: List[ColumnarBatch]) -> Dict[int, Tuple[int, int]]:
+        out = {}
+        pos = 0
+        for b, batch in zip(common, batches):
+            out[b] = (pos, pos + batch.num_rows)
+            pos += batch.num_rows
+        return out
+
+    l_off = offsets_of(l_batches)
+    r_off = offsets_of(r_batches)
+
+    def pack(codes: np.ndarray, off: Dict[int, Tuple[int, int]]):
+        dev_idx: List[np.ndarray] = []
+        for dev in owned:
+            parts = [np.arange(*off[b]) for b in dev]
+            dev_idx.append(
+                np.concatenate(parts) if parts else np.array([], dtype=np.int64)
+            )
+        cap = _pow2(max((len(ix) for ix in dev_idx), default=1))
+        out = np.full((D, cap), _I64_PAD, dtype=np.int64)
+        for d, ix in enumerate(dev_idx):
+            out[d, : len(ix)] = codes[ix]
+        return out, dev_idx, cap
+
+    l2, l_dev_idx, cap_l = pack(l_codes, l_off)
+    r2, r_dev_idx, cap_r = pack(r_codes, r_off)
+
+    fn = _dist_join_fn(mesh, cap_l, cap_r)
+    sharding = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0], None))
+    lt2, eq2, r_ord2 = fn(
+        jax.device_put(l2, sharding), jax.device_put(r2, sharding)
+    )
+    lt2 = np.asarray(lt2)
+    eq2 = np.asarray(eq2)
+    r_ord2 = np.asarray(r_ord2)
+    metrics.incr("join.path.distributed")
+
+    # expand per device on host; positions are into the device's locally
+    # sorted right codes -> map through r_order -> device rows -> global
+    parts: List[ColumnarBatch] = []
+    for d in range(D):
+        n_ld = len(l_dev_idx[d])
+        n_rd = len(r_dev_idx[d])
+        if n_ld == 0 or n_rd == 0:
+            continue
+        lt = lt2[d, :n_ld]
+        eq = eq2[d, :n_ld]
+        li_local, r_pos_sorted = _expand_ranges(lt, eq, None)
+        if not len(li_local):
+            continue
+        r_local = r_ord2[d][r_pos_sorted]
+        l_rows = l_dev_idx[d][li_local]
+        r_rows = r_dev_idx[d][r_local]
+        out: Dict[str, Column] = {}
+        out.update(l_all.take(l_rows).columns)
+        out.update(r_all.take(r_rows).columns)
+        parts.append(ColumnarBatch(out))
+    return parts

@@ -42,9 +42,28 @@ from .scan import index_scan
 
 
 class Executor:
-    def __init__(self, conf: Optional[HyperspaceConf] = None, device: bool = True):
+    def __init__(
+        self,
+        conf: Optional[HyperspaceConf] = None,
+        device: bool = True,
+        mesh=None,
+        dist_min_rows: Optional[int] = None,
+    ):
         self.conf = conf or HyperspaceConf()
         self.device = device
+        # a >1-device mesh routes bucketed scans/joins through the
+        # shard_map query paths (exec.distributed): each device handles
+        # the buckets it owns — the executor-pool replacement of SURVEY
+        # §2.2, now on the query side as well as the build side. Below
+        # dist_min_rows total rows the fixed dispatch+transfer latency of a
+        # mesh call can't win and execution stays host-side (same gate
+        # philosophy as scan.MIN_DEVICE_ROWS).
+        self.mesh = mesh if mesh is not None and mesh.devices.size > 1 else None
+        from .scan import MIN_DEVICE_ROWS
+
+        self.dist_min_rows = (
+            dist_min_rows if dist_min_rows is not None else MIN_DEVICE_ROWS
+        )
 
     # -- public --------------------------------------------------------------
     def execute(self, plan: LogicalPlan) -> ColumnarBatch:
@@ -139,6 +158,8 @@ class Executor:
         self, node: IndexScan, predicate: Optional[Expr]
     ) -> ColumnarBatch:
         entry = node.entry
+        if self.mesh is not None and predicate is not None:
+            return self._exec_index_scan_distributed(node, predicate)
         return index_scan(
             self._index_files(node),
             list(node.required_columns),
@@ -147,6 +168,54 @@ class Executor:
             indexed_columns=entry.indexed_columns,
             dtypes=entry.schema,
             num_buckets=entry.num_buckets,
+        )
+
+    def _exec_index_scan_distributed(
+        self, node: IndexScan, predicate: Expr
+    ) -> ColumnarBatch:
+        """Mesh filter scan: prune files (buckets + zone maps), place each
+        surviving bucket's rows on its owner device, evaluate the mask for
+        all devices in one shard_map call (exec.distributed)."""
+        from pathlib import Path
+
+        from .distributed import distributed_filter
+        from .scan import prune_index_files
+
+        entry = node.entry
+        files = prune_index_files(
+            [Path(p) for p in self._index_files(node)],
+            predicate,
+            entry.indexed_columns,
+            entry.schema,
+            entry.num_buckets,
+        )
+        need = list(
+            dict.fromkeys(
+                list(node.required_columns) + sorted(predicate.columns())
+            )
+        )
+        batches = layout.read_batches(files, columns=need)
+        by_bucket = self._group_batches_by_bucket(files, batches)
+        if not by_bucket:
+            if not files:
+                # every file pruned: empty result in the node's schema
+                resolved = {k.lower(): v for k, v in entry.schema.items()}
+                return ColumnarBatch.empty(
+                    {c: resolved[c.lower()] for c in node.required_columns}
+                )
+            empty = layout.read_batch(files[0], columns=list(node.required_columns))
+            return empty.take(np.array([], dtype=np.int64))
+        total_rows = sum(b.num_rows for b in by_bucket.values())
+        if total_rows < self.dist_min_rows:
+            # too small for the mesh round trip: host mask + compact
+            whole = ColumnarBatch.concat(
+                [by_bucket[b] for b in sorted(by_bucket)]
+            )
+            return self._apply_predicate(whole, predicate).select(
+                list(node.required_columns)
+            )
+        return distributed_filter(
+            by_bucket, predicate, list(node.required_columns), self.mesh
         )
 
     # -- joins ---------------------------------------------------------------
@@ -169,6 +238,21 @@ class Executor:
         right = self._exec(join.right, None)
         return inner_join(left, right, l_keys, r_keys)
 
+    @staticmethod
+    def _group_batches_by_bucket(files, batches) -> Dict[int, ColumnarBatch]:
+        """Group per-file batches by bucket id, one concat per bucket
+        (accumulating pairwise concats would copy multi-file buckets
+        quadratically)."""
+        groups: Dict[int, List[ColumnarBatch]] = {}
+        for f, batch in zip(files, batches):
+            if batch.num_rows == 0:
+                continue
+            groups.setdefault(layout.bucket_of_file(f), []).append(batch)
+        return {
+            b: parts[0] if len(parts) == 1 else ColumnarBatch.concat(parts)
+            for b, parts in groups.items()
+        }
+
     def _load_index_by_bucket(
         self, node: IndexScan, predicate: Optional[Expr]
     ) -> Dict[int, ColumnarBatch]:
@@ -179,18 +263,9 @@ class Executor:
         (round-1 verdict weak #4)."""
         files = self._index_files(node)
         batches = layout.read_batches(files, columns=list(node.required_columns))
-        by_bucket: Dict[int, ColumnarBatch] = {}
-        for f, batch in zip(files, batches):
-            b = layout.bucket_of_file(f)
-            if predicate is not None:
-                batch = self._apply_predicate(batch, predicate)
-            if batch.num_rows == 0:
-                continue
-            if b in by_bucket:
-                by_bucket[b] = ColumnarBatch.concat([by_bucket[b], batch])
-            else:
-                by_bucket[b] = batch
-        return by_bucket
+        if predicate is not None:
+            batches = [self._apply_predicate(b, predicate) for b in batches]
+        return self._group_batches_by_bucket(files, batches)
 
     def _repartition_by_bucket(
         self, node: Repartition, predicate: Optional[Expr]
@@ -324,7 +399,17 @@ class Executor:
             r_by_bucket = {
                 b: v.select(list(r_project.columns)) for b, v in r_by_bucket.items()
             }
-        parts = bucketed_join_pairs(l_by_bucket, r_by_bucket, l_keys, r_keys)
+        total_rows = sum(b.num_rows for b in l_by_bucket.values()) + sum(
+            b.num_rows for b in r_by_bucket.values()
+        )
+        if self.mesh is not None and total_rows >= self.dist_min_rows:
+            from .distributed import distributed_bucketed_join
+
+            parts = distributed_bucketed_join(
+                l_by_bucket, r_by_bucket, l_keys, r_keys, self.mesh
+            )
+        else:
+            parts = bucketed_join_pairs(l_by_bucket, r_by_bucket, l_keys, r_keys)
         if not parts:
             # no matching buckets (or an empty side): both sides' index
             # data is already loaded, so produce the correctly-shaped empty
@@ -349,8 +434,7 @@ class Executor:
         if by_bucket:
             any_batch = next(iter(by_bucket.values()))
             return any_batch.take(np.array([], dtype=np.int64))
-        schema = idx_node.entry.schema()
-        resolved = {k.lower(): v for k, v in schema.items()}
+        resolved = {k.lower(): v for k, v in idx_node.entry.schema.items()}
         return ColumnarBatch.empty(
             {c: resolved[c.lower()] for c in side_plan.output_columns()}
         )

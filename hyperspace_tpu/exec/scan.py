@@ -140,6 +140,30 @@ def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
 MIN_DEVICE_ROWS = 1_000_000
 
 
+def prune_index_files(
+    files: List[Path],
+    predicate: Optional[Expr],
+    indexed_columns: Optional[List[str]] = None,
+    dtypes: Optional[dict] = None,
+    num_buckets: Optional[int] = None,
+) -> List[Path]:
+    """Hash-bucket pruning (equality predicates pin buckets) followed by
+    footer zone-map pruning — shared by the single-device and distributed
+    scan paths; no file is opened for data."""
+    if predicate is None:
+        return files
+    if indexed_columns and dtypes and num_buckets:
+        buckets = buckets_for_predicate(predicate, indexed_columns, dtypes, num_buckets)
+        if buckets is not None:
+            files = [f for f in files if layout.bucket_of_file(f) in buckets]
+    # zone-map pruning on every column the predicate bounds
+    for c in sorted(predicate.columns()):
+        lo, hi = bounds_for_column(predicate, c)
+        if lo is not None or hi is not None:
+            files = layout.prune_by_min_max(files, c, lo, hi)
+    return files
+
+
 def index_scan(
     data_files: Iterable[str | Path],
     output_columns: List[str],
@@ -155,17 +179,9 @@ def index_scan(
     When ``indexed_columns``/``dtypes``/``num_buckets`` describe the
     index's bucketing, equality predicates prune to their hash buckets
     before any file is opened."""
-    files = [Path(p) for p in data_files]
-    if predicate is not None and indexed_columns and dtypes and num_buckets:
-        buckets = buckets_for_predicate(predicate, indexed_columns, dtypes, num_buckets)
-        if buckets is not None:
-            files = [f for f in files if layout.bucket_of_file(f) in buckets]
-    if predicate is not None:
-        # zone-map pruning on every column the predicate bounds
-        for c in sorted(predicate.columns()):
-            lo, hi = bounds_for_column(predicate, c)
-            if lo is not None or hi is not None:
-                files = layout.prune_by_min_max(files, c, lo, hi)
+    files = prune_index_files(
+        [Path(p) for p in data_files], predicate, indexed_columns, dtypes, num_buckets
+    )
     need = list(dict.fromkeys(list(output_columns) + sorted(predicate.columns()))) if predicate else list(output_columns)
     parts: List[ColumnarBatch] = []
     # all surviving files' column buffers load concurrently via the native

@@ -82,9 +82,10 @@ class StreamingIndexWriter:
     """Accumulates chunks into spilled sorted runs; ``finalize()`` merges
     them into the final per-bucket TCB files.
 
-    ``chunk_capacity`` is the padded device shape every chunk compiles to;
-    callers should feed chunks of at most this many rows (the tail chunk
-    may be smaller — it shares the executable thanks to the fixed pad)."""
+    ``chunk_capacity`` is the padded device shape every kernel run compiles
+    to. ``add_chunk`` accepts batches of any size: small batches are
+    buffered and coalesced into capacity-sized runs, oversized batches are
+    split — callers never need to pre-chunk."""
 
     def __init__(
         self,
