@@ -117,10 +117,23 @@ class Action(EventLogging):
             self.log_manager.create_latest_stable_log(entry.id)
 
 
+def _load_latest_entry(log_manager: IndexLogManager) -> IndexLogEntry:
+    """The LATEST log entry — not the latest stable one. The reference
+    validates modifying actions against ``getLog(baseId)``
+    (RefreshActionBase.scala:43-55), so an index stuck in a transient state
+    (a writer died mid-action) refuses further modification until cancel()
+    rolls it back. Loading the stable entry instead would skip the stuck
+    transient and let a second writer race the first's unfinished claim."""
+    entry = log_manager.get_latest_log()
+    if entry is None:
+        raise HyperspaceException("Index does not exist.")
+    return entry
+
+
 class MaintenanceActionBase:
     """Shared by actions that rebuild index *data* from an existing stable
-    entry (the refresh family, optimize): the previous stable entry plus
-    the next data-version directory."""
+    entry (the refresh family, optimize): the previous entry plus the next
+    data-version directory."""
 
     log_manager: IndexLogManager
     _previous: Optional[IndexLogEntry]
@@ -128,10 +141,7 @@ class MaintenanceActionBase:
     @property
     def previous_entry(self) -> IndexLogEntry:
         if self._previous is None:
-            entry = self.log_manager.get_latest_stable_log()
-            if entry is None:
-                raise HyperspaceException("Index does not exist.")
-            self._previous = entry
+            self._previous = _load_latest_entry(self.log_manager)
         return self._previous
 
     def next_version_dir(self):
@@ -158,10 +168,7 @@ class IndexAction(Action):
     @property
     def previous_entry(self) -> IndexLogEntry:
         if self._previous is None:
-            entry = self.log_manager.get_latest_log()
-            if entry is None:
-                raise HyperspaceException("Index does not exist.")
-            self._previous = entry
+            self._previous = _load_latest_entry(self.log_manager)
         return self._previous
 
     def validate(self) -> None:
