@@ -97,9 +97,11 @@ DISPLAY_MODE_CONSOLE = "console"
 DISPLAY_MODE_DEFAULT = DISPLAY_MODE_PLAIN_TEXT
 
 # --- sources -----------------------------------------------------------------
-# (reference: HyperspaceConf.scala:78-90)
+# (reference: HyperspaceConf.scala:78-90 — its list is
+# avro,csv,json,orc,parquet,text; avro is out of scope here because pyarrow
+# ships no avro reader and none is baked into this environment)
 FILE_BASED_SOURCE_BUILDERS = "hyperspace.index.sources.fileBasedBuilders"
-DEFAULT_SUPPORTED_FORMATS = ("csv", "json", "parquet")
+DEFAULT_SUPPORTED_FORMATS = ("csv", "json", "orc", "parquet", "text")
 # Globbing patterns for index sources (reference: IndexConstants.scala:101-106)
 GLOBBING_PATTERN_KEY = "hyperspace.source.globbingPattern"
 

@@ -56,9 +56,18 @@ class IndexLogManager:
 
 
 class IndexLogManagerImpl(IndexLogManager):
-    def __init__(self, index_path: str | Path):
+    """Operation log over any storage backend. ``fs`` defaults to the
+    local POSIX filesystem; passing an object-store FileSystem (e.g. a GCS
+    backend with if-generation-match creates) runs the identical protocol
+    against flat blob storage — the claim primitive is the seam's
+    ``create_if_absent`` either way (SURVEY.md §7 hard part 4)."""
+
+    def __init__(self, index_path: str | Path, fs=None):
+        from ..storage.filesystem import DEFAULT_FS
+
         self._index_path = Path(index_path)
         self._log_dir = self._index_path / C.HYPERSPACE_LOG
+        self._fs = fs if fs is not None else DEFAULT_FS
 
     @property
     def log_dir(self) -> Path:
@@ -68,21 +77,23 @@ class IndexLogManagerImpl(IndexLogManager):
         return self._log_dir / str(id)
 
     def _read(self, path: Path) -> Optional[IndexLogEntry]:
-        if not path.is_file():
+        # read-and-catch, not exists-then-read: one RPC on object stores
+        # and no TOCTOU window against concurrent deleters
+        try:
+            raw = self._fs.read(str(path))
+        except (FileNotFoundError, IsADirectoryError):
             return None
         return IndexLogEntry.from_json_dict(
-            json_utils.from_json(file_utils.read_string(path))
+            json_utils.from_json(raw.decode("utf-8"))
         )
 
     def get_log(self, id: int) -> Optional[IndexLogEntry]:
         return self._read(self._path_of(id))
 
     def get_latest_id(self) -> Optional[int]:
-        """Highest numeric filename in the log dir
+        """Highest numeric entry name in the log dir
         (IndexLogManager.scala:83-92)."""
-        if not self._log_dir.is_dir():
-            return None
-        ids = [int(p.name) for p in self._log_dir.iterdir() if p.name.isdigit()]
+        ids = [int(n) for n in self._fs.list(str(self._log_dir)) if n.isdigit()]
         return max(ids) if ids else None
 
     def get_latest_log(self) -> Optional[IndexLogEntry]:
@@ -110,16 +121,17 @@ class IndexLogManagerImpl(IndexLogManager):
 
     def write_log(self, id: int, entry: LogEntry) -> bool:
         """Atomically claim log id ``id``; False if already taken
-        (IndexLogManager.scala:149-165)."""
-        if self._path_of(id).exists():
-            return False
-        return file_utils.atomic_create(
-            self._path_of(id), json_utils.to_json(entry)
+        (IndexLogManager.scala:149-165). No exists() pre-check: the claim
+        primitive is the sole linearizable test, and a pre-check would be
+        an extra RPC plus a TOCTOU window on object stores."""
+        return self._fs.create_if_absent(
+            str(self._path_of(id)), json_utils.to_json(entry).encode("utf-8")
         )
 
     def create_latest_stable_log(self, id: int) -> bool:
         """Copy entry ``id`` to latestStable (IndexLogManager.scala:115-133).
-        Overwrites any previous latestStable."""
+        Overwrites any previous latestStable (an atomic whole-object write
+        on both POSIX and object stores)."""
         entry = self.get_log(id)
         if entry is None:
             logger.warning("create_latest_stable_log: no entry with id %s", id)
@@ -131,11 +143,11 @@ class IndexLogManagerImpl(IndexLogManager):
                 entry.state,
             )
             return False
-        self.delete_latest_stable_log()
-        return file_utils.atomic_create(
-            self._log_dir / LATEST_STABLE, json_utils.to_json(entry)
+        self._fs.write(
+            str(self._log_dir / LATEST_STABLE), json_utils.to_json(entry).encode("utf-8")
         )
+        return True
 
     def delete_latest_stable_log(self) -> bool:
-        file_utils.delete(self._log_dir / LATEST_STABLE)
+        self._fs.delete(str(self._log_dir / LATEST_STABLE))
         return True

@@ -84,6 +84,12 @@ class DataFrameReader:
     def json(self, *paths: str):
         return self._load("json", list(paths))
 
+    def orc(self, *paths: str):
+        return self._load("orc", list(paths))
+
+    def text(self, *paths: str):
+        return self._load("text", list(paths))
+
     def format(self, file_format: str):
         fmt = file_format
 

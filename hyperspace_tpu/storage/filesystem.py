@@ -1,0 +1,187 @@
+"""The filesystem seam: byte-blob storage behind the metadata and index
+data paths.
+
+Parity: the reference reaches storage exclusively through the Hadoop
+FileSystem API, and its concurrency control hangs on one primitive —
+atomic rename-if-absent (IndexLogManager.scala:149-165). SURVEY.md §7
+lists "atomic-rename OCC on object stores" as a hard part: GCS has no
+rename, but uploads accept an ``ifGenerationMatch=0`` precondition that
+makes object creation linearizable, which is the same claim primitive.
+
+This module defines the seam as a small byte-blob interface:
+
+* ``PosixFileSystem`` — local disk; the claim is ``os.link`` (fails with
+  EEXIST on an existing target), writes are temp-file + atomic replace;
+* ``FakeGcsFileSystem`` — an in-memory object store with GCS semantics:
+  flat namespace with prefix listing (no directories), per-object
+  generation numbers, atomic whole-object PUT, and create-if-absent via
+  the generation-0 precondition. Used by tests to prove the log protocol
+  and TCB writes run unchanged against object-store semantics; a real GCS
+  backend implements the same five methods over the JSON/XML API.
+
+``IndexLogManagerImpl`` and the TCB layout accept any FileSystem; POSIX
+remains the default (and keeps its mmap read fast path).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from ..exceptions import HyperspaceException
+
+
+class FileSystem:
+    """Minimal byte-blob storage interface — everything the operation log
+    and the TCB layout need."""
+
+    def create_if_absent(self, path: str, data: bytes) -> bool:
+        """Atomically create ``path`` iff it does not exist (the OCC
+        claim). True on success, False if already present."""
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes) -> None:
+        """Atomic whole-object write (overwrite allowed)."""
+        raise NotImplementedError
+
+    def read(self, path: str, offset: int = 0, length: Optional[int] = None) -> bytes:
+        """Ranged read; ``length=None`` reads to the end."""
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def size(self, path: str) -> int:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> List[str]:
+        """Names of immediate children under ``prefix`` (one level, the
+        way the log manager lists numeric entry names)."""
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+
+class PosixFileSystem(FileSystem):
+    """Local disk. The claim primitive is ``os.link(tmp, target)`` —
+    linearizable on POSIX, fails with EEXIST if the target exists (plain
+    rename overwrites, so it cannot claim)."""
+
+    def create_if_absent(self, path: str, data: bytes) -> bool:
+        target = Path(path)
+        target.parent.mkdir(parents=True, exist_ok=True)
+        tmp = target.parent / f".{target.name}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+        try:
+            tmp.write_bytes(data)
+            os.link(tmp, target)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            tmp.unlink(missing_ok=True)
+
+    def write(self, path: str, data: bytes) -> None:
+        target = Path(path)
+        target.parent.mkdir(parents=True, exist_ok=True)
+        tmp = target.parent / f".{target.name}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+        tmp.write_bytes(data)
+        os.replace(tmp, target)
+
+    def read(self, path: str, offset: int = 0, length: Optional[int] = None) -> bytes:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return f.read(length) if length is not None else f.read()
+
+    def exists(self, path: str) -> bool:
+        return Path(path).exists()
+
+    def size(self, path: str) -> int:
+        return os.path.getsize(path)
+
+    def list(self, prefix: str) -> List[str]:
+        p = Path(prefix)
+        if not p.is_dir():
+            return []
+        return sorted(child.name for child in p.iterdir())
+
+    def delete(self, path: str) -> None:
+        Path(path).unlink(missing_ok=True)
+
+
+class FakeGcsFileSystem(FileSystem):
+    """In-memory object store with GCS concurrency semantics.
+
+    * flat namespace — "directories" are just name prefixes; ``list``
+      returns the next path segment after the prefix, like a delimiter
+      query;
+    * every object carries a generation number bumped on each overwrite;
+    * ``create_if_absent`` is an upload with ``ifGenerationMatch=0``:
+      atomic under the store's lock, exactly one concurrent creator wins —
+      the linearizable claim the log protocol needs without any rename.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: Dict[str, Tuple[bytes, int]] = {}
+
+    @staticmethod
+    def _key(path: str) -> str:
+        return str(path).lstrip("/")
+
+    def create_if_absent(self, path: str, data: bytes) -> bool:
+        k = self._key(path)
+        with self._lock:
+            if k in self._objects:
+                return False  # ifGenerationMatch=0 precondition failed
+            self._objects[k] = (bytes(data), 1)
+            return True
+
+    def write(self, path: str, data: bytes) -> None:
+        k = self._key(path)
+        with self._lock:
+            gen = self._objects.get(k, (b"", 0))[1]
+            self._objects[k] = (bytes(data), gen + 1)
+
+    def generation(self, path: str) -> int:
+        with self._lock:
+            obj = self._objects.get(self._key(path))
+            return obj[1] if obj else 0
+
+    def read(self, path: str, offset: int = 0, length: Optional[int] = None) -> bytes:
+        k = self._key(path)
+        with self._lock:
+            if k not in self._objects:
+                raise FileNotFoundError(path)
+            data = self._objects[k][0]
+        end = len(data) if length is None else offset + length
+        return data[offset:end]
+
+    def exists(self, path: str) -> bool:
+        with self._lock:
+            return self._key(path) in self._objects
+
+    def size(self, path: str) -> int:
+        k = self._key(path)
+        with self._lock:
+            if k not in self._objects:
+                raise FileNotFoundError(path)
+            return len(self._objects[k][0])
+
+    def list(self, prefix: str) -> List[str]:
+        pfx = self._key(prefix).rstrip("/") + "/"
+        seen = set()
+        with self._lock:
+            for k in self._objects:
+                if k.startswith(pfx):
+                    seen.add(k[len(pfx):].split("/", 1)[0])
+        return sorted(seen)
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            self._objects.pop(self._key(path), None)
+
+
+DEFAULT_FS = PosixFileSystem()

@@ -61,10 +61,13 @@ def write_batch(
     sorted_by: Optional[List[str]] = None,
     bucket: Optional[int] = None,
     extra: Optional[Dict[str, Any]] = None,
+    fs=None,
 ) -> None:
-    """Write one batch as a TCB file."""
+    """Write one batch as a TCB file. ``fs=None`` streams buffers to local
+    disk (temp file + atomic replace); any other FileSystem gets one
+    atomic whole-object write — object-store PUTs are atomic by nature, so
+    the layout needs no rename there (storage.filesystem seam)."""
     path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
     columns_meta: List[Dict[str, Any]] = []
     offset = 0
     buffers: List[bytes] = []
@@ -95,17 +98,34 @@ def write_batch(
         "extra": extra or {},
     }
     footer_bytes = json.dumps(footer).encode("utf-8")
+    trailer = footer_bytes + len(footer_bytes).to_bytes(8, "little") + MAGIC
+    if fs is not None:
+        fs.write(str(path), b"".join(buffers) + trailer)
+        return
+    path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.parent / f".{path.name}.tmp"
     with open(tmp, "wb") as f:
         for buf in buffers:
             f.write(buf)
-        f.write(footer_bytes)
-        f.write(len(footer_bytes).to_bytes(8, "little"))
-        f.write(MAGIC)
+        f.write(trailer)
     os.replace(tmp, path)
 
 
-def read_footer(path: str | Path) -> Dict[str, Any]:
+def read_footer(path: str | Path, fs=None) -> Dict[str, Any]:
+    if fs is not None:
+        size = fs.size(str(path))
+        if size < 12:
+            raise HyperspaceException(f"Truncated TCB file: {path}")
+        trailer = fs.read(str(path), size - 12, 12)
+        if trailer[8:] != MAGIC:
+            raise HyperspaceException(f"Bad magic in {path}; not a TCB file.")
+        flen = int.from_bytes(trailer[:8], "little")
+        if flen <= 0 or flen > size - 12:
+            raise HyperspaceException(f"Corrupt TCB footer length in {path}.")
+        try:
+            return json.loads(fs.read(str(path), size - 12 - flen, flen))
+        except json.JSONDecodeError as e:
+            raise HyperspaceException(f"Corrupt TCB footer in {path}: {e}")
     with open(path, "rb") as f:
         f.seek(0, os.SEEK_END)
         size = f.tell()
@@ -156,11 +176,14 @@ class TcbReader:
     spill run; without this handle each read would re-parse the JSON footer
     (which embeds the full vocab for string columns) per (bucket, run)."""
 
-    def __init__(self, path: str | Path, mmap: bool = True):
+    def __init__(self, path: str | Path, mmap: bool = True, fs=None):
         self.path = Path(path)
-        self.footer = read_footer(path)
+        self.footer = read_footer(path, fs=fs)
         self._by_name = {m["name"]: m for m in self.footer["columns"]}
-        if mmap:
+        self._fs = fs
+        if fs is not None:
+            self._raw = None  # ranged fs reads per column
+        elif mmap:
             self._raw = np.memmap(self.path, dtype=np.uint8, mode="r")
         else:
             self._raw = np.fromfile(self.path, dtype=np.uint8)
@@ -201,7 +224,12 @@ class TcbReader:
             dt = CODE_DTYPE if is_string(m["dtype"]) else numpy_dtype(m["dtype"])
             lo = m["offset"] + s * dt.itemsize
             hi = m["offset"] + e * dt.itemsize
-            data = self._raw[lo:hi].view(dt)
+            if self._raw is not None:
+                data = self._raw[lo:hi].view(dt)
+            else:
+                data = np.frombuffer(
+                    self._fs.read(str(self.path), lo, hi - lo), dtype=dt
+                )
             vocab = self._vocab(name) if is_string(m["dtype"]) else None
             cols[name] = Column(m["dtype"], data, vocab)
         return ColumnarBatch(cols)

@@ -57,6 +57,50 @@ def read_json(paths: Iterable[str | Path], columns: Optional[List[str]] = None) 
     return _read_with(lambda p: pajson.read_json(p), "json", paths, columns)
 
 
+def read_orc(paths: Iterable[str | Path], columns: Optional[List[str]] = None) -> ColumnarBatch:
+    """ORC ingest via pyarrow.orc (reference allowlist includes orc,
+    HyperspaceConf.scala:85-90)."""
+    from pyarrow import orc as paorc
+
+    def reader(p):
+        t = paorc.ORCFile(p).read(columns=columns)
+        return t
+
+    return _read_with(reader, "orc", paths, columns)
+
+
+def read_text(paths: Iterable[str | Path], columns: Optional[List[str]] = None) -> ColumnarBatch:
+    """Text ingest: one ``value`` string column per line — Spark's text
+    source schema (the reference's allowlist includes text;
+    HyperspaceConf.scala:85-90). Lines split on ``\\n`` only (with ``\\r``
+    stripped before it), matching Spark's record delimiter — NOT Python's
+    splitlines(), whose extra separators (\\f, U+2028, ...) would change
+    row counts. Bytes stay bytes end to end, so non-UTF-8 content indexes
+    fine (the dictionary vocab is byte-typed)."""
+    from .columnar import Column
+
+    paths = [str(p) for p in paths]
+    if not paths:
+        raise HyperspaceException("read_text: no paths.")
+    batches = []
+    for p in paths:
+        data = Path(p).read_bytes()
+        if data.endswith(b"\n"):
+            data = data[:-1]
+        raw_lines = data.split(b"\n") if data else []
+        lines = [ln[:-1] if ln.endswith(b"\r") else ln for ln in raw_lines]
+        col = (
+            Column.from_values(np.array(lines, dtype=object), "string")
+            if lines
+            else Column("string", np.empty(0, dtype=np.int32), np.array([], dtype=object))
+        )
+        b = ColumnarBatch({"value": col})
+        if columns is not None:
+            b = b.select(columns)
+        batches.append(b)
+    return ColumnarBatch.concat(batches)
+
+
 def write_parquet(path: str | Path, batch: ColumnarBatch) -> None:
     """Write a batch as parquet (test-data generation and oracles)."""
     import pyarrow as pa
@@ -76,7 +120,13 @@ def write_parquet(path: str | Path, batch: ColumnarBatch) -> None:
     pq.write_table(table, str(path))
 
 
-READERS = {"parquet": read_parquet, "csv": read_csv, "json": read_json}
+READERS = {
+    "parquet": read_parquet,
+    "csv": read_csv,
+    "json": read_json,
+    "orc": read_orc,
+    "text": read_text,
+}
 
 
 def read_files(file_format: str, paths: Iterable[str | Path], columns=None) -> ColumnarBatch:

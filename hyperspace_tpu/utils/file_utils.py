@@ -54,19 +54,13 @@ def atomic_create(path: str | Path, content: str) -> bool:
     temp file then does an atomic ``fs.rename`` which fails if the target
     exists (IndexLogManager.scala:149-165). POSIX ``rename`` overwrites, so
     the equivalent linearizable claim here is ``os.link(tmp, target)`` which
-    fails with EEXIST if the id was already taken.
+    fails with EEXIST if the id was already taken. Implementation lives on
+    the filesystem seam (storage.filesystem); object stores provide the
+    same claim via if-generation-match preconditions.
     """
-    target = Path(path)
-    target.parent.mkdir(parents=True, exist_ok=True)
-    tmp = target.parent / f".{target.name}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
-    try:
-        tmp.write_text(content, encoding="utf-8")
-        os.link(tmp, target)
-        return True
-    except FileExistsError:
-        return False
-    finally:
-        tmp.unlink(missing_ok=True)
+    from ..storage.filesystem import DEFAULT_FS
+
+    return DEFAULT_FS.create_if_absent(str(path), content.encode("utf-8"))
 
 
 def expand_globs(paths: Iterable[str | Path]) -> List[Path]:

@@ -1,0 +1,175 @@
+"""Object-store OCC tests: the operation-log protocol and the TCB layout
+running against a GCS-semantics in-memory store (flat namespace, no
+rename, if-generation-match creates) — SURVEY.md §7 hard part 4 /
+round-1 verdict next #7. The claim primitive is the same seam POSIX uses
+(storage.filesystem), so the protocol code paths are identical.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+from hyperspace_tpu.storage import layout
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.storage.filesystem import FakeGcsFileSystem, PosixFileSystem
+from tests.test_log_entry import make_entry
+
+
+def entry_with(id, state):
+    e = make_entry()
+    e.id = id
+    e.state = state
+    return e
+
+
+def test_fake_gcs_claim_once_under_race():
+    fs = FakeGcsFileSystem()
+    n = 32
+    barrier = threading.Barrier(n)
+    results = [None] * n
+
+    def racer(i):
+        barrier.wait()
+        results[i] = fs.create_if_absent("bucket/claim", f"tag-{i}".encode())
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(results) == 1
+    winner = results.index(True)
+    assert fs.read("bucket/claim") == f"tag-{winner}".encode()
+    assert fs.generation("bucket/claim") == 1
+
+
+def test_fake_gcs_semantics():
+    fs = FakeGcsFileSystem()
+    assert not fs.exists("a/b/c")
+    fs.write("a/b/c", b"v1")
+    assert fs.generation("a/b/c") == 1
+    fs.write("a/b/c", b"v2")  # overwrite PUT bumps generation
+    assert fs.generation("a/b/c") == 2
+    assert fs.read("a/b/c") == b"v2"
+    assert fs.read("a/b/c", 1, 1) == b"2"  # ranged read
+    fs.write("a/b/d", b"x")
+    fs.write("a/zz", b"y")
+    assert fs.list("a/b") == ["c", "d"]
+    assert fs.list("a") == ["b", "zz"]  # delimiter-style one level
+    assert fs.size("a/b/c") == 2
+    fs.delete("a/b/c")
+    assert not fs.exists("a/b/c")
+    with pytest.raises(FileNotFoundError):
+        fs.read("a/b/c")
+
+
+def test_log_protocol_on_object_store():
+    """The full operation-log protocol over the fake object store: id
+    claiming, latest-id listing, latestStable copy and backward fallback
+    scan (IndexLogManager.scala:83-165 semantics, zero rename)."""
+    fs = FakeGcsFileSystem()
+    mgr = IndexLogManagerImpl("bucket/indexes/myidx", fs=fs)
+    assert mgr.get_latest_id() is None
+    assert mgr.write_log(0, entry_with(0, states.CREATING))
+    assert not mgr.write_log(0, entry_with(0, states.ACTIVE))  # claim-once
+    assert mgr.get_log(0).state == states.CREATING
+    assert mgr.write_log(1, entry_with(1, states.ACTIVE))
+    assert mgr.get_latest_id() == 1
+    mgr.create_latest_stable_log(1)
+    assert mgr.get_latest_stable_log().state == states.ACTIVE
+    # stable copy is refused for unstable entries
+    assert mgr.write_log(2, entry_with(2, states.REFRESHING))
+    assert not mgr.create_latest_stable_log(2)
+    # backward scan fallback when latestStable is gone
+    mgr.delete_latest_stable_log()
+    assert mgr.get_latest_stable_log().id == 1
+    # corrupt latestStable (unstable state) raises
+    from hyperspace_tpu.utils import json_utils
+
+    fs.write(
+        "bucket/indexes/myidx/_hyperspace_log/latestStable",
+        json_utils.to_json(entry_with(2, states.REFRESHING)).encode(),
+    )
+    with pytest.raises(HyperspaceException):
+        mgr.get_latest_stable_log()
+
+
+def test_log_race_on_object_store():
+    fs = FakeGcsFileSystem()
+    mgr = IndexLogManagerImpl("b/idx", fs=fs)
+    n = 16
+    barrier = threading.Barrier(n)
+    results = [None] * n
+
+    def racer(i):
+        e = entry_with(5, states.CREATING)
+        e.properties["racer"] = str(i)
+        barrier.wait()
+        results[i] = mgr.write_log(5, e)
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(bool(r) for r in results) == 1
+    assert mgr.get_log(5).properties["racer"] == str(results.index(True))
+
+
+def sample(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 100, n).astype(np.int64),
+            "p": (rng.random(n) * 100).astype(np.float64),
+            "s": rng.choice([b"aa", b"bb", b"cc"], n).astype(object),
+        },
+        {"k": "int64", "p": "float64", "s": "string"},
+    )
+
+
+def test_tcb_roundtrip_on_object_store():
+    fs = FakeGcsFileSystem()
+    b = sample(800, seed=2)
+    layout.write_batch("bucket/v__=0/b00001-abc.tcb", b, sorted_by=["k"], bucket=1, fs=fs)
+    footer = layout.read_footer("bucket/v__=0/b00001-abc.tcb", fs=fs)
+    assert footer["numRows"] == 800
+    assert footer["sortedBy"] == ["k"]
+    reader = layout.TcbReader("bucket/v__=0/b00001-abc.tcb", fs=fs)
+    back = reader.read()
+    np.testing.assert_array_equal(back.columns["k"].data, b.columns["k"].data)
+    np.testing.assert_array_equal(back.columns["p"].data, b.columns["p"].data)
+    assert back.columns["s"].to_values().tolist() == b.columns["s"].to_values().tolist()
+    # projection + row range via ranged object reads
+    sl = reader.read(columns=["k"], row_range=(100, 200))
+    np.testing.assert_array_equal(sl.columns["k"].data, b.columns["k"].data[100:200])
+    assert sl.column_names == ["k"]
+
+
+def test_posix_and_object_store_write_identical_bytes(tmp_path):
+    """The two backends must produce byte-identical TCB files (a reader
+    can't tell where an index was built)."""
+    fs = FakeGcsFileSystem()
+    b = sample(300, seed=5)
+    layout.write_batch(tmp_path / "x.tcb", b, sorted_by=["k"])
+    layout.write_batch("store/x.tcb", b, sorted_by=["k"], fs=fs)
+    assert (tmp_path / "x.tcb").read_bytes() == fs.read("store/x.tcb")
+
+
+def test_posix_fs_seam(tmp_path):
+    fs = PosixFileSystem()
+    p = str(tmp_path / "sub" / "obj")
+    assert fs.create_if_absent(p, b"first")
+    assert not fs.create_if_absent(p, b"second")
+    assert fs.read(p) == b"first"
+    assert fs.read(p, 1, 3) == b"irs"
+    fs.write(p, b"overwritten")
+    assert fs.read(p) == b"overwritten"
+    assert fs.size(p) == 11
+    assert fs.list(str(tmp_path)) == ["sub"]
+    fs.delete(p)
+    assert not fs.exists(p)
