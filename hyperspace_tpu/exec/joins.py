@@ -224,6 +224,7 @@ def inner_join(
     return ColumnarBatch(out)
 
 
+@metrics.timer("join.bucketed")
 def bucketed_join_pairs(
     left_by_bucket: Dict[int, ColumnarBatch],
     right_by_bucket: Dict[int, ColumnarBatch],
@@ -245,6 +246,7 @@ def bucketed_join_pairs(
     per-bucket gate)."""
     common = sorted(set(left_by_bucket) & set(right_by_bucket))
     if not common:
+        metrics.incr("join.path.no_common_buckets")
         return []
     l_batches = [left_by_bucket[b] for b in common]
     r_batches = [right_by_bucket[b] for b in common]

@@ -28,6 +28,7 @@ from ..ops.hashing import bucket_of_values
 from ..plan.expr import Expr, bounds_for_column, eval_mask, pinned_values
 from ..storage import layout
 from ..storage.columnar import Column, ColumnarBatch
+from ..telemetry.metrics import metrics
 
 
 def buckets_for_predicate(
@@ -78,6 +79,7 @@ def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
     # float64 never transits the device raw (lossy on TPU; see
     # ops.floatbits) — predicates touching f64 evaluate on host, exactly.
     if any(batch.columns[n_].dtype_str == "float64" for n_ in names):
+        metrics.incr("scan.path.host_f64")
         return np.asarray(eval_mask(predicate, batch))
 
     import jax
@@ -98,7 +100,9 @@ def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
             bound, {name: batch.columns[name].data for name in names}, n
         )
         if mask is not None:
+            metrics.incr("scan.path.pallas_mask")
             return mask
+    metrics.incr("scan.path.xla_mask")
 
     n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
     host_arrays = {
@@ -164,6 +168,7 @@ def prune_index_files(
     return files
 
 
+@metrics.timer("scan.total")
 def index_scan(
     data_files: Iterable[str | Path],
     output_columns: List[str],
@@ -185,8 +190,13 @@ def index_scan(
     need = list(dict.fromkeys(list(output_columns) + sorted(predicate.columns()))) if predicate else list(output_columns)
     parts: List[ColumnarBatch] = []
     # all surviving files' column buffers load concurrently via the native
-    # IO runtime (file-grained task parallelism; sequential mmap fallback)
-    batches = layout.read_batches(files, columns=need)
+    # IO runtime (file-grained task parallelism; sequential mmap fallback).
+    # NOTE the metric name: on the native path this timer covers the real
+    # byte loads, but the mmap fallback returns lazy views whose pages
+    # fault in later during mask eval — dispatch time only, hence not
+    # "scan.io".
+    with metrics.timer("scan.io_dispatch"):
+        batches = layout.read_batches(files, columns=need)
     for f, batch in zip(files, batches):
         if batch.num_rows == 0:
             continue
@@ -194,6 +204,7 @@ def index_scan(
             if device and batch.num_rows >= min_device_rows:
                 mask = _device_mask_padded(predicate, batch)
             else:
+                metrics.incr("scan.path.host_mask")
                 mask = eval_mask(predicate, batch)
             idx = np.flatnonzero(mask)
             if idx.size == 0:

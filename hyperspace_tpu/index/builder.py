@@ -18,6 +18,7 @@ import numpy as np
 from ..exceptions import HyperspaceException
 from ..storage import layout
 from ..storage.columnar import ColumnarBatch
+from ..telemetry.metrics import metrics
 from ..utils import resolver
 
 
@@ -41,6 +42,7 @@ def resolve_index_columns(
     return r_indexed, r_included
 
 
+@metrics.timer("build.total")
 def write_index_data(
     batch: ColumnarBatch,
     indexed_cols: List[str],

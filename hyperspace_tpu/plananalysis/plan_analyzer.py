@@ -16,6 +16,7 @@ from typing import List, Optional, Tuple
 
 from ..actions import states
 from ..plan.ir import LogicalPlan
+from ..telemetry.metrics import metrics
 from ..plan.rules import apply_hyperspace_rules
 from .buffer_stream import BufferStream
 from .display_mode import DisplayMode, display_mode_from_conf
@@ -109,5 +110,24 @@ def explain_string(
         for op in sorted(set(on_counts) | set(off_counts)):
             on_c, off_c = on_counts.get(op, 0), off_counts.get(op, 0)
             buf.write_line(f"{op:<30}{on_c:>15}{off_c:>16}{on_c - off_c:>11}")
+        buf.write_line()
+
+        # which execution engines have actually run in this process —
+        # Pallas kernel vs XLA vs host fallback per phase, with cumulative
+        # timers (SURVEY §5.1's per-kernel timing; the reference delegates
+        # this to the Spark UI, here it is first-class)
+        snap = metrics.snapshot()
+        buf.write_line(_BANNER)
+        buf.write_line("Engine metrics (cumulative, this process):")
+        buf.write_line(_BANNER)
+        if not snap["counters"] and not snap["timers_s"]:
+            buf.write_line("(no queries executed yet)")
+        for name in sorted(snap["counters"]):
+            buf.write_line(f"{name:<40}{snap['counters'][name]:>12}")
+        for name in sorted(snap["timers_s"]):
+            calls = snap["timer_counts"].get(name, 0)
+            buf.write_line(
+                f"{name:<40}{snap['timers_s'][name]:>10.4f}s{calls:>8} call(s)"
+            )
         buf.write_line()
     return buf.with_tag()
