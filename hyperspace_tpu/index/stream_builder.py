@@ -25,11 +25,13 @@ bucket-at-a-time scheduling" hard part of SURVEY.md §7.
 
 from __future__ import annotations
 
+import queue
 import shutil
+import threading
 import time
 import uuid
 from pathlib import Path
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, Iterator, List, Optional
 
 import numpy as np
 
@@ -55,10 +57,9 @@ def sort_encoding(col: Column) -> np.ndarray:
 
         return f64_to_ordered_i64(d)
     if d.dtype == np.float32:
-        d = np.where(d == 0.0, np.float32(0.0), d)
-        bits = d.view(np.int32)
-        top = np.int32(np.uint32(0x80000000).astype(np.int32))
-        return np.where(bits < 0, np.bitwise_xor(~bits, top), bits)
+        from ..ops.floatbits import f32_to_ordered_i32
+
+        return f32_to_ordered_i32(d)
     return d
 
 
@@ -265,6 +266,63 @@ class StreamingIndexWriter:
         return out
 
 
+def prefetch_chunks(
+    chunks: Iterable[ColumnarBatch], depth: int = 1
+) -> Iterator[ColumnarBatch]:
+    """Run the chunk producer (parquet decode) on a background thread so
+    ingest overlaps the device bucketize+sort and the spill write — the
+    pipelining Spark gets from running scan tasks concurrently with
+    shuffle writes. ``depth`` bounds in-flight chunks, keeping host memory
+    at O((depth + 1) · chunk). Producer exceptions re-raise at the
+    consumer."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    sentinel = object()
+    stop = threading.Event()
+    failure: List[BaseException] = []
+
+    def produce():
+        try:
+            for item in chunks:
+                # bounded put with a shutdown check: if the consumer dies
+                # mid-build (spill IO error, interrupt), the thread must
+                # exit instead of blocking on the full queue forever with
+                # a decoded chunk (and the source reader) pinned
+                while True:
+                    if stop.is_set():
+                        return
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 - re-raised at consumer
+            failure.append(e)
+        finally:
+            # deliver the sentinel with the same stop-aware retry: a
+            # fire-and-forget put_nowait could hit a momentarily-full
+            # queue and leave a live consumer blocked in q.get() forever
+            while not stop.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    t = threading.Thread(target=produce, daemon=True, name="chunk-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                t.join()
+                if failure:
+                    raise failure[0]
+                return
+            yield item
+    finally:
+        stop.set()
+
+
 def write_index_data_streaming(
     chunks: Iterable[ColumnarBatch],
     indexed_cols: List[str],
@@ -274,7 +332,8 @@ def write_index_data_streaming(
     extra_meta: Optional[dict] = None,
     mesh=None,
 ) -> List[Path]:
-    """Drive a StreamingIndexWriter over an iterator of chunks."""
+    """Drive a StreamingIndexWriter over an iterator of chunks, with
+    ingest prefetched one chunk ahead of device compute."""
     writer = StreamingIndexWriter(
         indexed_cols,
         num_buckets,
@@ -283,6 +342,6 @@ def write_index_data_streaming(
         extra_meta=extra_meta,
         mesh=mesh,
     )
-    for chunk in chunks:
+    for chunk in prefetch_chunks(chunks):
         writer.add_chunk(chunk)
     return writer.finalize()

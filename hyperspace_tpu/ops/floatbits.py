@@ -35,3 +35,16 @@ def ordered_i64_to_f64(o: np.ndarray) -> np.ndarray:
 
 def f64_scalar_to_ordered(v: float) -> np.int64:
     return f64_to_ordered_i64(np.array([v], dtype=np.float64))[0]
+
+
+_TOP32 = np.int32(np.uint32(0x80000000).astype(np.int32))
+
+
+def f32_to_ordered_i32(a: np.ndarray) -> np.ndarray:
+    """32-bit twin of f64_to_ordered_i64: float32 -> int32 with order
+    preserved (-0.0 normalized). Used by the Pallas predicate kernel's
+    narrowing and the streaming build's merge keys."""
+    a = np.asarray(a, dtype=np.float32)
+    a = np.where(a == np.float32(0.0), np.float32(0.0), a)
+    bits = a.view(np.int32)
+    return np.where(bits < 0, np.bitwise_xor(~bits, _TOP32), bits)

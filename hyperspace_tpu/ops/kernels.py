@@ -91,36 +91,85 @@ def _fits_i32(v) -> bool:
     )
 
 
-def narrow_expr_to_i32(expr: Expr) -> Optional[Expr]:
+from .floatbits import f32_to_ordered_i32 as _f32_ordered_i32  # noqa: E402
+
+
+def _f32_scalar_ordered(v) -> Optional[int]:
+    """Encoded int32 of an exactly-f32-representable numeric literal, else
+    None (the kernel refuses and the XLA/host path keeps exact numpy
+    comparison semantics — non-numeric, NaN, inf, huge, or rounding
+    literals all refuse rather than crash or change results)."""
+    if isinstance(v, bool) or not isinstance(
+        v, (int, float, np.floating, np.integer)
+    ):
+        return None
+    try:
+        f = np.float32(v)
+        if np.isnan(f) or np.isinf(f):
+            return None  # NaN never compares equal; inf is rare — skip
+        if float(f) != float(v):
+            return None  # literal not exactly representable in f32
+    except (ValueError, TypeError, OverflowError):
+        return None
+    return int(_f32_ordered_i32(np.array([f], dtype=np.float32))[0])
+
+
+def _col_is_f32(name: str, dtypes: Optional[Dict[str, str]]) -> bool:
+    return bool(dtypes) and dtypes.get(name) == "float32"
+
+
+def narrow_expr_to_i32(
+    expr: Expr, dtypes: Optional[Dict[str, str]] = None
+) -> Optional[Expr]:
     """Rewrite a (string-literal-bound) predicate into an equivalent form
     whose every literal is an int32-safe Python int, or None if the
-    expression is not int32-representable (float literals, huge ints).
-    IN over ints becomes an OR chain so evaluation stays tile-shaped."""
+    expression is not int32-representable. float32 columns compare through
+    the order-preserving int32 encoding (their literals are encoded the
+    same way; ``dtypes`` names which columns are float32 — the matching
+    array encode happens in narrow_arrays_to_i32). IN over ints becomes an
+    OR chain so evaluation stays tile-shaped."""
     if isinstance(expr, (And, Or)):
-        l = narrow_expr_to_i32(expr.left)
-        r = narrow_expr_to_i32(expr.right)
+        l = narrow_expr_to_i32(expr.left, dtypes)
+        r = narrow_expr_to_i32(expr.right, dtypes)
         if l is None or r is None:
             return None
         return type(expr)(l, r)
     if isinstance(expr, Not):
-        c = narrow_expr_to_i32(expr.child)
+        c = narrow_expr_to_i32(expr.child, dtypes)
         return None if c is None else Not(c)
     if isinstance(expr, Cmp):
         left, right = expr.left, expr.right
-        for a, b in ((left, right), (right, left)):
-            if isinstance(a, Col) and isinstance(b, Lit):
-                return expr if _fits_i32(b.value) else None
+        if isinstance(left, Col) and isinstance(right, Lit):
+            if _col_is_f32(left.name, dtypes):
+                enc = _f32_scalar_ordered(right.value)
+                return None if enc is None else Cmp(expr.op, left, Lit(enc))
+            return expr if _fits_i32(right.value) else None
+        if isinstance(left, Lit) and isinstance(right, Col):
+            if _col_is_f32(right.name, dtypes):
+                enc = _f32_scalar_ordered(left.value)
+                return None if enc is None else Cmp(expr.op, Lit(enc), right)
+            return expr if _fits_i32(left.value) else None
         if isinstance(left, Col) and isinstance(right, Col):
+            # both sides must share the encoding (both f32 or both int)
+            if _col_is_f32(left.name, dtypes) != _col_is_f32(right.name, dtypes):
+                return None
             return expr
         return None
     if isinstance(expr, In):
         if not isinstance(expr.child, Col) or not expr.values:
             return None
-        if not all(_fits_i32(v) for v in expr.values):
-            return None
-        out: Expr = Cmp("eq", expr.child, Lit(int(expr.values[0])))
-        for v in expr.values[1:]:
-            out = Or(out, Cmp("eq", expr.child, Lit(int(v))))
+        if _col_is_f32(expr.child.name, dtypes):
+            encs = [_f32_scalar_ordered(v) for v in expr.values]
+            if any(e is None for e in encs):
+                return None
+            vals = [int(e) for e in encs]
+        else:
+            if not all(_fits_i32(v) for v in expr.values):
+                return None
+            vals = [int(v) for v in expr.values]
+        out: Expr = Cmp("eq", expr.child, Lit(vals[0]))
+        for v in vals[1:]:
+            out = Or(out, Cmp("eq", expr.child, Lit(v)))
         return out
     return None
 
@@ -128,9 +177,12 @@ def narrow_expr_to_i32(expr: Expr) -> Optional[Expr]:
 def narrow_arrays_to_i32(
     arrays: Dict[str, np.ndarray]
 ) -> Optional[Dict[str, np.ndarray]]:
-    """Cast integer/bool columns to int32, range-checking 64-bit data on
-    host (one O(n) pass over the mmap — far cheaper than moving twice the
-    bytes to the device). None if any column cannot narrow losslessly."""
+    """Cast integer/bool columns to int32 (range-checking 64-bit data) and
+    float32 columns to their order-preserving int32 encoding — one O(n)
+    host pass over the mmap, far cheaper than moving twice the bytes to
+    the device. None if any column cannot narrow losslessly (including
+    float32 with NaNs: encoded NaN would order above +inf instead of
+    comparing false, so NaN data routes to the XLA path)."""
     out: Dict[str, np.ndarray] = {}
     for name, a in arrays.items():
         if a.dtype == np.int32:
@@ -141,6 +193,10 @@ def narrow_arrays_to_i32(
             if a.size and (a.min() < _I32_MIN or a.max() > _I32_MAX - 1):
                 return None
             out[name] = a.astype(np.int32)
+        elif a.dtype == np.float32:
+            if a.size and np.isnan(a).any():
+                return None
+            out[name] = _f32_ordered_i32(a)
         else:
             return None
     return out
@@ -196,8 +252,13 @@ def predicate_mask(
 ) -> Optional[np.ndarray]:
     """Tiled Pallas evaluation of ``bound`` over ``arrays``. Returns a bool
     mask of length ``n_rows``, or None when the predicate/data do not
-    narrow to int32 (caller falls back to the XLA path)."""
-    narrowed = narrow_expr_to_i32(bound)
+    narrow to int32 (caller falls back to the XLA path). float32 columns
+    run through the order-preserving int32 encoding (literals and arrays
+    encoded consistently)."""
+    f32_cols = {
+        name: "float32" for name, a in arrays.items() if a.dtype == np.float32
+    }
+    narrowed = narrow_expr_to_i32(bound, f32_cols or None)
     if narrowed is None:
         return None
     names = tuple(sorted(bound.columns()))

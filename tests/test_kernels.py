@@ -130,3 +130,35 @@ def test_index_scan_uses_kernel_path(tmp_path):
         np.sort(got.columns["v"].data),
         np.sort(batch.columns["v"].data[want_mask]),
     )
+
+
+def test_predicate_mask_float32():
+    # float32 predicates run on the kernel via the order-preserving int32
+    # encoding; parity with numpy eval incl. -0.0/+0.0 and negatives
+    rng = np.random.default_rng(31)
+    vals = (rng.standard_normal(700) * 100).astype(np.float32)
+    vals[0], vals[1], vals[2] = np.float32(-0.0), np.float32(0.0), np.float32(42.5)
+    arrays = {"p": vals}
+    for pred, ref in (
+        (col("p") == 42.5, vals == np.float32(42.5)),
+        (col("p") > 0.0, vals > 0.0),
+        ((col("p") >= -50.0) & (col("p") < 10.0), (vals >= -50.0) & (vals < 10.0)),
+        (col("p") == 0.0, vals == 0.0),  # matches both -0.0 and +0.0
+        (is_in(col("p"), [42.5, -1e9]), np.isin(vals, [np.float32(42.5)])),
+    ):
+        mask = kernels.predicate_mask(pred, arrays, len(vals))
+        assert mask is not None, pred
+        np.testing.assert_array_equal(mask, ref)
+    # NaN data -> kernel refuses (encoded NaN would mis-order)
+    vals_nan = vals.copy()
+    vals_nan[5] = np.nan
+    assert kernels.predicate_mask(col("p") > 0.0, {"p": vals_nan}, len(vals_nan)) is None
+    # NaN / non-representable / non-numeric / overflow literals -> refuse,
+    # never crash (the XLA path keeps exact numpy comparison semantics)
+    assert kernels.predicate_mask(col("p") == float("nan"), arrays, len(vals)) is None
+    # 0.1 is not exactly representable in f32: numpy would compare in f64
+    # (never equal), so encoding to nearest-f32 would change results
+    assert kernels.predicate_mask(col("p") == 0.1, arrays, len(vals)) is None
+    assert kernels.predicate_mask(col("p") == 2**1024, arrays, len(vals)) is None
+    assert kernels.predicate_mask(is_in(col("p"), ["x"]), arrays, len(vals)) is None
+    assert kernels.predicate_mask(is_in(col("p"), [None]), arrays, len(vals)) is None

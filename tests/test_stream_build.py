@@ -254,6 +254,47 @@ def test_writer_coalesces_small_chunks(tmp_path):
     assert bucket_contents(files) == bucket_contents(single)
 
 
+def test_prefetch_chunks_completion_and_abort():
+    import threading
+    import time
+
+    from hyperspace_tpu.index.stream_builder import prefetch_chunks
+
+    # normal completion: all items arrive, sentinel delivered, thread gone
+    assert list(prefetch_chunks(iter(range(50)))) == list(range(50))
+
+    # producer exception re-raises at the consumer
+    def boom():
+        yield 1
+        raise ValueError("producer died")
+
+    with pytest.raises(ValueError):
+        list(prefetch_chunks(boom()))
+
+    # consumer abort: the producer thread must exit instead of blocking
+    # forever on the full queue with a chunk pinned
+    produced = []
+
+    def chunks():
+        for i in range(100):
+            produced.append(i)
+            yield i
+
+    g = prefetch_chunks(chunks())
+    next(g)
+    next(g)
+    g.close()
+    deadline = time.time() + 5
+    while time.time() < deadline and any(
+        t.name == "chunk-prefetch" and t.is_alive() for t in threading.enumerate()
+    ):
+        time.sleep(0.05)
+    assert not any(
+        t.name == "chunk-prefetch" and t.is_alive() for t in threading.enumerate()
+    )
+    assert len(produced) < 100  # stopped early, not fully drained
+
+
 def test_writer_splits_oversized_batch(tmp_path):
     b = sample(3000, seed=19)
     w = StreamingIndexWriter(["orderkey"], 4, tmp_path / "o", chunk_capacity=1024)
