@@ -115,6 +115,17 @@ class StreamingIndexWriter:
         self._rows = 0
         self._chunk_times: List[float] = []
         self._finalized = False
+        # pipeline stage 3: a spill thread performs the blocking D2H fetch
+        # + decode + run write while the main thread dispatches the next
+        # chunk's H2D + kernel (stage 2) and the prefetch thread decodes
+        # source input (stage 1). Queue depth 1 bounds in-flight chunk
+        # results at three (worker fetching N, N+1 queued, N+2 dispatched
+        # before its enqueue blocks) — the HBM high-water mark.
+        self._spill_q: Optional[queue.Queue] = None
+        self._spill_thread: Optional[threading.Thread] = None
+        self._spill_failure: List[BaseException] = []
+        self._t_first_add: Optional[float] = None
+        self._t_pipeline_done: Optional[float] = None
 
     def _spill_run(self, sorted_batch: ColumnarBatch, counts: np.ndarray) -> None:
         """Persist one bucket-grouped, key-sorted run."""
@@ -128,6 +139,41 @@ class StreamingIndexWriter:
         )
         self._spills.append(p)
         self._spill_counts.append(np.asarray(counts, dtype=np.int64))
+
+    # -- spill pipeline -------------------------------------------------------
+    def _spill_worker(self) -> None:
+        while True:
+            item = self._spill_q.get()
+            if item is None:
+                return
+            if self._spill_failure:
+                continue  # drain after failure; error raised on main thread
+            try:
+                batch, counts = item()  # blocking D2H + decode
+                self._spill_run(batch, counts)
+            except BaseException as e:  # noqa: BLE001 - re-raised on main
+                self._spill_failure.append(e)
+
+    def _enqueue_spill(self, finish) -> None:
+        if self._spill_thread is None:
+            self._spill_q = queue.Queue(maxsize=1)
+            self._spill_thread = threading.Thread(
+                target=self._spill_worker, daemon=True, name="spill-writer"
+            )
+            self._spill_thread.start()
+        self._spill_q.put(finish)
+        self._check_spill_failure()
+
+    def _drain_spills(self) -> None:
+        if self._spill_thread is not None:
+            self._spill_q.put(None)
+            self._spill_thread.join()
+            self._spill_thread = None
+        self._check_spill_failure()
+
+    def _check_spill_failure(self) -> None:
+        if self._spill_failure:
+            raise self._spill_failure[0]
 
     # -- ingest ---------------------------------------------------------------
     def add_chunk(self, batch: ColumnarBatch) -> None:
@@ -156,10 +202,13 @@ class StreamingIndexWriter:
             self._process_chunk(emit)
 
     def _process_chunk(self, batch: ColumnarBatch) -> None:
+        if self._t_first_add is None:
+            self._t_first_add = time.perf_counter()
         t0 = time.perf_counter()
         if self.mesh is not None and self.mesh.devices.size > 1:
             # multi-chip chunk: shard_map bucketize + ICI all_to_all, then
             # spill each device's (bucket-grouped) shard as its own run
+            # (synchronous — per-device results come back materialized)
             from ..ops.build import build_partition_sharded
 
             per_device, _ = build_partition_sharded(
@@ -174,11 +223,17 @@ class StreamingIndexWriter:
         else:
             from ..ops.build import build_partition_single
 
-            sorted_batch, counts = build_partition_single(
-                batch, self.indexed_cols, self.num_buckets, pad_to=self.chunk_capacity
+            # dispatch H2D + kernel (async); the spill thread performs the
+            # blocking fetch + decode + write, overlapping the next chunk
+            finish = build_partition_single(
+                batch,
+                self.indexed_cols,
+                self.num_buckets,
+                pad_to=self.chunk_capacity,
+                defer=True,
             )
             self._chunk_times.append(time.perf_counter() - t0)
-            self._spill_run(sorted_batch, counts)
+            self._enqueue_spill(finish)
         self._rows += batch.num_rows
         metrics.incr("build.stream.chunks")
         metrics.incr("build.stream.rows", batch.num_rows)
@@ -198,6 +253,9 @@ class StreamingIndexWriter:
             self._pending = []
             self._pending_rows = 0
             self._process_chunk(tail)
+        self._drain_spills()
+        if self._t_first_add is not None:
+            self._t_pipeline_done = time.perf_counter()
         self._finalized = True
         t0 = time.perf_counter()
         written: List[Path] = []
@@ -246,8 +304,11 @@ class StreamingIndexWriter:
     @property
     def stats(self) -> Dict[str, float]:
         """Compile/steady split: the first chunk pays XLA compile; the rest
-        run the cached executable (round-1 verdict weak #2 asked for exactly
-        this split)."""
+        flow through the cached executable (round-1 verdict weak #2 asked
+        for exactly this split). Timing is WALL-CLOCK over the pipeline
+        (dispatch is async, so per-chunk dispatch times alone would
+        overstate throughput): steady time = pipeline end-to-end minus the
+        first chunk's synchronous (compile-bearing) dispatch."""
         out: Dict[str, float] = {
             "rows": float(self._rows),
             "chunks": float(len(self._chunk_times)),
@@ -255,14 +316,19 @@ class StreamingIndexWriter:
         }
         if self._chunk_times:
             out["first_chunk_s"] = self._chunk_times[0]
-            steady = self._chunk_times[1:]
-            if steady:
-                out["steady_chunk_s_avg"] = float(np.mean(steady))
-                out["steady_total_s"] = float(np.sum(steady))
+            if (
+                len(self._chunk_times) > 1
+                and self._t_first_add is not None
+                and self._t_pipeline_done is not None
+            ):
+                pipeline_s = self._t_pipeline_done - self._t_first_add
+                steady_s = max(pipeline_s - self._chunk_times[0], 0.0)
                 steady_rows = self._rows - min(self._rows, self.chunk_capacity)
+                out["steady_total_s"] = steady_s
                 out["steady_rows"] = float(steady_rows)
-                if steady_rows > 0 and sum(steady) > 0:
-                    out["steady_rows_per_s"] = steady_rows / sum(steady)
+                out["steady_chunk_s_avg"] = steady_s / (len(self._chunk_times) - 1)
+                if steady_rows > 0 and steady_s > 0:
+                    out["steady_rows_per_s"] = steady_rows / steady_s
         return out
 
 
@@ -280,33 +346,31 @@ def prefetch_chunks(
     stop = threading.Event()
     failure: List[BaseException] = []
 
+    def put_unless_stopped(item) -> bool:
+        """Bounded put with a shutdown check: if the consumer dies
+        mid-build (spill IO error, interrupt), the producer must exit
+        instead of blocking on the full queue forever with a decoded
+        chunk (and the source reader) pinned. A fire-and-forget
+        put_nowait would not do for the sentinel either: it could hit a
+        momentarily-full queue and leave a live consumer blocked in
+        q.get() forever."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def produce():
         try:
             for item in chunks:
-                # bounded put with a shutdown check: if the consumer dies
-                # mid-build (spill IO error, interrupt), the thread must
-                # exit instead of blocking on the full queue forever with
-                # a decoded chunk (and the source reader) pinned
-                while True:
-                    if stop.is_set():
-                        return
-                    try:
-                        q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                if not put_unless_stopped(item):
+                    return
         except BaseException as e:  # noqa: BLE001 - re-raised at consumer
             failure.append(e)
         finally:
-            # deliver the sentinel with the same stop-aware retry: a
-            # fire-and-forget put_nowait could hit a momentarily-full
-            # queue and leave a live consumer blocked in q.get() forever
-            while not stop.is_set():
-                try:
-                    q.put(sentinel, timeout=0.1)
-                    break
-                except queue.Full:
-                    continue
+            put_unless_stopped(sentinel)
 
     t = threading.Thread(target=produce, daemon=True, name="chunk-prefetch")
     t.start()

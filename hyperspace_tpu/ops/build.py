@@ -130,7 +130,8 @@ def build_partition_single(
     key_names: List[str],
     num_buckets: int,
     pad_to: Optional[int] = None,
-) -> Tuple[ColumnarBatch, np.ndarray]:
+    defer: bool = False,
+):
     """Single-device HOT LOOP: returns the batch reordered so rows are
     grouped by bucket (ascending) and sorted by the key columns within each
     bucket, plus per-bucket row counts.
@@ -144,7 +145,13 @@ def build_partition_single(
 
     ``pad_to`` pins the padded size explicitly: the streaming build feeds
     fixed-capacity chunks so EVERY chunk (including the short tail) reuses
-    one compiled executable — the steady-state throughput path."""
+    one compiled executable — the steady-state throughput path.
+
+    ``defer=True`` returns a zero-arg ``finish()`` callable instead of the
+    result: the kernel is dispatched (async — JAX returns futures) and
+    ``finish`` performs the blocking device→host fetch + decode. The
+    streaming writer calls finish() on its spill thread so D2H overlaps
+    the next chunk's H2D + compute."""
     dtypes = batch.schema()
     n = batch.num_rows
     n_pad = pad_to if pad_to is not None else (1 << (n - 1).bit_length() if n > 1 else 1)
@@ -172,17 +179,22 @@ def build_partition_single(
         )
         return _sort_by_bucket_and_keys(arrays, bucket, key_names, num_buckets)
 
-    out_arrays, _sorted_bucket, counts = kernel(arrays, vh, n_dev)
-    counts = np.asarray(counts)[:num_buckets]
-    cols = {
-        name: Column(
-            dtypes[name],
-            decode_from_device(dtypes[name], np.asarray(out_arrays[name])[:n]),
-            batch.columns[name].vocab,
-        )
-        for name in batch.column_names
-    }
-    return ColumnarBatch(cols), counts
+    out_arrays, _sorted_bucket, counts_dev = kernel(arrays, vh, n_dev)
+    vocabs = {name: batch.columns[name].vocab for name in batch.column_names}
+
+    def finish() -> Tuple[ColumnarBatch, np.ndarray]:
+        counts = np.asarray(counts_dev)[:num_buckets]
+        cols = {
+            name: Column(
+                dtypes[name],
+                decode_from_device(dtypes[name], np.asarray(out_arrays[name])[:n]),
+                vocabs[name],
+            )
+            for name in dtypes
+        }
+        return ColumnarBatch(cols), counts
+
+    return finish if defer else finish()
 
 
 # ---------------------------------------------------------------------------

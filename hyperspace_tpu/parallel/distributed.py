@@ -1,0 +1,72 @@
+"""Multi-host scaling: the DCN/ICI story.
+
+Parity: the reference scales out by running on a Spark cluster — its
+communication backend is Spark's netty shuffle service + broadcast
+(SURVEY.md §5.8: there is no NCCL/MPI in the repo; the executor pool IS
+the distributed runtime). Here the distributed runtime is JAX/XLA's:
+
+* **within a slice**: the bucket-parallel mesh (parallel.mesh) spans the
+  slice's chips; the build's hash-repartition rides the ICI
+  ``all_to_all`` and bucketed queries are collective-free by placement
+  (exec.distributed).
+* **across slices / hosts (single controller)**: nothing changes in this
+  codebase — ``make_mesh()`` over ``jax.devices()`` already spans every
+  addressable device, and XLA routes each collective over ICI within a
+  slice and DCN across slices automatically. That is the whole point of
+  expressing the shuffle as a named-axis collective instead of explicit
+  NCCL calls: topology is the compiler's problem.
+* **multi-controller (one process per host)**: call
+  ``initialize_multihost()`` first — the DCN control plane
+  (jax.distributed) makes ``jax.devices()`` global. The query side works
+  unchanged (index files live on shared storage; every process can read
+  any bucket). The build side's current ingest feeds the mesh from the
+  controller process (``jax.device_put`` of host arrays), which is
+  correct single-controller but would ship all bytes through one host's
+  NIC under multi-controller; the seam to lift is
+  ``ops.build.build_partition_sharded``'s device_put →
+  ``jax.make_array_from_process_local_data`` with per-process source
+  partitions. Until that lands, multi-controller builds should run one
+  create_index per controller over partitioned sources (indexes are
+  independent datasets; the operation log's OCC already arbitrates
+  concurrent writers on shared storage).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the JAX distributed (DCN) control plane so every host's
+    devices appear in ``jax.devices()``. Call once per process, before any
+    other JAX API. No-ops when already initialized."""
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:  # already initialized — idempotent by intent
+        msg = str(e).lower()
+        # jax 0.9 raises "distributed.initialize should only be called
+        # once."; older versions said "already initialized"
+        if "already" not in msg and "only be called once" not in msg:
+            raise
+
+
+def process_info() -> dict:
+    """This process's place in the job (single-process: 1 process, id 0)."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

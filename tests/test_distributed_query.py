@@ -185,3 +185,12 @@ def test_executor_mesh_filter_and_join_e2e(tmp_path, mesh):
     assert metrics.counter("join.path.distributed") == before + 1
     assert_row_parity(single, multi)
     assert single.num_rows > 0
+
+
+def test_process_info_single_controller(mesh):
+    from hyperspace_tpu.parallel.distributed import process_info
+
+    info = process_info()
+    assert info["process_count"] == 1
+    assert info["process_index"] == 0
+    assert info["global_devices"] >= 8
