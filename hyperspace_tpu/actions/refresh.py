@@ -35,8 +35,7 @@ from ..index.log_manager import IndexLogManager
 from ..index.signatures import create_signature_provider
 from ..plan.ir import Scan
 from ..sources.relation import FileRelation
-from ..storage import layout, parquet_io
-from ..storage.columnar import Column, ColumnarBatch
+from ..storage import layout
 from ..telemetry import (
     RefreshActionEvent,
     RefreshIncrementalActionEvent,

@@ -8,10 +8,10 @@ is emitted exactly when a rewrite fired (HyperspaceEvent.scala:150-156).
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 from .exceptions import HyperspaceException
-from .plan.expr import Expr, col
+from .plan.expr import Expr
 from .plan.ir import Filter, Join, LogicalPlan, Project
 from .session import HyperspaceSession
 from .storage.columnar import ColumnarBatch

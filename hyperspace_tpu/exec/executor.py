@@ -81,9 +81,14 @@ class Executor:
         if isinstance(plan, Filter):
             # push the predicate into the child scan where profitable;
             # row-wise predicates also distribute over unions, keeping
-            # bucket/zone pruning alive on the hybrid index side
+            # bucket/zone pruning alive on the hybrid index side. Project
+            # is transparent to pushdown (pure column selection, never a
+            # rename): Filter(Project(Filter(IndexScan))) — the Hybrid
+            # Scan delete shape, where Project drops the lineage column —
+            # must still deliver the user predicate to the scan for
+            # bucket/zone pruning
             child = plan.child
-            if isinstance(child, (IndexScan, Scan, Union, BucketUnion)):
+            if isinstance(child, (IndexScan, Scan, Union, BucketUnion, Project)):
                 return self._exec(
                     child,
                     predicate=self._conjoin(predicate, plan.condition),
@@ -181,6 +186,8 @@ class Executor:
         from .distributed import distributed_filter
         from .scan import prune_index_files
 
+        from ..telemetry.metrics import metrics
+
         entry = node.entry
         files = prune_index_files(
             [Path(p) for p in self._index_files(node)],
@@ -189,6 +196,7 @@ class Executor:
             entry.schema,
             entry.num_buckets,
         )
+        metrics.incr("scan.files_read", len(files))
         need = list(
             dict.fromkeys(
                 list(node.required_columns) + sorted(predicate.columns())

@@ -187,6 +187,7 @@ def index_scan(
     files = prune_index_files(
         [Path(p) for p in data_files], predicate, indexed_columns, dtypes, num_buckets
     )
+    metrics.incr("scan.files_read", len(files))
     need = list(dict.fromkeys(list(output_columns) + sorted(predicate.columns()))) if predicate else list(output_columns)
     parts: List[ColumnarBatch] = []
     # all surviving files' column buffers load concurrently via the native

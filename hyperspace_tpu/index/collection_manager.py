@@ -8,7 +8,6 @@ every mutating verb clears it).
 
 from __future__ import annotations
 
-from pathlib import Path
 from typing import List, Optional
 
 from .. import constants as C

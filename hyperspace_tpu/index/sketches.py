@@ -26,7 +26,7 @@ bloom build over a large batch is one vectorized pass.
 from __future__ import annotations
 
 import base64
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 import numpy as np

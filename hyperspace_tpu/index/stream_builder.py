@@ -175,6 +175,19 @@ class StreamingIndexWriter:
         if self._spill_failure:
             raise self._spill_failure[0]
 
+    def abort(self) -> None:
+        """Best-effort teardown after a failed build: stop the spill
+        thread (it would otherwise park on q.get() for the process
+        lifetime) and remove spill files. Safe to call repeatedly or
+        after finalize()."""
+        if self._spill_thread is not None:
+            self._spill_q.put(None)  # worker always drains; brief block ok
+            self._spill_thread.join()
+            self._spill_thread = None
+        self._spill_failure.clear()
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
+        self._finalized = True
+
     # -- ingest ---------------------------------------------------------------
     def add_chunk(self, batch: ColumnarBatch) -> None:
         """Buffer rows and run capacity-sized chunks through the device
@@ -397,7 +410,9 @@ def write_index_data_streaming(
     mesh=None,
 ) -> List[Path]:
     """Drive a StreamingIndexWriter over an iterator of chunks, with
-    ingest prefetched one chunk ahead of device compute."""
+    ingest prefetched one chunk ahead of device compute. A failure
+    anywhere tears the pipeline down (no parked spill thread, no orphan
+    spill files) before re-raising."""
     writer = StreamingIndexWriter(
         indexed_cols,
         num_buckets,
@@ -406,6 +421,10 @@ def write_index_data_streaming(
         extra_meta=extra_meta,
         mesh=mesh,
     )
-    for chunk in prefetch_chunks(chunks):
-        writer.add_chunk(chunk)
-    return writer.finalize()
+    try:
+        for chunk in prefetch_chunks(chunks):
+            writer.add_chunk(chunk)
+        return writer.finalize()
+    except BaseException:
+        writer.abort()
+        raise

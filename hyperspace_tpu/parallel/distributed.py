@@ -46,18 +46,13 @@ def initialize_multihost(
     other JAX API. No-ops when already initialized."""
     import jax
 
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except RuntimeError as e:  # already initialized — idempotent by intent
-        msg = str(e).lower()
-        # jax 0.9 raises "distributed.initialize should only be called
-        # once."; older versions said "already initialized"
-        if "already" not in msg and "only be called once" not in msg:
-            raise
+    if jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
 
 
 def process_info() -> dict:

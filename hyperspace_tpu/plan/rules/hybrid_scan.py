@@ -26,7 +26,6 @@ always goes through its own scan node. Same results, one extra plan node.
 
 from __future__ import annotations
 
-from dataclasses import replace
 from typing import List, Optional, Set
 
 from ...config import HyperspaceConf

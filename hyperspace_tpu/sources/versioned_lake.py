@@ -26,7 +26,6 @@ import uuid
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
-from .. import constants as C
 from ..exceptions import ConcurrentModificationException, HyperspaceException
 from ..index.log_entry import FileIdTracker, FileInfo, Relation
 from ..utils import file_utils

@@ -30,8 +30,6 @@ import threading
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
-from ..exceptions import HyperspaceException
-
 
 class FileSystem:
     """Minimal byte-blob storage interface — everything the operation log

@@ -201,3 +201,62 @@ def test_lineage_ids_stable_across_refresh_with_shifted_sort_order(env):
     session.enable_hyperspace()
     on = q.to_pandas().sort_values(["orderkey", "qty"]).reset_index(drop=True)
     assert off.equals(on)
+
+
+def test_delete_path_bucket_pruning(tmp_path):
+    """The hybrid-delete shape Filter(key, Project(Filter(NOT-IN,
+    IndexScan))) must still bucket-prune on the key predicate: the Project
+    that drops the lineage column is transparent to pushdown. Regression:
+    the executor used to stop pushdown at Project and read every bucket."""
+    import numpy as np
+
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.plan.expr import col
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+    from hyperspace_tpu.storage.columnar import ColumnarBatch
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    rng = np.random.default_rng(0)
+    n = 4000
+    b = ColumnarBatch.from_pydict(
+        {"k": rng.integers(0, 500, n).astype(np.int64),
+         "v": rng.integers(0, 10**6, n).astype(np.int64)}
+    )
+    src = tmp_path / "src"
+    src.mkdir()
+    per = n // 8
+    for i in range(8):
+        parquet_io.write_parquet(
+            src / f"part-{i}.parquet", b.take(np.arange(i * per, (i + 1) * per))
+        )
+    conf = HyperspaceConf({
+        C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
+        C.INDEX_NUM_BUCKETS: 16,
+        C.INDEX_LINEAGE_ENABLED: True,
+        C.INDEX_HYBRID_SCAN_ENABLED: True,
+    })
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("pr_idx", ["k"], ["v"]))
+    (src / "part-7.parquet").unlink()  # 12.5% deleted bytes, under the 0.2 cap
+
+    key = int(b.columns["k"].data[10])
+    q = session.read.parquet(str(src)).filter(col("k") == key).select("k", "v")
+    off = q.collect()
+    session.enable_hyperspace()
+    metrics.reset()
+    on = q.collect()
+    files_read = metrics.counter("scan.files_read")
+    # equality on the indexed column pins ONE bucket; without pushdown
+    # through Project all 16 bucket files would be read
+    assert 1 <= files_read <= 2, files_read
+    assert sorted(off.columns["v"].data.tolist()) == sorted(on.columns["v"].data.tolist())
+    # the deleted file's rows are gone from both paths
+    surviving = b.take(np.arange(0, 7 * per))
+    exp = surviving.columns["v"].data[surviving.columns["k"].data == key]
+    assert sorted(on.columns["v"].data.tolist()) == sorted(exp.tolist())

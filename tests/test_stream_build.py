@@ -304,3 +304,32 @@ def test_writer_splits_oversized_batch(tmp_path):
     assert sum(layout.read_footer(f)["numRows"] for f in files) == 3000
     single = write_index_data(b, ["orderkey"], 4, tmp_path / "single")
     assert bucket_contents(files) == bucket_contents(single)
+
+
+def test_streaming_failure_tears_down_pipeline(tmp_path, monkeypatch):
+    """A spill failure mid-build must stop the spill thread (no parked
+    daemon) and clean the spill dir, then re-raise."""
+    import threading
+    import time
+
+    from hyperspace_tpu.index import stream_builder as sb
+
+    b = sample(3000, seed=23)
+
+    def failing_write(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(sb.layout, "write_batch", failing_write)
+    with pytest.raises(OSError):
+        sb.write_index_data_streaming(
+            chunks_of(b, 512), ["orderkey"], 4, tmp_path / "o", chunk_capacity=512
+        )
+    deadline = time.time() + 5
+    while time.time() < deadline and any(
+        t.name == "spill-writer" and t.is_alive() for t in threading.enumerate()
+    ):
+        time.sleep(0.05)
+    assert not any(
+        t.name == "spill-writer" and t.is_alive() for t in threading.enumerate()
+    )
+    assert not (tmp_path / "o" / ".spill").exists()
