@@ -235,6 +235,12 @@ def main() -> None:
     hs.create_index(
         df_or, IndexConfig("or_idx", ["o_orderkey"], ["o_totalprice"])
     )
+    # config-6 (Q3 shape) needs the filter column covered on the lineitem
+    # side; the join ranker picks the usable candidate per side
+    hs.create_index(
+        df_li,
+        IndexConfig("li_q3_idx", ["l_orderkey"], ["l_partkey", "l_quantity"]),
+    )
     hs.create_index(
         session.read.parquet(str(WORKDIR / "lineitem_clustered")),
         DataSkippingIndexConfig(
@@ -334,6 +340,61 @@ def main() -> None:
     extras["join_fullscan_s"] = round(joff_s, 4)
     extras["join_index_s"] = round(jon_s, 4)
     extras["join_external_s"] = round(ext3_s, 4)
+
+    # ---- config 6 (extra): TPC-H Q3-shaped filtered join -------------------
+    # filter each side, join on the indexed keys — the composed-rewrite
+    # shape of the BASELINE north star's Q3 (both FilterIndexRule-eligible
+    # sides feed JoinIndexRule's exchange-free SMJ)
+    qty_cut, price_cut = 25, 250_000.0
+    q6 = lambda: (  # noqa: E731
+        session.read.parquet(str(WORKDIR / "lineitem"))
+        .filter(col("l_quantity") > qty_cut)
+        .join(
+            session.read.parquet(str(WORKDIR / "orders"))
+            .filter(col("o_totalprice") < price_cut),
+            col("l_orderkey") == col("o_orderkey"),
+        )
+        .select("l_partkey", "o_totalprice")
+    )
+    session.disable_hyperspace()
+    q6_off = q6().collect()
+    q6off_s = _time(lambda: q6().collect(), REPEATS)
+    session.enable_hyperspace()
+    _indexed_run_begin()
+    q6_on = q6().collect()
+    q6on_s = _time(lambda: q6().collect(), REPEATS)
+    _indexed_run_end()
+    if q6_off.num_rows != q6_on.num_rows:
+        _fail("config6 q3-shape row-count parity violated")
+    if int(q6_off.columns["l_partkey"].data.sum()) != int(
+        q6_on.columns["l_partkey"].data.sum()
+    ):
+        _fail("config6 q3-shape checksum parity violated")
+
+    def _ext_q3():
+        import pyarrow.dataset as pads
+
+        li = pads.dataset(str(WORKDIR / "lineitem"), format="parquet").to_table(
+            filter=pc.field("l_quantity") > qty_cut,
+            columns=["l_orderkey", "l_partkey"],
+        )
+        o = pads.dataset(str(WORKDIR / "orders"), format="parquet").to_table(
+            filter=pc.field("o_totalprice") < price_cut,
+            columns=["o_orderkey", "o_totalprice"],
+        )
+        return li.join(
+            o, keys="l_orderkey", right_keys="o_orderkey", join_type="inner"
+        ).select(["l_partkey", "o_totalprice"])
+
+    if _ext_q3().num_rows != q6_on.num_rows:
+        _fail("config6 external row-count parity violated")
+    ext6_s = _time(_ext_q3, REPEATS)
+    speedups["q3_filtered_join"] = q6off_s / q6on_s
+    ext_speedups["q3_filtered_join"] = ext6_s / q6on_s
+    extras["q3_rows"] = int(q6_on.num_rows)
+    extras["q3_fullscan_s"] = round(q6off_s, 4)
+    extras["q3_index_s"] = round(q6on_s, 4)
+    extras["q3_external_s"] = round(ext6_s, 4)
 
     # ---- config 4: hybrid scan after appends -------------------------------
     appended = lineitem.take(

@@ -29,7 +29,7 @@ from ...exceptions import HyperspaceException
 from ...index.log_entry import IndexLogEntry
 from ...utils import resolver
 from ..expr import And, Cmp, Col, Expr
-from ..ir import Join, LogicalPlan
+from ..ir import Filter, Join, LogicalPlan
 from . import rule_utils
 from .rankers import rank_join_index_pairs
 
@@ -91,6 +91,17 @@ def ensure_one_to_one(pairs: List[Tuple[str, str]]) -> Optional[Dict[str, str]]:
         l2r[l] = r
         r2l[r] = l
     return l2r
+
+
+def _side_required_columns(side: LogicalPlan, keys: List[str]) -> List[str]:
+    """Every column a join side references: its output, the join keys, and
+    any Filter condition columns inside the (linear) side — those survive
+    the rewrite as Filter nodes above the IndexScan, so the index must
+    carry them (JoinIndexRule.scala:451-463 allRequiredCols)."""
+    cols = list(side.output_columns()) + list(keys)
+    for f in side.collect(lambda n: isinstance(n, Filter)):
+        cols += sorted(f.condition.columns())
+    return list(dict.fromkeys(cols))
 
 
 def usable_indexes(
@@ -172,8 +183,14 @@ class JoinIndexRule:
         l_keys = list(dict.fromkeys(l for l, _ in oriented))
         r_keys = list(dict.fromkeys(r for _, r in oriented))
 
-        l_required = list(dict.fromkeys(left.output_columns() + l_keys))
-        r_required = list(dict.fromkeys(right.output_columns() + r_keys))
+        # ALL referenced columns must be covered, not just the side's
+        # output: a Filter inside a linear side (Project above Filter)
+        # references columns the projection drops, and a rewrite whose
+        # index lacks them would crash (or silently mis-filter) at exec —
+        # the reference's allRequiredCols walks every reference
+        # (JoinIndexRule.scala:451-463)
+        l_required = _side_required_columns(left, l_keys)
+        r_required = _side_required_columns(right, r_keys)
 
         l_candidates = rule_utils.get_candidate_indexes(indexes, left, conf)
         r_candidates = rule_utils.get_candidate_indexes(indexes, right, conf)

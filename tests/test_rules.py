@@ -377,3 +377,57 @@ def test_join_with_filter_below(conf):
     # the filter survives above the index scan
     filters = new_plan.collect(lambda n: isinstance(n, Filter))
     assert len(filters) == 1
+
+
+def test_join_rule_requires_filter_columns_covered(tmp_path):
+    """A join side whose Filter references a column the index does not
+    cover must NOT rewrite (the Filter survives above the IndexScan and
+    would crash/mis-filter); a covering index on the same side must.
+    Reference: JoinIndexRule.scala:451-463 allRequiredCols."""
+    import numpy as np
+
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.exec.executor import Executor
+    from hyperspace_tpu.plan.expr import col
+    from hyperspace_tpu.plan.ir import Filter, IndexScan, Join, Project, Scan
+    from hyperspace_tpu.plan.rules import apply_hyperspace_rules
+    from hyperspace_tpu.storage.columnar import ColumnarBatch
+    from tests.e2e_utils import assert_row_parity, build_index, write_source
+
+    rng = np.random.default_rng(0)
+    li = ColumnarBatch.from_pydict(
+        {"l_k": rng.integers(0, 80, 800).astype(np.int64),
+         "l_p": rng.integers(0, 50, 800).astype(np.int64),
+         "l_q": rng.integers(1, 50, 800).astype(np.int64)},
+    )
+    orders = ColumnarBatch.from_pydict(
+        {"o_k": rng.permutation(80).astype(np.int64),
+         "o_t": rng.integers(0, 1000, 80).astype(np.int64)},
+    )
+    l_rel = write_source(tmp_path / "li", li, n_files=2)
+    o_rel = write_source(tmp_path / "orders", orders, n_files=1)
+    conf = HyperspaceConf()
+
+    # index WITHOUT the filter column l_q
+    no_q = build_index("li_noq", l_rel, ["l_k"], ["l_p"], tmp_path / "idx")
+    o_idx = build_index("o_idx", o_rel, ["o_k"], ["o_t"], tmp_path / "idx")
+    plan = Project(
+        ("l_p", "o_t"),
+        Join(
+            Project(("l_p", "l_k"), Filter(col("l_q") > 25, Scan(l_rel))),
+            Scan(o_rel),
+            col("l_k") == col("o_k"),
+            "inner",
+        ),
+    )
+    rewritten, applied = apply_hyperspace_rules(plan, [no_q, o_idx], conf)
+    assert not rewritten.collect(lambda n: isinstance(n, IndexScan))
+    assert applied == []
+
+    # index WITH the filter column covers -> rewrite fires, rows identical
+    with_q = build_index("li_q", l_rel, ["l_k"], ["l_p", "l_q"], tmp_path / "idx")
+    rewritten, applied = apply_hyperspace_rules(plan, [with_q, o_idx], conf)
+    assert len(rewritten.collect(lambda n: isinstance(n, IndexScan))) == 2
+    assert {e.name for e in applied} == {"li_q", "o_idx"}
+    ex = Executor(conf)
+    assert_row_parity(ex.execute(plan), ex.execute(rewritten))
