@@ -19,9 +19,8 @@ from ..exceptions import HyperspaceException, NoChangesException
 from ..index.data_manager import IndexDataManager
 from ..index.log_entry import Content, FileIdTracker, IndexLogEntry, LogEntry
 from ..index.log_manager import IndexLogManager
-from ..ops.hashing import key_repr
 from ..storage import layout
-from ..storage.columnar import ColumnarBatch, is_string
+from ..storage.columnar import ColumnarBatch
 from ..telemetry import OptimizeActionEvent
 from . import states
 from .base import Action, MaintenanceActionBase
@@ -100,15 +99,14 @@ class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
             merged = ColumnarBatch.concat(
                 [layout.read_batch(f.name) for f in files]
             )
-            # restore per-bucket sort order on the indexed columns; strings
-            # sort by their (unified, order-preserving) dictionary codes —
-            # key_repr would sort by FNV hash, which is not an order
-            reprs = [
-                merged.columns[c].data
-                if is_string(merged.columns[c].dtype_str)
-                else key_repr(merged.columns[c])
-                for c in indexed
-            ]
+            # restore per-bucket sort order on the indexed columns via the
+            # shared order-preserving encodings (stream_builder.sort_encoding):
+            # strings sort by unified dictionary codes, floats by their
+            # ordered-int encodings — key_repr would sort strings by FNV
+            # hash and float32 by raw bit pattern (negatives reversed)
+            from ..index.stream_builder import sort_encoding
+
+            reprs = [sort_encoding(merged.columns[c]) for c in indexed]
             order = np.lexsort(list(reversed(reprs)))
             merged = merged.take(order)
             p = version_dir / layout.bucket_file_name(b)

@@ -118,11 +118,19 @@ class Executor:
                     )
                 avail = set(plan.relation.schema)
                 need = [c for c in need if c in avail]
+            arrow_filter = None
+            if predicate is not None and plan.relation.read_format == "parquet":
+                from ..plan.expr import to_arrow_filter
+
+                arrow_filter = to_arrow_filter(predicate)
             batch = parquet_io.read_files(
                 plan.relation.read_format,
                 [f.name for f in plan.relation.files],
                 columns=need,
+                arrow_filter=arrow_filter,
             )
+            # the full predicate is ALWAYS re-applied: the pushed filter is
+            # best-effort (partial conjunctions, reader fallback)
             return self._apply_predicate(batch, predicate)
         if isinstance(plan, IndexScan):
             return self._exec_index_scan(plan, predicate)

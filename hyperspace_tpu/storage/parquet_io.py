@@ -36,13 +36,30 @@ def _read_with(
 
 
 def read_parquet(
-    paths: Iterable[str | Path], columns: Optional[List[str]] = None
+    paths: Iterable[str | Path],
+    columns: Optional[List[str]] = None,
+    arrow_filter=None,
 ) -> ColumnarBatch:
-    """Read one or more parquet files into a single ColumnarBatch."""
+    """Read one or more parquet files into a single ColumnarBatch.
+
+    ``arrow_filter`` (a pyarrow compute Expression) pushes the predicate
+    into the reader — row-group statistics pruning and page skipping
+    happen inside parquet instead of materializing rows to mask later.
+    Callers must re-apply their own predicate after the read: the filter
+    is best-effort (a type-mismatched expression falls back to an
+    unfiltered read rather than failing the scan)."""
     import pyarrow.parquet as pq
 
+    def reader(p):
+        if arrow_filter is not None:
+            try:
+                return pq.read_table(p, columns=columns, filters=arrow_filter)
+            except Exception:  # noqa: BLE001 - pushdown is an optimization
+                pass
+        return pq.read_table(p, columns=columns)
+
     # column pushdown at the parquet reader; projection re-applied uniformly
-    return _read_with(lambda p: pq.read_table(p, columns=columns), "parquet", paths, columns)
+    return _read_with(reader, "parquet", paths, columns)
 
 
 def read_csv(paths: Iterable[str | Path], columns: Optional[List[str]] = None) -> ColumnarBatch:
@@ -129,11 +146,18 @@ READERS = {
 }
 
 
-def read_files(file_format: str, paths: Iterable[str | Path], columns=None) -> ColumnarBatch:
+def read_files(
+    file_format: str,
+    paths: Iterable[str | Path],
+    columns=None,
+    arrow_filter=None,
+) -> ColumnarBatch:
     try:
         reader = READERS[file_format]
     except KeyError:
         raise HyperspaceException(f"Unsupported source format: {file_format}")
+    if file_format == "parquet":
+        return reader(paths, columns, arrow_filter=arrow_filter)
     return reader(paths, columns)
 
 

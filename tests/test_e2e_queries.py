@@ -230,3 +230,86 @@ def test_multi_device_built_index_query_parity(tmp_path, conf, executor):
     rewritten, applied = apply_hyperspace_rules(plan, [entry], conf)
     assert applied == [entry]
     assert_row_parity(executor.execute(plan), executor.execute(rewritten))
+
+
+def test_arrow_filter_pushdown_parity(tmp_path, conf, executor):
+    """Parquet scans push translatable predicates into the pyarrow reader;
+    results must equal host-mask evaluation for every predicate shape,
+    including partially-translatable conjunctions and string NULLs."""
+    from hyperspace_tpu.plan.expr import to_arrow_filter
+    from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+    rng = np.random.default_rng(5)
+    n = 2000
+    batch = ColumnarBatch(
+        {
+            "k": Column.from_values(rng.integers(0, 100, n).astype(np.int64)),
+            "f": Column.from_values((rng.standard_normal(n) * 50).round(2)),
+            "s": Column.from_optional_values(
+                [None if i % 7 == 0 else ["x", "y", "z"][i % 3] for i in range(n)]
+            ),
+        }
+    )
+    rel = write_source(tmp_path / "src", batch, n_files=2)
+    for pred in (
+        col("k") == 42,
+        (col("k") > 20) & (col("f") < 0.0),
+        (col("k") < 5) | (col("k") > 95),
+        is_in(col("s"), ["x", "zz"]),
+        (col("s") == "y") & (col("k") >= 10),
+        # NULL-semantics shapes (review findings): Not over a nullable
+        # column must NOT be pushed (engine keeps NULL rows under
+        # negation), ne must keep NULL/NaN rows
+        ~(col("s") == "x"),
+        col("f") != 2.0,
+        ~(col("k") > 50),
+    ):
+        plan = Filter(pred, Scan(rel))
+        got = executor.execute(plan)
+        from hyperspace_tpu.plan.expr import eval_mask
+        whole = executor.execute(Scan(rel))
+        exp = whole.take(np.flatnonzero(np.asarray(eval_mask(pred, whole))))
+        assert sorted(got.columns["k"].data.tolist()) == sorted(
+            exp.columns["k"].data.tolist()
+        ), pred
+    # col-col conjunct: partially translated, still correct
+    pred = (col("k") > 50) & (col("k") == col("k"))
+    plan = Filter(pred, Scan(rel))
+    got = executor.execute(plan)
+    assert (got.columns["k"].data > 50).all()
+
+
+def test_arrow_filter_pushdown_float_nulls(tmp_path, conf, executor):
+    """Float NULLs in parquet ingest as NaN; ne-pushdown must keep those
+    rows ((x != v) | is_null(x)) — arrow's plain x != v drops them and the
+    re-applied mask can't resurrect unread rows (review finding)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu.sources.relation import FileRelation
+    from hyperspace_tpu.index.log_entry import FileIdTracker
+    from hyperspace_tpu.index.log_entry import Content
+    from hyperspace_tpu.utils import file_utils
+
+    d = tmp_path / "src"
+    d.mkdir()
+    pq.write_table(
+        pa.table({
+            "f": pa.array([1.0, None, 2.0, 3.0, None], type=pa.float64()),
+            "k": pa.array([1, 2, 3, 4, 5], type=pa.int64()),
+        }),
+        str(d / "p.parquet"),
+    )
+    tracker = FileIdTracker()
+    content = Content.from_leaf_files(
+        [str(p) for p in file_utils.list_leaf_files([d])], tracker
+    )
+    rel = FileRelation(
+        root_paths=[str(d)], file_format="parquet",
+        schema={"f": "float64", "k": "int64"},
+        files=content.file_infos(),
+    )
+    plan = Filter(col("f") != 2.0, Scan(rel))
+    got = executor.execute(plan)
+    # engine semantics: NULL->NaN, NaN != 2.0 is True -> 4 rows
+    assert sorted(got.columns["k"].data.tolist()) == [1, 2, 4, 5]

@@ -314,3 +314,51 @@ def test_globbing_pattern_mismatch_raises(env):
         session.read.option(
             C.GLOBBING_PATTERN_KEY, str(root / "data*")
         ).parquet(str(other))
+
+
+def test_optimize_restores_float32_sort_order(tmp_path):
+    """Optimize's restore-sort must use order-preserving encodings:
+    float32 keys with negatives sorted by raw bit pattern would write a
+    file that violates its sorted_by contract (regression)."""
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import layout, parquet_io
+    from hyperspace_tpu.storage.columnar import ColumnarBatch
+
+    rng = np.random.default_rng(0)
+    src = tmp_path / "data"
+    src.mkdir()
+
+    def batch(seed):
+        r = np.random.default_rng(seed)
+        return ColumnarBatch.from_pydict(
+            {"p": (r.standard_normal(300) * 100).astype(np.float32),
+             "v": r.integers(0, 1000, 300).astype(np.int64)},
+            {"p": "float32", "v": "int64"},
+        )
+
+    parquet_io.write_parquet(src / "part-0.parquet", batch(1))
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"), C.INDEX_NUM_BUCKETS: 2}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("f32i", ["p"], ["v"]))
+    # append + incremental refresh -> multiple files per bucket
+    parquet_io.write_parquet(src / "part-1.parquet", batch(2))
+    hs.refresh_index("f32i", C.REFRESH_MODE_INCREMENTAL)
+    hs.optimize_index("f32i", C.OPTIMIZE_MODE_FULL)
+
+    mgr = IndexLogManagerImpl(tmp_path / "idx" / "f32i")
+    entry = mgr.get_latest_stable_log()
+    from hyperspace_tpu.ops.floatbits import f32_to_ordered_i32
+
+    checked = 0
+    for f in entry.content.files():
+        fb = layout.read_batch(f)
+        enc = f32_to_ordered_i32(fb.columns["p"].data)
+        assert (np.diff(enc) >= 0).all(), f"mis-sorted after optimize: {f}"
+        checked += 1
+    assert checked >= 1
