@@ -88,6 +88,18 @@ class DataFrame(EventLogging):
     def to_pandas(self):
         return self.collect().to_pandas()
 
+    def show(self, n: int = 20) -> None:
+        """Print the first ``n`` rows (the df.show() notebook idiom the
+        reference exposes through Spark; SPARK/sql/hyperspace/utils
+        showString shim). Only the shown rows are converted to pandas."""
+        import numpy as np
+
+        batch = self.collect()
+        head = batch.take(np.arange(min(n, batch.num_rows)))
+        print(head.to_pandas().to_string(index=False))
+        if batch.num_rows > n:
+            print(f"... ({batch.num_rows - n} more rows)")
+
     def count(self) -> int:
         return self.collect().num_rows
 

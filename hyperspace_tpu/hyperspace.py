@@ -26,6 +26,22 @@ class Hyperspace:
     def indexes(self) -> List[IndexStatistics]:
         return self._manager.indexes()
 
+    def indexes_df(self):
+        """The summary as a pandas DataFrame — the reference's
+        ``hyperspace.indexes`` IS a Spark DataFrame with these summary
+        columns (IndexStatistics.scala:64-71); list-of-stats is the
+        pythonic surface, this is the tabular one."""
+        import pandas as pd
+
+        rows = [s.to_row() for s in self.indexes()]
+        return pd.DataFrame(
+            rows,
+            columns=[
+                "name", "indexedColumns", "includedColumns", "numBuckets",
+                "schema", "indexLocation", "state",
+            ],
+        )
+
     def create_index(self, df: DataFrame, config: IndexConfig) -> None:
         self._manager.create(df, config)
 

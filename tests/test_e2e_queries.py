@@ -313,3 +313,20 @@ def test_arrow_filter_pushdown_float_nulls(tmp_path, conf, executor):
     got = executor.execute(plan)
     # engine semantics: NULL->NaN, NaN != 2.0 is True -> 4 rows
     assert sorted(got.columns["k"].data.tolist()) == [1, 2, 4, 5]
+
+
+def test_dataframe_show(tmp_path, capsys):
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+
+    b = ColumnarBatch.from_pydict(
+        {"k": np.arange(30, dtype=np.int64), "v": np.arange(30, dtype=np.int64) * 2}
+    )
+    src = tmp_path / "d"
+    src.mkdir()
+    parquet_io.write_parquet(src / "p.parquet", b)
+    session = HyperspaceSession(HyperspaceConf({}))
+    session.read.parquet(str(src)).show(5)
+    out = capsys.readouterr().out
+    assert "k" in out and "v" in out
+    assert "(25 more rows)" in out

@@ -362,3 +362,19 @@ def test_optimize_restores_float32_sort_order(tmp_path):
         assert (np.diff(enc) >= 0).all(), f"mis-sorted after optimize: {f}"
         checked += 1
     assert checked >= 1
+
+
+def test_indexes_df_summary(env):
+    session, hs, src, root = env
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("sumIdx", ["orderkey"], ["qty"]))
+    table = hs.indexes_df()
+    assert list(table.columns) == [
+        "name", "indexedColumns", "includedColumns", "numBuckets",
+        "schema", "indexLocation", "state",
+    ]
+    row = table.iloc[0]
+    assert row["name"] == "sumIdx"
+    assert row["indexedColumns"] == ["orderkey"]
+    assert row["state"] == states.ACTIVE
+    assert row["numBuckets"] == 4
