@@ -65,7 +65,9 @@ class DataFrame(EventLogging):
         from .actions import states
         from .plan.rules import apply_hyperspace_rules
 
-        indexes = self.session.collection_manager.get_indexes([states.ACTIVE])
+        indexes = self.session.collection_manager.get_indexes(
+            [states.ACTIVE], prefer_stable=True
+        )
         new_plan, applied = apply_hyperspace_rules(pruned, indexes, self.session.conf)
         if applied and log_usage:
             self.log_event(

@@ -137,17 +137,46 @@ class IndexCollectionManager:
         CancelAction(self._existing_log_manager(name), self.conf).run()
 
     # -- enumeration (IndexCollectionManager.scala:109-152) -------------------
-    def get_indexes(
-        self, states_filter: Optional[List[str]] = None
-    ) -> List[IndexLogEntry]:
-        out: List[IndexLogEntry] = []
+    def _enumerate(self):
+        """One directory walk: (latest entry, stable entry or None) per
+        index. Stable == latest when the latest state is already stable,
+        so the extra latestStable read happens only for in-flight
+        writers."""
+        out = []
         root = self.path_resolver.system_path
         if not root.is_dir():
             return out
         for d in sorted(root.iterdir()):
             if not d.is_dir():
                 continue
-            entry = IndexLogManagerImpl(d).get_latest_log()
+            mgr = IndexLogManagerImpl(d)
+            latest = mgr.get_latest_log()
+            if latest is None:
+                continue
+            stable = (
+                latest
+                if latest.state in states.STABLE_STATES
+                else mgr.get_latest_stable_log()
+            )
+            out.append((latest, stable))
+        return out
+
+    def get_indexes(
+        self,
+        states_filter: Optional[List[str]] = None,
+        prefer_stable: bool = False,
+    ) -> List[IndexLogEntry]:
+        """``prefer_stable=True`` is the QUERY view: an in-flight writer
+        (transient latest state) is invisible — readers get the PREVIOUS
+        stable snapshot (its immutable v__ dirs are still on disk), so an
+        index neither vanishes mid-refresh nor exposes half-built state
+        (IndexLogManager.scala:94-113 latestStable-preferring reads;
+        SURVEY §5.3). The default latest view serves the management
+        surface, which must show transient states (a stuck CREATING index
+        is visible in hs.indexes() so cancel() is discoverable)."""
+        out: List[IndexLogEntry] = []
+        for latest, stable in self._enumerate():
+            entry = stable if prefer_stable else latest
             if entry is None:
                 continue
             if states_filter is None or entry.state in states_filter:
@@ -174,21 +203,19 @@ class CachingIndexCollectionManager(IndexCollectionManager):
 
     def __init__(self, session):
         super().__init__(session)
-        self._cache: CreationTimeBasedCache[List[IndexLogEntry]] = (
+        self._cache: CreationTimeBasedCache[list] = (
             CreationTimeBasedCache(self.conf.cache_expiry_seconds)
         )
 
     def clear_cache(self) -> None:
         self._cache.clear()
 
-    def get_indexes(self, states_filter=None):
+    def _enumerate(self):
         cached = self._cache.get()
         if cached is None:
-            cached = super().get_indexes(None)
+            cached = super()._enumerate()
             self._cache.set(cached)
-        if states_filter is None:
-            return list(cached)
-        return [e for e in cached if e.state in states_filter]
+        return cached
 
     def create(self, df, config):
         self.clear_cache()

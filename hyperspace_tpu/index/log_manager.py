@@ -83,9 +83,17 @@ class IndexLogManagerImpl(IndexLogManager):
             raw = self._fs.read(str(path))
         except (FileNotFoundError, IsADirectoryError):
             return None
-        return IndexLogEntry.from_json_dict(
-            json_utils.from_json(raw.decode("utf-8"))
-        )
+        try:
+            return IndexLogEntry.from_json_dict(
+                json_utils.from_json(raw.decode("utf-8"))
+            )
+        except (ValueError, KeyError, TypeError) as e:
+            # a truncated/garbled entry must name its file — a bare
+            # JSONDecodeError from deep inside index enumeration is
+            # undebuggable (and the OCC protocol means a *committed* entry
+            # is never partially written: corruption here is storage rot
+            # or outside interference, worth a loud, precise error)
+            raise HyperspaceException(f"Corrupt index log entry at {path}: {e}")
 
     def get_log(self, id: int) -> Optional[IndexLogEntry]:
         return self._read(self._path_of(id))

@@ -80,7 +80,9 @@ def explain_string(
     Hyperspace enabled — both plans are compiled here."""
     session = df.session
     mode = display_mode or display_mode_from_conf(session.conf)
-    indexes = session.collection_manager.get_indexes([states.ACTIVE])
+    indexes = session.collection_manager.get_indexes(
+        [states.ACTIVE], prefer_stable=True
+    )
     plan_off = df.plan
     plan_on, applied = apply_hyperspace_rules(plan_off, indexes, session.conf)
 

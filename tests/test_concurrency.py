@@ -270,3 +270,37 @@ def test_cancel_recovers_killed_writer(env):
     # and the index is writable again
     hs.refresh_index("kidx", C.REFRESH_MODE_FULL)
     assert mgr.get_latest_stable_log().state == states.ACTIVE
+
+
+def test_queries_see_stable_snapshot_during_refresh(env):
+    """While a refresh is in flight (transient REFRESHING in the log),
+    queries keep using the PREVIOUS stable snapshot — the index neither
+    vanishes nor exposes half-built state (latestStable-preferring reads,
+    IndexLogManager.scala:94-113)."""
+    session, hs, src, root = env
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("snapIdx", ["k"], ["v"]))
+    session.enable_hyperspace()
+    from hyperspace_tpu.plan.expr import col
+    from hyperspace_tpu.plan.ir import IndexScan
+
+    q = session.read.parquet(str(src)).filter(col("k") == 3).select("k", "v")
+    baseline = q.collect()
+    assert q.optimized_plan().collect(lambda n: isinstance(n, IndexScan))
+
+    # simulate an in-flight writer: transient entry appended by hand
+    idx_path = Path(session.conf.system_path()) / "snapIdx"
+    mgr = IndexLogManagerImpl(idx_path)
+    stuck = mgr.get_latest_log()
+    stuck.state = states.REFRESHING
+    assert mgr.write_log(stuck.id + 1, stuck)
+    session.collection_manager.clear_cache()
+
+    # the rewrite still fires, against the stable snapshot
+    assert q.optimized_plan().collect(lambda n: isinstance(n, IndexScan))
+    during = q.collect()
+    assert sorted(during.columns["v"].data.tolist()) == sorted(
+        baseline.columns["v"].data.tolist()
+    )
+    # listing still shows the index (stable view)
+    assert [s.name for s in hs.indexes()] == ["snapIdx"]

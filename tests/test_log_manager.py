@@ -62,3 +62,22 @@ def test_delete_latest_stable(tmp_path):
     mgr.delete_latest_stable_log()
     # falls back to scan
     assert mgr.get_latest_stable_log().id == 0
+
+
+def test_corrupt_log_entry_names_its_file(tmp_path):
+    """A garbled log entry raises HyperspaceException naming the file —
+    not a bare JSONDecodeError from deep inside enumeration."""
+    import pytest
+
+    from hyperspace_tpu.exceptions import HyperspaceException
+
+    d = tmp_path / "idx" / "_hyperspace_log"
+    d.mkdir(parents=True)
+    (d / "0").write_text("{corrupt json!!")
+    mgr = IndexLogManagerImpl(tmp_path / "idx")
+    with pytest.raises(HyperspaceException, match="Corrupt index log entry.*0"):
+        mgr.get_latest_log()
+    # truncated-but-valid-json missing required fields also names the file
+    (d / "0").write_text('{"id": 3}')
+    with pytest.raises(HyperspaceException, match="Corrupt index log entry"):
+        mgr.get_latest_log()
