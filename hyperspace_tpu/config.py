@@ -135,6 +135,10 @@ class HyperspaceConf:
     def build_chunk_rows(self) -> int:
         return int(self.get(C.BUILD_CHUNK_ROWS, C.BUILD_CHUNK_ROWS_DEFAULT))
 
+    def profile_dir(self) -> Optional[str]:
+        v = self.get(C.TPU_PROFILE_DIR)
+        return str(v) if v else None
+
     def build_streaming_threshold_bytes(self) -> int:
         return int(
             self.get(

@@ -118,3 +118,8 @@ SIGNATURE_PROVIDER = "hyperspace.index.signatureProvider"
 TPU_MESH_BUCKET_AXIS = "hyperspace.tpu.mesh.bucketAxis"
 TPU_MESH_BUCKET_AXIS_DEFAULT = "buckets"
 STORAGE_BLOCK_ALIGN = 128  # bytes; lane-friendly alignment for column buffers
+# When set to a directory, query execution runs under jax.profiler.trace —
+# the XLA-level view (per-op device timing, HLO) complementing the
+# engine-level metrics registry (SURVEY §5.1: "JAX profiler + per-kernel
+# timing"). The reference delegates the equivalent to the Spark UI.
+TPU_PROFILE_DIR = "hyperspace.tpu.profile.dir"

@@ -83,9 +83,22 @@ class DataFrame(EventLogging):
     def collect(self) -> ColumnarBatch:
         from .exec.executor import Executor
 
-        return Executor(self.session.conf, mesh=self.session.mesh).execute(
-            self.optimized_plan(log_usage=True)
-        )
+        import contextlib
+
+        executor = Executor(self.session.conf, mesh=self.session.mesh)
+        plan = self.optimized_plan(log_usage=True)
+        profile_dir = self.session.conf.profile_dir()
+        if profile_dir:
+            # XLA-level trace (per-op device timing, HLO) for this query —
+            # view with tensorboard/xprof; complements the engine-level
+            # metrics registry (SURVEY §5.1)
+            import jax
+
+            tracer = jax.profiler.trace(profile_dir)
+        else:
+            tracer = contextlib.nullcontext()
+        with tracer:
+            return executor.execute(plan)
 
     def to_pandas(self):
         return self.collect().to_pandas()

@@ -264,13 +264,34 @@ def sketch_key(spec_dict: Dict[str, Any]) -> str:
     return json.dumps(spec_dict, sort_keys=True)
 
 
+_sketch_table_cache: Dict[str, tuple] = {}
+
+
 def load_sketch_table(content_files: List[str]) -> Optional[Dict[str, Dict]]:
     """The {file: {sketch key: data}} table from an index's content file
-    list, or None if no sketch file is present."""
+    list, or None if no sketch file is present. Parsed tables are cached
+    per path, validated by (mtime, size) — sketch files live in immutable
+    ``v__=k`` version dirs (a refresh writes a NEW dir, hence a new cache
+    key), so hits are the common case and every query stops paying the
+    JSON parse."""
     import json
     from pathlib import Path
 
     for f in content_files:
         if f.endswith(SKETCH_FILE_NAME):
-            return json.loads(Path(f).read_text(encoding="utf-8"))["files"]
+            p = Path(f)
+            # a listed-but-unreadable sketch file raises (like read_text
+            # always did): the query rule catches and skips pruning, while
+            # refresh fails loudly instead of silently dropping unchanged
+            # files' sketches from the next version
+            st = p.stat()
+            stamp = (st.st_mtime_ns, st.st_size)
+            hit = _sketch_table_cache.get(f)
+            if hit is not None and hit[0] == stamp:
+                return hit[1]
+            table = json.loads(p.read_text(encoding="utf-8"))["files"]
+            if len(_sketch_table_cache) >= 32:
+                _sketch_table_cache.pop(next(iter(_sketch_table_cache)))
+            _sketch_table_cache[f] = (stamp, table)
+            return table
     return None

@@ -83,3 +83,17 @@ def test_explain_verbose_shows_engine_metrics(tmp_path):
     assert "Engine metrics (cumulative, this process):" in text
     # at least one counter or timer line rendered
     assert "scan." in text or "join." in text or "build." in text
+
+
+def test_profile_dir_captures_trace(tmp_path):
+    """hyperspace.tpu.profile.dir wraps query execution in
+    jax.profiler.trace — the XLA-level complement to the metrics registry
+    (SURVEY §5.1)."""
+    session, src = _setup(tmp_path)
+    prof = tmp_path / "prof"
+    session.conf.set(C.TPU_PROFILE_DIR, str(prof))
+    session.enable_hyperspace()
+    q = session.read.parquet(str(src)).filter(col("k") > 10).select("k", "v")
+    q.collect()
+    produced = list(prof.rglob("*"))
+    assert any(p.is_file() for p in produced), produced
