@@ -55,11 +55,13 @@ class DataFrame(EventLogging):
         (``log_usage=True``, set by collect()) — one event per execution,
         as in HyperspaceEvent.scala:150-156."""
         from .plan.rules.column_pruning import prune_columns
+        from .plan.rules.predicate_pushdown import push_filters_through_joins
 
-        # column pruning always runs (Catalyst runs its ColumnPruning batch
-        # before extraOptimizations, so the reference's rules see pruned
-        # plans; ours must too — and plain scans read fewer columns).
-        pruned = prune_columns(self.plan)
+        # Catalyst's normalization batches run before the reference's rules
+        # see a plan; ours must too: side predicates move through inner
+        # joins (so filtered-join shapes stay linear for the index rules),
+        # then column pruning narrows every scan.
+        pruned = prune_columns(push_filters_through_joins(self.plan))
         if not self.session.is_hyperspace_enabled():
             return pruned
         from .actions import states

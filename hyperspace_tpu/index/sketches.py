@@ -273,7 +273,13 @@ def load_sketch_table(content_files: List[str]) -> Optional[Dict[str, Dict]]:
     per path, validated by (mtime, size) — sketch files live in immutable
     ``v__=k`` version dirs (a refresh writes a NEW dir, hence a new cache
     key), so hits are the common case and every query stops paying the
-    JSON parse."""
+    JSON parse.
+
+    CONTRACT: the returned object is the SHARED cached instance — treat it
+    as frozen. Callers must never mutate the table or its nested dicts
+    (incremental refresh copies entry references into a fresh dict and
+    serializes; it does not modify them); an in-place edit would corrupt
+    every later query's pruning in this process."""
     import json
     from pathlib import Path
 
