@@ -213,8 +213,18 @@ def index_scan(
             batch = batch.take(idx)
         parts.append(batch.select(output_columns))
     if not parts:
-        # empty result with correct schema: read schema from any file
+        # empty result with correct schema: from the index's logged schema
+        # when available (covers every file pruned away — e.g. an equality
+        # key hashing to a bucket that holds no rows and hence no file),
+        # else from any surviving file's footer
         if not files:
+            if dtypes:
+                resolved = {k.lower(): v for k, v in dtypes.items()}
+                missing = [c for c in output_columns if c.lower() not in resolved]
+                if not missing:
+                    return ColumnarBatch.empty(
+                        {c: resolved[c.lower()] for c in output_columns}
+                    )
             raise HyperspaceException("index_scan over zero files with no schema.")
         empty = layout.read_batch(files[0], columns=output_columns)
         return empty.take(np.array([], dtype=np.int64))

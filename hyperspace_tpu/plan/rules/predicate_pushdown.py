@@ -51,6 +51,10 @@ def push_filters_through_joins(plan: LogicalPlan) -> LogicalPlan:
     def rewrite(node: LogicalPlan) -> Optional[LogicalPlan]:
         if not isinstance(node, Filter):
             return None
+        if isinstance(node.child, Filter):
+            # CombineFilters: stacked .filter() calls merge so a pushable
+            # conjunct above a retained mixed conjunct still descends
+            return Filter(And(node.condition, node.child.condition), node.child.child)
         if isinstance(node.child, Project):
             pr = node.child
             return Project(pr.columns, Filter(node.condition, pr.child))

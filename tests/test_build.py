@@ -296,3 +296,28 @@ def test_device_arrays_f64_encoding_round_trip():
     np.testing.assert_array_equal(
         back.view(np.int64), np.where(vals == 0.0, 0.0, vals).view(np.int64)
     )
+
+
+def test_empty_bucket_lookup_returns_empty(tmp_path):
+    """An equality key hashing to a bucket with no rows (hence no file)
+    returns an empty result in the index schema — regression: it crashed
+    with 'index_scan over zero files with no schema'."""
+    from hyperspace_tpu.ops.hashing import bucket_of_values
+
+    b = ColumnarBatch.from_pydict(
+        {"k": np.array([1, 2] * 50, dtype=np.int64),
+         "v": np.arange(100, dtype=np.int64)}
+    )
+    nb = 64
+    files = write_index_data(b, ["k"], nb, tmp_path / "v")
+    used = {layout.bucket_of_file(f) for f in files}
+    probe = next(
+        k for k in range(3, 10_000)
+        if bucket_of_values([k], ["int64"], nb) not in used
+    )
+    got = index_scan(
+        files, ["k", "v"], col("k") == probe,
+        indexed_columns=["k"], dtypes={"k": "int64", "v": "int64"}, num_buckets=nb,
+    )
+    assert got.num_rows == 0
+    assert got.schema() == {"k": "int64", "v": "int64"}

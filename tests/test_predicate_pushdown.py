@@ -176,3 +176,25 @@ def test_multi_join_chain_reaches_leaf(tmp_path):
     assert isinstance(out2, Project)
     assert depth_of_filter(out2) is not None
     assert_row_parity(ex.execute(plan2), ex.execute(out2))
+
+
+def test_stacked_filters_combine_and_descend(tmp_path):
+    """CombineFilters: a pushable predicate stacked above a mixed-conjunct
+    Filter still reaches its side (regression: it stalled)."""
+    l_rel, o_rel = make_rels(tmp_path)
+    plan = Filter(
+        col("l_q") > 25,
+        Filter(
+            col("l_k") > col("o_k"),
+            Join(Scan(l_rel), Scan(o_rel), col("l_k") == col("o_k"), "inner"),
+        ),
+    )
+    out = push_filters_through_joins(plan)
+    # mixed conjunct retained above; side conjunct sits over the left scan
+    assert isinstance(out, Filter)
+    join = out.child
+    assert isinstance(join, Join)
+    assert isinstance(join.left, Filter)
+    assert join.left.condition.columns() == {"l_q"}
+    ex = Executor(HyperspaceConf())
+    assert_row_parity(ex.execute(plan), ex.execute(out))
