@@ -213,14 +213,17 @@ class Executor:
         batches = layout.read_batches(files, columns=need)
         by_bucket = self._group_batches_by_bucket(files, batches)
         if not by_bucket:
+            from .scan import empty_batch_for
+
+            empty = empty_batch_for(list(node.required_columns), entry.schema)
+            if empty is not None:
+                return empty
             if not files:
-                # every file pruned: empty result in the node's schema
-                resolved = {k.lower(): v for k, v in entry.schema.items()}
-                return ColumnarBatch.empty(
-                    {c: resolved[c.lower()] for c in node.required_columns}
+                raise HyperspaceException(
+                    "distributed scan over zero files with no schema."
                 )
-            empty = layout.read_batch(files[0], columns=list(node.required_columns))
-            return empty.take(np.array([], dtype=np.int64))
+            eb = layout.read_batch(files[0], columns=list(node.required_columns))
+            return eb.take(np.array([], dtype=np.int64))
         total_rows = sum(b.num_rows for b in by_bucket.values())
         if total_rows < self.dist_min_rows:
             # too small for the mesh round trip: host mask + compact

@@ -144,6 +144,19 @@ def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
 MIN_DEVICE_ROWS = 1_000_000
 
 
+def empty_batch_for(output_columns, dtypes) -> Optional[ColumnarBatch]:
+    """A 0-row batch projecting ``output_columns`` out of a (possibly
+    differently-cased) ``dtypes`` schema, or None when the schema can't
+    cover the projection — shared by the single-device and distributed
+    scan paths for pruned-to-nothing results."""
+    if not dtypes:
+        return None
+    resolved = {k.lower(): v for k, v in dtypes.items()}
+    if any(c.lower() not in resolved for c in output_columns):
+        return None
+    return ColumnarBatch.empty({c: resolved[c.lower()] for c in output_columns})
+
+
 def prune_index_files(
     files: List[Path],
     predicate: Optional[Expr],
@@ -214,18 +227,14 @@ def index_scan(
         parts.append(batch.select(output_columns))
     if not parts:
         # empty result with correct schema: from the index's logged schema
-        # when available (covers every file pruned away — e.g. an equality
-        # key hashing to a bucket that holds no rows and hence no file),
-        # else from any surviving file's footer
+        # when available (also covers every file pruned away — e.g. an
+        # equality key hashing to a bucket that holds no rows and hence no
+        # file), else from a surviving file's footer
+        empty = empty_batch_for(output_columns, dtypes)
+        if empty is not None:
+            return empty
         if not files:
-            if dtypes:
-                resolved = {k.lower(): v for k, v in dtypes.items()}
-                missing = [c for c in output_columns if c.lower() not in resolved]
-                if not missing:
-                    return ColumnarBatch.empty(
-                        {c: resolved[c.lower()] for c in output_columns}
-                    )
             raise HyperspaceException("index_scan over zero files with no schema.")
-        empty = layout.read_batch(files[0], columns=output_columns)
-        return empty.take(np.array([], dtype=np.int64))
+        eb = layout.read_batch(files[0], columns=output_columns)
+        return eb.take(np.array([], dtype=np.int64))
     return ColumnarBatch.concat(parts)

@@ -1,0 +1,219 @@
+"""Property-based off/on parity fuzz: randomized schemas, data
+distributions, index configs, and predicate shapes, asserting the one
+invariant the whole framework rests on — enabling Hyperspace NEVER
+changes query results (E2EHyperspaceRulesTest.verifyIndexUsage
+generalized). Seeds are fixed: failures reproduce deterministically.
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col, is_in, lit
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+
+def random_batch(rng, n):
+    """A batch with a random mix of column types and value distributions
+    (dupes, negatives, skew, tiny vocab strings)."""
+    cols = {
+        "k_int": Column.from_values(
+            rng.integers(-(10 ** rng.integers(1, 9)), 10 ** rng.integers(1, 9), n).astype(np.int64)
+        ),
+        "k_small": Column.from_values(rng.integers(0, rng.integers(2, 50), n).astype(np.int32)),
+        "f32": Column.from_values((rng.standard_normal(n) * 10 ** rng.integers(0, 4)).astype(np.float32)),
+        "f64": Column.from_values(np.round(rng.standard_normal(n) * 1e3, 3)),
+        "s": Column.from_values(
+            rng.choice([b"a", b"bb", b"CCC", b"", b"zz~!", b"\xf0\x9f\x8c\x8d"], n).astype(object)
+        ),
+    }
+    return ColumnarBatch(cols)
+
+
+def random_predicate(rng, batch):
+    """A random predicate over the batch's columns, with literals drawn
+    from data (hits) and out-of-domain (misses)."""
+    def leaf():
+        c = rng.choice(["k_int", "k_small", "f64", "s"])
+        data = batch.columns[c]
+        if c == "s":
+            v = rng.choice(["a", "bb", "CCC", "", "nope"])
+            op = rng.choice(["eq", "ne", "lt", "ge"])
+        else:
+            pool = data.data
+            v = pool[rng.integers(0, len(pool))] if rng.random() < 0.7 else 10 ** 10
+            v = v.item() if hasattr(v, "item") else v
+            op = rng.choice(["eq", "ne", "lt", "le", "gt", "ge"])
+        e = col(c)
+        return {
+            "eq": e == v, "ne": e != v, "lt": e < v,
+            "le": e <= v, "gt": e > v, "ge": e >= v,
+        }[op]
+
+    p = leaf()
+    for _ in range(int(rng.integers(0, 3))):
+        q = leaf()
+        r = rng.random()
+        if r < 0.4:
+            p = p & q
+        elif r < 0.8:
+            p = p | q
+        else:
+            p = p & ~q
+    if rng.random() < 0.25:
+        vals = [int(x) for x in rng.choice(batch.columns["k_small"].data, 3)]
+        p = p | is_in(col("k_small"), vals)
+    return p
+
+
+def rows_key(batch):
+    cols = sorted(batch.column_names)
+    mats = []
+    for c in cols:
+        v = batch.columns[c]
+        mats.append(v.to_values() if v.vocab is not None else v.data)
+    return sorted(zip(*[list(map(repr, m)) for m in mats])) if batch.num_rows else []
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_filter_parity_fuzz(tmp_path, seed):
+    rng = np.random.default_rng(1000 + seed)
+    n = int(rng.integers(50, 3000))
+    batch = random_batch(rng, n)
+    src = tmp_path / "src"
+    src.mkdir()
+    n_files = int(rng.integers(1, 4))
+    per = (n + n_files - 1) // n_files
+    for i in range(n_files):
+        parquet_io.write_parquet(
+            src / f"p{i}.parquet", batch.take(np.arange(i * per, min((i + 1) * per, n)))
+        )
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
+            C.INDEX_NUM_BUCKETS: int(rng.choice([1, 2, 7, 16, 64])),
+            C.INDEX_LINEAGE_ENABLED: bool(rng.random() < 0.5),
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    indexed = str(rng.choice(["k_int", "k_small", "s", "f64"]))
+    others = [c for c in batch.column_names if c != indexed]
+    included = list(rng.choice(others, size=int(rng.integers(1, len(others) + 1)), replace=False))
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("fz", [indexed], included))
+
+    out_cols = [indexed] + included
+    for _ in range(4):
+        pred = random_predicate(rng, batch)
+        if not pred.columns() <= set(out_cols):
+            continue
+        q = session.read.parquet(str(src)).filter(pred).select(*out_cols)
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        on = q.collect()
+        assert rows_key(off) == rows_key(on), (seed, repr(pred))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_join_parity_fuzz(tmp_path, seed):
+    rng = np.random.default_rng(5000 + seed)
+    n_l = int(rng.integers(100, 2500))
+    n_r = int(rng.integers(20, 800))
+    key_space = int(rng.integers(5, 400))
+    left = ColumnarBatch.from_pydict(
+        {"lk": rng.integers(0, key_space, n_l).astype(np.int64),
+         "lv": rng.integers(-1000, 1000, n_l).astype(np.int64)},
+    )
+    right = ColumnarBatch.from_pydict(
+        {"rk": rng.integers(0, key_space, n_r).astype(np.int64),
+         "rv": rng.integers(-1000, 1000, n_r).astype(np.int64)},
+    )
+    (tmp_path / "l").mkdir(); (tmp_path / "r").mkdir()
+    parquet_io.write_parquet(tmp_path / "l" / "p.parquet", left)
+    parquet_io.write_parquet(tmp_path / "r" / "p.parquet", right)
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
+         C.INDEX_NUM_BUCKETS: int(rng.choice([1, 4, 32]))}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(str(tmp_path / "l")), IndexConfig("lfz", ["lk"], ["lv"]))
+    hs.create_index(session.read.parquet(str(tmp_path / "r")), IndexConfig("rfz", ["rk"], ["rv"]))
+
+    q = (
+        session.read.parquet(str(tmp_path / "l"))
+        .join(session.read.parquet(str(tmp_path / "r")), col("lk") == col("rk"))
+        .select("lk", "lv", "rv")
+    )
+    if rng.random() < 0.6:
+        q = q.filter(col("lv") > int(rng.integers(-500, 500)))
+    if rng.random() < 0.4:
+        q = q.filter(col("rv") < int(rng.integers(-500, 500)))
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    on = q.collect()
+    assert rows_key(off) == rows_key(on), seed
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_hybrid_parity_fuzz(tmp_path, seed):
+    """Random appends and/or a delete after indexing, hybrid scan on:
+    off/on parity must hold through the append-union and lineage NOT-IN
+    rewrites."""
+    rng = np.random.default_rng(9000 + seed)
+    n = int(rng.integers(200, 2000))
+    batch = ColumnarBatch.from_pydict(
+        {"k": rng.integers(0, 200, n).astype(np.int64),
+         "v": rng.integers(-10**6, 10**6, n).astype(np.int64)},
+    )
+    src = tmp_path / "src"
+    src.mkdir()
+    n_files = 8
+    per = (n + n_files - 1) // n_files
+    for i in range(n_files):
+        parquet_io.write_parquet(
+            src / f"p{i}.parquet", batch.take(np.arange(i * per, min((i + 1) * per, n)))
+        )
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
+            C.INDEX_NUM_BUCKETS: int(rng.choice([2, 8, 32])),
+            C.INDEX_LINEAGE_ENABLED: True,
+            C.INDEX_HYBRID_SCAN_ENABLED: True,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("hz", ["k"], ["v"]))
+
+    # mutate the source under the index (small enough for the ratio caps)
+    if rng.random() < 0.8:
+        extra = ColumnarBatch.from_pydict(
+            {"k": rng.integers(0, 200, 40).astype(np.int64),
+             "v": rng.integers(-10**6, 10**6, 40).astype(np.int64)},
+        )
+        parquet_io.write_parquet(src / "appended.parquet", extra)
+    if rng.random() < 0.6:
+        (src / f"p{int(rng.integers(0, n_files))}.parquet").unlink()
+
+    for _ in range(3):
+        key = int(rng.integers(0, 200))
+        ops = [
+            col("k") == key,
+            (col("k") >= key) & (col("k") < key + int(rng.integers(1, 30))),
+            col("v") > int(rng.integers(-10**6, 10**6)),
+        ]
+        pred = ops[int(rng.integers(0, len(ops)))]
+        q = session.read.parquet(str(src)).filter(pred).select("k", "v")
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        on = q.collect()
+        assert rows_key(off) == rows_key(on), (seed, repr(pred))
