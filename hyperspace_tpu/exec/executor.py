@@ -453,7 +453,12 @@ class Executor:
         if by_bucket:
             any_batch = next(iter(by_bucket.values()))
             return any_batch.take(np.array([], dtype=np.int64))
-        resolved = {k.lower(): v for k, v in idx_node.entry.schema.items()}
-        return ColumnarBatch.empty(
-            {c: resolved[c.lower()] for c in side_plan.output_columns()}
-        )
+        from .scan import empty_batch_for
+
+        empty = empty_batch_for(side_plan.output_columns(), idx_node.entry.schema)
+        if empty is None:
+            raise HyperspaceException(
+                f"Join side outputs {side_plan.output_columns()} not covered "
+                f"by index {idx_node.entry.name}'s schema."
+            )
+        return empty

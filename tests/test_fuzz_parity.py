@@ -35,11 +35,17 @@ def random_batch(rng, n):
     return ColumnarBatch(cols)
 
 
-def random_predicate(rng, batch):
+def random_predicate(rng, batch, allowed_cols=None):
     """A random predicate over the batch's columns, with literals drawn
-    from data (hits) and out-of-domain (misses)."""
+    from data (hits) and out-of-domain (misses). ``allowed_cols`` keeps
+    every leaf inside the index's output so parity checks never skip
+    vacuously."""
+    eligible = ["k_int", "k_small", "f64", "s"]
+    if allowed_cols is not None:
+        eligible = [c for c in eligible if c in allowed_cols]
+
     def leaf():
-        c = rng.choice(["k_int", "k_small", "f64", "s"])
+        c = rng.choice(eligible)
         data = batch.columns[c]
         if c == "s":
             v = rng.choice(["a", "bb", "CCC", "", "nope"])
@@ -65,7 +71,7 @@ def random_predicate(rng, batch):
             p = p | q
         else:
             p = p & ~q
-    if rng.random() < 0.25:
+    if "k_small" in eligible and rng.random() < 0.25:
         vals = [int(x) for x in rng.choice(batch.columns["k_small"].data, 3)]
         p = p | is_in(col("k_small"), vals)
     return p
@@ -108,16 +114,19 @@ def test_filter_parity_fuzz(tmp_path, seed):
     hs.create_index(session.read.parquet(str(src)), IndexConfig("fz", [indexed], included))
 
     out_cols = [indexed] + included
+    checked = 0
     for _ in range(4):
-        pred = random_predicate(rng, batch)
+        pred = random_predicate(rng, batch, allowed_cols=out_cols)
         if not pred.columns() <= set(out_cols):
             continue
+        checked += 1
         q = session.read.parquet(str(src)).filter(pred).select(*out_cols)
         session.disable_hyperspace()
         off = q.collect()
         session.enable_hyperspace()
         on = q.collect()
         assert rows_key(off) == rows_key(on), (seed, repr(pred))
+    assert checked >= 1, "vacuous seed: no parity check ran"
 
 
 @pytest.mark.parametrize("seed", range(6))
@@ -217,3 +226,62 @@ def test_hybrid_parity_fuzz(tmp_path, seed):
         session.enable_hyperspace()
         on = q.collect()
         assert rows_key(off) == rows_key(on), (seed, repr(pred))
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_mesh_parity_fuzz(tmp_path, seed):
+    """The distributed (shard_map) scan and join paths under randomized
+    shapes: a mesh-backed executor must be row-identical to single-device
+    execution on the same rewritten plan."""
+    from hyperspace_tpu.exec.executor import Executor
+    from hyperspace_tpu.parallel.mesh import make_mesh
+    from hyperspace_tpu.plan.ir import Filter, Join, Project, Scan
+    from hyperspace_tpu.plan.rules import apply_hyperspace_rules
+    from tests.e2e_utils import build_index, write_source
+
+    rng = np.random.default_rng(7000 + seed)
+    mesh = make_mesh(8)
+    conf = HyperspaceConf()
+    n_l = int(rng.integers(200, 2500))
+    n_r = int(rng.integers(50, 600))
+    key_space = int(rng.integers(10, 300))
+    left = ColumnarBatch.from_pydict(
+        {"lk": rng.integers(0, key_space, n_l).astype(np.int64),
+         "lv": rng.integers(-1000, 1000, n_l).astype(np.int64)},
+    )
+    right = ColumnarBatch.from_pydict(
+        {"rk": rng.integers(0, key_space, n_r).astype(np.int64),
+         "rv": rng.integers(-1000, 1000, n_r).astype(np.int64)},
+    )
+    l_rel = write_source(tmp_path / "l", left, n_files=int(rng.integers(1, 4)))
+    r_rel = write_source(tmp_path / "r", right, n_files=1)
+    li = build_index("lm", l_rel, ["lk"], ["lv"], tmp_path / "idx")
+    ri = build_index("rm", r_rel, ["rk"], ["rv"], tmp_path / "idx")
+
+    # filter plan (an lv-only predicate correctly does NOT rewrite — the
+    # head indexed column must appear in the filter; parity still checked)
+    key = int(rng.integers(0, key_space))
+    preds = [
+        col("lk") == key,
+        (col("lk") >= key) & (col("lk") < key + int(rng.integers(2, 40))),
+        col("lv") > int(rng.integers(-900, 900)),
+    ]
+    pick = int(rng.integers(0, len(preds)))
+    fplan = Filter(preds[pick], Scan(l_rel))
+    rewritten, applied = apply_hyperspace_rules(fplan, [li, ri], conf)
+    if pick < 2:
+        assert applied
+    single = Executor(conf).execute(rewritten)
+    multi = Executor(conf, mesh=mesh, dist_min_rows=0).execute(rewritten)
+    assert rows_key(single) == rows_key(multi), seed
+
+    # join plan
+    jplan = Project(
+        ("lv", "rv"),
+        Join(Scan(l_rel), Scan(r_rel), col("lk") == col("rk"), "inner"),
+    )
+    rewritten, applied = apply_hyperspace_rules(jplan, [li, ri], conf)
+    assert len(applied) == 2
+    single = Executor(conf).execute(rewritten)
+    multi = Executor(conf, mesh=mesh, dist_min_rows=0).execute(rewritten)
+    assert rows_key(single) == rows_key(multi), seed
