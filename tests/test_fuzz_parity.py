@@ -285,3 +285,91 @@ def test_mesh_parity_fuzz(tmp_path, seed):
     single = Executor(conf).execute(rewritten)
     multi = Executor(conf, mesh=mesh, dist_min_rows=0).execute(rewritten)
     assert rows_key(single) == rows_key(multi), seed
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_lifecycle_sequence_fuzz(tmp_path, seed):
+    """Stateful fuzz: a random sequence of source mutations and index
+    maintenance actions (append / delete / refresh full-incremental-quick /
+    optimize), with off/on parity asserted after every step. Maintenance
+    refusals (e.g. incremental delete without lineage, no-op refresh) are
+    legitimate outcomes — the invariant is that queries stay correct no
+    matter what state the sequence reaches."""
+    from hyperspace_tpu.exceptions import (
+        ConcurrentModificationException,
+        HyperspaceException,
+    )
+
+    rng = np.random.default_rng(3000 + seed)
+    lineage = bool(rng.random() < 0.7)
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
+            C.INDEX_NUM_BUCKETS: int(rng.choice([2, 8])),
+            C.INDEX_LINEAGE_ENABLED: lineage,
+            C.INDEX_HYBRID_SCAN_ENABLED: bool(rng.random() < 0.8),
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    src = tmp_path / "src"
+    src.mkdir()
+    next_file = [0]
+
+    def add_file(n_rows):
+        b = ColumnarBatch.from_pydict(
+            {"k": rng.integers(0, 150, n_rows).astype(np.int64),
+             "v": rng.integers(-10**6, 10**6, n_rows).astype(np.int64)},
+        )
+        parquet_io.write_parquet(src / f"p{next_file[0]:03d}.parquet", b)
+        next_file[0] += 1
+
+    for _ in range(6):
+        add_file(int(rng.integers(50, 400)))
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("lc", ["k"], ["v"]))
+
+    def check_parity(tag):
+        key = int(rng.integers(0, 150))
+        for pred in (col("k") == key, (col("k") > key - 10) & (col("k") <= key + 10)):
+            q = session.read.parquet(str(src)).filter(pred).select("k", "v")
+            session.disable_hyperspace()
+            off = q.collect()
+            session.enable_hyperspace()
+            on = q.collect()
+            assert rows_key(off) == rows_key(on), (seed, tag, repr(pred))
+
+    check_parity("initial")
+    for step in range(8):
+        action = rng.choice(
+            ["append", "delete", "refresh_full", "refresh_incr",
+             "refresh_quick", "optimize"]
+        )
+        try:
+            if action == "append":
+                add_file(int(rng.integers(20, 200)))
+            elif action == "delete":
+                existing = sorted(src.glob("p*.parquet"))
+                if len(existing) > 1:
+                    existing[int(rng.integers(0, len(existing)))].unlink()
+            elif action == "refresh_full":
+                hs.refresh_index("lc", C.REFRESH_MODE_FULL)
+            elif action == "refresh_incr":
+                hs.refresh_index("lc", C.REFRESH_MODE_INCREMENTAL)
+            elif action == "refresh_quick":
+                hs.refresh_index("lc", C.REFRESH_MODE_QUICK)
+            elif action == "optimize":
+                hs.optimize_index(
+                    "lc", str(rng.choice([C.OPTIMIZE_MODE_QUICK, C.OPTIMIZE_MODE_FULL]))
+                )
+        except ConcurrentModificationException:
+            # never legitimate in a single-threaded sequence: it means an
+            # earlier action broke the begin/op/end protocol and left a
+            # transient state behind
+            raise
+        except HyperspaceException:
+            pass  # legitimate validate()-time refusal (lineage required,
+            # nothing to compact, ...) — NoChanges is already a no-op
+        # NOTE: no manual cache clear — the maintenance verbs must
+        # invalidate the TTL cache themselves; a forgotten invalidation
+        # should fail this fuzz, not be papered over
+        check_parity(f"step{step}:{action}")
