@@ -135,6 +135,13 @@ class HyperspaceConf:
     def build_chunk_rows(self) -> int:
         return int(self.get(C.BUILD_CHUNK_ROWS, C.BUILD_CHUNK_ROWS_DEFAULT))
 
+    def distributed_min_rows(self) -> int:
+        return int(
+            self.get(
+                C.TPU_DISTRIBUTED_MIN_ROWS, C.TPU_DISTRIBUTED_MIN_ROWS_DEFAULT
+            )
+        )
+
     def profile_dir(self) -> Optional[str]:
         v = self.get(C.TPU_PROFILE_DIR)
         return str(v) if v else None

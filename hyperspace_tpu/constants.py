@@ -118,6 +118,11 @@ SIGNATURE_PROVIDER = "hyperspace.index.signatureProvider"
 TPU_MESH_BUCKET_AXIS = "hyperspace.tpu.mesh.bucketAxis"
 TPU_MESH_BUCKET_AXIS_DEFAULT = "buckets"
 STORAGE_BLOCK_ALIGN = 128  # bytes; lane-friendly alignment for column buffers
+# Below this many total rows a mesh query executes host-side: the fixed
+# dispatch+transfer latency of a shard_map call cannot win on small data
+# (same gate philosophy as the single-device scan's MIN_DEVICE_ROWS).
+TPU_DISTRIBUTED_MIN_ROWS = "hyperspace.tpu.distributedQuery.minRows"
+TPU_DISTRIBUTED_MIN_ROWS_DEFAULT = 1_000_000
 # When set to a directory, query execution runs under jax.profiler.trace —
 # the XLA-level view (per-op device timing, HLO) complementing the
 # engine-level metrics registry (SURVEY §5.1: "JAX profiler + per-kernel

@@ -59,10 +59,10 @@ class Executor:
         # mesh call can't win and execution stays host-side (same gate
         # philosophy as scan.MIN_DEVICE_ROWS).
         self.mesh = mesh if mesh is not None and mesh.devices.size > 1 else None
-        from .scan import MIN_DEVICE_ROWS
-
         self.dist_min_rows = (
-            dist_min_rows if dist_min_rows is not None else MIN_DEVICE_ROWS
+            dist_min_rows
+            if dist_min_rows is not None
+            else self.conf.distributed_min_rows()
         )
 
     # -- public --------------------------------------------------------------

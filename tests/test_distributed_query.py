@@ -194,3 +194,38 @@ def test_process_info_single_controller(mesh):
     assert info["process_count"] == 1
     assert info["process_index"] == 0
     assert info["global_devices"] >= 8
+
+
+def test_dist_min_rows_from_conf(tmp_path, mesh):
+    """The mesh gate is conf-tunable: with minRows=0 a session-level mesh
+    query routes distributed; with a huge threshold it stays host-side."""
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+
+    rng = np.random.default_rng(0)
+    b = ColumnarBatch.from_pydict(
+        {"k": rng.integers(0, 100, 2000).astype(np.int64),
+         "v": rng.integers(0, 10**6, 2000).astype(np.int64)}
+    )
+    src = tmp_path / "d"
+    src.mkdir()
+    parquet_io.write_parquet(src / "p.parquet", b)
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "i"), C.INDEX_NUM_BUCKETS: 8,
+         C.TPU_DISTRIBUTED_MIN_ROWS: 0}
+    )
+    session = HyperspaceSession(conf, mesh=mesh)
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("dm", ["k"], ["v"]))
+    session.enable_hyperspace()
+    q = session.read.parquet(str(src)).filter(col("k") > 50).select("k", "v")
+    before = metrics.counter("scan.path.distributed")
+    q.collect()
+    assert metrics.counter("scan.path.distributed") == before + 1
+    session.conf.set(C.TPU_DISTRIBUTED_MIN_ROWS, 10**9)
+    before = metrics.counter("scan.path.distributed")
+    q.collect()
+    assert metrics.counter("scan.path.distributed") == before  # host gate
