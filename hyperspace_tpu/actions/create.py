@@ -95,13 +95,11 @@ class CreateActionBase:
     ) -> ColumnarBatch:
         cols = list(indexed) + list(included)
         if not lineage:
-            return parquet_io.read_files(
-                relation.read_format, [f.name for f in relation.files], columns=cols
-            )
+            return parquet_io.read_relation(relation, columns=cols)
         pairs = self.session.sources.lineage_pairs(relation, tracker)
         parts = []
         for path, fid in pairs:
-            part = parquet_io.read_files(relation.read_format, [path], columns=cols)
+            part = parquet_io.read_relation(relation, paths=[path], columns=cols)
             part = part.with_column(
                 C.DATA_FILE_NAME_ID,
                 Column("int64", np.full(part.num_rows, fid, dtype=np.int64)),
@@ -126,14 +124,14 @@ class CreateActionBase:
         cols = list(indexed) + list(included)
         if not lineage:
             for f in relation.files:
-                yield from parquet_io.iter_file_batches(
-                    relation.read_format, f.name, columns=cols, chunk_rows=chunk_rows
+                yield from parquet_io.iter_relation_file_batches(
+                    relation, f.name, columns=cols, chunk_rows=chunk_rows
                 )
             return
         pairs = self.session.sources.lineage_pairs(relation, tracker)
         for path, fid in pairs:
-            for chunk in parquet_io.iter_file_batches(
-                relation.read_format, path, columns=cols, chunk_rows=chunk_rows
+            for chunk in parquet_io.iter_relation_file_batches(
+                relation, path, columns=cols, chunk_rows=chunk_rows
             ):
                 yield chunk.with_column(
                     C.DATA_FILE_NAME_ID,

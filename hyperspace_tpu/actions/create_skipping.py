@@ -58,7 +58,7 @@ def build_sketch_table(
     cols = list(dict.fromkeys(s.column for s in sketches))
     table: Dict[str, Dict[str, Dict]] = {}
     for f in files if files is not None else relation.files:
-        batch = parquet_io.read_files(relation.read_format, [f.name], columns=cols)
+        batch = parquet_io.read_relation(relation, paths=[f.name], columns=cols)
         per_file: Dict[str, Dict] = {}
         for spec in sketches:
             per_file[sketch_key(spec.to_json_dict())] = spec.build(

@@ -195,6 +195,7 @@ class RefreshIncrementalAction(RefreshActionBase):
                 self.appended_files,
                 self.relation.options,
                 internal_format=self.relation.internal_format,
+                partition_spec=self.relation.partition_spec,
             )
             batch = self.prepare_index_batch(
                 appended_rel, indexed, included, self.lineage, tracker

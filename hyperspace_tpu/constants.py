@@ -97,13 +97,23 @@ DISPLAY_MODE_CONSOLE = "console"
 DISPLAY_MODE_DEFAULT = DISPLAY_MODE_PLAIN_TEXT
 
 # --- sources -----------------------------------------------------------------
-# (reference: HyperspaceConf.scala:78-90 — its list is
-# avro,csv,json,orc,parquet,text; avro is out of scope here because pyarrow
-# ships no avro reader and none is baked into this environment)
+# (reference: HyperspaceConf.scala:78-90 — the full six-format list;
+# avro is served by the self-contained OCF reader in storage/avro_io.py
+# since the environment ships no avro library)
 FILE_BASED_SOURCE_BUILDERS = "hyperspace.index.sources.fileBasedBuilders"
-DEFAULT_SUPPORTED_FORMATS = ("csv", "json", "orc", "parquet", "text")
+DEFAULT_SUPPORTED_FORMATS = ("avro", "csv", "json", "orc", "parquet", "text")
 # Globbing patterns for index sources (reference: IndexConstants.scala:101-106)
 GLOBBING_PATTERN_KEY = "hyperspace.source.globbingPattern"
+# Hive-style partition discovery toggle (source option, default on — the
+# analog of Spark's PartitioningAwareFileIndex, which the reference's
+# partitioned-source support rides on; DefaultFileBasedSource.scala:235-250)
+PARTITION_INFERENCE_KEY = "hyperspace.source.partitionInference"
+# Internal relation option recording the discovered partition column names
+# (comma-joined, in directory order). Logged with the relation so refresh
+# reconstructs the SAME spec instead of re-guessing the layout — a later
+# re-layout that would shadow a data column with a same-named partition
+# directory is thereby inert rather than silently corrupting reads.
+PARTITION_COLUMNS_META = "hyperspace.source.partitionColumns"
 
 # --- telemetry ---------------------------------------------------------------
 # (reference: telemetry/Constants.scala:20)

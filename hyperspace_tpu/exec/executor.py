@@ -118,14 +118,48 @@ class Executor:
                     )
                 avail = set(plan.relation.schema)
                 need = [c for c in need if c in avail]
+            files = plan.relation.files
+            spec = plan.relation.partition_spec
+            pred_for_reader = predicate
+            if spec is not None and predicate is not None:
+                # split once: conjuncts over partition columns only are
+                # decidable from directory names (→ file pruning, before
+                # any byte is read — the win Spark's PartitioningAwareFile-
+                # Index provides the reference for free); conjuncts free of
+                # partition columns can still reach the file reader; mixed
+                # conjuncts do neither (the full predicate is re-applied
+                # after the read regardless)
+                from ..plan.rules.predicate_pushdown import (
+                    conjoin,
+                    split_conjuncts,
+                )
+                from ..storage import partitions as P
+                from ..telemetry.metrics import metrics
+
+                part_names = set(spec.names)
+                part_conjs, file_conjs = [], []
+                for c in split_conjuncts(predicate):
+                    refs = set(c.columns())
+                    if refs and refs <= part_names:
+                        part_conjs.append(c)
+                    elif not (refs & part_names):
+                        file_conjs.append(c)
+                pred_for_reader = conjoin(file_conjs) if file_conjs else None
+                if part_conjs:
+                    before = len(files)
+                    files = P.prune_files(files, spec, conjoin(part_conjs))
+                    metrics.incr("scan.partition_pruned", before - len(files))
+                    if not files:
+                        out = ColumnarBatch.empty(dict(plan.relation.schema))
+                        return out.select(need) if need is not None else out
             arrow_filter = None
-            if predicate is not None and plan.relation.read_format == "parquet":
+            if pred_for_reader is not None and plan.relation.read_format == "parquet":
                 from ..plan.expr import to_arrow_filter
 
-                arrow_filter = to_arrow_filter(predicate)
-            batch = parquet_io.read_files(
-                plan.relation.read_format,
-                [f.name for f in plan.relation.files],
+                arrow_filter = to_arrow_filter(pred_for_reader)
+            batch = parquet_io.read_relation(
+                plan.relation,
+                paths=[f.name for f in files],
                 columns=need,
                 arrow_filter=arrow_filter,
             )

@@ -121,6 +121,7 @@ def transform_plan_to_use_hybrid_scan(
             files=list(appended),
             options=dict(scan.relation.options),
             internal_format=scan.relation.internal_format,
+            partition_spec=scan.relation.partition_spec,
         )
         appended_side: LogicalPlan = Project(user_cols, Scan(appended_rel))
 

@@ -87,6 +87,9 @@ class DataFrameReader:
     def orc(self, *paths: str):
         return self._load("orc", list(paths))
 
+    def avro(self, *paths: str):
+        return self._load("avro", list(paths))
+
     def text(self, *paths: str):
         return self._load("text", list(paths))
 

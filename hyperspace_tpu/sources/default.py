@@ -8,6 +8,7 @@ footer via pyarrow (the analog of Spark's format inference).
 
 from __future__ import annotations
 
+import json
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
@@ -29,6 +30,11 @@ def _infer_schema(file_format: str, sample_path: str) -> Dict[str, str]:
 
         arrow_schema = pq.ParquetFile(sample_path).schema_arrow
         return ColumnarBatch.from_arrow(arrow_schema.empty_table()).schema()
+    if file_format.lower() == "avro":
+        # header-only: the OCF carries its schema before any data block
+        from ..storage.avro_io import infer_schema
+
+        return infer_schema(sample_path)
     batch = parquet_io.read_files(file_format, [sample_path])
     return batch.schema()
 
@@ -38,6 +44,51 @@ def _snapshot_files(root_paths: List[str]) -> List[FileInfo]:
     paths = [str(p) for p in file_utils.list_leaf_files(root_paths)]
     content = Content.from_leaf_files(paths, tracker)
     return content.file_infos() if content else []
+
+
+def _concrete_bases(root_paths) -> List[str]:
+    """Root paths with glob patterns expanded to the concrete directories
+    they currently match — partition components are resolved below these.
+    expand_globs passes non-pattern paths through unchanged, so it is the
+    single glob-detection policy."""
+    return [str(p.absolute()) for p in file_utils.expand_globs(root_paths)]
+
+
+def _discover_spec(files, root_paths, options, declared):
+    """Hive partition discovery over a snapshot (storage.partitions), off
+    when the ``partitionInference`` option is "false"."""
+    if (options or {}).get(C.PARTITION_INFERENCE_KEY, "true").lower() == "false":
+        return None
+    from ..storage.partitions import discover_partition_spec
+
+    return discover_partition_spec(
+        [f.name for f in files],
+        _concrete_bases(root_paths),
+        declared_schema=declared,
+    )
+
+
+def _logged_spec(relation: Relation):
+    """The create-time PartitionSpec, reconstructed from the logged
+    relation (names from PARTITION_COLUMNS_META, dtypes from the schema;
+    bases re-expanded from the logged roots — new directories matched by a
+    logged glob pattern resolve against their own expansion)."""
+    raw = (relation.options or {}).get(C.PARTITION_COLUMNS_META, "")
+    names = json.loads(raw) if raw else []
+    if not names:
+        return None
+    from ..storage.partitions import PartitionSpec
+
+    missing = [n for n in names if n not in relation.schema]
+    if missing:
+        raise HyperspaceException(
+            f"Logged partition columns {missing} absent from the logged "
+            "relation schema — corrupt metadata."
+        )
+    return PartitionSpec(
+        tuple((n, relation.schema[n]) for n in names),
+        tuple(_concrete_bases(relation.root_paths)),
+    )
 
 
 class DefaultFileBasedSource(FileBasedSourceProvider):
@@ -76,18 +127,39 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
                 )
             logged_roots = patterns
         files = _snapshot_files(root_paths)
+        # a user-declared schema may already include the partition columns
+        # (the standard way to pin their dtypes) — discovery treats it as
+        # authoritative for dtype, and such names are NOT collisions
+        spec = _discover_spec(files, root_paths, options, declared=schema)
         if schema is None:
             if not files:
                 raise HyperspaceException(
                     f"Cannot infer schema: no files under {root_paths}."
                 )
             schema = _infer_schema(file_format, files[0].name)
+            if spec is not None:
+                clash = [n for n in spec.names if n in schema]
+                if clash:
+                    raise HyperspaceException(
+                        f"Partition columns {clash} collide with data columns "
+                        f"of the same name under {root_paths}."
+                    )
+        if spec is not None:
+            # Spark's ordering: file columns first, partition columns after
+            # (already-declared partition columns keep their declared spot)
+            schema = {**schema, **{n: d for n, d in spec.columns if n not in schema}}
+        out_options = dict(options or {})
+        if spec is not None:
+            # JSON list, not comma-joined: a partition column named "a,b"
+            # must round-trip through the log intact
+            out_options[C.PARTITION_COLUMNS_META] = json.dumps(spec.names)
         return FileRelation(
             root_paths=logged_roots,
             file_format=file_format,
             schema=schema,
             files=files,
-            options=dict(options or {}),
+            options=out_options,
+            partition_spec=spec,
         )
 
     def refresh_relation(self, relation: Relation) -> Optional[FileRelation]:
@@ -95,12 +167,20 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
         paths with the logged schema/options."""
         if not self.supports_format(relation.file_format):
             return None
+        files = _snapshot_files(relation.root_paths)
         return FileRelation(
             root_paths=list(relation.root_paths),
             file_format=relation.file_format,
             schema=dict(relation.schema),
-            files=_snapshot_files(relation.root_paths),
+            files=files,
             options=dict(relation.options),
+            # the spec is REBUILT from what create-time discovery logged
+            # (names in options, dtypes in the schema) — never re-guessed
+            # from the new snapshot, so a re-layout that grows partition-
+            # looking directories around a data column stays inert, while
+            # files that stop matching the logged layout fail loudly at
+            # read time (partition_values_for)
+            partition_spec=_logged_spec(relation),
         )
 
     def all_files(self, relation: FileRelation) -> Optional[List[FileInfo]]:

@@ -1,0 +1,370 @@
+"""Avro ingest: a self-contained object-container-file (OCF) reader/writer.
+
+Parity: avro is in the reference's default source-format allowlist
+(HyperspaceConf.scala:85-90). The environment ships no avro library, so
+this module implements the OCF wire format directly from the Avro 1.11
+spec — enough to ingest flat tabular data into ColumnarBatches:
+
+* records of primitives: null, boolean, int, long, float, double, bytes,
+  string, plus enum and fixed;
+* nullable fields as the standard ``["null", T]`` union (nulls become
+  NULL strings / NaN floats; nullable int fields promote to float64 the
+  way arrow's pandas bridge does — an all-valid int column stays int64);
+* codecs: ``null`` and ``deflate`` (raw zlib).
+
+Arrays, maps, and nested records have no columnar equivalent here and are
+rejected loudly. The writer emits records-of-primitives OCFs (null codec)
+— it exists so tests and users can round-trip without an external avro
+dependency.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from .columnar import Column, ColumnarBatch
+
+MAGIC = b"Obj\x01"
+
+
+# ---------------------------------------------------------------------------
+# primitive binary codecs (Avro spec: zigzag varints, IEEE754 LE floats)
+# ---------------------------------------------------------------------------
+def _read_long(buf: io.BytesIO) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise HyperspaceException("avro: truncated varint.")
+        byte = b[0]
+        acc |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)  # zigzag decode
+
+
+def _write_long(out: io.BytesIO, v: int) -> None:
+    v = (v << 1) ^ (v >> 63) if v >= 0 else ((-v - 1) << 1 | 1)
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            return
+
+
+def _read_bytes(buf: io.BytesIO) -> bytes:
+    n = _read_long(buf)
+    data = buf.read(n)
+    if len(data) != n:
+        raise HyperspaceException("avro: truncated bytes value.")
+    return data
+
+
+def _write_bytes(out: io.BytesIO, data: bytes) -> None:
+    _write_long(out, len(data))
+    out.write(data)
+
+
+# ---------------------------------------------------------------------------
+# schema handling
+# ---------------------------------------------------------------------------
+_PRIMITIVES = {
+    "null",
+    "boolean",
+    "int",
+    "long",
+    "float",
+    "double",
+    "bytes",
+    "string",
+}
+
+
+def _normalize_field_type(t) -> Tuple[str, Optional[int], dict]:
+    """→ (base type name, union index of the null branch or None, full
+    type dict for enum/fixed). The null branch is whichever position
+    "null" occupies in the union — ["long","null"] is as legal as
+    ["null","long"]."""
+    null_idx: Optional[int] = None
+    if isinstance(t, list):  # union
+        branches = [b for b in t if b != "null"]
+        if "null" in t:
+            null_idx = t.index("null")
+        if len(branches) != 1:
+            raise HyperspaceException(
+                f"avro: only two-branch [null, T] unions are supported, got {t}."
+            )
+        t = branches[0]
+    if isinstance(t, dict):
+        kind = t.get("type")
+        if kind in ("enum", "fixed") or kind in _PRIMITIVES:
+            return kind, null_idx, t
+        raise HyperspaceException(
+            f"avro: unsupported complex type {kind!r} (flat tabular data only)."
+        )
+    if t not in _PRIMITIVES:
+        raise HyperspaceException(f"avro: unsupported type {t!r}.")
+    return t, null_idx, {}
+
+
+def _decode_value(buf: io.BytesIO, base: str, meta: dict):
+    if base == "null":
+        return None
+    if base == "boolean":
+        return buf.read(1)[0] != 0
+    if base in ("int", "long"):
+        return _read_long(buf)
+    if base == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if base == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if base in ("bytes", "string"):
+        return _read_bytes(buf)
+    if base == "enum":
+        return meta["symbols"][_read_long(buf)].encode()
+    if base == "fixed":
+        return buf.read(int(meta["size"]))
+    raise HyperspaceException(f"avro: unsupported type {base!r}.")
+
+
+_DTYPE_OF = {
+    "boolean": "bool",
+    "int": "int64",
+    "long": "int64",
+    "float": "float32",
+    "double": "float64",
+    "bytes": "string",
+    "string": "string",
+    "enum": "string",
+    "fixed": "string",
+    "null": "string",
+}
+
+
+def infer_schema(path: str | Path) -> Dict[str, str]:
+    """Column schema from the OCF header alone — no data block is decoded
+    (the avro analog of a parquet footer-only schema read). Dtypes follow
+    the same schema-determined rules as _to_column (nullable int → float64)
+    so inference and ingest always agree."""
+    with open(path, "rb") as f:
+        buf = io.BytesIO(f.read(1 << 20))  # header fits well within 1MB
+    schema, _codec, _sync = _read_header(buf)
+    if schema.get("type") != "record":
+        raise HyperspaceException("avro: top-level schema must be a record.")
+    out: Dict[str, str] = {}
+    for f_ in schema["fields"]:
+        base, null_idx, _meta = _normalize_field_type(f_["type"])
+        dt = _DTYPE_OF[base]
+        if null_idx is not None and base in ("int", "long"):
+            dt = "float64"
+        if null_idx is not None and base == "boolean":
+            raise HyperspaceException(
+                f"avro: nullable boolean field {f_['name']} is not representable."
+            )
+        out[f_["name"]] = dt
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reader
+# ---------------------------------------------------------------------------
+def _read_header(buf: io.BytesIO) -> Tuple[dict, str, bytes]:
+    if buf.read(4) != MAGIC:
+        raise HyperspaceException("avro: bad magic (not an OCF file).")
+    meta: Dict[str, bytes] = {}
+    while True:
+        count = _read_long(buf)
+        if count == 0:
+            break
+        if count < 0:  # negative count: block byte size follows (skip it)
+            count = -count
+            _read_long(buf)
+        for _ in range(count):
+            key = _read_bytes(buf).decode()
+            meta[key] = _read_bytes(buf)
+    schema = json.loads(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    sync = buf.read(16)
+    return schema, codec, sync
+
+
+def read_avro(
+    paths: Iterable[str | Path], columns: Optional[List[str]] = None
+) -> ColumnarBatch:
+    """Read OCF files into one ColumnarBatch (column projection applied
+    after decode — rows are row-major on the wire, so every field is
+    decoded regardless)."""
+    paths = [str(p) for p in paths]
+    if not paths:
+        raise HyperspaceException("read_avro: no paths.")
+    batches = [_read_one(p) for p in paths]
+    out = ColumnarBatch.concat(batches)
+    return out.select(columns) if columns is not None else out
+
+
+def _read_one(path: str) -> ColumnarBatch:
+    buf = io.BytesIO(Path(path).read_bytes())
+    schema, codec, sync = _read_header(buf)
+    if schema.get("type") != "record":
+        raise HyperspaceException("avro: top-level schema must be a record.")
+    fields = [
+        (f["name"], *_normalize_field_type(f["type"])) for f in schema["fields"]
+    ]
+    cols: Dict[str, list] = {name: [] for name, *_ in fields}
+    while True:
+        head = buf.read(1)
+        if not head:
+            break
+        buf.seek(-1, os.SEEK_CUR)
+        n_rows = _read_long(buf)
+        n_bytes = _read_long(buf)
+        block = buf.read(n_bytes)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise HyperspaceException(f"avro: unsupported codec {codec!r}.")
+        bbuf = io.BytesIO(block)
+        for _ in range(n_rows):
+            for name, base, null_idx, meta in fields:
+                if null_idx is not None:
+                    if _read_long(bbuf) == null_idx:
+                        cols[name].append(None)
+                        continue
+                cols[name].append(_decode_value(bbuf, base, meta))
+        if buf.read(16) != sync:
+            raise HyperspaceException("avro: sync marker mismatch.")
+    out: Dict[str, Column] = {}
+    for name, base, null_idx, _meta in fields:
+        out[name] = _to_column(name, base, null_idx is not None, cols[name])
+    return ColumnarBatch(out)
+
+
+def _to_column(name: str, base: str, nullable: bool, values: list) -> Column:
+    """Column dtype is a function of the SCHEMA alone (never of observed
+    values): a nullable int/long field is float64 whether or not this
+    particular file contains a null — otherwise two files of the same
+    schema could disagree and fail to concat."""
+    if base in ("string", "bytes", "enum", "fixed", "null"):
+        return Column.from_optional_values(values)
+    if base == "boolean":
+        if nullable:
+            raise HyperspaceException(
+                f"avro: nullable boolean field {name} is not representable."
+            )
+        return Column.from_values(np.array(values, dtype=np.bool_))
+    if base in ("int", "long"):
+        if nullable:  # arrow's pandas-bridge promotion: int + nulls → float
+            arr = np.array(
+                [np.nan if v is None else float(v) for v in values],
+                dtype=np.float64,
+            )
+            return Column.from_values(arr)
+        return Column.from_values(np.array(values, dtype=np.int64))
+    if base in ("float", "double"):
+        arr = np.array(
+            [np.nan if v is None else v for v in values], dtype=np.float64
+        )
+        return Column.from_values(
+            arr.astype(np.float32) if base == "float" else arr
+        )
+    raise HyperspaceException(f"avro: unsupported type {base!r}.")
+
+
+# ---------------------------------------------------------------------------
+# writer (tests + round-trips; null codec)
+# ---------------------------------------------------------------------------
+_WRITE_TYPES = {
+    "int64": "long",
+    "int32": "int",
+    "int16": "int",
+    "int8": "int",
+    "float64": "double",
+    "float32": "float",
+    "bool": "boolean",
+    "string": "string",
+}
+
+
+def write_avro(path: str | Path, batch: ColumnarBatch) -> None:
+    schema = {
+        "type": "record",
+        "name": "row",
+        "fields": [],
+    }
+    writers = []
+    for name, col in batch.columns.items():
+        if col.dtype_str == "string":
+            schema["fields"].append(
+                {"name": name, "type": ["null", "string"]}
+            )
+            vals = col.to_values()
+
+            def w(out, i, vals=vals):
+                v = vals[i]
+                if v is None:
+                    _write_long(out, 0)
+                else:
+                    _write_long(out, 1)
+                    _write_bytes(
+                        out, v.encode() if isinstance(v, str) else bytes(v)
+                    )
+
+        elif col.dtype_str in _WRITE_TYPES:
+            avro_t = _WRITE_TYPES[col.dtype_str]
+            schema["fields"].append({"name": name, "type": avro_t})
+            data = col.data
+
+            def w(out, i, data=data, avro_t=avro_t):
+                v = data[i]
+                if avro_t in ("long", "int"):
+                    _write_long(out, int(v))
+                elif avro_t == "double":
+                    out.write(struct.pack("<d", float(v)))
+                elif avro_t == "float":
+                    out.write(struct.pack("<f", float(v)))
+                else:  # boolean
+                    out.write(b"\x01" if v else b"\x00")
+
+        else:
+            raise HyperspaceException(
+                f"avro writer: unsupported dtype {col.dtype_str}."
+            )
+        writers.append(w)
+
+    sync = b"hyperspace-sync!"  # any 16 bytes
+    out = io.BytesIO()
+    out.write(MAGIC)
+    _write_long(out, 2)
+    _write_bytes(out, b"avro.schema")
+    _write_bytes(out, json.dumps(schema).encode())
+    _write_bytes(out, b"avro.codec")
+    _write_bytes(out, b"null")
+    _write_long(out, 0)
+    out.write(sync)
+    block = io.BytesIO()
+    n = batch.num_rows
+    for i in range(n):
+        for w in writers:
+            w(block, i)
+    payload = block.getvalue()
+    if n:
+        _write_long(out, n)
+        _write_long(out, len(payload))
+        out.write(payload)
+        out.write(sync)
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    Path(path).write_bytes(out.getvalue())

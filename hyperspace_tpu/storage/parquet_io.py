@@ -137,7 +137,14 @@ def write_parquet(path: str | Path, batch: ColumnarBatch) -> None:
     pq.write_table(table, str(path))
 
 
+def read_avro(paths: Iterable[str | Path], columns: Optional[List[str]] = None) -> ColumnarBatch:
+    from .avro_io import read_avro as _ra
+
+    return _ra(paths, columns)
+
+
 READERS = {
+    "avro": read_avro,
     "parquet": read_parquet,
     "csv": read_csv,
     "json": read_json,
@@ -159,6 +166,128 @@ def read_files(
     if file_format == "parquet":
         return reader(paths, columns, arrow_filter=arrow_filter)
     return reader(paths, columns)
+
+
+def _split_partition_columns(relation, columns):
+    """(file columns to read, partition columns to append) for a requested
+    projection against a possibly-partitioned relation. ``columns=None``
+    means all of each."""
+    spec = relation.partition_spec
+    if spec is None:
+        return columns, []
+    part_names = spec.names
+    if columns is None:
+        file_cols = [c for c in relation.schema if c not in part_names]
+        return file_cols, list(part_names)
+    return (
+        [c for c in columns if c not in part_names],
+        [c for c in columns if c in part_names],
+    )
+
+
+def _file_row_count(relation, path: str) -> int:
+    """Row count of one source file for a partition-only projection.
+    Parquet answers from the footer (no data decoded); other formats read
+    one file-borne column solely for its length."""
+    if relation.read_format == "parquet":
+        import pyarrow.parquet as pq
+
+        return pq.ParquetFile(path).metadata.num_rows
+    spec_names = set(relation.partition_spec.names)
+    for c in relation.schema:
+        if c not in spec_names:
+            return read_files(relation.read_format, [path], columns=[c]).num_rows
+    raise HyperspaceException(
+        "Relation has no file-borne columns to derive row counts from."
+    )
+
+
+def _partition_file_batches(
+    relation, path: str, columns, arrow_filter, chunk_rows: Optional[int]
+):
+    """Yield one file's batches with hive partition columns materialized —
+    the shared core of read_relation (chunk_rows=None: whole file) and
+    iter_relation_file_batches (streamed chunks)."""
+    from . import partitions as P
+
+    spec = relation.partition_spec
+    file_cols, part_cols = _split_partition_columns(relation, columns)
+    values = P.partition_values_for(path, spec)
+    if not file_cols and part_cols:
+        # partition-only projection: no file bytes needed beyond the count
+        # (still emitted in chunk_rows pieces — the streaming build's
+        # memory bound holds even for constant columns)
+        n = _file_row_count(relation, path)
+        step = n if chunk_rows is None else max(int(chunk_rows), 1)
+        starts = range(0, n, step) if n else [0]  # 0-row files still yield
+        for start in starts:
+            m = min(step, n - start)
+            consts = P.constant_columns(spec, values, m)
+            yield ColumnarBatch({name: consts[name] for name in part_cols})
+        return
+    if chunk_rows is None:
+        chunks = [
+            read_files(
+                relation.read_format,
+                [path],
+                columns=file_cols,
+                arrow_filter=arrow_filter,
+            )
+        ]
+    else:
+        chunks = iter_file_batches(
+            relation.read_format, path, columns=file_cols, chunk_rows=chunk_rows
+        )
+    for chunk in chunks:
+        consts = P.constant_columns(spec, values, chunk.num_rows)
+        for name in part_cols:
+            chunk = chunk.with_column(name, consts[name])
+        yield chunk
+
+
+def read_relation(
+    relation,
+    paths: Optional[Iterable[str | Path]] = None,
+    columns: Optional[List[str]] = None,
+    arrow_filter=None,
+) -> ColumnarBatch:
+    """Read files of a FileRelation, materializing hive partition columns
+    from the directory names (storage.partitions). The one ingest entry
+    point call sites should use when they hold a relation — plain
+    ``read_files`` knows nothing about partition layout."""
+    paths = (
+        [f.name for f in relation.files] if paths is None else [str(p) for p in paths]
+    )
+    if relation.partition_spec is None:
+        return read_files(
+            relation.read_format, paths, columns=columns, arrow_filter=arrow_filter
+        )
+    parts = []
+    for p in paths:
+        parts.extend(
+            _partition_file_batches(relation, p, columns, arrow_filter, None)
+        )
+    out = ColumnarBatch.concat(parts)
+    return out.select(columns) if columns is not None else out
+
+
+def iter_relation_file_batches(
+    relation,
+    path: str | Path,
+    columns: Optional[List[str]] = None,
+    chunk_rows: int = 1 << 21,
+):
+    """Streaming twin of read_relation for one file (the out-of-core build
+    ingest): yields chunks with partition columns materialized."""
+    if relation.partition_spec is None:
+        yield from iter_file_batches(
+            relation.read_format, path, columns=columns, chunk_rows=chunk_rows
+        )
+        return
+    for chunk in _partition_file_batches(
+        relation, str(path), columns, None, chunk_rows
+    ):
+        yield chunk.select(columns) if columns is not None else chunk
 
 
 def iter_file_batches(

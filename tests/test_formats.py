@@ -1,8 +1,8 @@
-"""ORC and text source-format tests — the reference's default allowlist is
-avro,csv,json,orc,parquet,text (HyperspaceConf.scala:85-90); avro is
-documented out of scope (no pyarrow avro reader in this environment).
-Each format gets a reader unit test plus an end-to-end create-index →
-rewrite → row-parity run through the facade.
+"""Source-format tests for the reference's full default allowlist —
+avro,csv,json,orc,parquet,text (HyperspaceConf.scala:85-90). Avro is
+served by the self-contained OCF reader (storage/avro_io.py). Each format
+gets a reader unit test plus an end-to-end create-index → rewrite →
+row-parity run through the facade.
 """
 
 import numpy as np
@@ -128,4 +128,194 @@ def test_unsupported_format_refused(tmp_path):
     from hyperspace_tpu.exceptions import HyperspaceException
 
     with pytest.raises(HyperspaceException):
-        parquet_io.read_files("avro", [tmp_path / "x.avro"])
+        parquet_io.read_files("xml", [tmp_path / "x.xml"])
+
+
+# ---------------------------------------------------------------------------
+# avro (self-contained OCF reader/writer, storage/avro_io.py)
+# ---------------------------------------------------------------------------
+def test_avro_roundtrip(tmp_path):
+    from hyperspace_tpu.storage import avro_io
+
+    b = sample(300, seed=7)
+    p = tmp_path / "d.avro"
+    avro_io.write_avro(p, b)
+    back = avro_io.read_avro([p])
+    np.testing.assert_array_equal(back.columns["k"].data, b.columns["k"].data)
+    assert (
+        back.columns["s"].to_values().tolist()
+        == b.columns["s"].to_values().tolist()
+    )
+    proj = avro_io.read_avro([p], columns=["v"])
+    assert proj.column_names == ["v"]
+    np.testing.assert_array_equal(proj.columns["v"].data, b.columns["v"].data)
+
+
+def test_avro_nullable_and_floats(tmp_path):
+    from hyperspace_tpu.storage import avro_io
+    from hyperspace_tpu.storage.columnar import Column
+
+    p = tmp_path / "n.avro"
+    b = ColumnarBatch(
+        {
+            "s": Column.from_optional_values(["a", None, "c"]),
+            "f": Column.from_values(np.array([1.5, 2.5, 3.5])),
+        }
+    )
+    avro_io.write_avro(p, b)
+    back = avro_io.read_avro([p])
+    assert back.columns["s"].to_values().tolist() == ["a", None, "c"]
+    np.testing.assert_allclose(back.columns["f"].data, [1.5, 2.5, 3.5])
+
+
+def test_avro_deflate_and_union_order(tmp_path):
+    """Hand-built OCF: deflate codec + a [T, "null"] union (null branch
+    NOT at index 0) + enum — the wire-format corners our writer does not
+    emit."""
+    import io
+    import json
+    import zlib
+
+    from hyperspace_tpu.storage import avro_io
+    from hyperspace_tpu.storage.avro_io import (
+        MAGIC,
+        _write_bytes,
+        _write_long,
+    )
+
+    schema = {
+        "type": "record",
+        "name": "r",
+        "fields": [
+            {"name": "x", "type": ["long", "null"]},
+            {
+                "name": "e",
+                "type": {"type": "enum", "name": "col", "symbols": ["RED", "BLUE"]},
+            },
+        ],
+    }
+    rows = [(5, 0), (None, 1), (9, 0)]
+    block = io.BytesIO()
+    for x, e in rows:
+        if x is None:
+            _write_long(block, 1)  # null branch is index 1 here
+        else:
+            _write_long(block, 0)
+            _write_long(block, x)
+        _write_long(block, e)
+    payload = zlib.compress(block.getvalue())[2:-4]  # raw deflate
+    sync = b"0123456789abcdef"
+    out = io.BytesIO()
+    out.write(MAGIC)
+    _write_long(out, 2)
+    _write_bytes(out, b"avro.schema")
+    _write_bytes(out, json.dumps(schema).encode())
+    _write_bytes(out, b"avro.codec")
+    _write_bytes(out, b"deflate")
+    _write_long(out, 0)
+    out.write(sync)
+    _write_long(out, len(rows))
+    _write_long(out, len(payload))
+    out.write(payload)
+    out.write(sync)
+    p = tmp_path / "h.avro"
+    p.write_bytes(out.getvalue())
+    back = avro_io.read_avro([p])
+    # nullable long with an actual null → float64 with NaN (arrow's bridge)
+    xs = back.columns["x"].data
+    assert xs[0] == 5 and np.isnan(xs[1]) and xs[2] == 9
+    assert back.columns["e"].to_values().tolist() == ["RED", "BLUE", "RED"]
+
+
+def test_avro_nullable_int_dtype_stable_across_files(tmp_path):
+    """Dtype is a function of the schema, not the values: a nullable-long
+    column is float64 in every file, whether or not that file contains a
+    null — otherwise multi-file reads fail to concat."""
+    import io
+    import json
+
+    from hyperspace_tpu.storage import avro_io
+    from hyperspace_tpu.storage.avro_io import MAGIC, _write_bytes, _write_long
+
+    schema = {
+        "type": "record",
+        "name": "r",
+        "fields": [{"name": "k", "type": ["null", "long"]}],
+    }
+
+    def make(path, values):
+        block = io.BytesIO()
+        for v in values:
+            if v is None:
+                _write_long(block, 0)
+            else:
+                _write_long(block, 1)
+                _write_long(block, v)
+        payload = block.getvalue()
+        sync = b"0123456789abcdef"
+        out = io.BytesIO()
+        out.write(MAGIC)
+        _write_long(out, 1)
+        _write_bytes(out, b"avro.schema")
+        _write_bytes(out, json.dumps(schema).encode())
+        _write_long(out, 0)
+        out.write(sync)
+        _write_long(out, len(values))
+        _write_long(out, len(payload))
+        out.write(payload)
+        out.write(sync)
+        path.write_bytes(out.getvalue())
+
+    make(tmp_path / "with_null.avro", [1, None, 3])
+    make(tmp_path / "all_valid.avro", [4, 5])
+    back = avro_io.read_avro(
+        [tmp_path / "with_null.avro", tmp_path / "all_valid.avro"]
+    )
+    assert back.columns["k"].dtype_str == "float64"
+    k = back.columns["k"].data
+    assert k[0] == 1 and np.isnan(k[1]) and k[4] == 5
+
+
+def test_avro_nested_rejected(tmp_path):
+    import io
+    import json
+
+    from hyperspace_tpu.exceptions import HyperspaceException
+    from hyperspace_tpu.storage import avro_io
+    from hyperspace_tpu.storage.avro_io import MAGIC, _write_bytes, _write_long
+
+    schema = {
+        "type": "record",
+        "name": "r",
+        "fields": [{"name": "a", "type": {"type": "array", "items": "long"}}],
+    }
+    out = io.BytesIO()
+    out.write(MAGIC)
+    _write_long(out, 1)
+    _write_bytes(out, b"avro.schema")
+    _write_bytes(out, json.dumps(schema).encode())
+    _write_long(out, 0)
+    out.write(b"0123456789abcdef")
+    p = tmp_path / "bad.avro"
+    p.write_bytes(out.getvalue())
+    with pytest.raises(HyperspaceException, match="unsupported complex type"):
+        avro_io.read_avro([p])
+
+
+def test_avro_source_end_to_end(tmp_path):
+    from hyperspace_tpu.storage import avro_io
+
+    session, hs = _session(tmp_path)
+    src = tmp_path / "data"
+    src.mkdir()
+    b = sample(600, seed=11)
+    avro_io.write_avro(src / "part-0.avro", b.take(np.arange(0, 300)))
+    avro_io.write_avro(src / "part-1.avro", b.take(np.arange(300, 600)))
+    df = session.read.avro(str(src))
+    hs.create_index(df, IndexConfig("avro_idx", ["k"], ["v"]))
+    q = session.read.avro(str(src)).filter(col("k") == 7).select("k", "v")
+    off = q.collect()
+    session.enable_hyperspace()
+    on = q.collect()
+    assert_row_parity(off, on)
+    assert q.optimized_plan().collect(lambda nd: isinstance(nd, IndexScan))

@@ -1,0 +1,429 @@
+"""Hive-partitioned sources: discovery, reads, pruning, indexing, and the
+hybrid-scan matrix over partitioned layouts — the analog of the reference's
+partitioned-source coverage (CreateActionBase.scala:164-208 materializes
+missing partition columns; HybridScanForPartitionedDataTest mutates data
+per partition).
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.plan.ir import IndexScan
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io, partitions as P
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+from tests.e2e_utils import assert_row_parity
+
+
+# ---------------------------------------------------------------------------
+# unit: layout parsing
+# ---------------------------------------------------------------------------
+def test_partition_segments_trailing_run():
+    B = ["/data/t"]
+    assert P.partition_segments(
+        "/data/t/date=2024-01-01/region=us/f.parquet", B
+    ) == [("date", "2024-01-01"), ("region", "us")]
+    # non-kv segment breaks the run: only the trailing components count
+    assert P.partition_segments("/data/t/raw/y=2/f.parquet", B) == [("y", "2")]
+    assert P.partition_segments("/data/t/f.parquet", B) == []
+    # '=' at position 0 or twice is not a partition segment
+    assert P.partition_segments("/data/t/=v/f.parquet", B) == []
+    assert P.partition_segments("/data/t/a=b=c/f.parquet", B) == []
+
+
+def test_partition_segments_bounded_by_base():
+    # components of (or above) the base itself are never partitions: a
+    # kv-named root, or reading a single partition dir of a table
+    assert P.partition_segments("/data/run=5/f.parquet", ["/data/run=5"]) == []
+    assert (
+        P.partition_segments(
+            "/t/date=2024-01-01/f.parquet", ["/t/date=2024-01-01"]
+        )
+        == []
+    )
+    # a file outside every base has no partition segments
+    assert P.partition_segments("/elsewhere/k=1/f.parquet", ["/t"]) == []
+    # the longest matching base wins
+    assert P.partition_segments(
+        "/t/a=1/b=2/f.parquet", ["/t", "/t/a=1"]
+    ) == [("b", "2")]
+
+
+def test_type_inference_and_nulls():
+    spec = P.discover_partition_spec(
+        ["/t/k=3/a.parquet", "/t/k=11/b.parquet"], ["/t"]
+    )
+    assert spec.columns == (("k", "int64"),)
+    spec = P.discover_partition_spec(
+        ["/t/k=1.5/a.parquet", "/t/k=2/b.parquet"], ["/t"]
+    )
+    assert spec.columns == (("k", "float64"),)
+    spec = P.discover_partition_spec(
+        ["/t/k=a/x.parquet", f"/t/k={P.HIVE_NULL}/y.parquet"], ["/t"]
+    )
+    assert spec.columns == (("k", "string"),)
+    assert P.partition_values_for(f"/t/k={P.HIVE_NULL}/y.parquet", spec) == {
+        "k": None
+    }
+
+
+def test_url_unquoting():
+    spec = P.discover_partition_spec(["/t/k=a%2Fb%3D1/f.parquet"], ["/t"])
+    assert spec.columns == (("k", "string"),)
+    assert P.partition_values_for("/t/k=a%2Fb%3D1/f.parquet", spec) == {
+        "k": "a/b=1"
+    }
+
+
+def test_conflicting_layout_rejected():
+    with pytest.raises(HyperspaceException, match="Conflicting partition"):
+        P.discover_partition_spec(
+            ["/t/k=1/a.parquet", "/t/b.parquet"], ["/t"]
+        )
+    with pytest.raises(HyperspaceException, match="Conflicting partition"):
+        P.discover_partition_spec(
+            ["/t/k=1/a.parquet", "/t/j=1/b.parquet"], ["/t"]
+        )
+
+
+def test_declared_schema_pins_dtype_and_bad_value_fails():
+    spec = P.discover_partition_spec(
+        ["/t/k=1/a.parquet"], ["/t"], declared_schema={"k": "int64"}
+    )
+    assert spec.columns == (("k", "int64"),)
+    with pytest.raises(HyperspaceException, match="does not parse"):
+        P.partition_values_for("/t/k=oops/b.parquet", spec)
+
+
+def test_date32_and_bool_partition_pins():
+    spec = P.discover_partition_spec(
+        ["/t/d=2024-01-02/flag=true/a.parquet"],
+        ["/t"],
+        declared_schema={"d": "date32", "flag": "bool"},
+    )
+    vals = P.partition_values_for("/t/d=2024-01-02/flag=true/a.parquet", spec)
+    # 2024-01-02 = 19724 days since epoch
+    assert vals == {"d": 19724, "flag": True}
+    with pytest.raises(HyperspaceException, match="does not parse"):
+        P.partition_values_for("/t/d=notadate/flag=true/a.parquet", spec)
+    bad = P.discover_partition_spec(
+        ["/t/k=1/a.parquet"], ["/t"], declared_schema={"k": "complex128"}
+    )
+    with pytest.raises(HyperspaceException, match="unsupported dtype"):
+        P.partition_values_for("/t/k=1/a.parquet", bad)
+
+
+# ---------------------------------------------------------------------------
+# e2e fixtures
+# ---------------------------------------------------------------------------
+def _batch(n, qty_base, seed):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch(
+        {
+            "orderkey": Column.from_values(
+                rng.integers(0, 50, n).astype(np.int64)
+            ),
+            "qty": Column.from_values(
+                (np.arange(n, dtype=np.int64) % 17) + qty_base
+            ),
+        }
+    )
+
+
+@pytest.fixture
+def env(tmp_path):
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.INDEX_NUM_BUCKETS: 4,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    src = tmp_path / "sales"
+    for region, day, seed in [
+        ("us", 1, 1),
+        ("us", 2, 2),
+        ("eu", 1, 3),
+        ("eu", 2, 4),
+    ]:
+        parquet_io.write_parquet(
+            src / f"region={region}" / f"day={day}" / "part-0.parquet",
+            _batch(200, day * 100, seed),
+        )
+    return session, hs, src, tmp_path
+
+
+def test_read_partitioned_schema_and_values(env):
+    session, _, src, _ = env
+    df = session.read.parquet(str(src))
+    # file columns first, partition columns after (Spark's ordering)
+    assert df.columns() == ["orderkey", "qty", "region", "day"]
+    out = df.collect()
+    assert out.num_rows == 800
+    pdf = out.to_pandas()
+    assert set(pdf["region"].unique()) == {"us", "eu"}
+    assert sorted(pdf["day"].unique().tolist()) == [1, 2]
+    assert pdf["day"].dtype == np.int64
+    # per-partition row attribution: qty encodes the day the file was
+    # written under
+    assert (pdf[pdf["day"] == 1]["qty"] >= 100).all()
+    assert (pdf[pdf["day"] == 1]["qty"] < 200).all()
+
+
+def test_partition_pruning_skips_files(env):
+    session, _, src, _ = env
+    q = (
+        session.read.parquet(str(src))
+        .filter((col("region") == "us") & (col("qty") >= lit(0)))
+        .select("orderkey", "qty", "day")
+    )
+    metrics.reset()
+    out = q.collect()
+    snap = metrics.snapshot()
+    assert snap["counters"].get("scan.partition_pruned") == 2  # both eu files
+    assert out.num_rows == 400
+    # parity against an unpruned evaluation of the same predicate
+    whole = session.read.parquet(str(src)).collect()
+    mask = np.asarray(whole.columns["region"].to_values()) == "us"
+    assert out.num_rows == int(mask.sum())
+
+
+def test_partition_pruning_to_zero_files(env):
+    session, _, src, _ = env
+    out = (
+        session.read.parquet(str(src))
+        .filter(col("region") == "mars")
+        .select("orderkey", "region")
+    ).collect()
+    assert out.num_rows == 0
+    assert out.column_names == ["orderkey", "region"]
+
+
+def test_index_includes_partition_column(env):
+    session, hs, src, _ = env
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("pidx", ["orderkey"], ["qty", "region"]))
+    q = (
+        session.read.parquet(str(src))
+        .filter(col("orderkey") == 7)
+        .select("orderkey", "qty", "region")
+    )
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    plan = q.optimized_plan()
+    assert plan.collect(lambda n: isinstance(n, IndexScan))
+    assert_row_parity(off, q.collect())
+
+
+def test_index_on_partition_column_as_key(env):
+    session, hs, src, _ = env
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("ridx", ["region"], ["qty"]))
+    q = (
+        session.read.parquet(str(src))
+        .filter(col("region") == "eu")
+        .select("region", "qty")
+    )
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    plan = q.optimized_plan()
+    assert plan.collect(lambda n: isinstance(n, IndexScan))
+    assert_row_parity(off, q.collect())
+
+
+def test_streaming_build_on_partitioned_source(env, monkeypatch):
+    session, hs, src, _ = env
+    session.conf.set(C.BUILD_MODE, C.BUILD_MODE_STREAMING)
+    session.conf.set(C.BUILD_CHUNK_ROWS, 128)
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("sidx", ["orderkey"], ["day"]))
+    q = (
+        session.read.parquet(str(src))
+        .filter(col("orderkey") == 3)
+        .select("orderkey", "day")
+    )
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    assert q.optimized_plan().collect(lambda n: isinstance(n, IndexScan))
+    assert_row_parity(off, q.collect())
+
+
+def test_hybrid_scan_append_new_partition(env):
+    session, hs, src, _ = env
+    session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, "true")
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("hidx", ["orderkey"], ["qty", "region"]))
+    # a new file in a brand-new partition value
+    parquet_io.write_parquet(
+        src / "region=ap" / "day=3" / "part-0.parquet", _batch(40, 300, 9)
+    )
+    q = (
+        session.read.parquet(str(src))
+        .filter(col("orderkey") == 7)
+        .select("orderkey", "qty", "region")
+    )
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    on = q.collect()
+    assert_row_parity(off, on)
+    assert "ap" in set(on.columns["region"].to_values())
+
+
+def test_hybrid_scan_delete_partition_file(env):
+    session, hs, src, _ = env
+    session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, "true")
+    session.conf.set(C.INDEX_LINEAGE_ENABLED, "true")
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("didx", ["orderkey"], ["qty", "day"]))
+    (src / "region=us" / "day=2" / "part-0.parquet").unlink()
+    q = (
+        session.read.parquet(str(src))
+        .filter(col("orderkey") == 7)
+        .select("orderkey", "qty", "day")
+    )
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    assert_row_parity(off, q.collect())
+
+
+def test_incremental_refresh_partitioned(env):
+    session, hs, src, _ = env
+    session.conf.set(C.INDEX_LINEAGE_ENABLED, "true")
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("iidx", ["orderkey"], ["qty", "region"]))
+    parquet_io.write_parquet(
+        src / "region=ap" / "day=3" / "part-0.parquet", _batch(40, 300, 11)
+    )
+    (src / "region=eu" / "day=2" / "part-0.parquet").unlink()
+    hs.refresh_index("iidx", "incremental")
+    q = (
+        session.read.parquet(str(src))
+        .filter(col("orderkey") == 7)
+        .select("orderkey", "qty", "region")
+    )
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    plan = q.optimized_plan()
+    assert plan.collect(lambda n: isinstance(n, IndexScan))
+    on = q.collect()
+    assert_row_parity(off, on)
+    regions = set(on.columns["region"].to_values())
+    assert "ap" in regions or off.num_rows == on.num_rows
+
+
+def test_partition_only_projection(env):
+    """Projecting ONLY partition columns must still produce one row per
+    source row (the file is read solely for its row count)."""
+    session, _, src, _ = env
+    out = session.read.parquet(str(src)).select("region").collect()
+    assert out.num_rows == 800
+    vals = out.columns["region"].to_values()
+    assert sorted(set(vals)) == ["eu", "us"]
+    assert (np.asarray(vals) == "us").sum() == 400
+    # and with a filter on a partition column
+    out2 = (
+        session.read.parquet(str(src))
+        .filter(col("day") == 2)
+        .select("region", "day")
+    ).collect()
+    assert out2.num_rows == 400
+
+
+def test_declared_schema_with_partition_columns(tmp_path):
+    """Declaring a schema that already names the partition columns (the
+    standard way to pin their dtypes) is not a collision — 'day' stays the
+    declared string dtype instead of the inferred int64."""
+    session = HyperspaceSession(HyperspaceConf({}))
+    src = tmp_path / "t"
+    parquet_io.write_parquet(src / "day=1" / "f.parquet", _batch(10, 0, 1))
+    df = session.read.schema(
+        {"orderkey": "int64", "qty": "int64", "day": "string"}
+    ).parquet(str(src))
+    out = df.collect()
+    assert out.columns["day"].dtype_str == "string"
+    assert set(out.columns["day"].to_values()) == {"1"}
+
+
+def test_refresh_ignores_new_partition_dirs_over_data_columns(env):
+    """A source indexed as UNPARTITIONED whose later files live under
+    kv-style directories must not re-type: the logged relation records no
+    partition columns, so the new directories are inert path segments and
+    the files' own columns are read (the silent-shadowing hazard)."""
+    session, hs, _, tmp = env
+    session.conf.set(C.INDEX_LINEAGE_ENABLED, "true")
+    flat = tmp / "flat"
+    parquet_io.write_parquet(flat / "a.parquet", _batch(100, 0, 1))
+    df = session.read.parquet(str(flat))
+    hs.create_index(df, IndexConfig("fidx", ["orderkey"], ["qty"]))
+    # new file under a directory named after a DATA column
+    parquet_io.write_parquet(flat / "qty=999" / "b.parquet", _batch(50, 0, 2))
+    hs.refresh_index("fidx", "incremental")
+    q = (
+        session.read.option(C.PARTITION_INFERENCE_KEY, "false")
+        .parquet(str(flat))
+        .filter(col("orderkey") == 3)
+        .select("orderkey", "qty")
+    )
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    on = q.collect()
+    assert_row_parity(off, on)
+    # qty values come from the files, never the directory constant
+    assert 999 not in set(on.columns["qty"].data.tolist())
+
+
+def test_kv_named_root_not_partitioned(tmp_path):
+    """Files directly under a root whose own name looks like k=v must not
+    grow phantom partition columns (discovery is bounded below the root)."""
+    session = HyperspaceSession(HyperspaceConf({}))
+    src = tmp_path / "run=5"
+    parquet_io.write_parquet(src / "f.parquet", _batch(10, 0, 1))
+    df = session.read.parquet(str(src))
+    assert df.columns() == ["orderkey", "qty"]
+    assert df.collect().num_rows == 10
+
+
+def test_reading_single_partition_dir(env):
+    """Pointing a read at ONE partition directory of a table reads its
+    files as unpartitioned (Spark semantics without a basePath option)."""
+    session, _, src, _ = env
+    df = session.read.parquet(str(src / "region=us" / "day=1"))
+    assert df.columns() == ["orderkey", "qty"]
+    assert df.collect().num_rows == 200
+
+
+def test_collision_with_data_column_rejected(tmp_path):
+    session = HyperspaceSession(HyperspaceConf({}))
+    src = tmp_path / "t"
+    parquet_io.write_parquet(
+        src / "qty=1" / "f.parquet", _batch(10, 0, 1)
+    )  # 'qty' is also a data column
+    with pytest.raises(HyperspaceException, match="collide"):
+        session.read.parquet(str(src)).collect()
+
+
+def test_partition_inference_can_be_disabled(tmp_path):
+    session = HyperspaceSession(HyperspaceConf({}))
+    src = tmp_path / "t"
+    parquet_io.write_parquet(src / "day=1" / "f.parquet", _batch(10, 0, 1))
+    df = (
+        session.read.option(C.PARTITION_INFERENCE_KEY, "false")
+        .parquet(str(src))
+    )
+    assert "day" not in df.columns()
+    assert df.collect().num_rows == 10
