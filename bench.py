@@ -449,6 +449,58 @@ def main() -> None:
     extras["q3_index_s"] = round(q6on_s, 4)
     extras["q3_external_s"] = round(ext6_s, 4)
 
+    # ---- config 7 (extra): TPC-H Q17-shaped aggregate over indexed join ----
+    # the BASELINE north star names Q3 AND Q17; Q17's execution shape is an
+    # aggregation over a part⋈lineitem join — here: the exchange-free SMJ
+    # through two covering indexes feeding the hash aggregate
+    from hyperspace_tpu.plan.aggregates import agg_avg, agg_count, agg_sum
+
+    q7 = lambda: (  # noqa: E731
+        session.read.parquet(str(WORKDIR / "lineitem"))
+        .join(
+            session.read.parquet(str(WORKDIR / "orders")),
+            col("l_orderkey") == col("o_orderkey"),
+        )
+        .group_by("l_partkey")
+        .agg(agg_sum("o_totalprice", "rev"), agg_avg("o_totalprice", "avg_rev"), agg_count())
+    )
+    session.disable_hyperspace()
+    q7_off = q7().collect()
+    q7off_s = _time(lambda: q7().collect(), REPEATS)
+    session.enable_hyperspace()
+    _indexed_run_begin()
+    q7_on = q7().collect()
+    q7on_s = _time(lambda: q7().collect(), REPEATS)
+    _indexed_run_end()
+    if q7_off.num_rows != q7_on.num_rows:
+        _fail("config7 q17-shape group-count parity violated")
+    if abs(
+        float(q7_off.columns["rev"].data.sum())
+        - float(q7_on.columns["rev"].data.sum())
+    ) > 1e-6 * abs(float(q7_off.columns["rev"].data.sum())):
+        _fail("config7 q17-shape checksum parity violated")
+
+    def _ext_q17():
+        t = _ext_join(WORKDIR / "lineitem", WORKDIR / "orders")
+        return t.group_by("l_partkey").aggregate(
+            [
+                ("o_totalprice", "sum"),
+                ("o_totalprice", "mean"),
+                ("o_totalprice", "count"),
+            ]
+        )
+
+    ext7_t = _ext_q17()
+    if ext7_t.num_rows != q7_on.num_rows:
+        _fail("config7 external group-count parity violated")
+    ext7_s = _time(_ext_q17, REPEATS)
+    speedups["q17_aggregate_join"] = q7off_s / q7on_s
+    ext_speedups["q17_aggregate_join"] = ext7_s / q7on_s
+    extras["q17_groups"] = int(q7_on.num_rows)
+    extras["q17_fullscan_s"] = round(q7off_s, 4)
+    extras["q17_index_s"] = round(q7on_s, 4)
+    extras["q17_external_s"] = round(ext7_s, 4)
+
     # ---- config 4: hybrid scan after appends -------------------------------
     appended = lineitem.take(
         np.arange(0, max(N_ROWS // 50, 1))

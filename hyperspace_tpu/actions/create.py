@@ -178,6 +178,7 @@ class CreateActionBase:
                 chunk_rows,
                 extra_meta=extra_meta,
                 mesh=self.session.mesh,
+                engine=self.conf.build_engine(),
             )
         batch = self.prepare_index_batch(relation, indexed, included, lineage, tracker)
         return write_index_data(

@@ -135,6 +135,16 @@ class HyperspaceConf:
     def build_chunk_rows(self) -> int:
         return int(self.get(C.BUILD_CHUNK_ROWS, C.BUILD_CHUNK_ROWS_DEFAULT))
 
+    def build_engine(self) -> str:
+        v = str(self.get(C.BUILD_ENGINE, C.BUILD_ENGINE_DEFAULT)).lower()
+        if v not in C.BUILD_ENGINES:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"Unknown build engine {v!r}; expected one of {C.BUILD_ENGINES}."
+            )
+        return v
+
     def distributed_min_rows(self) -> int:
         return int(
             self.get(

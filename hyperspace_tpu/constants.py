@@ -41,6 +41,17 @@ BUILD_CHUNK_ROWS_DEFAULT = 1 << 21  # 2M rows per streamed chunk
 # auto mode streams when the source files exceed this many bytes on disk
 BUILD_STREAMING_THRESHOLD_BYTES = "hyperspace.index.build.streamingThresholdBytes"
 BUILD_STREAMING_THRESHOLD_BYTES_DEFAULT = 256 * 1024 * 1024
+# Streaming-build chunk engine: device (fused XLA bucketize+sort), host
+# (numpy lexsort twin), or auto — probe both on early chunks and route the
+# rest to the measured winner (a thin device link, e.g. a tunneled chip,
+# makes the per-chunk D2H readback dominate; on a real TPU host the device
+# engine wins). The chosen engine is observable as build.engine.* counters.
+BUILD_ENGINE = "hyperspace.index.build.engine"
+BUILD_ENGINE_AUTO = "auto"
+BUILD_ENGINE_DEVICE = "device"
+BUILD_ENGINE_HOST = "host"
+BUILD_ENGINES = (BUILD_ENGINE_AUTO, BUILD_ENGINE_DEVICE, BUILD_ENGINE_HOST)
+BUILD_ENGINE_DEFAULT = BUILD_ENGINE_AUTO
 
 # Lineage (reference: IndexConstants.scala:74-76)
 INDEX_LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"

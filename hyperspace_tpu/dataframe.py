@@ -48,6 +48,20 @@ class DataFrame(EventLogging):
             raise HyperspaceException("Cannot join DataFrames from different sessions.")
         return DataFrame(self.session, Join(self.plan, other.plan, condition, how))
 
+    def group_by(self, *columns: str) -> "GroupedData":
+        """Hash-aggregate entry point: ``df.group_by("k").agg(agg_sum("v"))``
+        (specs from plan.aggregates). No columns = global aggregate."""
+        out = self.plan.output_columns()
+        resolved = []
+        for c in columns:
+            match = next((o for o in out if o.lower() == c.lower()), None)
+            if match is None:
+                raise HyperspaceException(f"Unknown group-by column: {c}.")
+            resolved.append(match)
+        return GroupedData(self, tuple(resolved))
+
+    groupBy = group_by
+
     # -- actions -------------------------------------------------------------
     def optimized_plan(self, log_usage: bool = False) -> LogicalPlan:
         """The plan after the Hyperspace rule batch (identity when
@@ -127,3 +141,46 @@ class DataFrame(EventLogging):
         from .plananalysis.plan_analyzer import explain_string
 
         return explain_string(self, verbose=verbose)
+
+
+class GroupedData:
+    """``df.group_by(...)`` result: call ``agg`` with AggSpecs (or use the
+    ``count`` shorthand) to get the aggregated DataFrame."""
+
+    def __init__(self, df: DataFrame, group_by):
+        self._df = df
+        self._group_by = group_by
+
+    def agg(self, *specs) -> DataFrame:
+        from .plan.aggregates import AggSpec, validate_specs
+        from .plan.ir import Aggregate
+
+        if not specs:
+            raise HyperspaceException("agg() needs at least one AggSpec.")
+        out = self._df.plan.output_columns()
+        resolved = []
+        for s in specs:
+            if not isinstance(s, AggSpec):
+                raise HyperspaceException(f"Not an AggSpec: {s!r}.")
+            if s.column is not None:
+                match = next(
+                    (o for o in out if o.lower() == s.column.lower()), None
+                )
+                if match is None:
+                    raise HyperspaceException(
+                        f"Unknown aggregate column: {s.column}."
+                    )
+                from dataclasses import replace as dc_replace
+
+                s = dc_replace(s, column=match)
+            resolved.append(s)
+        validate_specs(tuple(resolved), self._group_by)
+        return DataFrame(
+            self._df.session,
+            Aggregate(self._group_by, tuple(resolved), self._df.plan),
+        )
+
+    def count(self) -> DataFrame:
+        from .plan.aggregates import agg_count
+
+        return self.agg(agg_count())

@@ -1,0 +1,187 @@
+"""Hash-aggregate execution over columnar batches.
+
+Grouping factorizes the key tuple into dense int codes (np.unique — exact,
+collision-free, the same approach as the join's code factorization) and
+reduces each aggregate with one vectorized segment operation: bincount for
+count/sum, reduceat over the grouped order for min/max. No Python loop
+touches rows.
+
+NULL semantics (SQL): NULL group keys form their own group; count(col)
+counts non-NULL values; sum/avg/min/max skip NULLs (string code -1, float
+NaN); count(*) counts rows. Empty input yields zero groups.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..plan.aggregates import AggSpec, output_dtype
+from ..storage.columnar import Column, ColumnarBatch, is_string, numpy_dtype
+from ..telemetry.metrics import metrics
+
+
+def _key_array(col: Column) -> np.ndarray:
+    """int64 array whose equality ⟺ key equality. Strings use dictionary
+    codes (NULL = -1 is just another value); floats use their bit pattern
+    with -0.0 normalized."""
+    if is_string(col.dtype_str):
+        return col.data.astype(np.int64)
+    if col.data.dtype.kind == "f":
+        f = np.where(col.data == 0.0, 0.0, col.data.astype(np.float64))
+        return f.view(np.int64)
+    return col.data.astype(np.int64)
+
+
+def _dense(arr: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Hash-factorize to dense codes 0..k-1 (pandas' hashtable — O(n),
+    unlike np.unique's sort)."""
+    import pandas as pd
+
+    codes, uniques = pd.factorize(arr, sort=False)
+    return codes.astype(np.int64), len(uniques)
+
+
+def _group_codes(
+    batch: ColumnarBatch, group_by: Sequence[str]
+) -> Tuple[np.ndarray, int, np.ndarray]:
+    """(codes, n_groups, representative row index per group). Multi-key
+    tuples pack pairwise — each pack re-densifies, so the product of
+    cardinalities never exceeds n² and cannot overflow int64 for any
+    realistic n. Representatives are the FIRST occurrence of each group:
+    one reversed fancy-index store (last write wins ⇒ reversed order makes
+    the first occurrence win) instead of a sort."""
+    codes, card = _dense(_key_array(batch.columns[group_by[0]]))
+    for name in group_by[1:]:
+        nxt, nxt_card = _dense(_key_array(batch.columns[name]))
+        codes, card = _dense(codes * np.int64(nxt_card) + nxt)
+    n = len(codes)
+    rep = np.empty(card, dtype=np.int64)
+    rep[codes[::-1]] = np.arange(n - 1, -1, -1, dtype=np.int64)
+    return codes, card, rep
+
+
+def _valid_mask(col: Column) -> np.ndarray:
+    if is_string(col.dtype_str):
+        return col.data >= 0
+    if col.data.dtype.kind == "f":
+        return ~np.isnan(col.data)
+    return np.ones(len(col.data), dtype=bool)
+
+
+def _segment_minmax(
+    codes: np.ndarray,
+    col: Column,
+    n_groups: int,
+    want_max: bool,
+    order: np.ndarray,
+) -> Column:
+    """Per-group min/max via reduceat over the (shared) grouped order,
+    NULL-skipping. ``order`` is the stable argsort of ``codes``, computed
+    ONCE in hash_aggregate and reused by every min/max spec. Groups whose
+    values are all NULL yield NULL (string) / NaN (float); all-NULL
+    integer groups cannot occur (ints have no NULL)."""
+    valid_sorted = _valid_mask(col)[order]
+    seg_sorted = codes[order][valid_sorted]
+    vals_sorted = col.data[order][valid_sorted]
+    bounds = np.flatnonzero(np.diff(seg_sorted)) + 1
+    starts = np.concatenate([[0], bounds]) if len(seg_sorted) else np.array([], dtype=np.int64)
+    red = np.maximum if want_max else np.minimum
+    if is_string(col.dtype_str):
+        out_codes = np.full(n_groups, -1, dtype=col.data.dtype)
+        if len(seg_sorted):
+            # dictionary codes from one unified vocab are order-preserving
+            out_codes[seg_sorted[starts]] = red.reduceat(vals_sorted, starts)
+        return Column("string", out_codes, col.vocab)
+    fill = np.nan if col.data.dtype.kind == "f" else 0
+    out = np.full(n_groups, fill, dtype=col.data.dtype)
+    if len(seg_sorted):
+        out[seg_sorted[starts]] = red.reduceat(vals_sorted, starts)
+    return Column(col.dtype_str, out)
+
+
+@metrics.timer("aggregate")
+def hash_aggregate(
+    batch: ColumnarBatch,
+    group_by: Sequence[str],
+    aggs: Sequence[AggSpec],
+) -> ColumnarBatch:
+    schema = batch.schema()
+    missing = [c for c in list(group_by) + [a.column for a in aggs if a.column]
+               if c not in schema]
+    if missing:
+        raise HyperspaceException(f"Aggregate references unknown columns {missing}.")
+    n = batch.num_rows
+    if not group_by:
+        # global aggregate: one group covering every row (n=0 → one group
+        # of zero rows, matching SQL's single-row global-aggregate result)
+        codes = np.zeros(n, dtype=np.int64)
+        n_groups, rep_idx = 1, None
+    else:
+        if n == 0:
+            return ColumnarBatch.empty(
+                {c: schema[c] for c in group_by}
+                | {a.name: output_dtype(a, schema.get(a.column) if a.column else None)
+                   for a in aggs}
+            )
+        codes, n_groups, rep_idx = _group_codes(batch, group_by)
+
+    out = {}
+    if group_by:
+        rep = batch.select(list(group_by)).take(rep_idx)
+        out.update(rep.columns)
+
+    counts_all = np.bincount(codes, minlength=n_groups)
+    minmax_order = None
+    if any(a.fn in ("min", "max") for a in aggs):
+        minmax_order = np.argsort(codes, kind="stable")  # shared by all specs
+    for a in aggs:
+        dt = output_dtype(a, schema.get(a.column) if a.column else None)
+        if a.fn == "count":
+            if a.column is None:
+                out[a.name] = Column("int64", counts_all.astype(np.int64))
+            else:
+                valid = _valid_mask(batch.columns[a.column])
+                out[a.name] = Column(
+                    "int64",
+                    np.bincount(codes[valid], minlength=n_groups).astype(np.int64),
+                )
+            continue
+        col = batch.columns[a.column]
+        if a.fn in ("sum", "avg"):
+            if is_string(col.dtype_str):
+                raise HyperspaceException(f"{a.fn} over string column {a.column}.")
+            valid = _valid_mask(col)
+            vals = col.data[valid]
+            exact_int = a.fn == "sum" and not dt.startswith("float")
+            if exact_int and (
+                len(vals) == 0
+                or len(vals) * float(np.abs(vals).max()) < float(1 << 53)
+            ):
+                # bincount's float64 accumulator is provably exact here
+                exact_int = False
+            if exact_int:
+                # exact int64 segment sum: bincount accumulates in float64
+                # and corrupts totals past 2^53 (large ids, ns timestamps)
+                acc = np.zeros(n_groups, dtype=np.int64)
+                np.add.at(acc, codes[valid], vals.astype(np.int64))
+                out[a.name] = Column(dt, acc.astype(numpy_dtype(dt)))
+                continue
+            sums = np.bincount(
+                codes[valid],
+                weights=vals.astype(np.float64),
+                minlength=n_groups,
+            )
+            if a.fn == "sum":
+                out[a.name] = Column(dt, sums.astype(numpy_dtype(dt)))
+            else:
+                cnt = np.bincount(codes[valid], minlength=n_groups)
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    out[a.name] = Column("float64", sums / cnt)
+            continue
+        out[a.name] = _segment_minmax(
+            codes, col, n_groups, want_max=(a.fn == "max"), order=minmax_order
+        )
+    return ColumnarBatch(out)

@@ -24,6 +24,7 @@ from ..config import HyperspaceConf
 from ..exceptions import HyperspaceException
 from ..plan.expr import Expr, eval_mask
 from ..plan.ir import (
+    Aggregate,
     BucketUnion,
     Filter,
     IndexScan,
@@ -173,6 +174,20 @@ class Executor:
                 batch = self._exec_join(plan)
                 return self._apply_predicate(batch, predicate)
             return self._exec_join(plan)
+        if isinstance(plan, Aggregate):
+            from .aggregate import hash_aggregate
+
+            need = list(
+                dict.fromkeys(
+                    list(plan.group_by)
+                    + [a.column for a in plan.aggs if a.column is not None]
+                )
+            )
+            child = self._exec(plan.child, None, need)
+            result = hash_aggregate(child, list(plan.group_by), list(plan.aggs))
+            # a predicate above the aggregate (HAVING shape) applies to the
+            # aggregated rows, never the child's
+            return self._apply_predicate(result, predicate)
         if isinstance(plan, Union):
             parts = [self._exec(c, predicate, columns) for c in plan.children]
             return ColumnarBatch.concat(parts)

@@ -109,12 +109,11 @@ def _expand_ranges(
     if total == 0:
         return np.array([], dtype=np.int64), np.array([], dtype=np.int64)
     l_idx = np.repeat(np.arange(len(lo), dtype=np.int64), counts)
+    # one fused repeat: (lo - offsets) per left row, then + arange —
+    # instead of repeating lo and offsets separately (this expansion runs
+    # over every output pair; at 2M matches each repeat is ~40ms saved)
     offsets = np.cumsum(counts) - counts
-    r_pos = (
-        np.arange(total, dtype=np.int64)
-        - np.repeat(offsets, counts)
-        + np.repeat(lo, counts)
-    )
+    r_pos = np.arange(total, dtype=np.int64) + np.repeat(lo - offsets, counts)
     return l_idx, r_pos if r_order is None else r_order[r_pos]
 
 
@@ -186,6 +185,27 @@ def merge_join_indices_segmented(
     floats, or multi-file buckets after incremental refresh)."""
     if not _segments_sorted(r_codes, r_bounds):
         return merge_join_indices(l_codes, r_codes)
+    if _segments_sorted(l_codes, l_bounds):
+        # both sides ascending per segment (index data is, by construction):
+        # the native two-pointer SMJ is O(n+m) with parallel segments and
+        # no GIL — the merge step of the exchange-free SMJ in C++
+        from .. import native
+
+        pairs = native.smj_pairs(l_codes, r_codes, l_bounds, r_bounds)
+        if pairs is not None:
+            metrics.incr("join.path.native_smj")
+            return pairs
+    flat = _flat_segment_remap(l_codes, r_codes, l_bounds, r_bounds)
+    if flat is not None:
+        # ONE global searchsorted pair instead of a per-segment Python
+        # loop: codes remapped to seg*span + (code-min) live in disjoint
+        # ascending per-segment ranges, so the concatenated right side is
+        # globally sorted and matches cannot cross segments
+        metrics.incr("join.path.presorted_merge_flat")
+        l_flat, r_flat = flat
+        lo = np.searchsorted(r_flat, l_flat, side="left")
+        counts = np.searchsorted(r_flat, l_flat, side="right") - lo
+        return _expand_ranges(lo, counts, None)
     metrics.incr("join.path.presorted_merge")
     lo = np.empty(len(l_codes), dtype=np.int64)
     counts = np.empty(len(l_codes), dtype=np.int64)
@@ -198,6 +218,35 @@ def merge_join_indices_segmented(
         lo[ls:le] = rs + left_pos
         counts[ls:le] = np.searchsorted(seg, q, side="right") - left_pos
     return _expand_ranges(lo, counts, None)
+
+
+def _flat_segment_remap(
+    l_codes: np.ndarray,
+    r_codes: np.ndarray,
+    l_bounds: np.ndarray,
+    r_bounds: np.ndarray,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Remap segment-aligned codes into one global sort order:
+    code → seg_id * span + (code - min). Requires n_segments * span to fit
+    int64 — true for any realistic integer key domain (the common case);
+    float-bit-pattern or factorized codes with huge spans fall back to the
+    per-segment loop (returns None)."""
+    if len(l_codes) == 0 or len(r_codes) == 0:
+        return None
+    n_seg = len(l_bounds) - 1
+    mn = int(min(l_codes.min(), r_codes.min()))
+    mx = int(max(l_codes.max(), r_codes.max()))
+    span = mx - mn + 1
+    if span <= 0 or n_seg * span >= (1 << 62):
+        return None
+    l_seg = np.repeat(
+        np.arange(n_seg, dtype=np.int64), np.diff(np.asarray(l_bounds))
+    )
+    r_seg = np.repeat(
+        np.arange(n_seg, dtype=np.int64), np.diff(np.asarray(r_bounds))
+    )
+    sp = np.int64(span)
+    return l_seg * sp + (l_codes - mn), r_seg * sp + (r_codes - mn)
 
 
 def inner_join(

@@ -96,6 +96,7 @@ class StreamingIndexWriter:
         chunk_capacity: int,
         extra_meta: Optional[dict] = None,
         mesh=None,
+        engine: str = "auto",
     ):
         if chunk_capacity < 1:
             raise HyperspaceException("chunk_capacity must be positive.")
@@ -107,6 +108,11 @@ class StreamingIndexWriter:
         self.chunk_capacity = 1 << (chunk_capacity - 1).bit_length()
         self.extra_meta = extra_meta
         self.mesh = mesh
+        # chunk engine: device | host | auto (probe chunks 1 and 2 — past
+        # the chunk-0 compile — and route the rest to the measured winner;
+        # constants.BUILD_ENGINE documents why this exists)
+        self._engine = engine
+        self._probe: Dict[str, float] = {}
         self._spill_dir = self.out_dir / SPILL_DIR_NAME
         self._spills: List[Path] = []
         self._spill_counts: List[np.ndarray] = []
@@ -126,6 +132,33 @@ class StreamingIndexWriter:
         self._spill_failure: List[BaseException] = []
         self._t_first_add: Optional[float] = None
         self._t_pipeline_done: Optional[float] = None
+
+    def _route_engine(self) -> str:
+        """Which engine runs THIS chunk. Fixed engines pass through; auto
+        probes: chunk 0 on device (pays the XLA compile, unmeasured),
+        chunk 1 on device with a synchronous timed round trip, chunk 2 on
+        host timed, every later chunk on the measured winner."""
+        if self._engine in ("device", "host"):
+            return self._engine
+        ci = len(self._chunk_times)
+        if ci == 0:
+            return "device"
+        if ci == 1:
+            return "probe-device"
+        if ci == 2:
+            return "probe-host"
+        if "winner" not in self._probe:
+            dev = self._probe.get("device_s")
+            host = self._probe.get("host_s")
+            self._probe["winner"] = (
+                1.0 if host is not None and (dev is None or host < dev) else 0.0
+            )
+            metrics.incr(
+                "build.engine.auto_chose_host"
+                if self._probe["winner"]
+                else "build.engine.auto_chose_device"
+            )
+        return "host" if self._probe["winner"] else "device"
 
     def _spill_run(self, sorted_batch: ColumnarBatch, counts: np.ndarray) -> None:
         """Persist one bucket-grouped, key-sorted run."""
@@ -234,17 +267,51 @@ class StreamingIndexWriter:
                 counts = np.bincount(bucket_ids, minlength=self.num_buckets)
                 self._spill_run(dev_batch, counts)
         else:
-            from ..ops.build import build_partition_single
+            engine = self._route_engine()
+            if engine in ("host", "probe-host"):
+                from ..ops.build import build_partition_host
 
-            # dispatch H2D + kernel (async); the spill thread performs the
-            # blocking fetch + decode + write, overlapping the next chunk
-            finish = build_partition_single(
-                batch,
-                self.indexed_cols,
-                self.num_buckets,
-                pad_to=self.chunk_capacity,
-                defer=True,
-            )
+                metrics.incr("build.engine.host")
+                if engine == "probe-host":
+                    t1 = time.perf_counter()
+                    result = build_partition_host(
+                        batch, self.indexed_cols, self.num_buckets
+                    )
+                    self._probe["host_s"] = time.perf_counter() - t1
+                    metrics.record_time(
+                        "build.engine.probe_host", self._probe["host_s"]
+                    )
+                    finish = lambda r=result: r  # noqa: E731
+                else:
+                    # the host sort runs on the spill thread, overlapping
+                    # the prefetch thread's source decode
+                    finish = lambda b=batch: build_partition_host(  # noqa: E731
+                        b, self.indexed_cols, self.num_buckets
+                    )
+            else:
+                from ..ops.build import build_partition_single
+
+                # dispatch H2D + kernel (async); the spill thread performs
+                # the blocking fetch + decode + write, overlapping the next
+                # chunk
+                metrics.incr("build.engine.device")
+                finish = build_partition_single(
+                    batch,
+                    self.indexed_cols,
+                    self.num_buckets,
+                    pad_to=self.chunk_capacity,
+                    defer=True,
+                )
+                if engine == "probe-device":
+                    # synchronous D2H here on the main thread so the probe
+                    # time covers the full device round trip
+                    t1 = time.perf_counter()
+                    result = finish()
+                    self._probe["device_s"] = time.perf_counter() - t1
+                    metrics.record_time(
+                        "build.engine.probe_device", self._probe["device_s"]
+                    )
+                    finish = lambda r=result: r  # noqa: E731
             self._chunk_times.append(time.perf_counter() - t0)
             self._enqueue_spill(finish)
         self._rows += batch.num_rows
@@ -408,6 +475,7 @@ def write_index_data_streaming(
     chunk_capacity: int,
     extra_meta: Optional[dict] = None,
     mesh=None,
+    engine: str = "auto",
 ) -> List[Path]:
     """Drive a StreamingIndexWriter over an iterator of chunks, with
     ingest prefetched one chunk ahead of device compute. A failure
@@ -420,6 +488,7 @@ def write_index_data_streaming(
         chunk_capacity,
         extra_meta=extra_meta,
         mesh=mesh,
+        engine=engine,
     )
     try:
         for chunk in prefetch_chunks(chunks):

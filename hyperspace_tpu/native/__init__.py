@@ -73,28 +73,47 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(str(so))
-        except OSError:
+            _bind_symbols(lib)
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt .so lacking newer symbols —
+            # degrade to the pure-Python fallbacks, never crash
             _LIB_FAILED = True
             return None
-        lib.hs_pread_many.restype = ctypes.c_int32
-        lib.hs_pread_many.argtypes = [
-            ctypes.POINTER(ctypes.c_char_p),
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_void_p),
-            ctypes.c_int32,
-            ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.hs_write_file_atomic.restype = ctypes.c_int32
-        lib.hs_write_file_atomic.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_void_p,
-            ctypes.c_int64,
-        ]
         _LIB = lib
         return _LIB
+
+
+def _bind_symbols(lib: ctypes.CDLL) -> None:
+    lib.hs_pread_many.restype = ctypes.c_int32
+    lib.hs_pread_many.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.hs_write_file_atomic.restype = ctypes.c_int32
+    lib.hs_write_file_atomic.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.hs_smj_ranges.restype = ctypes.c_int64
+    lib.hs_smj_ranges.argtypes = [
+        i64p, i64p, i64p, i64p, ctypes.c_int32, i64p, i64p, ctypes.c_int32,
+    ]
+    lib.hs_expand_pairs.restype = None
+    lib.hs_expand_pairs.argtypes = [
+        i64p, i64p, i64p, ctypes.c_int64, i64p, i64p, ctypes.c_int32,
+    ]
+
+
+def _i64ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
 
 
 def available() -> bool:
@@ -170,6 +189,48 @@ def write_file_atomic(path: str, data: bytes | np.ndarray) -> bool:
         finally:
             raise OSError(rc, os.strerror(rc) if rc > 0 else "IO error", path)
     return True
+
+
+def smj_pairs(
+    l_codes: np.ndarray,
+    r_codes: np.ndarray,
+    l_bounds: np.ndarray,
+    r_bounds: np.ndarray,
+    n_threads: int = 0,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Segment-aligned sort-merge join through the native runtime: both
+    sides ascending int64 codes within aligned segments. Returns the
+    (l_idx, r_idx) inner-join pair arrays, or None when the native library
+    is unavailable (caller falls back to the numpy path). O(n+m) two-
+    pointer walk, parallel over segments, GIL released."""
+    lib = _load()
+    if lib is None:
+        return None
+    l = np.ascontiguousarray(l_codes, dtype=np.int64)
+    r = np.ascontiguousarray(r_codes, dtype=np.int64)
+    lb = np.ascontiguousarray(l_bounds, dtype=np.int64)
+    rb = np.ascontiguousarray(r_bounds, dtype=np.int64)
+    n_seg = len(lb) - 1
+    if n_seg != len(rb) - 1:
+        raise ValueError("smj_pairs: segment counts differ.")
+    n_l = len(l)
+    lo = np.empty(n_l, dtype=np.int64)
+    cnt = np.empty(n_l, dtype=np.int64)
+    total = lib.hs_smj_ranges(
+        _i64ptr(l), _i64ptr(r), _i64ptr(lb), _i64ptr(rb),
+        np.int32(n_seg), _i64ptr(lo), _i64ptr(cnt), int(n_threads),
+    )
+    off = np.empty(n_l + 1, dtype=np.int64)
+    off[0] = 0
+    np.cumsum(cnt, out=off[1:])
+    l_idx = np.empty(total, dtype=np.int64)
+    r_idx = np.empty(total, dtype=np.int64)
+    if total:
+        lib.hs_expand_pairs(
+            _i64ptr(lo), _i64ptr(cnt), _i64ptr(off),
+            np.int64(n_l), _i64ptr(l_idx), _i64ptr(r_idx), int(n_threads),
+        )
+    return l_idx, r_idx
 
 
 def load_columns(

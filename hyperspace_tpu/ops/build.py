@@ -197,6 +197,34 @@ def build_partition_single(
     return finish if defer else finish()
 
 
+def build_partition_host(
+    batch: ColumnarBatch,
+    key_names: List[str],
+    num_buckets: int,
+) -> Tuple[ColumnarBatch, np.ndarray]:
+    """Host twin of build_partition_single: identical output (same hash,
+    same (bucket, keys…) order, same stable tie-break) computed with one
+    numpy lexsort — no device round trip.
+
+    Exists for the streaming build's measured engine routing: on hosts
+    whose device link is thin (e.g. a tunneled chip) the D2H readback of
+    every sorted chunk dominates the pipeline, and the honest answer — as
+    with the join's path routing — is to measure both engines and take the
+    faster, recording the choice in metrics."""
+    from ..index.stream_builder import sort_encoding
+    from .hashing import bucket_ids_host, key_repr
+
+    bucket = bucket_ids_host(
+        [key_repr(batch.columns[k]) for k in key_names], num_buckets
+    )
+    # lexsort: LAST key is primary → (keyN … key1, bucket); stable, so ties
+    # keep original order exactly like the device kernel's iota tie-break
+    encs = [sort_encoding(batch.columns[k]) for k in key_names]
+    order = np.lexsort(tuple(reversed(encs)) + (bucket,))
+    counts = np.bincount(bucket, minlength=num_buckets).astype(np.int64)
+    return batch.take(order), counts
+
+
 # ---------------------------------------------------------------------------
 # multi-device build kernel (shard_map + all_to_all over ICI)
 # ---------------------------------------------------------------------------

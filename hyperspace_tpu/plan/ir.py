@@ -243,6 +243,43 @@ class Repartition(LogicalPlan):
 
 
 @dataclass(frozen=True)
+class Aggregate(LogicalPlan):
+    """Hash-aggregate: group by ``group_by`` columns, compute ``aggs``
+    (plan.aggregates.AggSpec). Sits ABOVE the index-rewritable subtree —
+    the reference's Q17-style queries aggregate over an index-rewritten
+    join, with Spark supplying this node; here the framework owns it."""
+
+    group_by: Tuple[str, ...]
+    aggs: Tuple["object", ...]  # AggSpec (untyped to avoid import cycle)
+    child: LogicalPlan
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, children):
+        return replace(self, child=children[0])
+
+    def output_columns(self) -> List[str]:
+        return list(self.group_by) + [a.name for a in self.aggs]
+
+    def output_schema(self) -> Dict[str, str]:
+        from .aggregates import output_dtype
+
+        child_schema = self.child.output_schema()
+        out = {c: child_schema[c] for c in self.group_by}
+        for a in self.aggs:
+            out[a.name] = output_dtype(
+                a, child_schema.get(a.column) if a.column else None
+            )
+        return out
+
+    def describe(self) -> str:
+        parts = [f"{a.fn}({a.column or '*'}) AS {a.name}" for a in self.aggs]
+        return f"Aggregate [{', '.join(self.group_by)}] [{', '.join(parts)}]"
+
+
+@dataclass(frozen=True)
 class Union(LogicalPlan):
     """Plain row union (the non-bucketed Hybrid Scan merge,
     RuleUtils.scala:443-446)."""

@@ -16,7 +16,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ...utils import resolver
-from ..ir import Filter, Join, LogicalPlan, Project, Scan
+from ..ir import Aggregate, Filter, Join, LogicalPlan, Project, Scan
 
 
 def _resolve_needed(needed: List[str], available: List[str]) -> List[str]:
@@ -47,6 +47,17 @@ def _prune(node: LogicalPlan, needed: Optional[List[str]]) -> LogicalPlan:
             child_needed = list(
                 dict.fromkeys(list(needed) + sorted(node.condition.columns()))
             )
+        child = _prune(node.child, child_needed)
+        return node.with_children((child,)) if child is not node.child else node
+    if isinstance(node, Aggregate):
+        # the child must expose exactly the group keys + aggregate inputs,
+        # regardless of what the plan above needs (agg outputs are derived)
+        child_needed = list(
+            dict.fromkeys(
+                list(node.group_by)
+                + [a.column for a in node.aggs if a.column is not None]
+            )
+        )
         child = _prune(node.child, child_needed)
         return node.with_children((child,)) if child is not node.child else node
     if isinstance(node, Join):

@@ -8,6 +8,7 @@
 //
 // Build: g++ -O3 -march=native -shared -fPIC -pthread tcb_io.cc -o libtcb_io.so
 
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <cstdint>
@@ -104,6 +105,106 @@ int hs_pread_many(const char **paths, const int64_t *offsets,
       t.join();
   }
   return failures.load();
+}
+
+// ---------------------------------------------------------------------------
+// Segmented sort-merge join (the exchange-free SMJ's merge step).
+//
+// Both sides hold int64 join codes grouped into aligned segments (buckets):
+// segment k of the left joins only segment k of the right, and both are
+// ascending within each segment (the on-disk index order). A two-pointer
+// walk per segment emits, for every left row, the [lo, lo+cnt) run of
+// matching GLOBAL right positions — O(n+m) total instead of the
+// O(n log m) of per-row binary search, parallel across segments, GIL
+// released for the whole call.
+// ---------------------------------------------------------------------------
+
+// Phase A: per-left-row match ranges. Returns total match count.
+int64_t hs_smj_ranges(const int64_t *l, const int64_t *r, const int64_t *lb,
+                      const int64_t *rb, int32_t n_seg, int64_t *lo,
+                      int64_t *cnt, int32_t n_threads) {
+  std::atomic<int32_t> next_seg(0);
+  std::vector<int64_t> seg_totals(static_cast<size_t>(n_seg), 0);
+  auto body = [&]() {
+    for (;;) {
+      int32_t k = next_seg.fetch_add(1);
+      if (k >= n_seg)
+        return;
+      int64_t i = lb[k], le = lb[k + 1];
+      int64_t j = rb[k], re = rb[k + 1];
+      int64_t total = 0;
+      while (i < le) {
+        const int64_t v = l[i];
+        while (j < re && r[j] < v)
+          ++j;
+        int64_t jr = j;
+        while (jr < re && r[jr] == v)
+          ++jr;
+        const int64_t run = jr - j;
+        while (i < le && l[i] == v) {
+          lo[i] = j;
+          cnt[i] = run;
+          total += run;
+          ++i;
+        }
+      }
+      seg_totals[static_cast<size_t>(k)] = total;
+    }
+  };
+  int32_t hw = static_cast<int32_t>(std::thread::hardware_concurrency());
+  int32_t workers = n_threads > 0 ? n_threads : (hw > 0 ? hw : 4);
+  if (workers > n_seg)
+    workers = n_seg;
+  if (workers <= 1) {
+    body();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(static_cast<size_t>(workers));
+    for (int32_t w = 0; w < workers; ++w)
+      pool.emplace_back(body);
+    for (auto &t : pool)
+      t.join();
+  }
+  int64_t total = 0;
+  for (int64_t s : seg_totals)
+    total += s;
+  return total;
+}
+
+// Phase B: expand ranges into (l_idx, r_idx) pair arrays. off[i] is the
+// exclusive prefix sum of cnt (the caller computes it once; off[n_l] =
+// total). Parallel over left-row chunks — each row's writes are disjoint.
+void hs_expand_pairs(const int64_t *lo, const int64_t *cnt, const int64_t *off,
+                     int64_t n_l, int64_t *l_idx, int64_t *r_idx,
+                     int32_t n_threads) {
+  int32_t hw = static_cast<int32_t>(std::thread::hardware_concurrency());
+  int32_t workers = n_threads > 0 ? n_threads : (hw > 0 ? hw : 4);
+  if (workers < 1)
+    workers = 1;
+  const int64_t chunk = (n_l + workers - 1) / workers;
+  auto body = [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      int64_t w = off[i];
+      const int64_t base = lo[i];
+      for (int64_t c = 0; c < cnt[i]; ++c, ++w) {
+        l_idx[w] = i;
+        r_idx[w] = base + c;
+      }
+    }
+  };
+  if (workers <= 1 || n_l < (1 << 16)) {
+    body(0, n_l);
+  } else {
+    std::vector<std::thread> pool;
+    for (int32_t w = 0; w < workers; ++w) {
+      int64_t b = w * chunk, e = std::min(n_l, b + chunk);
+      if (b >= e)
+        break;
+      pool.emplace_back(body, b, e);
+    }
+    for (auto &t : pool)
+      t.join();
+  }
 }
 
 // Durable single-buffer write: write tmp_path, fsync, rename() to path.

@@ -131,12 +131,8 @@ def test_host_fallback_observable(monkeypatch):
     assert metrics.counter("join.path.host_searchsorted") == before + 1
 
 
-def test_presorted_segmented_merge():
-    # sorted-per-segment right side: the argsort-free path fires and gives
-    # the same pairs as independent per-segment joins
-    from hyperspace_tpu.exec.joins import merge_join_indices_segmented
-
-    rng = np.random.default_rng(11)
+def _seg_data(seed=11):
+    rng = np.random.default_rng(seed)
     segs_l, segs_r = [], []
     for k in range(5):
         segs_l.append(np.sort(rng.integers(k * 100, (k + 1) * 100, 50)).astype(np.int64))
@@ -145,17 +141,105 @@ def test_presorted_segmented_merge():
     r = np.concatenate(segs_r)
     lb = np.cumsum([0] + [len(s) for s in segs_l])
     rb = np.cumsum([0] + [len(s) for s in segs_r])
-    before = metrics.counter("join.path.presorted_merge")
-    li, ri = merge_join_indices_segmented(l, r, lb, rb)
-    assert metrics.counter("join.path.presorted_merge") == before + 1
-    got = sorted(zip(l[li].tolist(), r[ri].tolist()))
     exp = []
     for k in range(5):
         a, b = segs_l[k], segs_r[k]
         for x in a:
             for y in b[b == x]:
                 exp.append((int(x), int(y)))
-    assert got == sorted(exp) and len(got) > 0
+    return l, r, lb, rb, sorted(exp)
+
+
+def test_presorted_segmented_merge_native():
+    # both sides sorted per segment: the native two-pointer SMJ fires
+    # (falls to the flat remap where the toolchain is absent)
+    from hyperspace_tpu import native
+    from hyperspace_tpu.exec.joins import merge_join_indices_segmented
+
+    l, r, lb, rb, exp = _seg_data()
+    counter = (
+        "join.path.native_smj"
+        if native.available()
+        else "join.path.presorted_merge_flat"
+    )
+    before = metrics.counter(counter)
+    li, ri = merge_join_indices_segmented(l, r, lb, rb)
+    assert metrics.counter(counter) == before + 1
+    got = sorted(zip(l[li].tolist(), r[ri].tolist()))
+    assert got == exp and len(got) > 0
+
+
+def test_presorted_segmented_merge_flat(monkeypatch):
+    # native unavailable + small int span: the single-searchsorted flat
+    # remap serves the merge with identical pairs
+    from hyperspace_tpu import native
+    from hyperspace_tpu.exec.joins import merge_join_indices_segmented
+
+    monkeypatch.setattr(native, "smj_pairs", lambda *a, **k: None)
+    l, r, lb, rb, exp = _seg_data(seed=13)
+    before = metrics.counter("join.path.presorted_merge_flat")
+    li, ri = merge_join_indices_segmented(l, r, lb, rb)
+    assert metrics.counter("join.path.presorted_merge_flat") == before + 1
+    got = sorted(zip(l[li].tolist(), r[ri].tolist()))
+    assert got == exp and len(got) > 0
+
+
+def test_presorted_segmented_merge_wide_span_loop(monkeypatch):
+    # native off AND a span too wide for the flat remap (~2^62): the
+    # per-segment searchsorted loop still produces exact pairs
+    from hyperspace_tpu import native
+    from hyperspace_tpu.exec.joins import merge_join_indices_segmented
+
+    monkeypatch.setattr(native, "smj_pairs", lambda *a, **k: None)
+    l = np.array([-(1 << 61), 5, 7, (1 << 61), (1 << 61) + 3], dtype=np.int64)
+    r = np.array([5, 5, (1 << 61), (1 << 61) + 3], dtype=np.int64)
+    lb = np.array([0, 3, 5])
+    rb = np.array([0, 2, 4])
+    before = metrics.counter("join.path.presorted_merge")
+    li, ri = merge_join_indices_segmented(l, r, lb, rb)
+    assert metrics.counter("join.path.presorted_merge") == before + 1
+    got = sorted(zip(l[li].tolist(), r[ri].tolist()))
+    assert got == [
+        (5, 5),
+        (5, 5),
+        (1 << 61, 1 << 61),
+        ((1 << 61) + 3, (1 << 61) + 3),
+    ]
+
+
+def test_native_smj_matches_numpy_fuzz():
+    # seeded fuzz: native pairs == argsort-based reference on random
+    # segment-aligned sorted inputs (incl. empty segments and dup runs)
+    from hyperspace_tpu import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    rng = np.random.default_rng(7)
+    for trial in range(20):
+        n_seg = int(rng.integers(1, 9))
+        segs_l, segs_r = [], []
+        for k in range(n_seg):
+            nl, nr = int(rng.integers(0, 40)), int(rng.integers(0, 40))
+            base = k * 50
+            segs_l.append(np.sort(rng.integers(base, base + 30, nl)).astype(np.int64))
+            segs_r.append(np.sort(rng.integers(base, base + 30, nr)).astype(np.int64))
+        l = np.concatenate(segs_l) if segs_l else np.array([], dtype=np.int64)
+        r = np.concatenate(segs_r) if segs_r else np.array([], dtype=np.int64)
+        lb = np.cumsum([0] + [len(s) for s in segs_l])
+        rb = np.cumsum([0] + [len(s) for s in segs_r])
+        pairs = native.smj_pairs(l, r, lb, rb)
+        assert pairs is not None
+        li, ri = pairs
+        exp = []
+        for k in range(n_seg):
+            ls, le = lb[k], lb[k + 1]
+            rs, re = rb[k], rb[k + 1]
+            for i in range(ls, le):
+                for j in range(rs, re):
+                    if l[i] == r[j]:
+                        exp.append((int(i), int(j)))
+        got = sorted(zip(li.tolist(), ri.tolist()))
+        assert got == sorted(exp), f"trial {trial}"
 
 
 def test_segmented_fallback_when_unsorted():

@@ -94,6 +94,67 @@ def test_streaming_matches_inmemory(tmp_path):
         assert footer["bucket"] == layout.bucket_of_file(f)
 
 
+def test_host_engine_identical_to_device(tmp_path):
+    """build_partition_host is an exact twin of the device kernel: same
+    hash → same buckets, same (bucket, keys…) order, same stable ties —
+    streamed outputs are byte-identical for every engine choice."""
+    b = sample(4000, seed=5)
+    nb = 8
+    outs = {}
+    for engine in ("device", "host", "auto"):
+        outs[engine] = write_index_data_streaming(
+            chunks_of(b, 600),
+            ["orderkey", "flag"],
+            nb,
+            tmp_path / engine,
+            chunk_capacity=600,
+            engine=engine,
+        )
+    dev = bucket_contents(outs["device"])
+    assert bucket_contents(outs["host"]) == dev
+    assert bucket_contents(outs["auto"]) == dev
+    # ties: duplicate keys keep ingest order under both engines
+    dup = ColumnarBatch.from_pydict(
+        {
+            "orderkey": np.array([7, 7, 7, 7, 7, 7], dtype=np.int64),
+            "qty": np.arange(6, dtype=np.int32),
+        },
+        schema={"orderkey": "int64", "qty": "int32"},
+    )
+    d1 = write_index_data_streaming(
+        chunks_of(dup, 3), ["orderkey"], 2, tmp_path / "d1",
+        chunk_capacity=8, engine="device",
+    )
+    d2 = write_index_data_streaming(
+        chunks_of(dup, 3), ["orderkey"], 2, tmp_path / "d2",
+        chunk_capacity=8, engine="host",
+    )
+    assert bucket_contents(d1, "qty") == bucket_contents(d2, "qty")
+
+
+def test_auto_engine_probes_and_routes(tmp_path):
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    b = sample(3000, seed=9)
+    metrics.reset()
+    write_index_data_streaming(
+        chunks_of(b, 500), ["orderkey"], 4, tmp_path / "o",
+        chunk_capacity=500, engine="auto",
+    )
+    snap = metrics.snapshot()
+    # both probes ran and a winner was chosen for the remaining chunks
+    assert "build.engine.probe_device" in snap["timers_s"]
+    assert "build.engine.probe_host" in snap["timers_s"]
+    assert (
+        snap["counters"].get("build.engine.auto_chose_host", 0)
+        + snap["counters"].get("build.engine.auto_chose_device", 0)
+    ) == 1
+    total = snap["counters"].get("build.engine.host", 0) + snap["counters"].get(
+        "build.engine.device", 0
+    )
+    assert total == snap["counters"]["build.stream.chunks"]
+
+
 def test_streaming_string_key_cross_chunk_vocabs(tmp_path):
     # chunks see disjoint vocabularies; merge must re-encode onto a shared
     # vocab and keep runs sorted
