@@ -51,10 +51,12 @@ class DataFrame(EventLogging):
     def group_by(self, *columns: str) -> "GroupedData":
         """Hash-aggregate entry point: ``df.group_by("k").agg(agg_sum("v"))``
         (specs from plan.aggregates). No columns = global aggregate."""
+        from .utils import resolver
+
         out = self.plan.output_columns()
         resolved = []
         for c in columns:
-            match = next((o for o in out if o.lower() == c.lower()), None)
+            match = resolver.resolve(c, out)
             if match is None:
                 raise HyperspaceException(f"Unknown group-by column: {c}.")
             resolved.append(match)
@@ -154,6 +156,7 @@ class GroupedData:
     def agg(self, *specs) -> DataFrame:
         from .plan.aggregates import AggSpec, validate_specs
         from .plan.ir import Aggregate
+        from .utils import resolver
 
         if not specs:
             raise HyperspaceException("agg() needs at least one AggSpec.")
@@ -163,9 +166,7 @@ class GroupedData:
             if not isinstance(s, AggSpec):
                 raise HyperspaceException(f"Not an AggSpec: {s!r}.")
             if s.column is not None:
-                match = next(
-                    (o for o in out if o.lower() == s.column.lower()), None
-                )
+                match = resolver.resolve(s.column, out)
                 if match is None:
                     raise HyperspaceException(
                         f"Unknown aggregate column: {s.column}."

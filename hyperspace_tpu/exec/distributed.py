@@ -181,6 +181,288 @@ def distributed_filter(
 
 
 # ---------------------------------------------------------------------------
+# distributed two-phase aggregate
+# ---------------------------------------------------------------------------
+_dist_agg_cache: dict = {}
+
+
+def _dist_agg_fn(mesh: Mesh, cap: int, n_vals: int, want_mask: bool,
+                 bound_repr: str, bound, shim, sig: tuple):
+    """Per-device PARTIAL aggregation kernel: evaluate the predicate mask
+    (optional), then sort rows by group code and segment-reduce — sums,
+    counts, mins, maxs per distinct code — all in fixed (cap,) shapes.
+    Only the partial tables come back to the host (group count ≤ rows, so
+    the D2H volume drops from every surviving row to one row per distinct
+    group per device — the point of two-phase aggregation on a mesh)."""
+    key = (mesh, cap, n_vals, want_mask, bound_repr, sig)
+    fn = _dist_agg_cache.get(key)
+    if fn is not None:
+        return fn
+    axis = mesh.axis_names[0]
+
+    def per_shard(codes, vals, pred_arrays):
+        # codes: (cap,) int64, pads are INT64_MAX; vals: (n_vals, cap) f64
+        valid = codes != jnp.int64(_I64_PAD)
+        if want_mask:
+            valid &= eval_mask(bound, shim, pred_arrays)
+        g = jnp.where(valid, codes, jnp.int64(_I64_PAD))
+        iota = lax.iota(jnp.int64, cap)
+        g_sorted, perm = lax.sort([g, iota], num_keys=1)
+        valid_sorted = g_sorted != jnp.int64(_I64_PAD)
+        first = jnp.concatenate(
+            [jnp.ones(1, jnp.int32),
+             (g_sorted[1:] != g_sorted[:-1]).astype(jnp.int32)]
+        )
+        seg = jnp.cumsum(first) - 1  # 0..n_groups-1 (pads share the tail)
+        rep = jnp.full(cap, _I64_PAD, jnp.int64).at[seg].set(g_sorted)
+        cnt = jnp.zeros(cap, jnp.int64).at[seg].add(valid_sorted.astype(jnp.int64))
+        sums, mins, maxs = [], [], []
+        for j in range(n_vals):
+            v = vals[j][perm]
+            nanv = jnp.isnan(v)
+            ok = valid_sorted & ~nanv
+            z = jnp.where(ok, v, 0.0)
+            sums.append(jnp.zeros(cap, v.dtype).at[seg].add(z))
+            mins.append(
+                jnp.full(cap, jnp.inf, v.dtype).at[seg].min(
+                    jnp.where(ok, v, jnp.inf))
+            )
+            maxs.append(
+                jnp.full(cap, -jnp.inf, v.dtype).at[seg].max(
+                    jnp.where(ok, v, -jnp.inf))
+            )
+        nn = [
+            jnp.zeros(cap, jnp.int64).at[seg].add(
+                (valid_sorted & ~jnp.isnan(vals[j][perm])).astype(jnp.int64))
+            for j in range(n_vals)
+        ]
+        # int64 results stay int64 end to end — group codes (incl. the
+        # INT64_MAX pad) and counts cannot round-trip through float64
+        ints = jnp.stack([rep, cnt] + nn)  # (2 + n_vals, cap) int64
+        floats = (
+            jnp.stack(
+                [x for j in range(n_vals) for x in (sums[j], mins[j], maxs[j])]
+            )
+            if n_vals
+            else jnp.zeros((0, cap), jnp.float64)
+        )  # (3*n_vals, cap) float64
+        return ints, floats
+
+    def shard_fn(codes2, vals3, pred_arrays):
+        ints, floats = per_shard(
+            codes2.reshape(-1),
+            vals3.reshape(n_vals, -1) if n_vals else vals3,
+            {k: v.reshape(-1) for k, v in pred_arrays.items()},
+        )
+        return ints[None], floats[None]
+
+    spec1 = PartitionSpec(axis, None)
+    fn = jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec1, PartitionSpec(None, axis, None),
+                      {k: spec1 for k, _ in sig}),
+            out_specs=(
+                PartitionSpec(axis, None, None),
+                PartitionSpec(axis, None, None),
+            ),
+            check_vma=False,
+        )
+    )
+    if len(_dist_agg_cache) >= 64:
+        _dist_agg_cache.pop(next(iter(_dist_agg_cache)))
+    _dist_agg_cache[key] = fn
+    return fn
+
+
+def distributed_filter_aggregate(
+    by_bucket: Dict[int, ColumnarBatch],
+    predicate: Optional[Expr],
+    group_by: List[str],
+    aggs,
+    mesh: Mesh,
+) -> Optional[ColumnarBatch]:
+    """Aggregate(Filter(bucketed scan)) across the mesh in one shard_map
+    call: each device masks and PARTIALLY aggregates the buckets it owns;
+    the host merges the per-device partial tables (sum→sum, count→sum,
+    min→min, max→max, avg→sum/count) — the standard two-phase distributed
+    aggregation, with per-device work bucket-local exactly like the scan
+    and join paths. Returns None when the shape doesn't qualify (string
+    aggregate inputs or no rows) — caller falls back to gather-then-
+    aggregate."""
+    from ..ops.floatbits import f64_to_ordered_i64  # noqa: F401 (doc anchor)
+    from .aggregate import _group_codes, hash_aggregate
+
+    batches = [by_bucket[b] for b in sorted(by_bucket)]
+    if not batches:
+        return None
+    whole = ColumnarBatch.concat(batches) if len(batches) > 1 else batches[0]
+    n = whole.num_rows
+    if n == 0 or not group_by:
+        return None
+    val_cols = sorted({a.column for a in aggs if a.column is not None})
+    for c in val_cols:
+        if whole.columns[c].vocab is not None:
+            return None  # string aggregate input: min/max need vocab order
+        d = whole.columns[c].data
+        if (
+            d.dtype.kind in "iu"
+            and len(d)
+            and len(d) * float(np.abs(d).max()) >= float(1 << 53)
+        ):
+            # the device partials and their merge ride float64; a SUM that
+            # could reach the mantissa limit would silently round (the
+            # host path is exact int64) — same rows*max bound as
+            # hash_aggregate's exact_int routing
+            return None
+    pred_names = sorted(predicate.columns()) if predicate is not None else []
+    if any(whole.columns[c].dtype_str == "float64" for c in pred_names):
+        return None  # f64 predicates evaluate on host (ops.floatbits)
+
+    # group codes factorized on host (exact, multi-key); device reduces
+    codes, n_groups, rep_idx = _group_codes(whole, group_by)
+
+    D = mesh.devices.size
+    owned = group_by_owner(by_bucket, D)
+    sizes = {b: by_bucket[b].num_rows for b in by_bucket}
+    offsets = {}
+    pos = 0
+    for b in sorted(by_bucket):
+        offsets[b] = pos
+        pos += sizes[b]
+    dev_idx = []
+    for dev in owned:
+        parts = [np.arange(offsets[b], offsets[b] + sizes[b]) for b in dev]
+        dev_idx.append(
+            np.concatenate(parts) if parts else np.array([], dtype=np.int64)
+        )
+    cap = _pow2(max((len(ix) for ix in dev_idx), default=1))
+
+    codes2 = np.full((D, cap), _I64_PAD, dtype=np.int64)
+    for d, ix in enumerate(dev_idx):
+        codes2[d, : len(ix)] = codes[ix]
+    vals3 = np.zeros((max(len(val_cols), 1), D, cap), dtype=np.float64)
+    for j, c in enumerate(val_cols):
+        data = whole.columns[c].data.astype(np.float64)
+        for d, ix in enumerate(dev_idx):
+            vals3[j, d, : len(ix)] = data[ix]
+
+    bound = None
+    shim = None
+    packed_pred: Dict[str, np.ndarray] = {}
+    if predicate is not None:
+        bound = bind_string_literals(predicate, whole)
+        shim = ColumnarBatch(
+            {
+                name: Column(
+                    "int32" if whole.columns[name].vocab is not None
+                    else whole.columns[name].dtype_str,
+                    np.empty(
+                        0,
+                        dtype=np.int32
+                        if whole.columns[name].vocab is not None
+                        else whole.columns[name].data.dtype,
+                    ),
+                )
+                for name in pred_names
+            }
+        )
+        for name in pred_names:
+            data = whole.columns[name].data
+            out = np.zeros((D, cap), dtype=data.dtype)
+            for d, ix in enumerate(dev_idx):
+                out[d, : len(ix)] = data[ix]
+            packed_pred[name] = out
+    sig = tuple((name, str(packed_pred[name].dtype)) for name in pred_names)
+
+    fn = _dist_agg_fn(
+        mesh, cap, len(val_cols), predicate is not None,
+        repr(bound), bound, shim, sig,
+    )
+    axis = mesh.axis_names[0]
+    sh1 = NamedSharding(mesh, PartitionSpec(axis, None))
+    sh3 = NamedSharding(mesh, PartitionSpec(None, axis, None))
+    ints_out, floats_out = fn(
+        jax.device_put(codes2, sh1),
+        jax.device_put(vals3, sh3),
+        {k: jax.device_put(v, sh1) for k, v in packed_pred.items()},
+    )
+    ints_out = np.asarray(ints_out)  # (D, 2 + n_vals, cap) int64
+    floats_out = np.asarray(floats_out)  # (D, 3*n_vals, cap) float64
+    metrics.incr("aggregate.path.distributed")
+
+    # merge partial tables on host: rebuild a row-per-(device, group) batch
+    # and aggregate it with merge semantics
+    rep_codes = ints_out[:, 0, :].reshape(-1)
+    keep = rep_codes != _I64_PAD
+    rep_codes = rep_codes[keep]
+    cnts = ints_out[:, 1, :].reshape(-1)[keep]
+    partial_cols: Dict[str, Column] = {
+        "__g": Column("int64", rep_codes),
+        "__cnt": Column("int64", cnts),
+    }
+    for j, c in enumerate(val_cols):
+        partial_cols[f"__sum_{c}"] = Column(
+            "float64", floats_out[:, 3 * j, :].reshape(-1)[keep]
+        )
+        mn = floats_out[:, 3 * j + 1, :].reshape(-1)[keep]
+        mx = floats_out[:, 3 * j + 2, :].reshape(-1)[keep]
+        partial_cols[f"__min_{c}"] = Column(
+            "float64", np.where(np.isinf(mn), np.nan, mn)
+        )
+        partial_cols[f"__max_{c}"] = Column(
+            "float64", np.where(np.isinf(mx), np.nan, mx)
+        )
+        partial_cols[f"__nn_{c}"] = Column(
+            "int64", ints_out[:, 2 + j, :].reshape(-1)[keep]
+        )
+    from ..plan.aggregates import AggSpec
+
+    merge_specs = [AggSpec("sum", "__cnt", "__rows")]
+    for c in val_cols:
+        merge_specs += [
+            AggSpec("sum", f"__sum_{c}", f"__S_{c}"),
+            AggSpec("min", f"__min_{c}", f"__m_{c}"),
+            AggSpec("max", f"__max_{c}", f"__M_{c}"),
+            AggSpec("sum", f"__nn_{c}", f"__N_{c}"),
+        ]
+    merged = hash_aggregate(
+        ColumnarBatch(partial_cols), ["__g"], merge_specs
+    )
+    # final projection per requested spec, keyed back to representative rows
+    g_final = merged.columns["__g"].data
+    key_batch = whole.select(list(group_by)).take(rep_idx[g_final])
+    result: Dict[str, Column] = dict(key_batch.columns)
+    from ..plan.aggregates import output_dtype
+    from ..storage.columnar import numpy_dtype as _npdt
+
+    schema = whole.schema()
+    for a in aggs:
+        dt = output_dtype(a, schema.get(a.column) if a.column else None)
+        if a.fn == "count":
+            src = (
+                merged.columns["__rows"].data
+                if a.column is None
+                else merged.columns[f"__N_{a.column}"].data
+            )
+            result[a.name] = Column("int64", src.astype(np.int64))
+        elif a.fn == "sum":
+            result[a.name] = Column(
+                dt, merged.columns[f"__S_{a.column}"].data.astype(_npdt(dt))
+            )
+        elif a.fn == "avg":
+            s = merged.columns[f"__S_{a.column}"].data
+            nn = merged.columns[f"__N_{a.column}"].data
+            with np.errstate(invalid="ignore", divide="ignore"):
+                result[a.name] = Column("float64", s / nn)
+        else:
+            col = merged.columns[f"__{'m' if a.fn == 'min' else 'M'}_{a.column}"]
+            result[a.name] = Column(dt, col.data.astype(_npdt(dt)))
+    return ColumnarBatch(result)
+
+
+# ---------------------------------------------------------------------------
 # distributed bucketed join
 # ---------------------------------------------------------------------------
 _dist_join_cache: dict = {}

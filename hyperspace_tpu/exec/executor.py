@@ -177,6 +177,10 @@ class Executor:
         if isinstance(plan, Aggregate):
             from .aggregate import hash_aggregate
 
+            if self.mesh is not None:
+                fused = self._try_distributed_aggregate(plan)
+                if fused is not None:
+                    return self._apply_predicate(fused, predicate)
             need = list(
                 dict.fromkeys(
                     list(plan.group_by)
@@ -285,6 +289,97 @@ class Executor:
         return distributed_filter(
             by_bucket, predicate, list(node.required_columns), self.mesh
         )
+
+    def _try_distributed_aggregate(self, plan: "Aggregate") -> Optional[ColumnarBatch]:
+        """Fuse Aggregate([Project][Filter](IndexScan)) into one mesh call:
+        per-device mask + PARTIAL aggregation, host merge of the partial
+        tables (exec.distributed.distributed_filter_aggregate). Only small
+        partials leave the devices — the two-phase distributed aggregate.
+        Returns None when the shape or dtypes don't qualify; the caller
+        falls back to gather-then-aggregate."""
+        from pathlib import Path
+
+        from ..plan.ir import Aggregate as _Agg  # noqa: F401 (shape doc)
+        from .distributed import distributed_filter_aggregate
+        from .scan import prune_index_files
+
+        from ..telemetry.metrics import metrics
+
+        from .aggregate import hash_aggregate
+
+        node = plan.child
+        pred = None
+        if isinstance(node, Project):
+            node = node.child
+        if isinstance(node, Filter):
+            pred = node.condition
+            node = node.child
+            if isinstance(node, Project):
+                node = node.child
+        if not isinstance(node, IndexScan):
+            return None
+        entry = node.entry
+        group_by = list(plan.group_by)
+        aggs = list(plan.aggs)
+        need = list(
+            dict.fromkeys(
+                group_by
+                + [a.column for a in aggs if a.column is not None]
+                + (sorted(pred.columns()) if pred is not None else [])
+            )
+        )
+        # dtype disqualifications are decidable from the logged schema —
+        # bail BEFORE paying any IO (string agg inputs need vocab-order
+        # min/max; f64 predicates evaluate on host)
+        if not group_by or any(c not in entry.schema for c in need):
+            return None
+        if any(
+            entry.schema[a.column] == "string" for a in aggs if a.column
+        ):
+            return None
+        if pred is not None and any(
+            entry.schema[c] == "float64" for c in pred.columns()
+        ):
+            return None
+        files = [Path(p) for p in self._index_files(node)]
+        if pred is not None:
+            files = prune_index_files(
+                files, pred, entry.indexed_columns, entry.schema, entry.num_buckets
+            )
+        if not files:
+            from .scan import empty_batch_for
+
+            empty = empty_batch_for(need, entry.schema)
+            if empty is None:
+                return None
+            return hash_aggregate(empty, group_by, aggs)
+        metrics.incr("scan.files_read", len(files))
+        batches = layout.read_batches(files, columns=need)
+        by_bucket = self._group_batches_by_bucket(files, batches)
+
+        def host_finish() -> ColumnarBatch:
+            # the data is already in hand — never re-read from disk just
+            # because the mesh path declined
+            if not by_bucket:
+                empty = ColumnarBatch.empty(
+                    {c: entry.schema[c] for c in need}
+                )
+                return hash_aggregate(empty, group_by, aggs)
+            whole = ColumnarBatch.concat(
+                [by_bucket[b] for b in sorted(by_bucket)]
+            )
+            whole = self._apply_predicate(whole, pred)
+            return hash_aggregate(whole, group_by, aggs)
+
+        if not by_bucket:
+            return host_finish()
+        total_rows = sum(b.num_rows for b in by_bucket.values())
+        if total_rows < self.dist_min_rows:
+            return host_finish()
+        fused = distributed_filter_aggregate(
+            by_bucket, pred, group_by, aggs, self.mesh
+        )
+        return fused if fused is not None else host_finish()
 
     # -- joins ---------------------------------------------------------------
     def _exec_join(self, join: Join) -> ColumnarBatch:

@@ -25,6 +25,7 @@ _SRC = Path(__file__).resolve().parent.parent.parent / "native" / "tcb_io.cc"
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_FAILED = False
+_HAS_SMJ = False
 
 
 def _build_dir() -> Path:
@@ -101,15 +102,23 @@ def _bind_symbols(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p,
         ctypes.c_int64,
     ]
-    i64p = ctypes.POINTER(ctypes.c_int64)
-    lib.hs_smj_ranges.restype = ctypes.c_int64
-    lib.hs_smj_ranges.argtypes = [
-        i64p, i64p, i64p, i64p, ctypes.c_int32, i64p, i64p, ctypes.c_int32,
-    ]
-    lib.hs_expand_pairs.restype = None
-    lib.hs_expand_pairs.argtypes = [
-        i64p, i64p, i64p, ctypes.c_int64, i64p, i64p, ctypes.c_int32,
-    ]
+    # Newer symbols bind under their own guard: a stale prebuilt .so that
+    # predates them must lose only the features they serve (smj_pairs
+    # returns None), never the proven pread/write fast paths.
+    global _HAS_SMJ
+    try:
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.hs_smj_ranges.restype = ctypes.c_int64
+        lib.hs_smj_ranges.argtypes = [
+            i64p, i64p, i64p, i64p, ctypes.c_int32, i64p, i64p, ctypes.c_int32,
+        ]
+        lib.hs_expand_pairs.restype = None
+        lib.hs_expand_pairs.argtypes = [
+            i64p, i64p, i64p, ctypes.c_int64, i64p, i64p, ctypes.c_int32,
+        ]
+        _HAS_SMJ = True
+    except AttributeError:
+        _HAS_SMJ = False
 
 
 def _i64ptr(a: np.ndarray):
@@ -204,7 +213,7 @@ def smj_pairs(
     is unavailable (caller falls back to the numpy path). O(n+m) two-
     pointer walk, parallel over segments, GIL released."""
     lib = _load()
-    if lib is None:
+    if lib is None or not _HAS_SMJ:
         return None
     l = np.ascontiguousarray(l_codes, dtype=np.int64)
     r = np.ascontiguousarray(r_codes, dtype=np.int64)
