@@ -42,6 +42,27 @@ from ..telemetry.metrics import metrics
 
 SPILL_DIR_NAME = ".spill"
 
+# Per-process memo of the auto engine probe's winner ("device" | "host"),
+# keyed by (JAX backend platform, padded chunk capacity). The probe
+# measures the host↔device LINK as much as the kernels — a property of the
+# process's runtime — so later builds skip straight to the measured winner
+# instead of re-paying a full device round trip (and its compile) per
+# index. Capacity stays in the key because the device/host ratio flips
+# with chunk size (host sort is O(n log n) on real rows, device D2H scales
+# with the padded capacity); capacities are already power-of-two quantized
+# so the memo stays small.
+_ENGINE_CACHE: Dict[tuple, str] = {}
+
+
+def _engine_cache_key(chunk_capacity: int) -> tuple:
+    try:
+        import jax
+
+        platform = jax.default_backend()
+    except Exception:  # noqa: BLE001 - cache key only
+        platform = "unknown"
+    return (platform, chunk_capacity)
+
 
 def sort_encoding(col: Column) -> np.ndarray:
     """An integer array whose ascending order equals the device sort order
@@ -140,6 +161,9 @@ class StreamingIndexWriter:
         host timed, every later chunk on the measured winner."""
         if self._engine in ("device", "host"):
             return self._engine
+        cached = _ENGINE_CACHE.get(_engine_cache_key(self.chunk_capacity))
+        if cached is not None:
+            return cached
         ci = len(self._chunk_times)
         if ci == 0:
             return "device"
@@ -147,17 +171,21 @@ class StreamingIndexWriter:
             return "probe-device"
         if ci == 2:
             return "probe-host"
+        return self._decide_winner()
+
+    def _decide_winner(self) -> str:
+        """Pick (and memoize) the probed winner. Called from routing AND
+        right after the host probe lands — a short build (≤3 chunks) must
+        still publish its measurement for the next build in this process."""
         if "winner" not in self._probe:
             dev = self._probe.get("device_s")
             host = self._probe.get("host_s")
             self._probe["winner"] = (
                 1.0 if host is not None and (dev is None or host < dev) else 0.0
             )
-            metrics.incr(
-                "build.engine.auto_chose_host"
-                if self._probe["winner"]
-                else "build.engine.auto_chose_device"
-            )
+            choice = "host" if self._probe["winner"] else "device"
+            _ENGINE_CACHE[_engine_cache_key(self.chunk_capacity)] = choice
+            metrics.incr(f"build.engine.auto_chose_{choice}")
         return "host" if self._probe["winner"] else "device"
 
     def _spill_run(self, sorted_batch: ColumnarBatch, counts: np.ndarray) -> None:
@@ -281,6 +309,7 @@ class StreamingIndexWriter:
                     metrics.record_time(
                         "build.engine.probe_host", self._probe["host_s"]
                     )
+                    self._decide_winner()  # publish even if no chunks remain
                     finish = lambda r=result: r  # noqa: E731
                 else:
                     # the host sort runs on the spill thread, overlapping

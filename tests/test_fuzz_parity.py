@@ -129,6 +129,99 @@ def test_filter_parity_fuzz(tmp_path, seed):
     assert checked >= 1, "vacuous seed: no parity check ran"
 
 
+@pytest.mark.parametrize("seed", range(8))
+def test_aggregate_parity_fuzz(tmp_path, seed):
+    """Randomized group-by aggregates: off/on index parity AND a pandas
+    cross-check of the aggregate itself (random keys incl. strings,
+    random fns over int/float inputs with NaNs in f64)."""
+    import pandas as pd
+
+    from hyperspace_tpu.plan.aggregates import (
+        agg_avg, agg_count, agg_max, agg_min, agg_sum,
+    )
+
+    rng = np.random.default_rng(7000 + seed)
+    n = int(rng.integers(100, 3000))
+    batch = random_batch(rng, n)
+    if rng.random() < 0.4:  # sprinkle NaNs into the f64 aggregate input
+        d = batch.columns["f64"].data.copy()
+        d[rng.random(n) < 0.1] = np.nan
+        batch = ColumnarBatch({**batch.columns, "f64": Column.from_values(d)})
+    src = tmp_path / "src"
+    src.mkdir()
+    parquet_io.write_parquet(src / "p0.parquet", batch)
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
+            C.INDEX_NUM_BUCKETS: int(rng.choice([2, 8, 16])),
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    keys = list(
+        rng.choice(["k_small", "s", "k_int"], size=int(rng.integers(1, 3)), replace=False)
+    )
+    val = str(rng.choice(["f64", "k_int", "f32"]))
+    hs.create_index(
+        session.read.parquet(str(src)),
+        IndexConfig("az", [keys[0]], [c for c in batch.column_names if c != keys[0]]),
+    )
+    specs = [agg_count(), agg_sum(val, "S"), agg_min(val, "m"),
+             agg_max(val, "M"), agg_avg(val, "A")]
+    pred = random_predicate(rng, batch, allowed_cols=batch.column_names)
+    q = (
+        session.read.parquet(str(src))
+        .filter(pred)
+        .group_by(*keys)
+        .agg(*specs)
+    )
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    on = q.collect()
+    odf = off.to_pandas().sort_values(keys).reset_index(drop=True)
+    ndf = on.to_pandas().sort_values(keys).reset_index(drop=True)
+    assert len(odf) == len(ndf), seed
+    for c in odf.columns:
+        if odf[c].dtype.kind == "f":
+            np.testing.assert_allclose(
+                odf[c].to_numpy().astype(float),
+                ndf[c].to_numpy().astype(float),
+                rtol=1e-9, equal_nan=True, err_msg=str((seed, c)),
+            )
+        else:
+            assert (odf[c].fillna("§") == ndf[c].fillna("§")).all(), (seed, c)
+    # pandas oracle: same predicate via eval_mask, pandas groupby-agg
+    from hyperspace_tpu.plan.expr import eval_mask
+
+    masked = batch.take(np.flatnonzero(np.asarray(eval_mask(pred, batch))))
+    base = masked.to_pandas()
+    # the engine accumulates float32 sums in float64; make pandas do the
+    # same so the oracle differs only by accumulation order (~1e-16 rel)
+    base[val] = base[val].astype(np.float64)
+    if len(base):
+        ref = (
+            base.groupby(keys, dropna=False)
+            .agg(
+                count=(val, "size"), S=(val, "sum"), m=(val, "min"),
+                M=(val, "max"), A=(val, "mean"),
+            )
+            .reset_index()
+            .sort_values(keys)
+            .reset_index(drop=True)
+        )
+        assert len(ref) == len(odf), seed
+        for oc in ("S", "m", "M", "A"):
+            np.testing.assert_allclose(
+                odf[oc].to_numpy().astype(float),
+                ref[oc].to_numpy().astype(float),
+                rtol=1e-9, equal_nan=True, err_msg=str((seed, oc)),
+            )
+        assert (odf["count"] == ref["count"]).all(), seed
+    else:
+        assert len(odf) == 0, seed
+
+
 @pytest.mark.parametrize("seed", range(6))
 def test_join_parity_fuzz(tmp_path, seed):
     rng = np.random.default_rng(5000 + seed)

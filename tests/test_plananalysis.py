@@ -103,3 +103,105 @@ def test_explain_console_mode(env):
     session.conf.set(C.DISPLAY_MODE, "console")
     text = hs.explain(q)
     assert "\x1b[42m" in text and "\x1b[0m" in text
+
+
+def test_explain_golden_filter(env, tmp_path):
+    """Golden plaintext explain for a filter rewrite — the exact layout the
+    reference's ExplainTest pins per display mode (SURVEY.md §4). Paths
+    are normalized so the golden string is machine-independent."""
+    session, hs, src = env
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("gidx", ["orderkey"], ["qty"]))
+    q = (
+        session.read.parquet(str(src))
+        .filter(col("orderkey") == 5)
+        .select("orderkey", "qty")
+    )
+    text = hs.explain(q).replace(str(tmp_path), "<root>")
+    # every line whose SUBTREE differs is highlighted — the swap at the
+    # leaf marks the whole enclosing chain, as in PlanAnalyzer's queue-walk
+    golden = """\
+=============================================================
+Plan with indexes:
+=============================================================
+<----Project [orderkey, qty]---->
+  <----Filter [(col(orderkey) eq lit(5))]---->
+    <----IndexScan Hyperspace(Type: CI, Name: gidx, LogVersion: 1) [orderkey, qty]---->
+
+=============================================================
+Plan without indexes:
+=============================================================
+<----Project [orderkey, qty]---->
+  <----Filter [(col(orderkey) eq lit(5))]---->
+    <----Scan [parquet:<root>/data] (1 files)---->
+
+=============================================================
+Indexes used:
+=============================================================
+gidx:<root>/indexes/gidx/v__=0
+
+"""
+    assert text == golden
+
+
+def test_explain_golden_join_verbose_sections(env, tmp_path):
+    """Join rewrite explain: both sides highlighted as index scans, both
+    indexes listed, and the verbose operator table counts the swap."""
+    session, hs, src = env
+    rng = np.random.default_rng(1)
+    right = ColumnarBatch.from_pydict(
+        {
+            "o_key": rng.permutation(100).astype(np.int64),
+            "o_val": rng.integers(0, 9, 100).astype(np.int64),
+        },
+        schema={"o_key": "int64", "o_val": "int64"},
+    )
+    rsrc = src.parent / "orders"
+    rsrc.mkdir()
+    parquet_io.write_parquet(rsrc / "part-0.parquet", right)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("jl", ["orderkey"], ["qty"])
+    )
+    hs.create_index(
+        session.read.parquet(str(rsrc)), IndexConfig("jr", ["o_key"], ["o_val"])
+    )
+    q = (
+        session.read.parquet(str(src))
+        .join(session.read.parquet(str(rsrc)), col("orderkey") == col("o_key"))
+        .select("qty", "o_val")
+    )
+    text = hs.explain(q, verbose=True).replace(str(tmp_path), "<root>")
+    assert (
+        "<----IndexScan Hyperspace(Type: CI, Name: jl, LogVersion: 1) "
+        "[orderkey, qty] bucketed---->" in text
+    )
+    assert (
+        "<----IndexScan Hyperspace(Type: CI, Name: jr, LogVersion: 1) "
+        "[o_key, o_val] bucketed---->" in text
+    )
+    assert "jl:<root>/indexes/jl/v__=0" in text
+    assert "jr:<root>/indexes/jr/v__=0" in text
+    # verbose operator table: two Scans swapped for two IndexScans
+    assert "Physical operator stats:" in text
+    import re
+
+    def row(op):
+        m = re.search(rf"^{op}\s+(-?\d+)\s+(-?\d+)\s+(-?\d+)\s*$", text, re.M)
+        assert m, f"operator row {op} missing:\n{text}"
+        return tuple(int(g) for g in m.groups())
+
+    assert row("IndexScan") == (2, 0, 2)
+    assert row("Scan") == (0, 2, -2)
+    assert row("Join")[2] == 0
+    assert "Engine metrics (cumulative, this process):" in text
+
+
+def test_explain_no_indexes_section_empty(env, tmp_path):
+    """No applicable index: plans identical (nothing highlighted), empty
+    'Indexes used'."""
+    session, hs, src = env
+    q = session.read.parquet(str(src)).filter(col("qty") == 1)
+    text = hs.explain(q).replace(str(tmp_path), "<root>")
+    assert "<----" not in text
+    tail = text.split("Indexes used:")[1]
+    assert tail.strip("=\n ") == ""

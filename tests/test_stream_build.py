@@ -133,26 +133,49 @@ def test_host_engine_identical_to_device(tmp_path):
 
 
 def test_auto_engine_probes_and_routes(tmp_path):
+    from hyperspace_tpu.index import stream_builder as sb
     from hyperspace_tpu.telemetry.metrics import metrics
 
     b = sample(3000, seed=9)
     metrics.reset()
-    write_index_data_streaming(
-        chunks_of(b, 500), ["orderkey"], 4, tmp_path / "o",
-        chunk_capacity=500, engine="auto",
-    )
-    snap = metrics.snapshot()
-    # both probes ran and a winner was chosen for the remaining chunks
-    assert "build.engine.probe_device" in snap["timers_s"]
-    assert "build.engine.probe_host" in snap["timers_s"]
-    assert (
-        snap["counters"].get("build.engine.auto_chose_host", 0)
-        + snap["counters"].get("build.engine.auto_chose_device", 0)
-    ) == 1
-    total = snap["counters"].get("build.engine.host", 0) + snap["counters"].get(
-        "build.engine.device", 0
-    )
-    assert total == snap["counters"]["build.stream.chunks"]
+    sb._ENGINE_CACHE.clear()  # force a fresh probe (memoized per process)
+    try:
+        write_index_data_streaming(
+            chunks_of(b, 500), ["orderkey"], 4, tmp_path / "o",
+            chunk_capacity=500, engine="auto",
+        )
+        snap = metrics.snapshot()
+        # both probes ran and a winner was chosen for the remaining chunks
+        assert "build.engine.probe_device" in snap["timers_s"]
+        assert "build.engine.probe_host" in snap["timers_s"]
+        assert (
+            snap["counters"].get("build.engine.auto_chose_host", 0)
+            + snap["counters"].get("build.engine.auto_chose_device", 0)
+        ) == 1
+        total = snap["counters"].get("build.engine.host", 0) + snap[
+            "counters"
+        ].get("build.engine.device", 0)
+        assert total == snap["counters"]["build.stream.chunks"]
+        # the winner is memoized PER (platform, capacity): a second auto
+        # build at the same capacity probes nothing ...
+        metrics.reset()
+        write_index_data_streaming(
+            chunks_of(b, 500), ["orderkey"], 4, tmp_path / "o2",
+            chunk_capacity=500, engine="auto",
+        )
+        snap2 = metrics.snapshot()
+        assert "build.engine.probe_device" not in snap2["timers_s"]
+        assert "build.engine.probe_host" not in snap2["timers_s"]
+        # ... while a different chunk capacity re-probes (the device/host
+        # ratio flips with chunk size, so the memo must not cross over)
+        metrics.reset()
+        write_index_data_streaming(
+            chunks_of(b, 250), ["orderkey"], 4, tmp_path / "o3",
+            chunk_capacity=250, engine="auto",
+        )
+        assert "build.engine.probe_device" in metrics.snapshot()["timers_s"]
+    finally:
+        sb._ENGINE_CACHE.clear()
 
 
 def test_streaming_string_key_cross_chunk_vocabs(tmp_path):
