@@ -187,6 +187,7 @@ class CreateActionBase:
             num_buckets,
             version_dir,
             mesh=self.session.mesh,
+            engine=self.conf.build_engine(),
             extra_meta=extra_meta,
         )
 

@@ -204,6 +204,7 @@ class RefreshIncrementalAction(RefreshActionBase):
                 write_index_data(
                     batch, indexed, self.num_buckets, version_dir,
                     mesh=self.session.mesh,
+                    engine=self.conf.build_engine(),
                 )
             )
 

@@ -52,7 +52,9 @@ _I64_PAD = np.iinfo(np.int64).max
 
 
 def _pow2(n: int) -> int:
-    return 1 << (n - 1).bit_length() if n > 1 else 1
+    from ..utils.intmath import next_pow2
+
+    return next_pow2(n)
 
 
 def group_by_owner(

@@ -50,10 +50,13 @@ def write_index_data(
     out_dir: str | Path,
     mesh=None,
     extra_meta: Optional[dict] = None,
+    engine: str = "auto",
 ) -> List[Path]:
     """Partition+sort ``batch`` and write one TCB file per non-empty bucket
     into ``out_dir``. Returns written paths. ``mesh`` selects the sharded
-    (ICI all_to_all) path; None uses the single-device kernel."""
+    (ICI all_to_all) path; None routes between the single-device kernel
+    and its host twin (``engine``: device | host | auto — see
+    _route_inmemory_engine)."""
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     written: List[Path] = []
@@ -83,12 +86,40 @@ def write_index_data(
             for s, e in zip(starts, ends):
                 write_bucket(int(bucket_ids[s]), dev_batch.take(np.arange(s, e)))
     else:
-        from ..ops.build import build_partition_single
+        if _route_inmemory_engine(engine, batch.num_rows) == "host":
+            from ..ops.build import build_partition_host
 
-        sorted_batch, counts = build_partition_single(batch, indexed_cols, num_buckets)
+            metrics.incr("build.engine.host")
+            sorted_batch, counts = build_partition_host(
+                batch, indexed_cols, num_buckets
+            )
+        else:
+            from ..ops.build import build_partition_single
+
+            metrics.incr("build.engine.device")
+            sorted_batch, counts = build_partition_single(
+                batch, indexed_cols, num_buckets
+            )
         offsets = np.concatenate([[0], np.cumsum(counts)])
         for b in range(num_buckets):
             s, e = int(offsets[b]), int(offsets[b + 1])
             if e > s:
                 write_bucket(b, sorted_batch.take(np.arange(s, e)))
     return sorted(written)
+
+
+# In-memory builds run ONE kernel launch, so a fresh XLA compile (tens of
+# seconds on TPU) cannot amortize the way the streaming build's per-chunk
+# executable does — and build_partition_single traces a fresh jit closure
+# per call, so not even a same-shape repeat reuses the executable. Below
+# this many rows the host twin is therefore the sure win; above it the
+# device sort's throughput can cover the compile. (The streaming probe
+# cache deliberately does NOT override here: its measurements come from a
+# warm per-chunk executable, a premise one-shot builds don't share.)
+INMEMORY_HOST_MAX_ROWS = 1 << 22
+
+
+def _route_inmemory_engine(engine: str, n_rows: int) -> str:
+    if engine in ("device", "host"):
+        return engine
+    return "host" if n_rows < INMEMORY_HOST_MAX_ROWS else "device"

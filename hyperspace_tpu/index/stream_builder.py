@@ -126,7 +126,9 @@ class StreamingIndexWriter:
         self.out_dir = Path(out_dir)
         # pad to a power of two: lax.sort shapes stay friendly and every
         # chunk <= capacity hits the same executable
-        self.chunk_capacity = 1 << (chunk_capacity - 1).bit_length()
+        from ..utils.intmath import next_pow2
+
+        self.chunk_capacity = next_pow2(chunk_capacity)
         self.extra_meta = extra_meta
         self.mesh = mesh
         # chunk engine: device | host | auto (probe chunks 1 and 2 — past

@@ -154,7 +154,9 @@ def build_partition_single(
     the next chunk's H2D + compute."""
     dtypes = batch.schema()
     n = batch.num_rows
-    n_pad = pad_to if pad_to is not None else (1 << (n - 1).bit_length() if n > 1 else 1)
+    from ..utils.intmath import next_pow2
+
+    n_pad = pad_to if pad_to is not None else next_pow2(n)
     if n_pad < n:
         raise HyperspaceException(f"pad_to={n_pad} smaller than batch rows {n}.")
     arrays = {
@@ -222,7 +224,17 @@ def build_partition_host(
     encs = [sort_encoding(batch.columns[k]) for k in key_names]
     order = np.lexsort(tuple(reversed(encs)) + (bucket,))
     counts = np.bincount(bucket, minlength=num_buckets).astype(np.int64)
-    return batch.take(order), counts
+    out = batch.take(order)
+    for name, col in out.columns.items():
+        if col.dtype_str == "float64":
+            # the float64 transport encoding canonicalizes -0.0 to +0.0
+            # (ops.floatbits; only f64 crosses the device encoded — f32
+            # travels raw and keeps its sign bit on both engines); the
+            # twin must produce identical bytes
+            out.columns[name] = Column(
+                col.dtype_str, np.where(col.data == 0.0, 0.0, col.data)
+            )
+    return out, counts
 
 
 # ---------------------------------------------------------------------------

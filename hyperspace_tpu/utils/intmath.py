@@ -1,0 +1,11 @@
+"""Shared integer helpers."""
+
+from __future__ import annotations
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (1 for n <= 1). The ONE quantization rule
+    shared by chunk padding, in-memory padding, the engine-probe cache key,
+    and the mesh packers — these must agree or cache lookups and executable
+    reuse silently miss."""
+    return 1 << (n - 1).bit_length() if n > 1 else 1

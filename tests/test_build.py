@@ -101,10 +101,11 @@ def test_sharded_build_matches_single(tmp_path):
     assert sorted(all_keys) == sorted(b.columns["orderkey"].data.tolist())
 
 
-def test_write_index_data_and_scan_row_parity(tmp_path):
+@pytest.mark.parametrize("engine", ["device", "host"])
+def test_write_index_data_and_scan_row_parity(tmp_path, engine):
     b = sample(1500, seed=3)
     nb = 8
-    files = write_index_data(b, ["orderkey"], nb, tmp_path / "v__=0")
+    files = write_index_data(b, ["orderkey"], nb, tmp_path / "v__=0", engine=engine)
     assert files
     for f in files:
         footer = layout.read_footer(f)
@@ -186,7 +187,7 @@ def test_sharded_write_index_data(tmp_path):
     b = sample(500, seed=9)
     mesh = make_mesh(8)
     files = write_index_data(b, ["orderkey"], 16, tmp_path / "v", mesh=mesh)
-    single = write_index_data(b, ["orderkey"], 16, tmp_path / "v1")
+    single = write_index_data(b, ["orderkey"], 16, tmp_path / "v1", engine="device")
     # same buckets, same per-bucket contents
     def contents(fs):
         out = {}
@@ -198,7 +199,8 @@ def test_sharded_write_index_data(tmp_path):
     assert contents(files) == contents(single)
 
 
-def test_float64_exact_through_build(tmp_path):
+@pytest.mark.parametrize("engine", ["device", "host"])
+def test_float64_exact_through_build(tmp_path, engine):
     # float64 must survive the build bit-exactly (ops.floatbits transport);
     # includes negatives, -0.0, tiny/huge magnitudes.
     vals = np.array(
@@ -212,7 +214,7 @@ def test_float64_exact_through_build(tmp_path):
         {"k": rng.integers(0, 50, n).astype(np.int64), "price": price},
         schema={"k": "int64", "price": "float64"},
     )
-    files = write_index_data(b, ["k"], 8, tmp_path / "v")
+    files = write_index_data(b, ["k"], 8, tmp_path / "v", engine=engine)
     got = index_scan(files, ["price"])
     got_sorted = np.sort(got.columns["price"].data)
     exp_sorted = np.sort(np.where(price == 0.0, 0.0, price))
@@ -221,7 +223,8 @@ def test_float64_exact_through_build(tmp_path):
     )
 
 
-def test_float64_as_indexed_key(tmp_path):
+@pytest.mark.parametrize("engine", ["device", "host"])
+def test_float64_as_indexed_key(tmp_path, engine):
     from hyperspace_tpu.ops.floatbits import (
         f64_to_ordered_i64,
         ordered_i64_to_f64,
@@ -239,7 +242,7 @@ def test_float64_as_indexed_key(tmp_path):
     price[7] = 42.125
     b = ColumnarBatch.from_pydict({"price": price, "v": np.arange(500, dtype=np.int64)},
                                   schema={"price": "float64", "v": "int64"})
-    files = write_index_data(b, ["price"], 4, tmp_path / "v")
+    files = write_index_data(b, ["price"], 4, tmp_path / "v", engine=engine)
     got = index_scan(files, ["v"], col("price") == 42.125,
                      indexed_columns=["price"], dtypes=b.schema(), num_buckets=4)
     expected = np.flatnonzero(price == 42.125)

@@ -178,6 +178,29 @@ def test_auto_engine_probes_and_routes(tmp_path):
         sb._ENGINE_CACHE.clear()
 
 
+def test_inmemory_engine_routing_and_parity(tmp_path):
+    """The in-memory (single-launch) build routes small batches to the
+    host twin by default — one kernel launch cannot amortize a fresh XLA
+    compile — and both engines write byte-identical buckets."""
+    from hyperspace_tpu.index import builder
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    b = sample(3000, seed=21)
+    # auto below the threshold → host
+    metrics.reset()
+    auto = write_index_data(b, ["orderkey"], 8, tmp_path / "auto")
+    assert metrics.snapshot()["counters"].get("build.engine.host") == 1
+    metrics.reset()
+    forced = write_index_data(
+        b, ["orderkey"], 8, tmp_path / "dev", engine="device"
+    )
+    assert metrics.snapshot()["counters"].get("build.engine.device") == 1
+    assert bucket_contents(auto) == bucket_contents(forced)
+    # above the threshold, one launch can amortize the compile → device
+    assert builder._route_inmemory_engine("auto", 1 << 23) == "device"
+    assert builder._route_inmemory_engine("host", 1 << 23) == "host"
+
+
 def test_streaming_string_key_cross_chunk_vocabs(tmp_path):
     # chunks see disjoint vocabularies; merge must re-encode onto a shared
     # vocab and keep runs sorted
