@@ -122,4 +122,8 @@ INMEMORY_HOST_MAX_ROWS = 1 << 22
 def _route_inmemory_engine(engine: str, n_rows: int) -> str:
     if engine in ("device", "host"):
         return engine
+    if engine != "auto":
+        raise HyperspaceException(
+            f"Unknown build engine {engine!r}; expected device, host, or auto."
+        )
     return "host" if n_rows < INMEMORY_HOST_MAX_ROWS else "device"

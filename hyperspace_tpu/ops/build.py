@@ -327,6 +327,167 @@ def _sharded_build_fn(
     return fn
 
 
+def _exchange_cap(
+    host_dest: np.ndarray, shard_rows: int, n: int, n_shards: int, D: int
+) -> int:
+    """Max rows any one source shard sends to any one destination device —
+    the static all_to_all block capacity, shared by the single-controller
+    and multihost packers (they must agree or executables stop caching)."""
+    cap = 1
+    for s in range(n_shards):
+        seg = host_dest[s * shard_rows : min((s + 1) * shard_rows, n)]
+        if seg.size:
+            cap = max(cap, int(np.bincount(seg, minlength=D).max()))
+    return cap
+
+
+# jitted consensus/reduction programs per (mesh, D, num_buckets) — fresh
+# jit(lambda) objects would re-trace on every build (jit caches on the
+# function object), so they are built once and reused
+_mh_reduce_cache: Dict[tuple, dict] = {}
+
+
+def _mh_reducers(mesh: Mesh, axis: str, D: int, num_buckets: int) -> dict:
+    key = (mesh, axis, D, num_buckets)
+    out = _mh_reduce_cache.get(key)
+    if out is not None:
+        return out
+    replicated = NamedSharding(mesh, PartitionSpec())
+    out = {
+        "max": jax.jit(jnp.max, out_shardings=replicated),
+        "sum_counts": jax.jit(
+            lambda c: c.reshape(D, num_buckets).sum(axis=0),
+            out_shardings=replicated,
+        ),
+        "sum_valid": jax.jit(lambda v: v.sum(), out_shardings=replicated),
+    }
+    if len(_mh_reduce_cache) >= 32:
+        _mh_reduce_cache.pop(next(iter(_mh_reduce_cache)))
+    _mh_reduce_cache[key] = out
+    return out
+
+
+def build_partition_sharded_multihost(
+    local_batch: ColumnarBatch,
+    key_names: List[str],
+    num_buckets: int,
+    mesh: Mesh,
+) -> Tuple[List[Tuple[ColumnarBatch, np.ndarray]], np.ndarray]:
+    """Multi-CONTROLLER twin of build_partition_sharded: every process
+    calls this SPMD-style with its OWN local rows (e.g. its share of the
+    source files), and ingest never funnels through one host's NIC —
+    each process feeds its local devices via
+    ``jax.make_array_from_process_local_data`` and the hash repartition
+    rides the same all_to_all program (ICI within a slice, DCN across
+    hosts; parallel/distributed.py documents the seam this lifts).
+
+    Returns ``(per_local_device, global_counts)``: this process's devices'
+    (batch, bucket_ids) pairs — grouped by bucket, key-sorted — plus the
+    replicated global per-bucket counts. Shape consensus (max shard rows,
+    exchange capacity) runs as two tiny device collectives so every
+    process compiles the identical program.
+
+    String key/include columns are not yet supported here: per-process
+    dictionaries would need a cross-process vocab union before codes can
+    transit the exchange (single-controller builds and queries support
+    strings fully)."""
+    import jax as _jax
+
+    dtypes = local_batch.schema()
+    if any(is_string(dt) for dt in dtypes.values()):
+        raise HyperspaceException(
+            "multihost build does not support string columns yet "
+            "(per-process vocabs need a cross-process union)."
+        )
+    axis = mesh.axis_names[0]
+    D = mesh.devices.size
+    local_devs = [d for d in mesh.devices.flat if d.process_index == _jax.process_index()]
+    L = len(local_devs)
+    if L == 0:
+        raise HyperspaceException("This process owns no devices of the mesh.")
+    n_local = local_batch.num_rows
+    reducers = _mh_reducers(mesh, axis, D, num_buckets)
+
+    def consensus_max(value: int) -> int:
+        """Max of a per-process value, agreed via one replicated-output
+        collective (every process must end up with identical statics)."""
+        sharding = NamedSharding(mesh, PartitionSpec(axis))
+        arr = _jax.make_array_from_process_local_data(
+            sharding, np.full(L, value, dtype=np.int64), (D,)
+        )
+        return int(reducers["max"](arr))
+
+    from ..utils.intmath import next_pow2
+
+    shard_rows = next_pow2(consensus_max(max(-(-n_local // L), 1)))
+    pad_local = shard_rows * L
+
+    host_dest = (
+        bucket_ids_host(
+            [key_repr(local_batch.columns[k]) for k in key_names], num_buckets
+        )
+        % D
+    )
+    cap = next_pow2(
+        consensus_max(_exchange_cap(host_dest, shard_rows, n_local, L, D))
+    )
+
+    def pad(a: np.ndarray) -> np.ndarray:
+        return np.pad(a, (0, pad_local - n_local))
+
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+    dev_arrays = {
+        name: _jax.make_array_from_process_local_data(
+            sharding, pad(encode_for_device(local_batch.columns[name])),
+            (shard_rows * D,),
+        )
+        for name in local_batch.column_names
+    }
+    valid = _jax.make_array_from_process_local_data(
+        sharding, pad(np.ones(n_local, dtype=bool)), (shard_rows * D,)
+    )
+
+    fn = _sharded_build_fn(
+        mesh, axis, tuple(dtypes.items()), tuple(key_names), (), num_buckets, cap
+    )
+    out_arrays, out_bucket, counts_all, n_valid_all = fn(dev_arrays, valid, {})
+
+    # replicate the global bucket counts (the per-device counts array is
+    # distributed; only a replicated reduction is host-readable everywhere)
+    global_counts = np.asarray(reducers["sum_counts"](counts_all))
+    n_global = int(np.asarray(reducers["sum_valid"](n_valid_all)))
+    if int(global_counts.sum()) != n_global:
+        raise HyperspaceException(
+            f"Multihost shuffle lost rows: {int(global_counts.sum())} != {n_global}."
+        )
+
+    # this process's output shards only (device d holds D*cap rows)
+    shard_of = {s.device: s for s in out_arrays[local_batch.column_names[0]].addressable_shards}
+    per_local: List[Tuple[ColumnarBatch, np.ndarray]] = []
+    nv_shards = {s.device: s for s in n_valid_all.addressable_shards}
+    bucket_shards = {s.device: s for s in out_bucket.addressable_shards}
+    col_shards = {
+        name: {s.device: s for s in out_arrays[name].addressable_shards}
+        for name in local_batch.column_names
+    }
+    for dev in shard_of:
+        nv = int(np.asarray(nv_shards[dev].data)[0])
+        cols = {
+            name: Column(
+                dtypes[name],
+                decode_from_device(
+                    dtypes[name], np.asarray(col_shards[name][dev].data)[:nv]
+                ),
+                None,
+            )
+            for name in local_batch.column_names
+        }
+        per_local.append(
+            (ColumnarBatch(cols), np.asarray(bucket_shards[dev].data)[:nv])
+        )
+    return per_local, global_counts
+
+
 def build_partition_sharded(
     batch: ColumnarBatch,
     key_names: List[str],
@@ -351,19 +512,15 @@ def build_partition_sharded(
     )
     host_dest = host_bucket % D
 
+    from ..utils.intmath import next_pow2
+
     # shard rows quantized to a power of two so repeated chunked calls of
     # similar sizes share one executable
-    shard_rows = max(-(-n // D), 1)
-    shard_rows = 1 << (shard_rows - 1).bit_length()
+    shard_rows = next_pow2(max(-(-n // D), 1))
     n_pad = shard_rows * D
     # max rows any one src shard sends to any one dst device, power-of-two
     # quantized for the same reason (skew varies chunk to chunk)
-    cap = 1
-    for s in range(D):
-        seg = host_dest[s * shard_rows : min((s + 1) * shard_rows, n)]
-        if seg.size:
-            cap = max(cap, int(np.bincount(seg, minlength=D).max()))
-    cap = 1 << (cap - 1).bit_length()
+    cap = next_pow2(_exchange_cap(host_dest, shard_rows, n, D, D))
 
     def pad(a: np.ndarray) -> np.ndarray:
         return np.pad(a, (0, n_pad - n))

@@ -19,16 +19,18 @@ the distributed runtime). Here the distributed runtime is JAX/XLA's:
   ``initialize_multihost()`` first — the DCN control plane
   (jax.distributed) makes ``jax.devices()`` global. The query side works
   unchanged (index files live on shared storage; every process can read
-  any bucket). The build side's current ingest feeds the mesh from the
-  controller process (``jax.device_put`` of host arrays), which is
-  correct single-controller but would ship all bytes through one host's
-  NIC under multi-controller; the seam to lift is
-  ``ops.build.build_partition_sharded``'s device_put →
-  ``jax.make_array_from_process_local_data`` with per-process source
-  partitions. Until that lands, multi-controller builds should run one
-  create_index per controller over partitioned sources (indexes are
-  independent datasets; the operation log's OCC already arbitrates
-  concurrent writers on shared storage).
+  any bucket). The build side's multi-controller ingest is
+  ``ops.build.build_partition_sharded_multihost``: every process feeds its
+  OWN rows to its OWN devices (``jax.make_array_from_process_local_data``
+  — no single-NIC funnel), shape consensus runs as two tiny replicated
+  collectives, the hash repartition rides the same all_to_all program,
+  and each process writes the bucket files its devices own (ownership
+  ``b % D`` is globally disjoint, so files never collide on shared
+  storage). Proven end-to-end by tests/test_multihost.py: two OS
+  processes × 4 virtual CPU devices rendezvous at a coordinator and their
+  combined output equals the single-process sharded build byte-for-row.
+  String columns there still need a cross-process vocab union (numeric
+  keys/includes are supported; strings raise with a clear message).
 """
 
 from __future__ import annotations

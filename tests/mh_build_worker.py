@@ -1,0 +1,82 @@
+"""Worker script for the multi-controller build test: one OS process per
+'host', each with 4 virtual CPU devices, ingesting ONLY its own rows and
+writing ONLY its own devices' buckets — driven by test_multihost.py via
+subprocess (the standard way to exercise jax.distributed on one machine).
+
+argv: process_id num_processes coordinator out_dir
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+pid, nproc, coord, out_dir = (
+    int(sys.argv[1]),
+    int(sys.argv[2]),
+    sys.argv[3],
+    sys.argv[4],
+)
+jax.distributed.initialize(coord, num_processes=nproc, process_id=pid)
+
+from pathlib import Path  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from hyperspace_tpu.ops.build import build_partition_sharded_multihost  # noqa: E402
+from hyperspace_tpu.storage import layout  # noqa: E402
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+NUM_BUCKETS = 16
+TOTAL = 3000
+
+# deterministic global dataset; each process takes a disjoint slice
+rng = np.random.default_rng(42)
+orderkey = rng.integers(0, 10**9, TOTAL).astype(np.int64)
+qty = rng.integers(0, 50, TOTAL).astype(np.int64)
+lo = pid * TOTAL // nproc
+hi = (pid + 1) * TOTAL // nproc
+local = ColumnarBatch(
+    {
+        "orderkey": Column.from_values(orderkey[lo:hi]),
+        "qty": Column.from_values(qty[lo:hi]),
+    }
+)
+
+mesh = Mesh(np.array(jax.devices()), ("d",))
+per_local, global_counts = build_partition_sharded_multihost(
+    local, ["orderkey"], NUM_BUCKETS, mesh
+)
+
+# every process sees the same replicated global counts over the FULL data
+assert int(global_counts.sum()) == TOTAL, global_counts.sum()
+
+out = Path(out_dir)
+written = 0
+for i, (dev_batch, bucket_ids) in enumerate(per_local):
+    if dev_batch.num_rows == 0:
+        continue
+    bounds = np.flatnonzero(np.diff(bucket_ids)) + 1
+    starts = np.concatenate([[0], bounds])
+    ends = np.concatenate([bounds, [len(bucket_ids)]])
+    for s, e in zip(starts, ends):
+        b = int(bucket_ids[s])
+        # one file per (bucket): bucket ownership is per device, and
+        # devices are disjoint across processes, so names never collide
+        layout.write_batch(
+            out / layout.bucket_file_name(b),
+            dev_batch.take(np.arange(s, e)),
+            sorted_by=["orderkey"],
+            bucket=b,
+        )
+        written += 1
+print(f"proc {pid}: wrote {written} bucket files", flush=True)
