@@ -120,7 +120,7 @@ GLOBBING_PATTERN_KEY = "hyperspace.source.globbingPattern"
 # partitioned-source support rides on; DefaultFileBasedSource.scala:235-250)
 PARTITION_INFERENCE_KEY = "hyperspace.source.partitionInference"
 # Internal relation option recording the discovered partition column names
-# (comma-joined, in directory order). Logged with the relation so refresh
+# (a JSON list, in directory order). Logged with the relation so refresh
 # reconstructs the SAME spec instead of re-guessing the layout — a later
 # re-layout that would shadow a data column with a same-named partition
 # directory is thereby inert rather than silently corrupting reads.
