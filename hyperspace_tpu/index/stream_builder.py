@@ -131,9 +131,10 @@ class StreamingIndexWriter:
         self.chunk_capacity = next_pow2(chunk_capacity)
         self.extra_meta = extra_meta
         self.mesh = mesh
-        # chunk engine: device | host | auto (probe chunks 1 and 2 — past
-        # the chunk-0 compile — and route the rest to the measured winner;
-        # constants.BUILD_ENGINE documents why this exists)
+        # chunk engine: device | host | auto (host probe on chunk 0, link
+        # check, device compile on chunk 1, device probe on chunk 2, then
+        # the measured winner — see _route_engine; constants.BUILD_ENGINE
+        # documents why this exists)
         self._engine = engine
         self._probe: Dict[str, float] = {}
         self._spill_dir = self.out_dir / SPILL_DIR_NAME
@@ -156,38 +157,83 @@ class StreamingIndexWriter:
         self._t_first_add: Optional[float] = None
         self._t_pipeline_done: Optional[float] = None
 
-    def _route_engine(self) -> str:
-        """Which engine runs THIS chunk. Fixed engines pass through; auto
-        probes: chunk 0 on device (pays the XLA compile, unmeasured),
-        chunk 1 on device with a synchronous timed round trip, chunk 2 on
-        host timed, every later chunk on the measured winner."""
+    def _route_engine(self, batch_rows: int) -> str:
+        """Which engine runs THIS chunk. Fixed engines pass through. Auto
+        probes HOST FIRST (chunk 0, cheap, no compile), then checks the
+        raw device link: if moving one chunk's bytes D2H already takes
+        longer than the whole host sort, the device path cannot win and
+        its (potentially tens-of-seconds) XLA compile is never paid —
+        the thin-tunneled-chip case. Otherwise chunk 1 runs on device
+        (compile bearer), chunk 2 is the timed device round trip, and the
+        measured winner takes the rest.
+
+        Probes run ONLY on full-capacity chunks: a partial tail is an
+        unrepresentative sample (a 100-row host sort "beating" the link's
+        fixed dispatch overhead would poison the per-capacity memo for
+        the whole process). Partial chunks without a verdict route by the
+        in-memory size policy and publish nothing."""
         if self._engine in ("device", "host"):
             return self._engine
         cached = _ENGINE_CACHE.get(_engine_cache_key(self.chunk_capacity))
         if cached is not None:
             return cached
+        if batch_rows < self.chunk_capacity:
+            from .builder import INMEMORY_HOST_MAX_ROWS
+
+            return "host" if batch_rows < INMEMORY_HOST_MAX_ROWS else "device"
         ci = len(self._chunk_times)
         if ci == 0:
-            return "device"
-        if ci == 1:
-            return "probe-device"
-        if ci == 2:
             return "probe-host"
+        if ci == 1:
+            return "device"
+        if ci == 2:
+            return "probe-device"
         return self._decide_winner()
 
+    def _link_rules_out_device(self, sample: ColumnarBatch) -> bool:
+        """True when a timed, compile-free device round trip of one
+        chunk's bytes (H2D + D2H of the sorted result is the device
+        path's unavoidable floor) already exceeds the measured host sort
+        time — the device engine cannot win, whatever its kernel speed."""
+        host_s = self._probe.get("host_s")
+        if host_s is None:
+            return False
+        try:
+            import jax
+
+            t0 = time.perf_counter()
+            total = 0
+            for col in sample.columns.values():
+                arr = jax.device_put(col.data)
+                arr.block_until_ready()
+                np.asarray(arr)
+                total += col.data.nbytes
+            link_s = time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 - probing must never fail a build
+            return False
+        metrics.record_time("build.engine.probe_link", link_s)
+        return total > 0 and link_s > host_s
+
+    def _publish_winner(self, choice: str, by_link: bool = False) -> None:
+        """The ONE place the probe verdict is recorded: probe state, the
+        per-(platform, capacity) memo, and the observability counters."""
+        self._probe["winner"] = 1.0 if choice == "host" else 0.0
+        _ENGINE_CACHE[_engine_cache_key(self.chunk_capacity)] = choice
+        metrics.incr(f"build.engine.auto_chose_{choice}")
+        if by_link:
+            metrics.incr("build.engine.auto_chose_host_by_link")
+
     def _decide_winner(self) -> str:
-        """Pick (and memoize) the probed winner. Called from routing AND
-        right after the host probe lands — a short build (≤3 chunks) must
-        still publish its measurement for the next build in this process."""
+        """Pick (and memoize) the probed winner; also called from
+        finalize() so a 3-chunk build publishes its measurement for the
+        next build in this process."""
         if "winner" not in self._probe:
             dev = self._probe.get("device_s")
             host = self._probe.get("host_s")
-            self._probe["winner"] = (
-                1.0 if host is not None and (dev is None or host < dev) else 0.0
+            self._publish_winner(
+                "host" if host is not None and (dev is None or host < dev)
+                else "device"
             )
-            choice = "host" if self._probe["winner"] else "device"
-            _ENGINE_CACHE[_engine_cache_key(self.chunk_capacity)] = choice
-            metrics.incr(f"build.engine.auto_chose_{choice}")
         return "host" if self._probe["winner"] else "device"
 
     def _spill_run(self, sorted_batch: ColumnarBatch, counts: np.ndarray) -> None:
@@ -297,7 +343,7 @@ class StreamingIndexWriter:
                 counts = np.bincount(bucket_ids, minlength=self.num_buckets)
                 self._spill_run(dev_batch, counts)
         else:
-            engine = self._route_engine()
+            engine = self._route_engine(batch.num_rows)
             if engine in ("host", "probe-host"):
                 from ..ops.build import build_partition_host
 
@@ -311,7 +357,10 @@ class StreamingIndexWriter:
                     metrics.record_time(
                         "build.engine.probe_host", self._probe["host_s"]
                     )
-                    self._decide_winner()  # publish even if no chunks remain
+                    if self._link_rules_out_device(result[0]):
+                        # D2H alone beats the whole host sort: decide now
+                        # and never pay the device compile
+                        self._publish_winner("host", by_link=True)
                     finish = lambda r=result: r  # noqa: E731
                 else:
                     # the host sort runs on the spill thread, overlapping
@@ -365,6 +414,15 @@ class StreamingIndexWriter:
             self._pending_rows = 0
             self._process_chunk(tail)
         self._drain_spills()
+        if (
+            self._engine == "auto"
+            and "device_s" in self._probe
+            and "host_s" in self._probe
+        ):
+            # short builds (3 chunks) complete both probes but never reach
+            # the deciding chunk — publish the measurement for the next
+            # build in this process
+            self._decide_winner()
         if self._t_first_add is not None:
             self._t_pipeline_done = time.perf_counter()
         self._finalized = True
@@ -426,14 +484,20 @@ class StreamingIndexWriter:
             "chunk_capacity": float(self.chunk_capacity),
         }
         if self._chunk_times:
-            out["first_chunk_s"] = self._chunk_times[0]
+            # the SETUP bearer is whichever early chunk paid the one-off
+            # costs: in auto mode the XLA compile lands on the chunk-1
+            # dispatch and the probes on chunks 0/2, so the bearer is the
+            # max over the probe window rather than literally chunk 0
+            probe_window = 3 if self._engine == "auto" else 1
+            bearer = max(self._chunk_times[:probe_window])
+            out["first_chunk_s"] = bearer
             if (
                 len(self._chunk_times) > 1
                 and self._t_first_add is not None
                 and self._t_pipeline_done is not None
             ):
                 pipeline_s = self._t_pipeline_done - self._t_first_add
-                steady_s = max(pipeline_s - self._chunk_times[0], 0.0)
+                steady_s = max(pipeline_s - bearer, 0.0)
                 steady_rows = self._rows - min(self._rows, self.chunk_capacity)
                 out["steady_total_s"] = steady_s
                 out["steady_rows"] = float(steady_rows)

@@ -132,13 +132,18 @@ def test_host_engine_identical_to_device(tmp_path):
     assert bucket_contents(d1, "qty") == bucket_contents(d2, "qty")
 
 
-def test_auto_engine_probes_and_routes(tmp_path):
+def test_auto_engine_probes_and_routes(tmp_path, monkeypatch):
     from hyperspace_tpu.index import stream_builder as sb
     from hyperspace_tpu.telemetry.metrics import metrics
 
     b = sample(3000, seed=9)
     metrics.reset()
     sb._ENGINE_CACHE.clear()  # force a fresh probe (memoized per process)
+    # pin the full probe sequence: at test scale the link probe's fixed
+    # overhead can legitimately rule the device out before any compile
+    monkeypatch.setattr(
+        sb.StreamingIndexWriter, "_link_rules_out_device", lambda self, s: False
+    )
     try:
         write_index_data_streaming(
             chunks_of(b, 500), ["orderkey"], 4, tmp_path / "o",
@@ -173,7 +178,60 @@ def test_auto_engine_probes_and_routes(tmp_path):
             chunks_of(b, 250), ["orderkey"], 4, tmp_path / "o3",
             chunk_capacity=250, engine="auto",
         )
-        assert "build.engine.probe_device" in metrics.snapshot()["timers_s"]
+        assert "build.engine.probe_host" in metrics.snapshot()["timers_s"]
+    finally:
+        sb._ENGINE_CACHE.clear()
+
+
+def test_partial_tail_chunk_never_memoizes(tmp_path):
+    """A build smaller than the chunk capacity probes nothing and writes
+    nothing to the per-capacity engine memo — a 100-row tail is an
+    unrepresentative sample that would poison every later build at that
+    capacity."""
+    from hyperspace_tpu.index import stream_builder as sb
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    sb._ENGINE_CACHE.clear()
+    metrics.reset()
+    b = sample(100, seed=30)
+    try:
+        write_index_data_streaming(
+            chunks_of(b, 100), ["orderkey"], 4, tmp_path / "o",
+            chunk_capacity=512, engine="auto",
+        )
+        snap = metrics.snapshot()
+        assert "build.engine.probe_host" not in snap["timers_s"]
+        assert "build.engine.probe_device" not in snap["timers_s"]
+        assert sb._ENGINE_CACHE == {}
+        # routed by the in-memory size policy (host below the threshold)
+        assert snap["counters"].get("build.engine.host") == 1
+    finally:
+        sb._ENGINE_CACHE.clear()
+
+
+def test_auto_engine_link_probe_short_circuit(tmp_path, monkeypatch):
+    """When the raw device round trip of a chunk already exceeds the host
+    sort, the device engine is ruled out BEFORE any XLA compile: no
+    device chunk runs, and the decision is memoized."""
+    from hyperspace_tpu.index import stream_builder as sb
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    b = sample(2500, seed=10)
+    metrics.reset()
+    sb._ENGINE_CACHE.clear()
+    monkeypatch.setattr(
+        sb.StreamingIndexWriter, "_link_rules_out_device", lambda self, s: True
+    )
+    try:
+        write_index_data_streaming(
+            chunks_of(b, 400), ["orderkey"], 4, tmp_path / "o",
+            chunk_capacity=400, engine="auto",
+        )
+        snap = metrics.snapshot()
+        assert "build.engine.probe_device" not in snap["timers_s"]
+        assert snap["counters"].get("build.engine.device", 0) == 0
+        assert snap["counters"].get("build.engine.auto_chose_host_by_link") == 1
+        assert sb._ENGINE_CACHE[sb._engine_cache_key(512)] == "host"
     finally:
         sb._ENGINE_CACHE.clear()
 
