@@ -13,7 +13,7 @@ NaN); count(*) counts rows. Empty input yields zero groups.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
 import numpy as np
 
@@ -36,8 +36,26 @@ def _key_array(col: Column) -> np.ndarray:
 
 
 def _dense(arr: np.ndarray) -> Tuple[np.ndarray, int]:
-    """Hash-factorize to dense codes 0..k-1 (pandas' hashtable — O(n),
-    unlike np.unique's sort)."""
+    """Factorize to dense codes 0..k-1. Bounded-range integer keys (ids —
+    the common case) go through pure offset arithmetic + one bincount
+    compaction, several times faster than any hashtable; everything else
+    uses pandas' hash factorize (O(n), unlike np.unique's sort)."""
+    n = len(arr)
+    if n and arr.dtype.kind in "iu":
+        mn = int(arr.min())
+        mx = int(arr.max())
+        span = mx - mn + 1
+        # span must be O(n): the compaction scans span slots, so a wide id
+        # domain over few rows would cost far more than hashing
+        if 0 < span <= max(4 * n, 1 << 16):
+            offset = (arr - mn).astype(np.int64)
+            occupancy = np.bincount(offset, minlength=span)
+            occupied = np.flatnonzero(occupancy)
+            if len(occupied) == span:  # every value in range present
+                return offset, span
+            lookup = np.empty(span, dtype=np.int64)
+            lookup[occupied] = np.arange(len(occupied), dtype=np.int64)
+            return lookup[offset], len(occupied)
     import pandas as pd
 
     codes, uniques = pd.factorize(arr, sort=False)
@@ -137,24 +155,69 @@ def hash_aggregate(
     minmax_order = None
     if any(a.fn in ("min", "max") for a in aggs):
         minmax_order = np.argsort(codes, kind="stable")  # shared by all specs
+
+    # shared per-column work — sum/avg/count over the same column must not
+    # recompute masks, float casts, or weighted bincounts (the hot cost at
+    # bench scale is exactly these passes)
+    col_cache: Dict[str, dict] = {}
+
+    def col_work(name: str) -> dict:
+        w = col_cache.get(name)
+        if w is not None:
+            return w
+        col = batch.columns[name]
+        valid = _valid_mask(col)
+        all_valid = bool(valid.all())
+        w = {
+            "all_valid": all_valid,
+            "vcodes": codes if all_valid else codes[valid],
+            # vals materialize lazily: a count-only aggregate never reads
+            # them, and the filtered copy of a wide column is the cost
+            "_data": col.data,
+            "_valid": valid,
+        }
+        col_cache[name] = w
+        return w
+
+    def col_vals(w: dict) -> np.ndarray:
+        if "vals" not in w:
+            w["vals"] = w["_data"] if w["all_valid"] else w["_data"][w["_valid"]]
+        return w["vals"]
+
+    def col_counts(w: dict) -> np.ndarray:
+        if "cnt" not in w:
+            w["cnt"] = (
+                counts_all
+                if w["all_valid"]
+                else np.bincount(w["vcodes"], minlength=n_groups)
+            )
+        return w["cnt"]
+
+    def col_sums(w: dict) -> np.ndarray:
+        if "sums" not in w:
+            w["sums"] = np.bincount(
+                w["vcodes"],
+                weights=col_vals(w).astype(np.float64, copy=False),
+                minlength=n_groups,
+            )
+        return w["sums"]
+
     for a in aggs:
         dt = output_dtype(a, schema.get(a.column) if a.column else None)
         if a.fn == "count":
             if a.column is None:
                 out[a.name] = Column("int64", counts_all.astype(np.int64))
             else:
-                valid = _valid_mask(batch.columns[a.column])
                 out[a.name] = Column(
-                    "int64",
-                    np.bincount(codes[valid], minlength=n_groups).astype(np.int64),
+                    "int64", col_counts(col_work(a.column)).astype(np.int64)
                 )
             continue
         col = batch.columns[a.column]
         if a.fn in ("sum", "avg"):
             if is_string(col.dtype_str):
                 raise HyperspaceException(f"{a.fn} over string column {a.column}.")
-            valid = _valid_mask(col)
-            vals = col.data[valid]
+            w = col_work(a.column)
+            vals = col_vals(w)
             exact_int = a.fn == "sum" and not dt.startswith("float")
             if exact_int and (
                 len(vals) == 0
@@ -166,20 +229,15 @@ def hash_aggregate(
                 # exact int64 segment sum: bincount accumulates in float64
                 # and corrupts totals past 2^53 (large ids, ns timestamps)
                 acc = np.zeros(n_groups, dtype=np.int64)
-                np.add.at(acc, codes[valid], vals.astype(np.int64))
+                np.add.at(acc, w["vcodes"], vals.astype(np.int64))
                 out[a.name] = Column(dt, acc.astype(numpy_dtype(dt)))
                 continue
-            sums = np.bincount(
-                codes[valid],
-                weights=vals.astype(np.float64),
-                minlength=n_groups,
-            )
+            sums = col_sums(w)
             if a.fn == "sum":
                 out[a.name] = Column(dt, sums.astype(numpy_dtype(dt)))
             else:
-                cnt = np.bincount(codes[valid], minlength=n_groups)
                 with np.errstate(invalid="ignore", divide="ignore"):
-                    out[a.name] = Column("float64", sums / cnt)
+                    out[a.name] = Column("float64", sums / col_counts(w))
             continue
         out[a.name] = _segment_minmax(
             codes, col, n_groups, want_max=(a.fn == "max"), order=minmax_order
