@@ -19,7 +19,6 @@ import numpy as np
 
 from .. import constants as C
 from ..exceptions import HyperspaceException, NoChangesException
-from ..index.builder import write_index_data
 from ..index.data_manager import IndexDataManager
 from ..index.index_config import IndexConfig
 from ..index.log_entry import (
@@ -197,14 +196,18 @@ class RefreshIncrementalAction(RefreshActionBase):
                 internal_format=self.relation.internal_format,
                 partition_spec=self.relation.partition_spec,
             )
-            batch = self.prepare_index_batch(
-                appended_rel, indexed, included, self.lineage, tracker
-            )
+            # the same mode-aware write as create: large appends stream
+            # through the out-of-core pipeline instead of materializing
+            # every appended row in host memory (a month of appended files
+            # can dwarf the original build)
             new_files.extend(
-                write_index_data(
-                    batch, indexed, self.num_buckets, version_dir,
-                    mesh=self.session.mesh,
-                    engine=self.conf.build_engine(),
+                self.write(
+                    appended_rel,
+                    self.index_config,
+                    version_dir,
+                    self.num_buckets,
+                    self.lineage,
+                    tracker,
                 )
             )
 
