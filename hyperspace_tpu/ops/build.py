@@ -367,11 +367,85 @@ def _mh_reducers(mesh: Mesh, axis: str, D: int, num_buckets: int) -> dict:
     return out
 
 
+def unify_vocabs_shared_storage(
+    local_batch: ColumnarBatch,
+    scratch_dir,
+    barrier,
+    process_index: int,
+    process_count: int,
+) -> ColumnarBatch:
+    """Cross-process dictionary union over shared storage: every process
+    writes its string columns' vocabs, a collective barrier orders the
+    writes before any read, and each process re-encodes onto the union —
+    after this, codes are globally comparable and string columns transit
+    the exchange like any numeric column. (Vocabs ride shared storage
+    rather than a collective because they are ragged bytes; index data
+    already lives on shared storage, so this adds no new requirement.)
+
+    ``barrier`` is any zero-arg callable that returns only after every
+    process has entered it (a replicated-output collective works)."""
+    import pickle
+    from pathlib import Path
+
+    names = [
+        n for n, c in local_batch.columns.items() if c.vocab is not None
+    ]
+    if not names:
+        return local_batch
+    scratch = Path(scratch_dir)
+    scratch.mkdir(parents=True, exist_ok=True)
+    payload = {n: local_batch.columns[n].vocab for n in names}
+    import os as _os
+    import time as _time
+
+    tmp = scratch / f".vocab-{process_index:05d}.tmp"
+    tmp.write_bytes(pickle.dumps(payload))
+    # durable on REAL shared storage: fsync the file and its directory
+    # before the barrier, or a peer's post-barrier read can miss the
+    # rename under NFS-style caching
+    fd = _os.open(tmp, _os.O_RDONLY)
+    try:
+        _os.fsync(fd)
+    finally:
+        _os.close(fd)
+    tmp.replace(scratch / f"vocab-{process_index:05d}.pkl")
+    dfd = _os.open(scratch, _os.O_RDONLY)
+    try:
+        _os.fsync(dfd)
+    finally:
+        _os.close(dfd)
+    barrier()  # all vocab files durable before anyone reads
+    merged: Dict[str, np.ndarray] = {}
+    for p in range(process_count):
+        path = scratch / f"vocab-{p:05d}.pkl"
+        deadline = _time.monotonic() + 30.0
+        while True:  # belt to the fsync braces: retry stale-cache misses
+            try:
+                data = pickle.loads(path.read_bytes())
+                break
+            except FileNotFoundError:
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.05)
+        for n, v in data.items():
+            merged.setdefault(n, []).append(v)
+    # second barrier: nobody may overwrite these files (a later build
+    # reusing the scratch dir) until EVERY process has finished reading —
+    # without it, successive builds race and unions silently diverge
+    barrier()
+    out = dict(local_batch.columns)
+    for n in names:
+        union = np.unique(np.concatenate(merged[n]))
+        out[n] = local_batch.columns[n].reencode(union)
+    return ColumnarBatch(out)
+
+
 def build_partition_sharded_multihost(
     local_batch: ColumnarBatch,
     key_names: List[str],
     num_buckets: int,
     mesh: Mesh,
+    scratch_dir=None,
 ) -> Tuple[List[Tuple[ColumnarBatch, np.ndarray]], np.ndarray]:
     """Multi-CONTROLLER twin of build_partition_sharded: every process
     calls this SPMD-style with its OWN local rows (e.g. its share of the
@@ -387,35 +461,44 @@ def build_partition_sharded_multihost(
     exchange capacity) runs as two tiny device collectives so every
     process compiles the identical program.
 
-    String key/include columns are not yet supported here: per-process
-    dictionaries would need a cross-process vocab union before codes can
-    transit the exchange (single-controller builds and queries support
-    strings fully)."""
+    String columns require ``scratch_dir`` (a shared-storage directory):
+    per-process dictionaries union there (unify_vocabs_shared_storage) so
+    codes become globally comparable before the exchange."""
     import jax as _jax
 
-    dtypes = local_batch.schema()
-    if any(is_string(dt) for dt in dtypes.values()):
-        raise HyperspaceException(
-            "multihost build does not support string columns yet "
-            "(per-process vocabs need a cross-process union)."
-        )
     axis = mesh.axis_names[0]
     D = mesh.devices.size
     local_devs = [d for d in mesh.devices.flat if d.process_index == _jax.process_index()]
     L = len(local_devs)
     if L == 0:
         raise HyperspaceException("This process owns no devices of the mesh.")
-    n_local = local_batch.num_rows
     reducers = _mh_reducers(mesh, axis, D, num_buckets)
 
     def consensus_max(value: int) -> int:
         """Max of a per-process value, agreed via one replicated-output
-        collective (every process must end up with identical statics)."""
+        collective (every process must end up with identical statics).
+        consensus_max(0) doubles as the collective barrier."""
         sharding = NamedSharding(mesh, PartitionSpec(axis))
         arr = _jax.make_array_from_process_local_data(
             sharding, np.full(L, value, dtype=np.int64), (D,)
         )
         return int(reducers["max"](arr))
+
+    if any(c.vocab is not None for c in local_batch.columns.values()):
+        if scratch_dir is None:
+            raise HyperspaceException(
+                "multihost build with string columns needs scratch_dir on "
+                "shared storage for the cross-process vocab union."
+            )
+        local_batch = unify_vocabs_shared_storage(
+            local_batch,
+            scratch_dir,
+            lambda: consensus_max(0),
+            _jax.process_index(),
+            _jax.process_count(),
+        )
+    dtypes = local_batch.schema()
+    n_local = local_batch.num_rows
 
     from ..utils.intmath import next_pow2
 
@@ -446,11 +529,30 @@ def build_partition_sharded_multihost(
     valid = _jax.make_array_from_process_local_data(
         sharding, pad(np.ones(n_local, dtype=bool)), (shard_rows * D,)
     )
+    # string KEY columns hash through replicated per-vocab-entry hashes;
+    # the vocab union made every process's vocab (hence these arrays)
+    # identical, so each process supplies the full replicated value
+    replicated = NamedSharding(mesh, PartitionSpec())
+    vh_np = {
+        k: vocab_hashes(local_batch.columns[k])
+        for k in key_names
+        if is_string(dtypes[k])
+    }
+    vh_dev = {
+        k: _jax.make_array_from_process_local_data(replicated, v, v.shape)
+        for k, v in vh_np.items()
+    }
 
     fn = _sharded_build_fn(
-        mesh, axis, tuple(dtypes.items()), tuple(key_names), (), num_buckets, cap
+        mesh,
+        axis,
+        tuple(dtypes.items()),
+        tuple(key_names),
+        tuple(sorted(vh_np)),
+        num_buckets,
+        cap,
     )
-    out_arrays, out_bucket, counts_all, n_valid_all = fn(dev_arrays, valid, {})
+    out_arrays, out_bucket, counts_all, n_valid_all = fn(dev_arrays, valid, vh_dev)
 
     # replicate the global bucket counts (the per-device counts array is
     # distributed; only a replicated reduction is host-readable everywhere)
@@ -470,6 +572,7 @@ def build_partition_sharded_multihost(
         name: {s.device: s for s in out_arrays[name].addressable_shards}
         for name in local_batch.column_names
     }
+    vocabs = {name: local_batch.columns[name].vocab for name in local_batch.column_names}
     for dev in shard_of:
         nv = int(np.asarray(nv_shards[dev].data)[0])
         cols = {
@@ -478,7 +581,7 @@ def build_partition_sharded_multihost(
                 decode_from_device(
                     dtypes[name], np.asarray(col_shards[name][dev].data)[:nv]
                 ),
-                None,
+                vocabs[name],
             )
             for name in local_batch.column_names
         }

@@ -26,11 +26,14 @@ the distributed runtime). Here the distributed runtime is JAX/XLA's:
   collectives, the hash repartition rides the same all_to_all program,
   and each process writes the bucket files its devices own (ownership
   ``b % D`` is globally disjoint, so files never collide on shared
-  storage). Proven end-to-end by tests/test_multihost.py: two OS
-  processes × 4 virtual CPU devices rendezvous at a coordinator and their
-  combined output equals the single-process sharded build byte-for-row.
-  String columns there still need a cross-process vocab union (numeric
-  keys/includes are supported; strings raise with a clear message).
+  storage). String columns union their per-process dictionaries over
+  shared storage first (``ops.build.unify_vocabs_shared_storage`` —
+  vocabs are ragged bytes, so they ride the same shared storage the
+  index lives on, with a collective barrier ordering writes before
+  reads). Proven end-to-end by tests/test_multihost.py: two OS processes
+  × 4 virtual CPU devices rendezvous at a coordinator and their combined
+  output — string column included — equals the single-process sharded
+  build row-for-row.
 """
 
 from __future__ import annotations

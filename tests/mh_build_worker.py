@@ -43,18 +43,23 @@ TOTAL = 3000
 rng = np.random.default_rng(42)
 orderkey = rng.integers(0, 10**9, TOTAL).astype(np.int64)
 qty = rng.integers(0, 50, TOTAL).astype(np.int64)
+# a string column whose VOCABS differ per process slice — exercises the
+# shared-storage cross-process dictionary union
+modes = np.array([b"AIR", b"SHIP", b"RAIL", b"MAIL", b"TRUCK"], dtype=object)
+mode = modes[rng.integers(0, 5, TOTAL)]
 lo = pid * TOTAL // nproc
 hi = (pid + 1) * TOTAL // nproc
 local = ColumnarBatch(
     {
         "orderkey": Column.from_values(orderkey[lo:hi]),
         "qty": Column.from_values(qty[lo:hi]),
+        "mode": Column.from_values(mode[lo:hi], "string"),
     }
 )
 
 mesh = Mesh(np.array(jax.devices()), ("d",))
 per_local, global_counts = build_partition_sharded_multihost(
-    local, ["orderkey"], NUM_BUCKETS, mesh
+    local, ["orderkey"], NUM_BUCKETS, mesh, scratch_dir=Path(out_dir) / ".vocab"
 )
 
 # every process sees the same replicated global counts over the FULL data

@@ -70,12 +70,14 @@ def test_two_process_build_matches_single(tmp_path):
 
     rng = np.random.default_rng(42)
     TOTAL, NB = 3000, 16
+    modes = np.array([b"AIR", b"SHIP", b"RAIL", b"MAIL", b"TRUCK"], dtype=object)
+    orderkey = rng.integers(0, 10**9, TOTAL).astype(np.int64)
+    qty = rng.integers(0, 50, TOTAL).astype(np.int64)
     whole = ColumnarBatch(
         {
-            "orderkey": Column.from_values(
-                rng.integers(0, 10**9, TOTAL).astype(np.int64)
-            ),
-            "qty": Column.from_values(rng.integers(0, 50, TOTAL).astype(np.int64)),
+            "orderkey": Column.from_values(orderkey),
+            "qty": Column.from_values(qty),
+            "mode": Column.from_values(modes[rng.integers(0, 5, TOTAL)], "string"),
         }
     )
     per_device, counts = build_partition_sharded(
@@ -89,7 +91,8 @@ def test_two_process_build_matches_single(tmp_path):
             b = layout.bucket_of_file(f)
             got.setdefault(b, []).append(
                 list(zip(fb.columns["orderkey"].data.tolist(),
-                         fb.columns["qty"].data.tolist()))
+                         fb.columns["qty"].data.tolist(),
+                         fb.columns["mode"].to_values().tolist()))
             )
         return {b: sorted(sum(v, [])) for b, v in got.items()}
 
@@ -99,7 +102,8 @@ def test_two_process_build_matches_single(tmp_path):
             rows = dev_batch.take(np.flatnonzero(bucket_ids == b))
             exp.setdefault(int(b), []).extend(
                 zip(rows.columns["orderkey"].data.tolist(),
-                    rows.columns["qty"].data.tolist())
+                    rows.columns["qty"].data.tolist(),
+                    rows.columns["mode"].to_values().tolist())
             )
     exp = {b: sorted(v) for b, v in exp.items()}
     got = contents_from_files()
