@@ -29,10 +29,14 @@ def __getattr__(name):
         from .dataframe import DataFrame
 
         return DataFrame
-    if name == "col":
-        from .plan.expr import col
+    if name in ("col", "lit", "is_in"):
+        from .plan import expr
 
-        return col
+        return getattr(expr, name)
+    if name in ("agg_sum", "agg_count", "agg_min", "agg_max", "agg_avg", "AggSpec"):
+        from .plan import aggregates
+
+        return getattr(aggregates, name)
     if name == "DataSkippingIndexConfig":
         from .index.index_config import DataSkippingIndexConfig
 

@@ -387,6 +387,60 @@ def test_refresh_ignores_new_partition_dirs_over_data_columns(env):
     assert 999 not in set(on.columns["qty"].data.tolist())
 
 
+def test_join_over_partitioned_sources(env, tmp_path):
+    """The exchange-free SMJ over two hive-partitioned sources, with a
+    partition column in the projection — rewrite fires, rows match."""
+    session, hs, src, _ = env
+    rng = np.random.default_rng(23)
+    orders = tmp_path / "orders"
+    # a DIFFERENT partition column name on the right: both sides carrying
+    # `region` would make the projection ambiguous (the engine rejects
+    # duplicate output columns, as Spark rejects ambiguous references)
+    for zone in ("us", "eu"):
+        parquet_io.write_parquet(
+            orders / f"zone={zone}" / "part-0.parquet",
+            ColumnarBatch(
+                {
+                    "o_key": Column.from_values(
+                        rng.permutation(50).astype(np.int64)
+                    ),
+                    "o_val": Column.from_values(
+                        rng.integers(0, 9, 50).astype(np.int64)
+                    ),
+                }
+            ),
+        )
+    hs.create_index(
+        session.read.parquet(str(src)),
+        IndexConfig("jp_l", ["orderkey"], ["qty", "region"]),
+    )
+    hs.create_index(
+        session.read.parquet(str(orders)),
+        IndexConfig("jp_r", ["o_key"], ["o_val"]),
+    )
+    q = (
+        session.read.parquet(str(src))
+        .join(session.read.parquet(str(orders)), col("orderkey") == col("o_key"))
+        .select("qty", "region", "o_val")
+    )
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    plan = q.optimized_plan()
+    assert len(plan.collect(lambda n: isinstance(n, IndexScan))) == 2
+    assert_row_parity(off, q.collect())
+    assert off.num_rows > 0
+
+
+def test_top_level_exports():
+    import hyperspace_tpu as h
+
+    for name in ("col", "lit", "is_in", "agg_sum", "agg_avg", "agg_count",
+                 "agg_min", "agg_max", "AggSpec", "DataSkippingIndexConfig",
+                 "MinMaxSketch", "Hyperspace", "HyperspaceSession"):
+        assert getattr(h, name) is not None, name
+
+
 def test_kv_named_root_not_partitioned(tmp_path):
     """Files directly under a root whose own name looks like k=v must not
     grow phantom partition columns (discovery is bounded below the root)."""
