@@ -461,6 +461,76 @@ def test_reading_single_partition_dir(env):
     assert df.collect().num_rows == 200
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_partitioned_layout_fuzz(tmp_path, seed):
+    """Random partition depths/cardinalities/dtypes: reads, pruning, and
+    index rewrites stay at parity with a pandas oracle."""
+    rng = np.random.default_rng(4000 + seed)
+    depth = int(rng.integers(1, 4))
+    names = [f"p{i}" for i in range(depth)]
+    cards = [int(rng.integers(1, 4)) for _ in range(depth)]
+    str_col = rng.random() < 0.5
+    session = HyperspaceSession(
+        HyperspaceConf(
+            {
+                C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
+                C.INDEX_NUM_BUCKETS: int(rng.choice([2, 4, 8])),
+            }
+        )
+    )
+    hs = Hyperspace(session)
+    src = tmp_path / "t"
+
+    def values_for(level):
+        if str_col and level == 0:
+            return [f"v{j}" for j in range(cards[level])]
+        return list(range(cards[level]))
+
+    import itertools
+
+    combos = list(itertools.product(*[values_for(i) for i in range(depth)]))
+    frames = []
+    for combo in combos:
+        n = int(rng.integers(20, 120))
+        b = _batch(n, 0, int(rng.integers(0, 10**6)))
+        sub = src
+        for nm, v in zip(names, combo):
+            sub = sub / f"{nm}={v}"
+        parquet_io.write_parquet(sub / "part-0.parquet", b)
+        pdf = b.to_pandas()
+        for nm, v in zip(names, combo):
+            pdf[nm] = v
+        frames.append(pdf)
+    import pandas as pd
+
+    oracle = pd.concat(frames, ignore_index=True)
+
+    df = session.read.parquet(str(src))
+    assert df.columns() == ["orderkey", "qty"] + names
+    got = df.collect().to_pandas()
+    assert len(got) == len(oracle)
+
+    # filter on a random partition column + a data column
+    pcol = names[int(rng.integers(0, depth))]
+    pval = values_for(names.index(pcol))[0]
+    pred = (col(pcol) == pval) & (col("orderkey") >= 10)
+    q = df.filter(pred).select("orderkey", "qty", pcol)
+    exp = oracle[(oracle[pcol] == pval) & (oracle["orderkey"] >= 10)]
+    out = q.collect().to_pandas()
+    assert len(out) == len(exp), (seed, pcol, pval)
+
+    # index over the data key including a partition column; off/on parity
+    hs.create_index(df, IndexConfig("fz", ["orderkey"], ["qty", pcol]))
+    q2 = session.read.parquet(str(src)).filter(col("orderkey") == 7).select(
+        "orderkey", "qty", pcol
+    )
+    session.disable_hyperspace()
+    off = q2.collect()
+    session.enable_hyperspace()
+    assert q2.optimized_plan().collect(lambda n: isinstance(n, IndexScan))
+    assert_row_parity(off, q2.collect())
+
+
 def test_collision_with_data_column_rejected(tmp_path):
     session = HyperspaceSession(HyperspaceConf({}))
     src = tmp_path / "t"
