@@ -505,19 +505,26 @@ def test_partitioned_layout_fuzz(tmp_path, seed):
 
     oracle = pd.concat(frames, ignore_index=True)
 
+    def rows(pdf, cols):
+        return sorted(map(repr, pdf[sorted(cols)].itertuples(index=False)))
+
     df = session.read.parquet(str(src))
     assert df.columns() == ["orderkey", "qty"] + names
     got = df.collect().to_pandas()
-    assert len(got) == len(oracle)
+    all_cols = ["orderkey", "qty"] + names
+    assert rows(got, all_cols) == rows(oracle, all_cols), seed
 
-    # filter on a random partition column + a data column
+    # filter on a random partition column + a data column; the partition
+    # value is drawn randomly so non-first values get exercised too
     pcol = names[int(rng.integers(0, depth))]
-    pval = values_for(names.index(pcol))[0]
+    vals = values_for(names.index(pcol))
+    pval = vals[int(rng.integers(0, len(vals)))]
     pred = (col(pcol) == pval) & (col("orderkey") >= 10)
     q = df.filter(pred).select("orderkey", "qty", pcol)
     exp = oracle[(oracle[pcol] == pval) & (oracle["orderkey"] >= 10)]
     out = q.collect().to_pandas()
-    assert len(out) == len(exp), (seed, pcol, pval)
+    sel = ["orderkey", "qty", pcol]
+    assert rows(out, sel) == rows(exp, sel), (seed, pcol, pval)
 
     # index over the data key including a partition column; off/on parity
     hs.create_index(df, IndexConfig("fz", ["orderkey"], ["qty", pcol]))
