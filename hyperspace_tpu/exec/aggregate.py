@@ -219,12 +219,17 @@ def hash_aggregate(
             w = col_work(a.column)
             vals = col_vals(w)
             exact_int = a.fn == "sum" and not dt.startswith("float")
-            if exact_int and (
-                len(vals) == 0
-                or len(vals) * float(np.abs(vals).max()) < float(1 << 53)
-            ):
-                # bincount's float64 accumulator is provably exact here
-                exact_int = False
+            if exact_int:
+                # bound computed in Python ints: np.abs(int64 min) wraps to a
+                # negative value and would falsely look "provably exact"
+                bound = (
+                    max(abs(int(vals.min())), abs(int(vals.max())))
+                    if len(vals)
+                    else 0
+                )
+                if len(vals) * bound < (1 << 53):
+                    # bincount's float64 accumulator is provably exact here
+                    exact_int = False
             if exact_int:
                 # exact int64 segment sum: bincount accumulates in float64
                 # and corrupts totals past 2^53 (large ids, ns timestamps)
@@ -234,7 +239,12 @@ def hash_aggregate(
                 continue
             sums = col_sums(w)
             if a.fn == "sum":
-                out[a.name] = Column(dt, sums.astype(numpy_dtype(dt)))
+                s = sums.astype(numpy_dtype(dt))
+                if dt.startswith("float"):
+                    # SQL NULL: sum of an all-NULL group is NULL (NaN),
+                    # matching avg/min/max of the same group
+                    s = np.where(col_counts(w) == 0, np.nan, s)
+                out[a.name] = Column(dt, s)
             else:
                 with np.errstate(invalid="ignore", divide="ignore"):
                     out[a.name] = Column("float64", sums / col_counts(w))

@@ -308,16 +308,16 @@ def distributed_filter_aggregate(
         if whole.columns[c].vocab is not None:
             return None  # string aggregate input: min/max need vocab order
         d = whole.columns[c].data
-        if (
-            d.dtype.kind in "iu"
-            and len(d)
-            and len(d) * float(np.abs(d).max()) >= float(1 << 53)
-        ):
-            # the device partials and their merge ride float64; a SUM that
-            # could reach the mantissa limit would silently round (the
-            # host path is exact int64) — same rows*max bound as
-            # hash_aggregate's exact_int routing
-            return None
+        if d.dtype.kind in "iu" and len(d):
+            # bound computed in Python ints: np.abs(int64 min) wraps negative
+            # and would falsely pass the mantissa check
+            bound = max(abs(int(d.min())), abs(int(d.max())))
+            if len(d) * bound >= (1 << 53):
+                # the device partials and their merge ride float64; a SUM that
+                # could reach the mantissa limit would silently round (the
+                # host path is exact int64) — same rows*max bound as
+                # hash_aggregate's exact_int routing
+                return None
     pred_names = sorted(predicate.columns()) if predicate is not None else []
     if any(whole.columns[c].dtype_str == "float64" for c in pred_names):
         return None  # f64 predicates evaluate on host (ops.floatbits)
@@ -410,15 +410,16 @@ def distributed_filter_aggregate(
         )
         mn = floats_out[:, 3 * j + 1, :].reshape(-1)[keep]
         mx = floats_out[:, 3 * j + 2, :].reshape(-1)[keep]
+        nn = ints_out[:, 2 + j, :].reshape(-1)[keep]
+        # a partial is NULL iff its group had zero valid rows on that device
+        # (nn == 0) — deciding by isinf would also nullify genuine ±inf values
         partial_cols[f"__min_{c}"] = Column(
-            "float64", np.where(np.isinf(mn), np.nan, mn)
+            "float64", np.where(nn == 0, np.nan, mn)
         )
         partial_cols[f"__max_{c}"] = Column(
-            "float64", np.where(np.isinf(mx), np.nan, mx)
+            "float64", np.where(nn == 0, np.nan, mx)
         )
-        partial_cols[f"__nn_{c}"] = Column(
-            "int64", ints_out[:, 2 + j, :].reshape(-1)[keep]
-        )
+        partial_cols[f"__nn_{c}"] = Column("int64", nn)
     from ..plan.aggregates import AggSpec
 
     merge_specs = [AggSpec("sum", "__cnt", "__rows")]
@@ -450,9 +451,13 @@ def distributed_filter_aggregate(
             )
             result[a.name] = Column("int64", src.astype(np.int64))
         elif a.fn == "sum":
-            result[a.name] = Column(
-                dt, merged.columns[f"__S_{a.column}"].data.astype(_npdt(dt))
-            )
+            s = merged.columns[f"__S_{a.column}"].data.astype(_npdt(dt))
+            if dt.startswith("float"):
+                # SQL NULL: sum of an all-NULL group is NULL (parity with
+                # hash_aggregate and with avg's 0/0 → NaN)
+                nn = merged.columns[f"__N_{a.column}"].data
+                s = np.where(nn == 0, np.nan, s)
+            result[a.name] = Column(dt, s)
         elif a.fn == "avg":
             s = merged.columns[f"__S_{a.column}"].data
             nn = merged.columns[f"__N_{a.column}"].data

@@ -25,6 +25,8 @@ bucket-at-a-time scheduling" hard part of SURVEY.md §7.
 
 from __future__ import annotations
 
+import json
+import os
 import queue
 import shutil
 import threading
@@ -55,13 +57,83 @@ _ENGINE_CACHE: Dict[tuple, str] = {}
 
 
 def _engine_cache_key(chunk_capacity: int) -> tuple:
-    try:
-        import jax
+    """(platform, capacity) memo key. The platform MUST be derived without
+    initializing the jax backend: cold backend init on a tunneled chip
+    costs seconds, and paying it just to look up a verdict that says
+    "host" would charge every pure-host build the device tax the memo
+    exists to avoid. The configured platform string (env / jax.config) is
+    a faithful proxy — it is what decides which backend WOULD initialize."""
+    platform = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    if not platform:
+        try:
+            import jax
 
-        platform = jax.default_backend()
-    except Exception:  # noqa: BLE001 - cache key only
-        platform = "unknown"
+            cfg = getattr(jax.config, "jax_platforms", None)
+            platform = (
+                cfg.split(",")[0].strip() if cfg else jax.default_backend()
+            )
+        except Exception:  # noqa: BLE001 - cache key only
+            platform = "unknown"
     return (platform, chunk_capacity)
+
+
+def _probe_cache_path() -> Optional[Path]:
+    """Cross-process home of the probe memo. The verdict is a property of
+    the MACHINE (backend platform + link bandwidth + chunk capacity), not
+    of one process, so a fresh process should not re-pay the probe's
+    device compile + round trip — that cost is why the recorded cold
+    ``build_s`` trailed the external baseline in round 2. Overridable via
+    ``HYPERSPACE_TPU_PROBE_CACHE`` (empty string disables; tests disable
+    it so probe-path assertions stay hermetic)."""
+    env = os.environ.get("HYPERSPACE_TPU_PROBE_CACHE")
+    if env is not None:
+        return Path(env) if env else None
+    return Path(os.path.expanduser("~/.cache/hyperspace_tpu/engine_probe.json"))
+
+
+# One day: long enough that a bench/CI process never re-probes, short
+# enough that a congested-link session's verdict cannot permanently rule
+# an engine out — link bandwidth on a tunneled chip varies session to
+# session, and the per-process memo's self-healing must survive the move
+# to disk.
+PROBE_CACHE_TTL_S = 24 * 3600.0
+
+
+def _load_persisted_winner(key: tuple) -> Optional[str]:
+    p = _probe_cache_path()
+    if p is None:
+        return None
+    try:
+        data = json.loads(p.read_text())
+    except Exception:  # noqa: BLE001 - absent/corrupt cache = no verdict
+        return None
+    v = data.get(f"{key[0]}:{key[1]}")
+    if not isinstance(v, dict) or v.get("winner") not in ("device", "host"):
+        return None
+    try:
+        if time.time() - float(v["ts"]) > PROBE_CACHE_TTL_S:
+            return None
+    except Exception:  # noqa: BLE001 - malformed timestamp = stale
+        return None
+    return v["winner"]
+
+
+def _persist_winner(key: tuple, choice: str) -> None:
+    p = _probe_cache_path()
+    if p is None:
+        return
+    try:
+        p.parent.mkdir(parents=True, exist_ok=True)
+        try:
+            data = json.loads(p.read_text())
+        except Exception:  # noqa: BLE001
+            data = {}
+        data[f"{key[0]}:{key[1]}"] = {"winner": choice, "ts": time.time()}
+        tmp = p.with_name(p.name + f".tmp-{uuid.uuid4().hex[:8]}")
+        tmp.write_text(json.dumps(data, indent=0))
+        os.replace(tmp, p)  # atomic: concurrent writers last-write-win
+    except Exception:  # noqa: BLE001 - caching must never fail a build
+        pass
 
 
 def sort_encoding(col: Column) -> np.ndarray:
@@ -174,9 +246,21 @@ class StreamingIndexWriter:
         in-memory size policy and publish nothing."""
         if self._engine in ("device", "host"):
             return self._engine
-        cached = _ENGINE_CACHE.get(_engine_cache_key(self.chunk_capacity))
+        key = _engine_cache_key(self.chunk_capacity)
+        cached = _ENGINE_CACHE.get(key)
         if cached is not None:
             return cached
+        persisted = _load_persisted_winner(key)
+        # honor a disk verdict of "host" unconditionally (host is always
+        # compile-free), but a "device" verdict only for full-capacity
+        # chunks: a fresh process's small partial build would pay the cold
+        # XLA compile the sub-capacity size policy exists to avoid
+        if persisted is not None and (
+            persisted == "host" or batch_rows >= self.chunk_capacity
+        ):
+            _ENGINE_CACHE[key] = persisted
+            metrics.incr("build.engine.winner_from_disk_cache")
+            return persisted
         if batch_rows < self.chunk_capacity:
             from .builder import INMEMORY_HOST_MAX_ROWS
 
@@ -201,6 +285,13 @@ class StreamingIndexWriter:
         try:
             import jax
 
+            # untimed warmup: the process's FIRST device_put pays one-time
+            # backend/allocator init (seconds on a cold tunnel) that is not
+            # link bandwidth; timing it would permanently rule out the
+            # device engine on hosts where it wins after warmup
+            warm = jax.device_put(np.zeros(16, dtype=np.int32))
+            warm.block_until_ready()
+            np.asarray(warm)
             t0 = time.perf_counter()
             total = 0
             for col in sample.columns.values():
@@ -218,7 +309,9 @@ class StreamingIndexWriter:
         """The ONE place the probe verdict is recorded: probe state, the
         per-(platform, capacity) memo, and the observability counters."""
         self._probe["winner"] = 1.0 if choice == "host" else 0.0
-        _ENGINE_CACHE[_engine_cache_key(self.chunk_capacity)] = choice
+        key = _engine_cache_key(self.chunk_capacity)
+        _ENGINE_CACHE[key] = choice
+        _persist_winner(key, choice)
         metrics.incr(f"build.engine.auto_chose_{choice}")
         if by_link:
             metrics.incr("build.engine.auto_chose_host_by_link")

@@ -316,3 +316,37 @@ def test_dist_min_rows_from_conf(tmp_path, mesh):
     before = metrics.counter("scan.path.distributed")
     q.collect()
     assert metrics.counter("scan.path.distributed") == before  # host gate
+
+
+def test_distributed_minmax_preserves_genuine_inf(mesh):
+    """A float column that genuinely contains ±inf keeps its true min/max
+    on the mesh path (parity with host hash_aggregate). Emptiness of a
+    device partial is decided by its non-NULL count, not isinf — deciding
+    by isinf silently nulled real infinities (ADVICE r2)."""
+    from hyperspace_tpu.exec.aggregate import hash_aggregate
+    from hyperspace_tpu.exec.distributed import distributed_filter_aggregate
+    from hyperspace_tpu.plan.aggregates import agg_max, agg_min, agg_sum
+
+    rng = np.random.default_rng(21)
+    n = 512
+    f = rng.normal(0, 5, n)
+    f[7] = np.inf
+    f[19] = -np.inf
+    f[33] = np.nan  # and a NULL, so the nn-count path is exercised too
+    b = ColumnarBatch.from_pydict(
+        {"k": rng.integers(0, 6, n).astype(np.int64), "f": f},
+        {"k": "int64", "f": "float64"},
+    )
+    by_bucket = split_by_bucket(b, ["k"], 16)
+    specs = [agg_min("f", "mn"), agg_max("f", "mx"), agg_sum("f", "s")]
+    got = distributed_filter_aggregate(by_bucket, None, ["k"], specs, mesh)
+    assert got is not None
+    exp = hash_aggregate(b, ["k"], specs)
+    gdf = got.to_pandas().sort_values(["k"]).reset_index(drop=True)
+    edf = exp.to_pandas().sort_values(["k"]).reset_index(drop=True)
+    for c in ("mn", "mx", "s"):
+        np.testing.assert_allclose(
+            gdf[c].to_numpy(), edf[c].to_numpy(), rtol=1e-9, equal_nan=True
+        )
+    assert np.isinf(gdf["mx"].to_numpy()).any()
+    assert np.isinf(gdf["mn"].to_numpy()).any()

@@ -203,7 +203,11 @@ def test_aggregate_parity_fuzz(tmp_path, seed):
         ref = (
             base.groupby(keys, dropna=False)
             .agg(
-                count=(val, "size"), S=(val, "sum"), m=(val, "min"),
+                # min_count=1: pandas' default sum of an all-NULL group is 0;
+                # the engine follows SQL (NULL), as does pyarrow
+                count=(val, "size"),
+                S=(val, lambda s: s.sum(min_count=1)),
+                m=(val, "min"),
                 M=(val, "max"), A=(val, "mean"),
             )
             .reset_index()

@@ -498,3 +498,142 @@ def test_streaming_failure_tears_down_pipeline(tmp_path, monkeypatch):
         t.name == "spill-writer" and t.is_alive() for t in threading.enumerate()
     )
     assert not (tmp_path / "o" / ".spill").exists()
+
+
+def test_probe_winner_persists_across_processes(tmp_path, monkeypatch):
+    """The probe verdict is a machine property (platform + link + chunk
+    capacity), so a fresh process reads the winner from the disk memo
+    instead of re-paying the probe's compile + round trip (the cost that
+    made round 2's cold build trail the external baseline)."""
+    from hyperspace_tpu.index import stream_builder as sb
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    cache = tmp_path / "probe-cache" / "engine_probe.json"
+    monkeypatch.setenv("HYPERSPACE_TPU_PROBE_CACHE", str(cache))
+    b = sample(3000, seed=11)
+    sb._ENGINE_CACHE.clear()
+    metrics.reset()
+    monkeypatch.setattr(
+        sb.StreamingIndexWriter, "_link_rules_out_device", lambda self, s: True
+    )
+    try:
+        write_index_data_streaming(
+            chunks_of(b, 500), ["orderkey"], 4, tmp_path / "o",
+            chunk_capacity=500, engine="auto",
+        )
+        assert cache.exists()
+        key = sb._engine_cache_key(512)
+        assert sb._load_persisted_winner(key) == "host"
+        # "new process": in-memory memo cleared; disk verdict honored, no probe
+        sb._ENGINE_CACHE.clear()
+        metrics.reset()
+        write_index_data_streaming(
+            chunks_of(b, 500), ["orderkey"], 4, tmp_path / "o2",
+            chunk_capacity=500, engine="auto",
+        )
+        snap = metrics.snapshot()
+        assert "build.engine.probe_host" not in snap["timers_s"]
+        assert snap["counters"].get("build.engine.winner_from_disk_cache") == 1
+        # a corrupt cache file is ignored, never fatal
+        cache.write_text("{not json")
+        assert sb._load_persisted_winner(key) is None
+        sb._ENGINE_CACHE.clear()
+        metrics.reset()
+        write_index_data_streaming(
+            chunks_of(b, 500), ["orderkey"], 4, tmp_path / "o3",
+            chunk_capacity=500, engine="auto",
+        )
+        assert "build.engine.probe_host" in metrics.snapshot()["timers_s"]
+    finally:
+        sb._ENGINE_CACHE.clear()
+
+
+def test_sum_of_all_null_group_is_null():
+    """SQL NULL semantics: sum over a group whose float values are all NULL
+    is NULL (NaN), matching avg/min/max of the same group — on both the
+    host hash_aggregate and the distributed merge path (ADVICE r2)."""
+    import numpy as np
+
+    from hyperspace_tpu.exec.aggregate import hash_aggregate
+    from hyperspace_tpu.plan.aggregates import AggSpec
+    from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+    batch = ColumnarBatch(
+        {
+            "g": Column("int64", np.array([0, 0, 1, 1])),
+            "v": Column("float64", np.array([1.0, 2.0, np.nan, np.nan])),
+        }
+    )
+    out = hash_aggregate(
+        batch, ["g"], [AggSpec("sum", "v", "s"), AggSpec("avg", "v", "a")]
+    )
+    rows = {int(g): (s, a) for g, s, a in zip(
+        out.columns["g"].data, out.columns["s"].data, out.columns["a"].data
+    )}
+    assert rows[0][0] == 3.0
+    assert np.isnan(rows[1][0]) and np.isnan(rows[1][1])
+
+
+def test_exact_int_sum_guard_handles_int64_min():
+    """np.abs(int64 min) wraps negative; the exactness bound must be
+    computed in Python ints so a column containing -2^63 routes through
+    the exact int64 accumulator (ADVICE r2)."""
+    import numpy as np
+
+    from hyperspace_tpu.exec.aggregate import hash_aggregate
+    from hyperspace_tpu.plan.aggregates import AggSpec
+    from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+    lo = np.int64(np.iinfo(np.int64).min)
+    batch = ColumnarBatch(
+        {
+            "g": Column("int64", np.array([0, 0])),
+            "v": Column("int64", np.array([lo, 3], dtype=np.int64)),
+        }
+    )
+    out = hash_aggregate(batch, ["g"], [AggSpec("sum", "v", "s")])
+    # exact int64 wrap-around semantics, not a float64 rounding
+    assert out.columns["s"].data[0] == np.int64(lo + 3)
+
+
+def test_persisted_device_verdict_not_applied_to_partial_builds(
+    tmp_path, monkeypatch
+):
+    """A disk verdict of "device" must not route a sub-capacity build in a
+    fresh process — that build would pay the cold XLA compile the size
+    policy exists to avoid. A "host" verdict (always compile-free) and an
+    expired entry fall back correctly too."""
+    import time as _time
+
+    from hyperspace_tpu.index import stream_builder as sb
+
+    cache = tmp_path / "engine_probe.json"
+    monkeypatch.setenv("HYPERSPACE_TPU_PROBE_CACHE", str(cache))
+    key = sb._engine_cache_key(512)
+    sb._persist_winner(key, "device")
+    sb._ENGINE_CACHE.clear()
+    try:
+        w = sb.StreamingIndexWriter(
+            ["orderkey"], 4, tmp_path / "o", chunk_capacity=512, engine="auto"
+        )
+        # partial chunk: size policy, not the persisted device verdict
+        assert w._route_engine(100) == "host"
+        assert sb._ENGINE_CACHE == {}
+        # full-capacity chunk: verdict applies (compile amortizable)
+        assert w._route_engine(512) == "device"
+        # host verdicts apply even to partial chunks
+        sb._ENGINE_CACHE.clear()
+        sb._persist_winner(key, "host")
+        w2 = sb.StreamingIndexWriter(
+            ["orderkey"], 4, tmp_path / "o2", chunk_capacity=512, engine="auto"
+        )
+        assert w2._route_engine(100) == "host"
+        # expired entries are ignored
+        sb._ENGINE_CACHE.clear()
+        sb._persist_winner(key, "host")
+        monkeypatch.setattr(
+            _time, "time", lambda: _time.time_ns() / 1e9 + sb.PROBE_CACHE_TTL_S + 60
+        )
+        assert sb._load_persisted_winner(key) is None
+    finally:
+        sb._ENGINE_CACHE.clear()
