@@ -110,12 +110,13 @@ def write_index_data(
 
 # In-memory builds run ONE kernel launch, so a fresh XLA compile (tens of
 # seconds on TPU) cannot amortize the way the streaming build's per-chunk
-# executable does — and build_partition_single traces a fresh jit closure
-# per call, so not even a same-shape repeat reuses the executable. Below
-# this many rows the host twin is therefore the sure win; above it the
-# device sort's throughput can cover the compile. (The streaming probe
-# cache deliberately does NOT override here: its measurements come from a
-# warm per-chunk executable, a premise one-shot builds don't share.)
+# executable does. (build_partition_single's jitted closure is cached per
+# (schema, keys, buckets) now, so repeats DO reuse the executable — but a
+# one-shot build's FIRST launch still bears the compile.) Below this many
+# rows the host twin is therefore the sure win; above it the device
+# sort's throughput can cover the compile. (The streaming probe cache
+# deliberately does NOT override here: its measurements come from a warm
+# per-chunk executable, a premise one-shot builds don't share.)
 INMEMORY_HOST_MAX_ROWS = 1 << 22
 
 

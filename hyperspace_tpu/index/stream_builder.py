@@ -351,8 +351,20 @@ class StreamingIndexWriter:
             if self._spill_failure:
                 continue  # drain after failure; error raised on main thread
             try:
+                # phase split for the throughput story: compute = blocking
+                # D2H fetch + decode (device engine) or the host sort (host
+                # engine); write = spill-file IO. Both overlap the main
+                # thread's dispatch, so their SUM can exceed wall-clock —
+                # they identify the pipeline's bottleneck stage, not a
+                # wall-clock decomposition.
+                t0 = time.perf_counter()
                 batch, counts = item()  # blocking D2H + decode
+                t1 = time.perf_counter()
                 self._spill_run(batch, counts)
+                metrics.record_time("build.stream.spill_compute", t1 - t0)
+                metrics.record_time(
+                    "build.stream.spill_write", time.perf_counter() - t1
+                )
             except BaseException as e:  # noqa: BLE001 - re-raised on main
                 self._spill_failure.append(e)
 
@@ -531,15 +543,19 @@ class StreamingIndexWriter:
             readers = [layout.TcbReader(p) for p in self._spills]
             totals = np.sum(self._spill_counts, axis=0)
             self.out_dir.mkdir(parents=True, exist_ok=True)
+            read_s = merge_s = write_s = 0.0
             for b in range(self.num_buckets):
                 if totals[b] == 0:
                     continue
+                t_r = time.perf_counter()
                 runs = []
                 for reader, off in zip(readers, offsets):
                     s, e = int(off[b]), int(off[b + 1])
                     if e > s:
                         runs.append(reader.read(row_range=(s, e)))
+                t_m = time.perf_counter()
                 merged = merge_sorted_runs(runs, self.indexed_cols)
+                t_w = time.perf_counter()
                 p = self.out_dir / layout.bucket_file_name(b)
                 layout.write_batch(
                     p,
@@ -549,6 +565,12 @@ class StreamingIndexWriter:
                     extra=self.extra_meta,
                 )
                 written.append(p)
+                read_s += t_m - t_r
+                merge_s += t_w - t_m
+                write_s += time.perf_counter() - t_w
+            metrics.record_time("build.stream.merge_read", read_s)
+            metrics.record_time("build.stream.merge_sort", merge_s)
+            metrics.record_time("build.stream.merge_write", write_s)
             shutil.rmtree(self._spill_dir, ignore_errors=True)
         metrics.record_time("build.stream.finalize", time.perf_counter() - t0)
         # publish the compile/steady split (bench.py reports rows/s from
@@ -679,8 +701,20 @@ def write_index_data_streaming(
         engine=engine,
     )
     try:
-        for chunk in prefetch_chunks(chunks):
+        # time spent blocked on the prefetch queue = source decode is the
+        # bottleneck (the producer can't keep the device/sort stage fed);
+        # near-zero means ingest fully overlaps compute
+        it = iter(prefetch_chunks(chunks))
+        wait_s = 0.0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                chunk = next(it)
+            except StopIteration:
+                break
+            wait_s += time.perf_counter() - t0
             writer.add_chunk(chunk)
+        metrics.record_time("build.stream.ingest_wait", wait_s)
         return writer.finalize()
     except BaseException:
         writer.abort()

@@ -125,6 +125,38 @@ def _sort_by_bucket_and_keys(
     return out, sorted_ops[0], counts
 
 
+# One jitted closure per (schema, keys, num_buckets): jax.jit caches by
+# function object, so a closure defined inside build_partition_single
+# would RETRACE on every call — the persistent compile cache saves the
+# XLA compile but the per-call retrace (~100ms+) was still charged to
+# every streamed chunk and every device microbench repeat. Array shapes
+# vary freely under one cached closure (jit's own shape cache).
+_single_kernel_cache: dict = {}
+
+
+def _single_kernel(dtypes_key: tuple, key_names: tuple, num_buckets: int):
+    cache_key = (dtypes_key, key_names, num_buckets)
+    fn = _single_kernel_cache.get(cache_key)
+    if fn is not None:
+        return fn
+    dtypes = dict(dtypes_key)
+    keys = list(key_names)
+
+    @jax.jit
+    def kernel(arrays, vh, n_valid):
+        bucket = device_bucket_ids(arrays, dtypes, keys, vh, num_buckets)
+        m = bucket.shape[0]
+        bucket = jnp.where(
+            lax.iota(jnp.int32, m) < n_valid, bucket, num_buckets
+        )
+        return _sort_by_bucket_and_keys(arrays, bucket, keys, num_buckets)
+
+    if len(_single_kernel_cache) >= 64:
+        _single_kernel_cache.pop(next(iter(_single_kernel_cache)))
+    _single_kernel_cache[cache_key] = kernel
+    return kernel
+
+
 def build_partition_single(
     batch: ColumnarBatch,
     key_names: List[str],
@@ -171,16 +203,9 @@ def build_partition_single(
         if is_string(dtypes[k])
     }
     n_dev = jnp.asarray(n, dtype=jnp.int32)
-
-    @jax.jit
-    def kernel(arrays, vh, n_valid):
-        bucket = device_bucket_ids(arrays, dtypes, key_names, vh, num_buckets)
-        m = bucket.shape[0]
-        bucket = jnp.where(
-            lax.iota(jnp.int32, m) < n_valid, bucket, num_buckets
-        )
-        return _sort_by_bucket_and_keys(arrays, bucket, key_names, num_buckets)
-
+    kernel = _single_kernel(
+        tuple(sorted(dtypes.items())), tuple(key_names), num_buckets
+    )
     out_arrays, _sorted_bucket, counts_dev = kernel(arrays, vh, n_dev)
     vocabs = {name: batch.columns[name].vocab for name in batch.column_names}
 

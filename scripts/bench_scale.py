@@ -1,0 +1,439 @@
+"""SF10-class scale benchmark: the reproducible artifact behind every scale
+claim in README/docs (round-2 verdict missing #1).
+
+Builds a covering index over ``SCALE_ROWS`` rows (default 60M — the TPC-H
+SF10 lineitem row count) through the SAME session/action streaming path a
+user calls, then runs the BASELINE.md filter / Q3-shape / Q17-shape query
+configs with external pyarrow/Acero baselines and row/checksum parity
+gates. Emits ONE JSON object (pretty-printed to ``BENCH_SCALE.json`` at the
+repo root when invoked with ``--write``, and always printed as one line to
+stdout).
+
+The JSON carries the full phase decomposition of the build (ingest wait,
+spill compute/write, per-bucket merge read/sort/write) so end-to-end
+rows/s is *derivable*, not asserted — this is the artifact that settles
+round 2's unexplained 2.9M-vs-793k rows/s gap between the 2M-row bench and
+the manually-run 60M build: the small bench's "steady" window excludes the
+finalize merge entirely, while at 60M the merge (re-reading and re-writing
+every row, single-threaded) is a constant per-row cost that dominates the
+denominator. Both numbers are real; they measure different fractions of
+the pipeline. ``rows_per_s_end_to_end`` here is the honest whole-build
+rate.
+
+Reference parity: the reference gets scale for free by delegating to
+Spark's distributed scan→shuffle→bucketed write
+(CreateActionBase.scala:122-140); this artifact proves the TPU-native
+streaming pipeline (stream_builder.py) delivers the same
+arbitrarily-large-input property with bounded memory, and records peak RSS
+to show it.
+
+Env knobs: SCALE_ROWS (60_000_000), SCALE_BUCKETS (128), SCALE_REPEATS (2),
+SCALE_WORKDIR (.bench_scale_workspace), SCALE_KEEP=1 keeps the workspace
+(generated source data is reused across runs automatically when present).
+
+Run:  PYTHONPATH=/root/repo:/root/.axon_site python scripts/bench_scale.py --write
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import shutil
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+N_ROWS = int(os.environ.get("SCALE_ROWS", 60_000_000))
+N_BUCKETS = int(os.environ.get("SCALE_BUCKETS", 128))
+REPEATS = int(os.environ.get("SCALE_REPEATS", 2))
+WORKDIR = Path(os.environ.get("SCALE_WORKDIR", str(REPO / ".bench_scale_workspace")))
+GEN_CHUNK = 1 << 21  # rows generated per slab: bounds generation RSS at ~100MB
+N_LI_FILES = 32
+SHIP_MODES = np.array(
+    [b"AIR", b"SHIP", b"RAIL", b"MAIL", b"TRUCK", b"FOB", b"REG AIR"], dtype=object
+)
+
+
+def _rss_gb() -> float:
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024**2, 2)
+
+
+def _gen_lineitem_file(path: Path, seed: int, n: int, n_orders: int) -> None:
+    """One source file, generated slab-wise so RSS stays O(GEN_CHUNK).
+    Per-file seeding keeps regeneration deterministic and file-local."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng((42, seed))
+    writer = None
+    try:
+        for lo in range(0, n, GEN_CHUNK):
+            m = min(GEN_CHUNK, n - lo)
+            t = pa.table(
+                {
+                    "l_orderkey": rng.integers(1, n_orders, m).astype(np.int64),
+                    "l_partkey": rng.integers(1, 2_000_000, m).astype(np.int64),
+                    "l_suppkey": rng.integers(1, 100_000, m).astype(np.int64),
+                    "l_quantity": rng.integers(1, 51, m).astype(np.int64),
+                    "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, m), 2),
+                    "l_shipmode": pa.array(
+                        SHIP_MODES[rng.integers(0, 7, m)], type=pa.binary()
+                    ),
+                }
+            )
+            if writer is None:
+                writer = pq.ParquetWriter(str(path), t.schema)
+            writer.write_table(t)
+    finally:
+        if writer is not None:
+            writer.close()
+
+
+def _gen_orders(dir_path: Path, n_orders: int, n_files: int = 8) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(7)
+    per = (n_orders + n_files - 1) // n_files
+    for i in range(n_files):
+        lo, hi = i * per, min((i + 1) * per, n_orders)
+        t = pa.table(
+            {
+                "o_orderkey": np.arange(lo + 1, hi + 1).astype(np.int64),
+                "o_custkey": rng.integers(1, 1_500_000, hi - lo).astype(np.int64),
+                "o_totalprice": np.round(rng.uniform(1_000.0, 500_000.0, hi - lo), 2),
+            }
+        )
+        pq.write_table(t, str(dir_path / f"orders-{i:03d}.parquet"))
+
+
+def _ensure_data(n_rows: int, n_orders: int) -> float:
+    """Generate (or reuse) the source dataset; returns generation seconds
+    (0.0 when the cached workspace already matches)."""
+    marker = WORKDIR / "source.json"
+    want = {"rows": n_rows, "orders": n_orders, "files": N_LI_FILES, "gen": 3}
+    if marker.exists():
+        try:
+            if json.loads(marker.read_text()) == want:
+                return 0.0
+        except Exception:  # noqa: BLE001
+            pass
+    for sub in ("lineitem", "orders"):
+        shutil.rmtree(WORKDIR / sub, ignore_errors=True)
+    (WORKDIR / "lineitem").mkdir(parents=True, exist_ok=True)
+    (WORKDIR / "orders").mkdir(parents=True, exist_ok=True)
+    t0 = time.perf_counter()
+    per = (n_rows + N_LI_FILES - 1) // N_LI_FILES
+    for i in range(N_LI_FILES):
+        n = min(per, n_rows - i * per)
+        if n <= 0:
+            break
+        _gen_lineitem_file(
+            WORKDIR / "lineitem" / f"part-{i:03d}.parquet", i, n, n_orders
+        )
+    _gen_orders(WORKDIR / "orders", n_orders)
+    gen_s = time.perf_counter() - t0
+    marker.write_text(json.dumps(want))
+    return gen_s
+
+
+def _time(fn, repeats: int) -> float:
+    fn()  # warm-up
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _fail(reason: str):
+    print(json.dumps({"metric": "scale_build_rows_per_s", "value": 0.0,
+                      "unit": "rows/s", "error": reason}))
+    sys.exit(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write", action="store_true",
+                    help="write BENCH_SCALE.json at the repo root")
+    args = ap.parse_args()
+
+    import pyarrow.compute as pc
+    import pyarrow.dataset as pads
+
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.plan.aggregates import agg_avg, agg_count, agg_sum
+    from hyperspace_tpu.plan.expr import col
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    n_orders = max(N_ROWS // 4, 2)
+    gen_s = _ensure_data(N_ROWS, n_orders)
+    rss_after_gen = _rss_gb()
+
+    # a fresh index tree per run: the BUILD is the thing under test
+    shutil.rmtree(WORKDIR / "indexes", ignore_errors=True)
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(WORKDIR / "indexes"),
+            C.INDEX_NUM_BUCKETS: N_BUCKETS,
+            C.BUILD_MODE: C.BUILD_MODE_STREAMING,
+            C.BUILD_CHUNK_ROWS: 1 << 22,  # 4M-row chunks -> 15 chunks at 60M
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    df_li = session.read.parquet(str(WORKDIR / "lineitem"))
+    df_or = session.read.parquet(str(WORKDIR / "orders"))
+
+    # ---- the scale build ---------------------------------------------------
+    metrics.reset()
+    t0 = time.perf_counter()
+    hs.create_index(
+        df_li,
+        IndexConfig("li_idx", ["l_orderkey"], ["l_partkey", "l_extendedprice"]),
+    )
+    build_s = time.perf_counter() - t0
+    snap = metrics.snapshot()
+    timers, counters = snap["timers_s"], snap["counters"]
+    build = {
+        "build_s": round(build_s, 2),
+        "build_rows_per_s_end_to_end": round(N_ROWS / build_s),
+        "build_chunks": counters.get("build.stream.chunks", 0),
+        "build_rss_gb": _rss_gb(),
+        "phase_first_chunk_s": round(timers.get("build.stream.first_chunk", 0.0), 2),
+        "phase_steady_s": round(timers.get("build.stream.steady", 0.0), 2),
+        "phase_finalize_s": round(timers.get("build.stream.finalize", 0.0), 2),
+        "phase_ingest_wait_s": round(timers.get("build.stream.ingest_wait", 0.0), 2),
+        "phase_spill_compute_s": round(
+            timers.get("build.stream.spill_compute", 0.0), 2
+        ),
+        "phase_spill_write_s": round(timers.get("build.stream.spill_write", 0.0), 2),
+        "phase_merge_read_s": round(timers.get("build.stream.merge_read", 0.0), 2),
+        "phase_merge_sort_s": round(timers.get("build.stream.merge_sort", 0.0), 2),
+        "phase_merge_write_s": round(timers.get("build.stream.merge_write", 0.0), 2),
+    }
+    steady_rows = counters.get("build.stream.steady_rows", 0)
+    steady_s = timers.get("build.stream.steady", 0.0)
+    if steady_rows and steady_s > 0:
+        build["build_rows_per_s_steady"] = round(steady_rows / steady_s)
+    build["throughput_note"] = (
+        "steady rows/s excludes the first (setup-bearing) chunk and the "
+        "finalize merge; end-to-end rows/s divides ALL rows by ALL wall "
+        "time including the per-row merge rewrite — the r2 2.9M-vs-793k "
+        "discrepancy is exactly this definitional gap, now decomposed by "
+        "the phase_* timers"
+    )
+
+    # ---- external build baseline at the same scale -------------------------
+    # pyarrow doing the equivalent job: scan the three columns, bucket on
+    # the key, sort each bucket, write one parquet per bucket. Streamed
+    # per-bucket via repeated filtered scans would be pathological, so it
+    # materializes — its RSS is reported for the memory comparison.
+    def _ext_build():
+        import pyarrow.parquet as pq
+
+        out = WORKDIR / "ext_build"
+        shutil.rmtree(out, ignore_errors=True)
+        out.mkdir()
+        t = pads.dataset(str(WORKDIR / "lineitem"), format="parquet").to_table(
+            columns=["l_orderkey", "l_partkey", "l_extendedprice"]
+        )
+        bucket = pc.cast(
+            pc.bit_wise_and(t.column("l_orderkey"), N_BUCKETS - 1), "int32"
+        )
+        t = t.append_column("b", bucket)
+        t = t.sort_by([("b", "ascending"), ("l_orderkey", "ascending")])
+        bvals = t.column("b").to_numpy()
+        bounds = np.flatnonzero(np.diff(bvals)) + 1
+        starts = np.concatenate([[0], bounds])
+        ends = np.concatenate([bounds, [len(bvals)]])
+        for s_, e_ in zip(starts, ends):
+            pq.write_table(
+                t.slice(s_, e_ - s_).drop(["b"]),
+                str(out / f"b{int(bvals[s_]):05d}.parquet"),
+            )
+
+    t0 = time.perf_counter()
+    _ext_build()
+    build["build_external_s"] = round(time.perf_counter() - t0, 2)
+    build["rss_after_external_gb"] = _rss_gb()
+    shutil.rmtree(WORKDIR / "ext_build", ignore_errors=True)
+
+    # second-side index for the join configs (warm: probe memo + compile
+    # already paid)
+    t0 = time.perf_counter()
+    hs.create_index(df_or, IndexConfig("or_idx", ["o_orderkey"], ["o_totalprice"]))
+    build["build_orders_warm_s"] = round(time.perf_counter() - t0, 2)
+    hs.create_index(
+        df_li,
+        IndexConfig("li_q3_idx", ["l_orderkey"], ["l_partkey", "l_quantity"]),
+    )
+
+    speed, ext_speed, extras = {}, {}, {}
+
+    # ---- filter point lookup ----------------------------------------------
+    # the key is drawn from the data so it exists
+    probe = pads.dataset(
+        str(WORKDIR / "lineitem" / "part-000.parquet"), format="parquet"
+    ).head(1)
+    lookup_key = int(probe.column("l_orderkey")[0].as_py())
+    q2 = lambda: (  # noqa: E731
+        session.read.parquet(str(WORKDIR / "lineitem"))
+        .filter(col("l_orderkey") == lookup_key)
+        .select("l_orderkey", "l_partkey", "l_extendedprice")
+    )
+    session.disable_hyperspace()
+    off = q2().to_pandas().sort_values("l_partkey").reset_index(drop=True)
+    off_s = _time(lambda: q2().collect(), REPEATS)
+    session.enable_hyperspace()
+    on = q2().to_pandas().sort_values("l_partkey").reset_index(drop=True)
+    on_s = _time(lambda: q2().collect(), REPEATS)
+    if not off.equals(on):
+        _fail("filter row parity violated")
+    ext2 = lambda: pads.dataset(  # noqa: E731
+        str(WORKDIR / "lineitem"), format="parquet"
+    ).to_table(
+        filter=pc.field("l_orderkey") == lookup_key,
+        columns=["l_orderkey", "l_partkey", "l_extendedprice"],
+    )
+    if ext2().num_rows != len(on):
+        _fail("filter external row parity violated")
+    ext2_s = _time(ext2, REPEATS)
+    speed["filter_point_lookup"] = off_s / on_s
+    ext_speed["filter_point_lookup"] = ext2_s / on_s
+    extras.update(
+        filter_fullscan_s=round(off_s, 3),
+        filter_index_s=round(on_s, 4),
+        filter_external_s=round(ext2_s, 3),
+    )
+
+    # ---- Q3-shaped filtered join -------------------------------------------
+    qty_cut, price_cut = 45, 40_000.0
+    q3 = lambda: (  # noqa: E731
+        session.read.parquet(str(WORKDIR / "lineitem"))
+        .filter(col("l_quantity") > qty_cut)
+        .join(
+            session.read.parquet(str(WORKDIR / "orders"))
+            .filter(col("o_totalprice") < price_cut),
+            col("l_orderkey") == col("o_orderkey"),
+        )
+        .select("l_partkey", "o_totalprice")
+    )
+    session.disable_hyperspace()
+    q3_off = q3().collect()
+    q3off_s = _time(lambda: q3().collect(), REPEATS)
+    session.enable_hyperspace()
+    q3_on = q3().collect()
+    q3on_s = _time(lambda: q3().collect(), REPEATS)
+    if q3_off.num_rows != q3_on.num_rows:
+        _fail("q3 row-count parity violated")
+    if int(q3_off.columns["l_partkey"].data.sum()) != int(
+        q3_on.columns["l_partkey"].data.sum()
+    ):
+        _fail("q3 checksum parity violated")
+
+    def _ext_q3():
+        li = pads.dataset(str(WORKDIR / "lineitem"), format="parquet").to_table(
+            filter=pc.field("l_quantity") > qty_cut,
+            columns=["l_orderkey", "l_partkey"],
+        )
+        o = pads.dataset(str(WORKDIR / "orders"), format="parquet").to_table(
+            filter=pc.field("o_totalprice") < price_cut,
+            columns=["o_orderkey", "o_totalprice"],
+        )
+        return li.join(
+            o, keys="l_orderkey", right_keys="o_orderkey", join_type="inner"
+        ).select(["l_partkey", "o_totalprice"])
+
+    if _ext_q3().num_rows != q3_on.num_rows:
+        _fail("q3 external row-count parity violated")
+    ext3_s = _time(_ext_q3, REPEATS)
+    speed["q3_filtered_join"] = q3off_s / q3on_s
+    ext_speed["q3_filtered_join"] = ext3_s / q3on_s
+    extras.update(
+        q3_rows=int(q3_on.num_rows),
+        q3_fullscan_s=round(q3off_s, 3),
+        q3_index_s=round(q3on_s, 3),
+        q3_external_s=round(ext3_s, 3),
+    )
+
+    # ---- Q17-shaped aggregate over the indexed join ------------------------
+    q17 = lambda: (  # noqa: E731
+        session.read.parquet(str(WORKDIR / "lineitem"))
+        .filter(col("l_quantity") > qty_cut)
+        .join(
+            session.read.parquet(str(WORKDIR / "orders"))
+            .filter(col("o_totalprice") < price_cut),
+            col("l_orderkey") == col("o_orderkey"),
+        )
+        .group_by("l_partkey")
+        .agg(agg_sum("o_totalprice", "rev"), agg_avg("o_totalprice", "avg_rev"),
+             agg_count())
+    )
+    session.disable_hyperspace()
+    q17_off = q17().collect()
+    q17off_s = _time(lambda: q17().collect(), REPEATS)
+    session.enable_hyperspace()
+    q17_on = q17().collect()
+    q17on_s = _time(lambda: q17().collect(), REPEATS)
+    if q17_off.num_rows != q17_on.num_rows:
+        _fail("q17 group-count parity violated")
+    ref_sum = float(q17_off.columns["rev"].data.sum())
+    if abs(float(q17_on.columns["rev"].data.sum()) - ref_sum) > 1e-6 * abs(ref_sum):
+        _fail("q17 checksum parity violated")
+
+    def _ext_q17():
+        return _ext_q3().group_by("l_partkey").aggregate(
+            [("o_totalprice", "sum"), ("o_totalprice", "mean"),
+             ("o_totalprice", "count")]
+        )
+
+    if _ext_q17().num_rows != q17_on.num_rows:
+        _fail("q17 external group-count parity violated")
+    ext17_s = _time(_ext_q17, REPEATS)
+    speed["q17_aggregate_join"] = q17off_s / q17on_s
+    ext_speed["q17_aggregate_join"] = ext17_s / q17on_s
+    extras.update(
+        q17_groups=int(q17_on.num_rows),
+        q17_fullscan_s=round(q17off_s, 3),
+        q17_index_s=round(q17on_s, 3),
+        q17_external_s=round(ext17_s, 3),
+    )
+
+    out = {
+        "metric": "scale_build_rows_per_s",
+        "value": build["build_rows_per_s_end_to_end"],
+        "unit": "rows/s",
+        "rows": N_ROWS,
+        "num_buckets": N_BUCKETS,
+        "repeats": REPEATS,
+        "gen_s": round(gen_s, 1),
+        "rss_after_gen_gb": rss_after_gen,
+        "host_cores": os.cpu_count(),
+        **build,
+        **{f"speedup_{k}": round(v, 2) for k, v in speed.items()},
+        **{f"ext_speedup_{k}": round(v, 2) for k, v in ext_speed.items()},
+        **extras,
+        "final_rss_gb": _rss_gb(),
+    }
+    if args.write:
+        (REPO / "BENCH_SCALE.json").write_text(json.dumps(out, indent=1) + "\n")
+    print(json.dumps(out))
+    if not os.environ.get("SCALE_KEEP"):
+        shutil.rmtree(WORKDIR / "indexes", ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
