@@ -253,3 +253,292 @@ def hash_aggregate(
             codes, col, n_groups, want_max=(a.fn == "max"), order=minmax_order
         )
     return ColumnarBatch(out)
+
+
+def _join_ranges_native(l_all, r_all, group_by, aggs, lo, counts, r_order):
+    """Single-pass C++ fast path for the dense-int-key FK→PK aggregate
+    join (Q17's exact shape): one group key column with a bounded integer
+    domain, aggregates over right-side numeric columns only. One native
+    pass per value column replaces factorize + per-agg bincounts +
+    several full-width numpy temporaries. None when ineligible."""
+    from .. import native
+
+    if len(group_by) != 1:
+        return None
+    kcol = l_all.columns[group_by[0]]
+    if is_string(kcol.dtype_str) or kcol.data.dtype.kind not in "iu":
+        return None
+    rcols = []
+    for a in aggs:
+        if a.column is None:
+            continue
+        if a.column in l_all.column_names or a.column not in r_all.column_names:
+            return None  # left-side values use the generic path
+        c = r_all.columns[a.column]
+        if is_string(c.dtype_str):
+            return None
+        if c.data.dtype.kind not in "iuf":
+            return None
+        if a.column not in rcols:
+            rcols.append(a.column)
+    n_l = l_all.num_rows
+    # int64 BEFORE the subtraction: narrow key dtypes (int8/int16) would
+    # wrap across the sign boundary and hand the C kernel negative slot
+    # indices (out-of-bounds writes)
+    keys = kcol.data.astype(np.int64, copy=False)
+    mn = int(keys.min())
+    mx = int(keys.max())
+    span = mx - mn + 1
+    # span must be O(n): same dense-domain rule as _dense
+    if span <= 0 or span > max(4 * n_l, 1 << 16):
+        return None
+    offset_keys = keys - mn
+    per_col = {}
+    rows = None
+    for name in rcols:
+        vals = r_all.columns[name].data
+        if r_order is not None:
+            vals = vals[r_order]
+        if vals.dtype.kind == "f":
+            vals = vals.astype(np.float64, copy=False)
+        else:
+            vals = vals.astype(np.int64, copy=False)
+        res = native.group_agg_ranges(offset_keys, lo, counts, vals, span)
+        if res is None:
+            return None
+        per_col[name] = res
+        rows = res[2]
+    if rows is None:  # count(*)-only aggregation
+        rows64 = np.bincount(
+            offset_keys, weights=counts.astype(np.float64), minlength=span
+        )
+        rows = rows64.astype(np.int64)
+    keep = np.flatnonzero(rows > 0)
+    schema = r_all.schema()
+    out: Dict[str, Column] = {
+        group_by[0]: Column(
+            kcol.dtype_str, (keep + mn).astype(kcol.data.dtype), kcol.vocab
+        )
+    }
+    for a in aggs:
+        if a.column is None:
+            out[a.name] = Column("int64", rows[keep])
+            continue
+        sums, nn, _ = per_col[a.column]
+        dt = output_dtype(a, schema[a.column])
+        if a.fn == "count":
+            out[a.name] = Column("int64", nn[keep])
+        elif a.fn == "avg":
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out[a.name] = Column(
+                    "float64", sums[keep].astype(np.float64) / nn[keep]
+                )
+        else:
+            s = sums[keep].astype(numpy_dtype(dt))
+            if dt.startswith("float"):
+                # SQL NULL: sum of an all-NULL group is NULL
+                s = np.where(nn[keep] == 0, np.nan, s)
+            out[a.name] = Column(dt, s)
+    return ColumnarBatch(out)
+
+
+@metrics.timer("aggregate.join_ranges")
+def aggregate_join_ranges(
+    l_all: ColumnarBatch,
+    r_all: ColumnarBatch,
+    group_by: Sequence[str],
+    aggs: Sequence[AggSpec],
+    lo: np.ndarray,
+    counts: np.ndarray,
+    r_order,
+):
+    """Aggregate an inner join from its match ranges — no pair expansion.
+
+    ``(lo, counts, r_order)`` come from joins.bucketed_join_ranges: left
+    row i matches right positions r_order[lo[i]:lo[i]+counts[i]] (r_order
+    None = identity). An output row of the join replicates left row i
+    ``counts[i]`` times, so:
+
+    * count(*) per group        = Σ counts over the group's left rows;
+    * sum/count of a LEFT col   = Σ value·counts / Σ valid·counts;
+    * sum/count of a RIGHT col  = per-left-row range sums via prefix
+      arithmetic (exact int64 — wraparound cancels in the difference), or
+      a direct gather when every count ≤ 1 (the FK→PK join, where the
+      right key is unique — Q17's shape);
+    * groups whose total count is 0 do not appear (inner-join semantics).
+
+    Returns None when the shape isn't supported (min/max, string values,
+    float right columns under duplicate matches — the float prefix-sum
+    difference loses precision that bincount never does; the caller falls
+    back to materialize + hash_aggregate). Supported combinations produce
+    EXACTLY hash_aggregate's results, NULL semantics included.
+    """
+    lset = set(l_all.column_names)
+    rset = set(r_all.column_names)
+    if not group_by or not all(g in lset for g in group_by):
+        return None
+    n_l = l_all.num_rows
+    if n_l == 0 or len(counts) != n_l:
+        return None
+    uniq_right = bool(counts.max() <= 1) if len(counts) else True
+    for a in aggs:
+        if a.fn not in ("count", "sum", "avg"):
+            return None
+
+    # native single-pass fast path first: it accumulates float right
+    # columns DIRECTLY (no prefix trick), so it is not subject to the
+    # generic path's float-under-duplicate-matches restriction below
+    fast = _join_ranges_native(l_all, r_all, group_by, aggs, lo, counts, r_order)
+    if fast is not None:
+        metrics.incr("aggregate.path.join_fused_native")
+        return fast
+
+    for a in aggs:
+        if a.column is None:
+            continue
+        if a.column in lset:
+            col = l_all.columns[a.column]
+            if a.fn != "count" and is_string(col.dtype_str):
+                return None
+        elif a.column in rset:
+            col = r_all.columns[a.column]
+            if is_string(col.dtype_str):
+                return None  # valid-prefix plumbing not worth the branch
+            if (
+                col.data.dtype.kind == "f"
+                and not uniq_right
+                and a.fn in ("sum", "avg")
+            ):
+                return None
+        else:
+            return None
+
+    codes, n_groups, rep = _group_codes(l_all, list(group_by))
+    # rows per group: float64 bincount is exact below 2^53 rows — beyond
+    # any materializable join
+    rows_per_group = np.bincount(
+        codes, weights=counts.astype(np.float64), minlength=n_groups
+    )
+    keep = rows_per_group > 0
+
+    hi = lo + counts
+    _range_cache: Dict[str, tuple] = {}
+    _left_cache: Dict[str, tuple] = {}
+
+    def right_range_sums(name: str):
+        """(per-left-row sum, per-left-row non-NULL count) of a right
+        column over each match range, exactly. Memoized per column —
+        sum+avg over the same column (the Q17 shape) share one pass."""
+        if name in _range_cache:
+            return _range_cache[name]
+        col = r_all.columns[name]
+        vals = col.data if r_order is None else col.data[r_order]
+        if vals.dtype.kind == "f":
+            valid = ~np.isnan(vals)
+            v64 = np.where(valid, vals, 0.0).astype(np.float64)
+        else:
+            valid = np.ones(len(vals), dtype=bool)
+            v64 = vals.astype(np.int64)
+        if uniq_right:
+            pos = np.where(counts > 0, lo, 0)
+            hit = counts > 0
+            s = np.where(hit, v64[pos], 0)
+            nn = np.where(hit & valid[pos], 1, 0).astype(np.int64)
+            if vals.dtype.kind == "f":
+                s = np.where(nn > 0, s, 0.0)
+            _range_cache[name] = (s, nn)
+            return _range_cache[name]
+        # prefix differences: int64 wraparound cancels exactly; floats
+        # were excluded above
+        cum = np.concatenate([[0], np.cumsum(v64, dtype=np.int64)])
+        ncum = np.concatenate([[0], np.cumsum(valid.astype(np.int64))])
+        _range_cache[name] = (cum[hi] - cum[lo], ncum[hi] - ncum[lo])
+        return _range_cache[name]
+
+    def group_accumulate(per_left, dt: str, cache_key=None) -> np.ndarray:
+        """Σ per-left contributions per group, exact for int outputs.
+        ``cache_key`` memoizes shared accumulations (a column's nn, or
+        sum+avg over one column)."""
+        if cache_key is not None and cache_key in _left_cache:
+            return _left_cache[cache_key]
+        out = _group_accumulate_raw(per_left, dt)
+        if cache_key is not None:
+            _left_cache[cache_key] = out
+        return out
+
+    def _group_accumulate_raw(per_left, dt: str) -> np.ndarray:
+        if not dt.startswith("float") and per_left.dtype.kind in "iu":
+            bound = (
+                max(abs(int(per_left.min())), abs(int(per_left.max())))
+                if len(per_left)
+                else 0
+            )
+            if len(per_left) * bound >= (1 << 53):
+                acc = np.zeros(n_groups, dtype=np.int64)
+                np.add.at(acc, codes, per_left)
+                return acc
+        return np.bincount(
+            codes, weights=per_left.astype(np.float64), minlength=n_groups
+        )
+
+    schema = {**l_all.schema(), **r_all.schema()}
+    out: Dict[str, Column] = {}
+    key_batch = l_all.select(list(group_by)).take(rep)
+    for name, col in key_batch.columns.items():
+        out[name] = Column(col.dtype_str, col.data[keep], col.vocab)
+
+    kidx = np.flatnonzero(keep)
+    for a in aggs:
+        dt = output_dtype(a, schema.get(a.column) if a.column else None)
+        if a.column is None:
+            out[a.name] = Column("int64", rows_per_group[kidx].astype(np.int64))
+            continue
+        from_left = a.column in lset
+        if from_left:
+            col = l_all.columns[a.column]
+            if is_string(col.dtype_str):
+                valid_l = col.data >= 0
+                nn = group_accumulate(
+                    np.where(valid_l, counts, 0), "int64",
+                    cache_key=("nn_l", a.column),
+                )
+                out[a.name] = Column("int64", nn[kidx].astype(np.int64))
+                continue
+            if col.data.dtype.kind == "f":
+                valid_l = ~np.isnan(col.data)
+                v = np.where(valid_l, col.data, 0.0).astype(np.float64)
+            else:
+                valid_l = np.ones(n_l, dtype=bool)
+                v = col.data.astype(np.int64)
+            nn = group_accumulate(
+                np.where(valid_l, counts, 0), "int64",
+                cache_key=("nn_l", a.column),
+            )
+            if a.fn == "count":
+                out[a.name] = Column("int64", nn[kidx].astype(np.int64))
+                continue
+            sums = group_accumulate(
+                v * counts, dt, cache_key=("sum_l", a.column, dt.startswith("float"))
+            )
+        else:
+            sums_pl, nn_pl = right_range_sums(a.column)
+            nn = group_accumulate(
+                nn_pl, "int64", cache_key=("nn_r", a.column)
+            )
+            if a.fn == "count":
+                out[a.name] = Column("int64", nn[kidx].astype(np.int64))
+                continue
+            sums = group_accumulate(
+                sums_pl, dt, cache_key=("sum_r", a.column, dt.startswith("float"))
+            )
+        if a.fn == "avg":
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out[a.name] = Column("float64", (sums / nn)[kidx])
+            continue
+        s = sums[kidx].astype(numpy_dtype(dt))
+        if dt.startswith("float"):
+            # SQL NULL: sum of an all-NULL group is NULL
+            s = np.where(nn[kidx] == 0, np.nan, s)
+        out[a.name] = Column(dt, s)
+    metrics.incr("aggregate.path.join_fused")
+    return ColumnarBatch(out)

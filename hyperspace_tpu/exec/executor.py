@@ -189,6 +189,9 @@ class Executor:
                 fused = self._try_distributed_aggregate(plan)
                 if fused is not None:
                     return self._apply_predicate(fused, predicate)
+            fused = self._try_join_aggregate(plan)
+            if fused is not None:
+                return self._apply_predicate(fused, predicate)
             need = list(
                 dict.fromkeys(
                     list(plan.group_by)
@@ -416,6 +419,92 @@ class Executor:
         return fused if fused is not None else host_finish()
 
     # -- joins ---------------------------------------------------------------
+    def _try_join_aggregate(self, plan: "Aggregate") -> Optional[ColumnarBatch]:
+        """Fuse Aggregate([Project](Join)) over a bucketed SMJ into range
+        arithmetic: the join's match ranges (lo, counts) feed
+        aggregate_join_ranges directly — the expanded pair arrays and the
+        materialized joined batch (the bulk of Q17's indexed time) are
+        never built. Falls back (None) whenever the shapes, key columns,
+        or aggregate functions don't qualify; results are identical to
+        materialize + hash_aggregate."""
+        from .aggregate import aggregate_join_ranges
+        from .joins import bucketed_join_ranges
+
+        if self.mesh is not None:
+            # the mesh path has its own distributed join + two-phase
+            # aggregate; the host fusion must not hijack it
+            return None
+        node = plan.child
+        if isinstance(node, Project):
+            node = node.child
+        if not isinstance(node, Join):
+            return None
+        # metadata-decidable eligibility BEFORE any bucket I/O: an
+        # ineligible shape would load both sides, fail in
+        # aggregate_join_ranges, then re-load everything on the fallback
+        if any(a.fn not in ("count", "sum", "avg") for a in plan.aggs):
+            return None
+        pairs = extract_equi_condition(node.condition)
+        if pairs is None:
+            return None
+        oriented = align_condition_sides(
+            pairs, node.left.output_columns(), node.right.output_columns()
+        )
+        if oriented is None:
+            return None
+        l_keys = [l for l, _ in oriented]
+        r_keys = [r for _, r in oriented]
+        # same metadata gates as _try_bucketed_join
+        l_meta = self._bucketed_meta(node.left)
+        r_meta = self._bucketed_meta(node.right)
+        if l_meta is None or r_meta is None:
+            return None
+        if l_meta.entry.num_buckets != r_meta.entry.num_buckets:
+            return None
+        if {c.lower() for c in l_meta.entry.indexed_columns} != {
+            k.lower() for k in l_keys
+        } or {c.lower() for c in r_meta.entry.indexed_columns} != {
+            k.lower() for k in r_keys
+        }:
+            return None
+        # the fusion needs group keys on the LEFT side; the inner join is
+        # symmetric, so swap when they live on the right
+        group_by = list(plan.group_by)
+        left_cols = {c.lower() for c in node.left.output_columns()}
+        right_cols = {c.lower() for c in node.right.output_columns()}
+        sides = (node.left, node.right, l_keys, r_keys)
+        if not all(g.lower() in left_cols for g in group_by):
+            if not all(g.lower() in right_cols for g in group_by):
+                return None  # group keys span both sides: not fusable
+            sides = (node.right, node.left, r_keys, l_keys)
+        left_plan, right_plan, lk, rk = sides
+        lload = self._scan_side_by_bucket(left_plan)
+        rload = self._scan_side_by_bucket(right_plan)
+        if lload is None or rload is None:
+            return None
+        l_by_bucket, l_node, l_project = lload
+        r_by_bucket, r_node, r_project = rload
+        if l_project is not None:
+            l_by_bucket = {
+                b: v.select(list(l_project.columns)) for b, v in l_by_bucket.items()
+            }
+        if r_project is not None:
+            r_by_bucket = {
+                b: v.select(list(r_project.columns)) for b, v in r_by_bucket.items()
+            }
+        # merge runs in index order (compatible_pairs alignment), as in
+        # _try_bucketed_join
+        k2k = {a.lower(): b for a, b in zip(lk, rk)}
+        lk = list(l_node.entry.indexed_columns) if l_node else lk
+        rk = [k2k[k.lower()] for k in lk]
+        ranges = bucketed_join_ranges(l_by_bucket, r_by_bucket, lk, rk)
+        if ranges is None:
+            return None
+        l_all, r_all, lo, counts, r_order = ranges
+        return aggregate_join_ranges(
+            l_all, r_all, group_by, list(plan.aggs), lo, counts, r_order
+        )
+
     def _exec_join(self, join: Join) -> ColumnarBatch:
         pairs = extract_equi_condition(join.condition)
         if pairs is None:

@@ -26,6 +26,7 @@ _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_FAILED = False
 _HAS_SMJ = False
+_HAS_GROUP_AGG = False
 
 
 def _build_dir() -> Path:
@@ -119,6 +120,21 @@ def _bind_symbols(lib: ctypes.CDLL) -> None:
         _HAS_SMJ = True
     except AttributeError:
         _HAS_SMJ = False
+    global _HAS_GROUP_AGG
+    try:
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        lib.hs_group_agg_ranges_f64.restype = None
+        lib.hs_group_agg_ranges_f64.argtypes = [
+            i64p, i64p, i64p, ctypes.c_int64, f64p, f64p, i64p, i64p,
+        ]
+        lib.hs_group_agg_ranges_i64.restype = None
+        lib.hs_group_agg_ranges_i64.argtypes = [
+            i64p, i64p, i64p, ctypes.c_int64, i64p, i64p, i64p, i64p,
+        ]
+        _HAS_GROUP_AGG = True
+    except AttributeError:
+        _HAS_GROUP_AGG = False
 
 
 def _i64ptr(a: np.ndarray):
@@ -198,6 +214,79 @@ def write_file_atomic(path: str, data: bytes | np.ndarray) -> bool:
         finally:
             raise OSError(rc, os.strerror(rc) if rc > 0 else "IO error", path)
     return True
+
+
+def group_agg_ranges(
+    keys: np.ndarray,
+    lo: np.ndarray,
+    counts: np.ndarray,
+    r_vals: np.ndarray,
+    span: int,
+):
+    """Single-pass dense group aggregate over SMJ match ranges: returns
+    (sums, nn, rows) arrays of length ``span`` — per dense key slot, the
+    sum / non-NULL count of ``r_vals`` over the key's match ranges and
+    the joined row count. ``keys`` must be pre-offset to [0, span).
+    float64 r_vals skip NaN (SQL NULL); int64 accumulate exactly.
+    None when the native library lacks the symbol (caller falls back)."""
+    lib = _load()
+    if lib is None or not _HAS_GROUP_AGG:
+        return None
+    k = np.ascontiguousarray(keys, dtype=np.int64)
+    lo_ = np.ascontiguousarray(lo, dtype=np.int64)
+    cnt = np.ascontiguousarray(counts, dtype=np.int64)
+    nn = np.zeros(span, dtype=np.int64)
+    rows = np.zeros(span, dtype=np.int64)
+    n_l = np.int64(len(k))
+    if r_vals.dtype == np.float64:
+        v = np.ascontiguousarray(r_vals)
+        sums = np.zeros(span, dtype=np.float64)
+        lib.hs_group_agg_ranges_f64(
+            _i64ptr(k), _i64ptr(lo_), _i64ptr(cnt), n_l,
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            sums.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            _i64ptr(nn), _i64ptr(rows),
+        )
+        return sums, nn, rows
+    v = np.ascontiguousarray(r_vals, dtype=np.int64)
+    sums = np.zeros(span, dtype=np.int64)
+    lib.hs_group_agg_ranges_i64(
+        _i64ptr(k), _i64ptr(lo_), _i64ptr(cnt), n_l,
+        _i64ptr(v), _i64ptr(sums), _i64ptr(nn), _i64ptr(rows),
+    )
+    return sums, nn, rows
+
+
+def smj_ranges(
+    l_codes: np.ndarray,
+    r_codes: np.ndarray,
+    l_bounds: np.ndarray,
+    r_bounds: np.ndarray,
+    n_threads: int = 0,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Match ranges of the segment-aligned SMJ WITHOUT pair expansion:
+    per left row, (first matching right position, match count). The
+    aggregate-over-join fusion consumes ranges directly — expanding to
+    pair arrays first would write (and immediately re-read) 16 bytes per
+    output pair for nothing. None when the native library is missing."""
+    lib = _load()
+    if lib is None or not _HAS_SMJ:
+        return None
+    l = np.ascontiguousarray(l_codes, dtype=np.int64)
+    r = np.ascontiguousarray(r_codes, dtype=np.int64)
+    lb = np.ascontiguousarray(l_bounds, dtype=np.int64)
+    rb = np.ascontiguousarray(r_bounds, dtype=np.int64)
+    n_seg = len(lb) - 1
+    if n_seg != len(rb) - 1:
+        raise ValueError("smj_ranges: segment counts differ.")
+    n_l = len(l)
+    lo = np.empty(n_l, dtype=np.int64)
+    cnt = np.empty(n_l, dtype=np.int64)
+    lib.hs_smj_ranges(
+        _i64ptr(l), _i64ptr(r), _i64ptr(lb), _i64ptr(rb),
+        np.int32(n_seg), _i64ptr(lo), _i64ptr(cnt), int(n_threads),
+    )
+    return lo, cnt
 
 
 def smj_pairs(

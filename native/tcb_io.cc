@@ -11,6 +11,7 @@
 #include <algorithm>
 #include <atomic>
 #include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -204,6 +205,92 @@ void hs_expand_pairs(const int64_t *lo, const int64_t *cnt, const int64_t *off,
     }
     for (auto &t : pool)
       t.join();
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Fused group-by aggregate over SMJ match ranges (the Q17 hot path).
+//
+// One pass over the left rows accumulates, into dense per-group slots
+// (group keys pre-offset by the caller to 0..span), the join's row count
+// and the sum / non-NULL count of ONE right-side value column read
+// straight through the match ranges — the pair expansion, the 16-byte-
+// per-pair index traffic, the joined-batch gathers, and the separate
+// factorize+bincount passes of the materialized path all disappear.
+// Sequential by design: the scatter targets shared slots, and the whole
+// pass is memory-bound on one stream.
+// ---------------------------------------------------------------------------
+// The scatter into per-group slots is the pass's wall: three separate
+// span-sized arrays cost three cache misses per left row. One interleaved
+// 24-byte slot {sum, nn, rows} keeps a group's whole accumulator on one
+// cache line — measured ~2x on the 200k-group Q17 shape — and is copied
+// out to the caller's arrays once at the end.
+namespace {
+struct AggSlot {
+  double sum;
+  int64_t nn;
+  int64_t rows;
+};
+struct AggSlotI {
+  int64_t sum;
+  int64_t nn;
+  int64_t rows;
+};
+} // namespace
+
+void hs_group_agg_ranges_f64(const int64_t *keys, const int64_t *lo,
+                             const int64_t *cnt, int64_t n_l,
+                             const double *r_vals, double *sums, int64_t *nn,
+                             int64_t *rows) {
+  int64_t span = 0;
+  for (int64_t i = 0; i < n_l; ++i)
+    span = std::max(span, keys[i] + 1);
+  std::vector<AggSlot> acc(static_cast<size_t>(span), AggSlot{0.0, 0, 0});
+  for (int64_t i = 0; i < n_l; ++i) {
+    AggSlot &s = acc[static_cast<size_t>(keys[i])];
+    const int64_t c = cnt[i];
+    s.rows += c;
+    const int64_t b = lo[i], e = b + c;
+    for (int64_t j = b; j < e; ++j) {
+      const double v = r_vals[j];
+      if (!std::isnan(v)) {
+        s.sum += v;
+        s.nn += 1;
+      }
+    }
+  }
+  for (int64_t k = 0; k < span; ++k) {
+    sums[k] = acc[static_cast<size_t>(k)].sum;
+    nn[k] = acc[static_cast<size_t>(k)].nn;
+    rows[k] = acc[static_cast<size_t>(k)].rows;
+  }
+}
+
+// int64 variant: exact (wraparound is modular and cancels nowhere — the
+// true sum either fits int64 or the caller's bound guard routed away).
+// Integers have no NULL, so nn == rows contribution per match.
+void hs_group_agg_ranges_i64(const int64_t *keys, const int64_t *lo,
+                             const int64_t *cnt, int64_t n_l,
+                             const int64_t *r_vals, int64_t *sums, int64_t *nn,
+                             int64_t *rows) {
+  int64_t span = 0;
+  for (int64_t i = 0; i < n_l; ++i)
+    span = std::max(span, keys[i] + 1);
+  std::vector<AggSlotI> acc(static_cast<size_t>(span), AggSlotI{0, 0, 0});
+  for (int64_t i = 0; i < n_l; ++i) {
+    AggSlotI &s = acc[static_cast<size_t>(keys[i])];
+    const int64_t c = cnt[i];
+    s.rows += c;
+    const int64_t b = lo[i], e = b + c;
+    for (int64_t j = b; j < e; ++j) {
+      s.sum += r_vals[j];
+      s.nn += 1;
+    }
+  }
+  for (int64_t k = 0; k < span; ++k) {
+    sums[k] = acc[static_cast<size_t>(k)].sum;
+    nn[k] = acc[static_cast<size_t>(k)].nn;
+    rows[k] = acc[static_cast<size_t>(k)].rows;
   }
 }
 

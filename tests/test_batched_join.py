@@ -176,6 +176,7 @@ def test_presorted_segmented_merge_flat(monkeypatch):
     from hyperspace_tpu.exec.joins import merge_join_indices_segmented
 
     monkeypatch.setattr(native, "smj_pairs", lambda *a, **k: None)
+    monkeypatch.setattr(native, "smj_ranges", lambda *a, **k: None)
     l, r, lb, rb, exp = _seg_data(seed=13)
     before = metrics.counter("join.path.presorted_merge_flat")
     li, ri = merge_join_indices_segmented(l, r, lb, rb)
@@ -191,6 +192,7 @@ def test_presorted_segmented_merge_wide_span_loop(monkeypatch):
     from hyperspace_tpu.exec.joins import merge_join_indices_segmented
 
     monkeypatch.setattr(native, "smj_pairs", lambda *a, **k: None)
+    monkeypatch.setattr(native, "smj_ranges", lambda *a, **k: None)
     l = np.array([-(1 << 61), 5, 7, (1 << 61), (1 << 61) + 3], dtype=np.int64)
     r = np.array([5, 5, (1 << 61), (1 << 61) + 3], dtype=np.int64)
     lb = np.array([0, 3, 5])

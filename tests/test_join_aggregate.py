@@ -1,0 +1,231 @@
+"""Aggregate-over-join fusion parity: aggregate_join_ranges (and its
+native single-pass fast path) must equal materialize + hash_aggregate for
+every supported shape, across dtypes, NULLs, duplicate/unique right keys,
+and sorted/unsorted segments. The repo's oracle convention is parity
+fuzzing (tests/test_fuzz_parity.py); this file applies it to the fused
+path — a dtype-randomized fuzz is exactly what catches narrow-int offset
+wraps and NULL-semantics drift."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.exec.aggregate import aggregate_join_ranges, hash_aggregate
+from hyperspace_tpu.exec.joins import bucketed_join_pairs, bucketed_join_ranges
+from hyperspace_tpu.ops.hashing import bucket_ids_host, key_repr
+from hyperspace_tpu.plan.aggregates import (
+    agg_avg,
+    agg_count,
+    agg_sum,
+)
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+
+def split_by_bucket(batch, keys, nb, sort_keys=False):
+    b = bucket_ids_host([key_repr(batch.columns[k]) for k in keys], nb)
+    out = {}
+    for x in np.unique(b):
+        part = batch.take(np.flatnonzero(b == x))
+        if sort_keys:
+            order = np.argsort(part.columns[keys[0]].data, kind="stable")
+            part = part.take(order)
+        out[int(x)] = part
+    return out
+
+
+def _fused(lb, rb, group_by, aggs):
+    ranges = bucketed_join_ranges(lb, rb, ["lk"], ["rk"])
+    assert ranges is not None
+    l_all, r_all, lo, counts, r_order = ranges
+    return aggregate_join_ranges(l_all, r_all, group_by, aggs, lo, counts, r_order)
+
+
+def _materialized(lb, rb, group_by, aggs):
+    parts = bucketed_join_pairs(lb, rb, ["lk"], ["rk"])
+    joined = ColumnarBatch.concat(parts)
+    return hash_aggregate(joined, group_by, list(aggs))
+
+
+def _assert_parity(got, exp, group_by):
+    assert got is not None
+    gdf = got.to_pandas().sort_values(group_by).reset_index(drop=True)
+    edf = exp.to_pandas().sort_values(group_by).reset_index(drop=True)
+    assert list(gdf.columns) == list(edf.columns)
+    assert len(gdf) == len(edf)
+    for c in edf.columns:
+        g, e = gdf[c].to_numpy(), edf[c].to_numpy()
+        if e.dtype.kind == "f":
+            np.testing.assert_allclose(g, e, rtol=1e-9, equal_nan=True)
+        else:
+            np.testing.assert_array_equal(g, e)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fused_aggregate_parity_fuzz(seed):
+    rng = np.random.default_rng(9000 + seed)
+    n_l = int(rng.integers(200, 4000))
+    n_r = int(rng.integers(50, 1500))
+    nb = int(rng.choice([4, 8, 16]))
+    key_dt = rng.choice(["int8", "int16", "int32", "int64"])
+    val_dt = rng.choice(["int32", "int64", "float32", "float64"])
+    unique_right = bool(rng.random() < 0.5)
+    sort_buckets = bool(rng.random() < 0.5)
+    key_hi = min(int(rng.integers(20, 120)), np.iinfo(np.dtype(key_dt)).max)
+    key_lo = max(-key_hi, int(np.iinfo(np.dtype(key_dt)).min))
+
+    if unique_right:
+        rk = rng.permutation(np.arange(n_r * 3))[:n_r].astype(np.int64)
+    else:
+        rk = rng.integers(0, max(n_r // 2, 2), n_r).astype(np.int64)
+    lk = rng.choice(rk, n_l).astype(np.int64)
+    lk[rng.random(n_l) < 0.2] = -5  # some left rows match nothing
+
+    gvals = rng.integers(key_lo, key_hi + 1, n_l).astype(np.dtype(key_dt))
+    rvals = rng.normal(0, 100, n_r).astype(np.dtype(val_dt))
+    if val_dt.startswith("float"):
+        rvals[rng.random(n_r) < 0.15] = np.nan  # NULLs
+    lvals = rng.integers(-50, 50, n_l).astype(np.int64)
+
+    left = ColumnarBatch(
+        {
+            "lk": Column("int64", lk),
+            "g": Column(key_dt, gvals),
+            "lv": Column("int64", lvals),
+        }
+    )
+    right = ColumnarBatch(
+        {"rk": Column("int64", rk), "rv": Column(val_dt, rvals)}
+    )
+    lb = split_by_bucket(left, ["lk"], nb, sort_keys=sort_buckets)
+    rb = split_by_bucket(right, ["rk"], nb, sort_keys=sort_buckets)
+    if not (set(lb) & set(rb)):
+        return  # no common buckets: nothing to compare
+
+    # right-only aggregates: the native single-pass kernel is eligible for
+    # every dtype mix here (incl. float values under duplicate matches),
+    # so this comparison must never fall back
+    aggs_r = [agg_count(), agg_sum("rv", "s"), agg_avg("rv", "a"),
+              agg_count("rv", "c")]
+    got = _fused(lb, rb, ["g"], aggs_r)
+    assert got is not None
+    _assert_parity(got, _materialized(lb, rb, ["g"], aggs_r), ["g"])
+
+    # adding a left-side value column exercises the generic (numpy) fused
+    # path; float right values under duplicate matches legitimately fall
+    # back there (prefix-difference precision), so None is acceptable
+    aggs_full = aggs_r + [agg_sum("lv", "ls")]
+    got_full = _fused(lb, rb, ["g"], aggs_full)
+    if got_full is not None:
+        _assert_parity(got_full, _materialized(lb, rb, ["g"], aggs_full), ["g"])
+
+
+def test_fused_int8_key_spanning_sign_boundary():
+    """Regression: int8 group keys spanning -128..127 must not wrap when
+    the native fast path builds dense slot offsets (an int8 subtraction
+    would produce negative slots → out-of-bounds C writes)."""
+    n_r = 64
+    rk = np.arange(n_r, dtype=np.int64)
+    lk = np.tile(rk, 8)
+    g = np.tile(
+        np.array([-128, -1, 0, 127], dtype=np.int8), len(lk) // 4
+    )
+    left = ColumnarBatch(
+        {"lk": Column("int64", lk), "g": Column("int8", g)}
+    )
+    right = ColumnarBatch(
+        {
+            "rk": Column("int64", rk),
+            "rv": Column("float64", np.linspace(0, 1, n_r)),
+        }
+    )
+    lb = split_by_bucket(left, ["lk"], 4, sort_keys=True)
+    rb = split_by_bucket(right, ["rk"], 4, sort_keys=True)
+    aggs = [agg_count(), agg_sum("rv", "s"), agg_avg("rv", "a")]
+    got = _fused(lb, rb, ["g"], aggs)
+    exp = _materialized(lb, rb, ["g"], aggs)
+    _assert_parity(got, exp, ["g"])
+    assert set(got.columns["g"].data.tolist()) == {-128, -1, 0, 127}
+
+
+def test_fused_rejects_minmax_and_string_values():
+    from hyperspace_tpu.plan.aggregates import agg_min
+
+    rng = np.random.default_rng(3)
+    rk = np.arange(40, dtype=np.int64)
+    left = ColumnarBatch(
+        {
+            "lk": Column("int64", rng.choice(rk, 200)),
+            "g": Column("int64", rng.integers(0, 5, 200)),
+        }
+    )
+    right = ColumnarBatch(
+        {"rk": Column("int64", rk), "rv": Column("float64", rng.normal(0, 1, 40))}
+    )
+    lb = split_by_bucket(left, ["lk"], 4)
+    rb = split_by_bucket(right, ["rk"], 4)
+    assert _fused(lb, rb, ["g"], [agg_min("rv", "m")]) is None
+
+
+def test_executor_fuses_aggregate_over_indexed_join(tmp_workspace):
+    """End-to-end through the session: Aggregate(Join(idx, idx)) takes the
+    fused path (counter) and equals the hyperspace-off answer."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.plan.expr import col
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    rng = np.random.default_rng(11)
+    n = 6000
+    (tmp_workspace / "li").mkdir()
+    (tmp_workspace / "orders").mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "okey": rng.integers(1, 1200, n).astype(np.int64),
+                "pkey": rng.integers(1, 300, n).astype(np.int64),
+            }
+        ),
+        str(tmp_workspace / "li" / "a.parquet"),
+    )
+    pq.write_table(
+        pa.table(
+            {
+                "o_okey": np.arange(1, 1201).astype(np.int64),
+                "price": rng.normal(100, 20, 1200),
+            }
+        ),
+        str(tmp_workspace / "orders" / "a.parquet"),
+    )
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_workspace / "indexes"),
+            C.INDEX_NUM_BUCKETS: 8,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    df_li = session.read.parquet(str(tmp_workspace / "li"))
+    df_or = session.read.parquet(str(tmp_workspace / "orders"))
+    hs.create_index(df_li, IndexConfig("li_i", ["okey"], ["pkey"]))
+    hs.create_index(df_or, IndexConfig("or_i", ["o_okey"], ["price"]))
+
+    q = lambda: (  # noqa: E731
+        df_li.join(df_or, col("okey") == col("o_okey"))
+        .group_by("pkey")
+        .agg(agg_sum("price", "rev"), agg_avg("price", "avg_rev"), agg_count())
+    )
+    session.disable_hyperspace()
+    off = q().collect()
+    session.enable_hyperspace()
+    metrics.reset()
+    on = q().collect()
+    assert (
+        metrics.counter("aggregate.path.join_fused")
+        + metrics.counter("aggregate.path.join_fused_native")
+    ) >= 1
+    _assert_parity(on, off, ["pkey"])
