@@ -192,12 +192,7 @@ class Executor:
             fused = self._try_join_aggregate(plan)
             if fused is not None:
                 return self._apply_predicate(fused, predicate)
-            need = list(
-                dict.fromkeys(
-                    list(plan.group_by)
-                    + [a.column for a in plan.aggs if a.column is not None]
-                )
-            )
+            need = plan.input_columns()
             child = self._exec(plan.child, None, need)
             result = hash_aggregate(child, list(plan.group_by), list(plan.aggs))
             # a predicate above the aggregate (HAVING shape) applies to the
@@ -360,8 +355,7 @@ class Executor:
         aggs = list(plan.aggs)
         need = list(
             dict.fromkeys(
-                group_by
-                + [a.column for a in aggs if a.column is not None]
+                plan.input_columns()
                 + (sorted(pred.columns()) if pred is not None else [])
             )
         )

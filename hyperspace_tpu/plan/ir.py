@@ -253,6 +253,17 @@ class Aggregate(LogicalPlan):
     aggs: Tuple["object", ...]  # AggSpec (untyped to avoid import cycle)
     child: LogicalPlan
 
+    def input_columns(self) -> List[str]:
+        """The child columns this aggregate reads: group keys + aggregate
+        input columns, first-occurrence order. The ONE definition shared
+        by execution, the distributed fusion, and column pruning."""
+        return list(
+            dict.fromkeys(
+                list(self.group_by)
+                + [a.column for a in self.aggs if a.column is not None]
+            )
+        )
+
     @property
     def children(self):
         return (self.child,)

@@ -52,13 +52,23 @@ def _prune(node: LogicalPlan, needed: Optional[List[str]]) -> LogicalPlan:
     if isinstance(node, Aggregate):
         # the child must expose exactly the group keys + aggregate inputs,
         # regardless of what the plan above needs (agg outputs are derived)
-        child_needed = list(
-            dict.fromkeys(
-                list(node.group_by)
-                + [a.column for a in node.aggs if a.column is not None]
-            )
-        )
+        child_needed = node.input_columns()
         child = _prune(node.child, child_needed)
+        # passing `needed` down narrows Join children (they insert their
+        # own Projects), but a Filter/Scan chain has no insertion point —
+        # without a Project here a projection-free
+        # ``df.filter(p).group_by(g).agg(...)`` carries every source
+        # column and no covering index can match the filter subtree
+        # (round-3 dryrun found the mesh aggregate silently unindexed)
+        child_cols = child.output_columns()
+        resolved = _resolve_needed(child_needed, child_cols)
+        if (
+            child_needed
+            and len(resolved) == len(child_needed)
+            and len(resolved) < len(child_cols)
+            and not isinstance(child, Project)
+        ):
+            child = Project(tuple(resolved), child)
         return node.with_children((child,)) if child is not node.child else node
     if isinstance(node, Join):
         want = list(needed) if needed is not None else node.output_columns()

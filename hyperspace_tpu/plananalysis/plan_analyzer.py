@@ -83,7 +83,14 @@ def explain_string(
     indexes = session.collection_manager.get_indexes(
         [states.ACTIVE], prefer_stable=True
     )
-    plan_off = df.plan
+    # the SAME normalization batch execution runs (DataFrame.optimized_plan:
+    # filter pushdown through joins, then column pruning) — explain must
+    # show the plan the executor would actually see, or the "with indexes"
+    # tree can claim no rewrite while execution rewrites (or vice versa)
+    from ..plan.rules.column_pruning import prune_columns
+    from ..plan.rules.predicate_pushdown import push_filters_through_joins
+
+    plan_off = prune_columns(push_filters_through_joins(df.plan))
     plan_on, applied = apply_hyperspace_rules(plan_off, indexes, session.conf)
 
     buf = BufferStream(mode)
