@@ -1,0 +1,175 @@
+"""A minimal GCS JSON-API server on localhost for exercising the REAL
+GcsFileSystem client over real HTTP: media uploads with
+``ifGenerationMatch=0`` preconditions (412 on conflict), ranged media
+reads, metadata, delimiter listings, deletes — plus a fault injector that
+returns 503 for the first N requests so the client's retry loop is
+provable. Single source of truth is a dict guarded by one lock, so
+concurrent claims are linearized exactly like the real store."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Tuple
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.objects: Dict[str, Tuple[bytes, int]] = {}
+        self.fail_next = 0  # 503s to serve before behaving (retry tests)
+
+
+def _make_handler(state: _State):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _maybe_fail(self) -> bool:
+            with state.lock:
+                if state.fail_next > 0:
+                    state.fail_next -= 1
+                    fail = True
+                else:
+                    fail = False
+            if fail:
+                self._json(503, {"error": {"message": "injected unavailability"}})
+            return fail
+
+        # -- uploads ---------------------------------------------------------
+        def do_POST(self):
+            if self._maybe_fail():
+                return
+            u = urllib.parse.urlparse(self.path)
+            m = re.match(r"^/upload/storage/v1/b/([^/]+)/o$", u.path)
+            if not m:
+                return self._json(404, {"error": {"message": "bad path"}})
+            q = urllib.parse.parse_qs(u.query)
+            name = q["name"][0]
+            n = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(n)
+            with state.lock:
+                existing = state.objects.get(name)
+                if "ifGenerationMatch" in q:
+                    want = int(q["ifGenerationMatch"][0])
+                    have = existing[1] if existing else 0
+                    if want != have:
+                        return self._json(
+                            412, {"error": {"message": "conditionNotMet"}}
+                        )
+                gen = (existing[1] if existing else 0) + 1
+                state.objects[name] = (data, gen)
+            self._json(200, {"name": name, "size": str(len(data)),
+                             "generation": str(gen)})
+
+        # -- reads / metadata / listing --------------------------------------
+        def do_GET(self):
+            if self._maybe_fail():
+                return
+            u = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(u.query)
+            m = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)$", u.path)
+            if m:
+                name = urllib.parse.unquote(m.group(2))
+                with state.lock:
+                    obj = state.objects.get(name)
+                if obj is None:
+                    return self._json(404, {"error": {"message": "notFound"}})
+                data, gen = obj
+                if q.get("alt") == ["media"]:
+                    rng = self.headers.get("Range")
+                    status, out = 200, data
+                    if rng:
+                        mr = re.match(r"bytes=(\d+)-(\d*)$", rng)
+                        lo = int(mr.group(1))
+                        hi = int(mr.group(2)) if mr.group(2) else len(data) - 1
+                        if lo >= len(data):
+                            return self._json(
+                                416, {"error": {"message": "range"}}
+                            )
+                        status, out = 206, data[lo:hi + 1]
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(out)))
+                    self.end_headers()
+                    self.wfile.write(out)
+                    return
+                return self._json(
+                    200,
+                    {"name": name, "size": str(len(data)),
+                     "generation": str(gen)},
+                )
+            if re.match(r"^/storage/v1/b/([^/]+)/o$", u.path):
+                pfx = q.get("prefix", [""])[0]
+                delim = q.get("delimiter", [None])[0]
+                items, prefixes = [], set()
+                with state.lock:
+                    names = sorted(state.objects)
+                for name in names:
+                    if not name.startswith(pfx):
+                        continue
+                    rest = name[len(pfx):]
+                    if delim and delim in rest:
+                        prefixes.add(pfx + rest.split(delim, 1)[0] + delim)
+                    else:
+                        items.append({"name": name})
+                return self._json(
+                    200, {"items": items, "prefixes": sorted(prefixes)}
+                )
+            self._json(404, {"error": {"message": "bad path"}})
+
+        def do_DELETE(self):
+            if self._maybe_fail():
+                return
+            u = urllib.parse.urlparse(self.path)
+            m = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)$", u.path)
+            if not m:
+                return self._json(404, {"error": {"message": "bad path"}})
+            name = urllib.parse.unquote(m.group(2))
+            with state.lock:
+                existed = state.objects.pop(name, None) is not None
+            if not existed:
+                return self._json(404, {"error": {"message": "notFound"}})
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    return Handler
+
+
+class _Server(ThreadingHTTPServer):
+    # default backlog of 5 drops connections under the 16-way claim race
+    request_queue_size = 64
+    daemon_threads = True
+
+
+class FakeGcsServer:
+    """Context manager: a threaded fake GCS endpoint on 127.0.0.1."""
+
+    def __init__(self):
+        self.state = _State()
+        self._srv = _Server(("127.0.0.1", 0), _make_handler(self.state))
+        self.endpoint = f"http://127.0.0.1:{self._srv.server_port}"
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *a):
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(5)
