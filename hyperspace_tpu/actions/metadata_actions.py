@@ -91,12 +91,47 @@ class CancelAction(IndexAction):
     """Recovery from a stuck transient state: write a new entry restoring the
     last *stable* state (CancelAction.scala:35-72). Refuses if the index is
     already stable (:55-60). If no stable entry exists (e.g. first create
-    crashed), the index goes to DOESNOTEXIST."""
+    crashed), the index goes to DOESNOTEXIST.
 
-    def __init__(self, log_manager: IndexLogManager, conf: Optional[HyperspaceConf] = None):
+    Beyond the reference (whose orphan parquet is inert until vacuum):
+    a writer killed mid-STREAMING-build leaves a ``.spill`` scratch tree
+    holding up to a full copy of the dataset in its in-progress version
+    dir; ``op()`` garbage-collects spill dirs from version dirs the
+    restored entry does not reference (the committed versions' data is
+    never touched)."""
+
+    def __init__(
+        self,
+        log_manager: IndexLogManager,
+        conf: Optional[HyperspaceConf] = None,
+        data_manager: Optional[IndexDataManager] = None,
+    ):
         super().__init__(log_manager)
         self.conf = conf or HyperspaceConf()
+        self.data_manager = data_manager
         self._stable: Optional[IndexLogEntry] = None
+
+    def op(self) -> None:
+        if self.data_manager is None:
+            return
+        import shutil
+
+        from .. import constants as C
+
+        prefix = C.INDEX_VERSION_DIRECTORY_PREFIX + "="
+        stable = self.log_manager.get_latest_stable_log()
+        referenced = set()
+        if stable is not None and hasattr(stable, "content"):
+            for f in stable.content.files():
+                for part in str(f).split("/"):
+                    if part.startswith(prefix):
+                        referenced.add(int(part[len(prefix):]))
+        for vid in self.data_manager.get_all_version_ids():
+            if vid in referenced:
+                continue
+            spill = self.data_manager.get_path(vid) / ".spill"
+            if spill.is_dir():
+                shutil.rmtree(spill, ignore_errors=True)
 
     transient_state = states.CANCELLING
 

@@ -63,7 +63,10 @@ def buckets_for_predicate(
     return buckets
 
 
+import threading as _threading  # noqa: E402 (kept near its user)
+
 _mask_fn_cache: dict = {}
+_mask_fn_lock = _threading.Lock()  # union sides run concurrently
 
 
 def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
@@ -118,7 +121,8 @@ def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
         n_pad,
         tuple((name, str(a.dtype)) for name, a in host_arrays.items()),
     )
-    fn = _mask_fn_cache.get(key)
+    with _mask_fn_lock:
+        fn = _mask_fn_cache.get(key)
     if fn is None:
         # rows-free, vocab-free schema shim: code columns act as int32
         shim = ColumnarBatch(
@@ -133,9 +137,10 @@ def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
             }
         )
         fn = jax.jit(lambda arrays: eval_mask(bound, shim, arrays))
-        if len(_mask_fn_cache) >= 512:
-            _mask_fn_cache.pop(next(iter(_mask_fn_cache)))  # evict oldest
-        _mask_fn_cache[key] = fn
+        with _mask_fn_lock:
+            if len(_mask_fn_cache) >= 512:
+                _mask_fn_cache.pop(next(iter(_mask_fn_cache)))  # evict oldest
+            _mask_fn_cache[key] = fn
     mask = np.asarray(fn(host_arrays))
     return mask[:n]
 

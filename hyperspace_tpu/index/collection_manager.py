@@ -134,7 +134,11 @@ class IndexCollectionManager:
         ).run()
 
     def cancel(self, name: str) -> None:
-        CancelAction(self._existing_log_manager(name), self.conf).run()
+        CancelAction(
+            self._existing_log_manager(name),
+            self.conf,
+            data_manager=self._data_manager(name),
+        ).run()
 
     # -- enumeration (IndexCollectionManager.scala:109-152) -------------------
     def _enumerate(self):

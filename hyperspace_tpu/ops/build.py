@@ -28,6 +28,7 @@ import numpy as np
 
 from ..exceptions import HyperspaceException
 from ..storage.columnar import Column, ColumnarBatch, is_string
+from ..telemetry.metrics import metrics
 from . import ensure_x64
 from .hashing import bucket_ids_host, fnv1a64, hash32_device, key_repr
 
@@ -398,6 +399,7 @@ def unify_vocabs_shared_storage(
     barrier,
     process_index: int,
     process_count: int,
+    timeout_s: float = 30.0,
 ) -> ColumnarBatch:
     """Cross-process dictionary union over shared storage: every process
     writes its string columns' vocabs, a collective barrier orders the
@@ -443,14 +445,16 @@ def unify_vocabs_shared_storage(
     merged: Dict[str, np.ndarray] = {}
     for p in range(process_count):
         path = scratch / f"vocab-{p:05d}.pkl"
-        deadline = _time.monotonic() + 30.0
+        deadline = _time.monotonic() + timeout_s
         while True:  # belt to the fsync braces: retry stale-cache misses
             try:
                 data = pickle.loads(path.read_bytes())
+                metrics.incr("build.multihost.vocab_read")
                 break
             except FileNotFoundError:
                 if _time.monotonic() >= deadline:
                     raise
+                metrics.incr("build.multihost.vocab_stale_retry")
                 _time.sleep(0.05)
         for n, v in data.items():
             merged.setdefault(n, []).append(v)

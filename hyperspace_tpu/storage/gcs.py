@@ -30,6 +30,7 @@ backends pass.
 
 from __future__ import annotations
 
+import http.client
 import json
 import time
 import urllib.error
@@ -108,12 +109,22 @@ class GcsFileSystem(FileSystem):
                     return e.code, body
                 if e.code in _RETRYABLE and attempt < self.max_retries:
                     last = e
+                    if retried_out is not None:
+                        # a 5xx may have been emitted AFTER the server (or
+                        # a proxy) applied the upload — claims must run
+                        # self-win detection on the retry's 412 too
+                        retried_out.append(True)
                     time.sleep(0.05 * (2**attempt))
                     continue
                 raise OSError(
                     f"GCS {method} {url} -> {e.code}: {body[:200]!r}"
                 ) from e
-            except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+            except (
+                urllib.error.URLError,
+                ConnectionError,
+                TimeoutError,
+                http.client.HTTPException,  # e.g. IncompleteRead mid-body
+            ) as e:
                 # raw socket failures (reset, refused, timeout) retry like
                 # 5xx; the retry is reported via retried_out so claims can
                 # run self-win detection (see create_if_absent)

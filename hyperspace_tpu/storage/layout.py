@@ -236,9 +236,11 @@ class TcbReader:
 
 
 from collections import OrderedDict  # noqa: E402 (kept near its user)
+from threading import Lock  # noqa: E402
 
 _READER_CACHE: "OrderedDict[tuple, TcbReader]" = OrderedDict()
 _READER_CACHE_CAP = 256
+_READER_CACHE_LOCK = Lock()  # union sides execute concurrently
 
 
 def cached_reader(path: str | Path) -> TcbReader:
@@ -253,14 +255,19 @@ def cached_reader(path: str | Path) -> TcbReader:
     p = Path(path)
     st = p.stat()
     key = (str(p), st.st_size, st.st_mtime_ns)
-    r = _READER_CACHE.get(key)
-    if r is None:
-        r = TcbReader(p)
+    with _READER_CACHE_LOCK:
+        r = _READER_CACHE.get(key)
+        if r is not None:
+            _READER_CACHE.move_to_end(key)
+            return r
+    r = TcbReader(p)  # footer parse outside the lock
+    with _READER_CACHE_LOCK:
+        existing = _READER_CACHE.get(key)
+        if existing is not None:
+            return existing
         _READER_CACHE[key] = r
         while len(_READER_CACHE) > _READER_CACHE_CAP:
             _READER_CACHE.popitem(last=False)
-    else:
-        _READER_CACHE.move_to_end(key)
     return r
 
 

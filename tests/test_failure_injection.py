@@ -1,0 +1,262 @@
+"""Failure injection for the subtle protocols (round-2 verdict next #6):
+
+(a) the multihost vocab-union's unhappy branches — the stale-cache retry
+    loop actually retrying, its timeout raising cleanly, and a peer dying
+    before the barrier surfacing as a clean error on the survivor (never
+    a hang, never a corrupted union);
+(b) a streaming index build KILLED mid-spill (SIGKILL, no teardown):
+    the log is stuck in CREATING, further actions refuse, ``cancel()``
+    recovers to the last stable state AND garbage-collects the orphaned
+    ``.spill`` scratch, and a rebuild then succeeds.
+"""
+
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.ops.build import unify_vocabs_shared_storage
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _string_batch(values):
+    return ColumnarBatch.from_pydict(
+        {"s": np.array(values, dtype=object)}, {"s": "string"}
+    )
+
+
+def test_vocab_union_stale_cache_retry_fires():
+    """Peer file appears LATE (NFS-style staleness): the retry loop polls
+    until it lands and the union is still exact."""
+    import tempfile
+
+    scratch = Path(tempfile.mkdtemp())
+    batch = _string_batch([b"aa", b"cc", b"aa"])
+
+    def late_peer():
+        time.sleep(0.4)
+        (scratch / ".late.tmp").write_bytes(
+            pickle.dumps({"s": np.array([b"bb", b"dd"], dtype=object)})
+        )
+        (scratch / ".late.tmp").replace(scratch / "vocab-00001.pkl")
+
+    t = threading.Thread(target=late_peer, daemon=True)
+    metrics.reset()
+    t.start()
+    out = unify_vocabs_shared_storage(
+        batch, scratch, barrier=lambda: None, process_index=0,
+        process_count=2, timeout_s=10.0,
+    )
+    t.join()
+    assert metrics.counter("build.multihost.vocab_stale_retry") >= 1
+    assert out.columns["s"].vocab.tolist() == [b"aa", b"bb", b"cc", b"dd"]
+    assert out.columns["s"].to_values().tolist() == ["aa", "cc", "aa"]
+
+
+def test_vocab_union_timeout_raises_cleanly():
+    """A peer that never writes must surface as FileNotFoundError at the
+    deadline — not an infinite poll."""
+    import tempfile
+
+    scratch = Path(tempfile.mkdtemp())
+    batch = _string_batch([b"x"])
+    t0 = time.monotonic()
+    with pytest.raises(FileNotFoundError):
+        unify_vocabs_shared_storage(
+            batch, scratch, barrier=lambda: None, process_index=0,
+            process_count=2, timeout_s=0.3,
+        )
+    assert time.monotonic() - t0 < 5.0
+
+
+_UNIFY_WORKER = r"""
+import pickle, sys, time
+from pathlib import Path
+import numpy as np
+sys.path.insert(0, sys.argv[4])
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.ops.build import unify_vocabs_shared_storage
+
+scratch = Path(sys.argv[1]); pid = int(sys.argv[2]); mode = sys.argv[3]
+
+def file_barrier(name="b0", timeout=3.0):
+    # shared-storage barrier: write my marker, wait for every peer's.
+    # A dead peer => timeout => RuntimeError (clean error, never a hang).
+    (scratch / f".bar-{name}-{pid}").touch()
+    deadline = time.monotonic() + timeout
+    while True:
+        if all((scratch / f".bar-{name}-{p}").exists() for p in range(2)):
+            return
+        if time.monotonic() >= deadline:
+            raise RuntimeError(f"barrier {name}: peer missing")
+        time.sleep(0.02)
+
+batch = ColumnarBatch.from_pydict(
+    {"s": np.array([b"p%d" % pid, b"zz"], dtype=object)}, {"s": "string"}
+)
+if mode == "die-before-barrier":
+    # write the vocab file (the protocol's first step), then die hard
+    import os
+    payload = {"s": batch.columns["s"].vocab}
+    tmp = scratch / f".vocab-{pid:05d}.tmp"
+    tmp.write_bytes(pickle.dumps(payload))
+    tmp.replace(scratch / f"vocab-{pid:05d}.pkl")
+    os._exit(9)
+
+calls = {"n": 0}
+def barrier():
+    calls["n"] += 1
+    file_barrier(f"b{calls['n']}")
+
+out = unify_vocabs_shared_storage(
+    batch, scratch, barrier=barrier, process_index=pid, process_count=2,
+    timeout_s=3.0,
+)
+print("UNION:" + ",".join(v.decode() for v in out.columns["s"].vocab))
+"""
+
+
+def test_peer_death_mid_barrier_errors_survivor_cleanly(tmp_path):
+    """Process 1 dies after writing its vocab but BEFORE entering the
+    barrier; process 0 must get a clean barrier error within its timeout
+    — not hang, not fabricate a partial union."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _UNIFY_WORKER, str(tmp_path), str(pid),
+             "die-before-barrier" if pid == 1 else "normal", str(REPO)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=60)[0].decode(errors="replace") for p in procs]
+    assert procs[1].returncode == 9
+    assert procs[0].returncode != 0
+    assert "barrier" in outs[0] and "peer missing" in outs[0]
+    assert "UNION:" not in outs[0]  # no partial union fabricated
+
+
+def test_both_alive_union_succeeds_via_same_barrier(tmp_path):
+    """Control for the test above: the same worker + barrier with both
+    processes alive produces the exact union on both."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _UNIFY_WORKER, str(tmp_path), str(pid),
+             "normal", str(REPO)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=60)[0].decode(errors="replace") for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "UNION:p0,p1,zz" in out
+
+
+_KILL_BUILD_WORKER = r"""
+import os, sys, time
+import numpy as np
+sys.path.insert(0, sys.argv[2])
+ws = sys.argv[1]
+import pyarrow as pa, pyarrow.parquet as pq
+rng = np.random.default_rng(0)
+n = 400_000
+os.makedirs(f"{ws}/src", exist_ok=True)
+pq.write_table(pa.table({"k": rng.integers(0, 10**6, n).astype(np.int64),
+                         "v": rng.integers(0, 100, n).astype(np.int64)}),
+               f"{ws}/src/a.parquet")
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.index import stream_builder
+
+# suicide mid-spill: the third spilled run SIGKILLs the process — no
+# teardown, no atexit, exactly a crashed builder
+real = stream_builder.StreamingIndexWriter._spill_run
+count = {"n": 0}
+def killer(self, *a, **k):
+    count["n"] += 1
+    if count["n"] >= 3:
+        print("KILLING", flush=True)
+        os.kill(os.getpid(), 9)
+    return real(self, *a, **k)
+stream_builder.StreamingIndexWriter._spill_run = killer
+
+conf = HyperspaceConf({C.INDEX_SYSTEM_PATH: f"{ws}/indexes",
+                       C.INDEX_NUM_BUCKETS: 8,
+                       C.BUILD_MODE: C.BUILD_MODE_STREAMING,
+                       C.BUILD_CHUNK_ROWS: 1 << 16})
+hs = Hyperspace(HyperspaceSession(conf))
+df = hs.session.read.parquet(f"{ws}/src")
+hs.create_index(df, IndexConfig("victim", ["k"], ["v"]))
+print("SHOULD NOT REACH", flush=True)
+"""
+
+
+def test_sigkill_mid_spill_cancel_recovers_and_gcs_spill(tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "HYPERSPACE_TPU_PROBE_CACHE": ""}
+    p = subprocess.Popen(
+        [sys.executable, "-c", _KILL_BUILD_WORKER, str(tmp_path), str(REPO)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+    )
+    out, _ = p.communicate(timeout=240)
+    assert p.returncode == -signal.SIGKILL or p.returncode == 137, out.decode()
+    assert b"SHOULD NOT REACH" not in out
+
+    # crash artifacts: transient CREATING entry + orphaned spill scratch
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.actions import states
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.INDEX_NUM_BUCKETS: 8,
+        }
+    )
+    hs = Hyperspace(HyperspaceSession(conf))
+    df = hs.session.read.parquet(str(tmp_path / "src"))
+    victim_dir = tmp_path / "indexes" / "victim"
+    spills = list(victim_dir.glob("v__=*/.spill"))
+    assert spills, "expected an orphaned spill dir from the killed build"
+
+    # further modifying actions refuse while stuck in CREATING
+    with pytest.raises(HyperspaceException):
+        hs.delete_index("victim")
+    entry = hs.session.collection_manager._existing_log_manager("victim").get_latest_log()
+    assert entry.state == states.CREATING
+
+    # cancel(): log recovered to the last stable state (none -> gone) and
+    # the spill scratch is garbage-collected
+    hs.cancel("victim")
+    entry = hs.session.collection_manager._existing_log_manager("victim").get_latest_log()
+    assert entry.state == states.DOESNOTEXIST
+    assert not list(victim_dir.glob("v__=*/.spill"))
+
+    # and the index can be rebuilt cleanly afterwards
+    hs.create_index(df, IndexConfig("victim", ["k"], ["v"]))
+    q = hs.session.read.parquet(str(tmp_path / "src"))
+    hs.session.enable_hyperspace()
+    from hyperspace_tpu.plan.expr import col
+
+    key = int(np.random.default_rng(0).integers(0, 10**6, 400_000)[0])
+    got = q.filter(col("k") == key).select("k", "v").collect()
+    assert got.num_rows >= 1
