@@ -48,6 +48,12 @@ class DataFrame(EventLogging):
             raise HyperspaceException("Cannot join DataFrames from different sessions.")
         return DataFrame(self.session, Join(self.plan, other.plan, condition, how))
 
+    def create_or_replace_temp_view(self, name: str) -> None:
+        """Register this DataFrame's logical plan under ``name``
+        (Spark's createOrReplaceTempView): ``session.table(name)``
+        queries rewrite against indexes exactly like this DataFrame."""
+        self.session.catalog.create_or_replace_temp_view(name, self)
+
     def group_by(self, *columns: str) -> "GroupedData":
         """Hash-aggregate entry point: ``df.group_by("k").agg(agg_sum("v"))``
         (specs from plan.aggregates). No columns = global aggregate."""

@@ -17,13 +17,88 @@ from .config import HyperspaceConf
 from .sources.manager import FileBasedSourceProviderManager
 
 
+class Catalog:
+    """Named relations — the catalog-table/temp-view surface the
+    reference exercises through Spark's catalog
+    (E2EHyperspaceRulesTest.scala "catalog temp tables/views" /
+    "managed catalog tables"). Two kinds of entries, both
+    case-insensitive like the reference's resolver:
+
+    * **views** bind a name to a DataFrame's LOGICAL PLAN (Spark's
+      ``createOrReplaceTempView``): the stored plan is exactly what the
+      path-based read produced, so signature matching and the rewrite
+      rules fire identically on ``session.table(name)``;
+    * **tables** bind a name to a (format, paths, options) source
+      registration resolved at read time — a fresh file listing per
+      query, so appends/deletes show up the way re-reading a path does
+      (and Hybrid Scan handles them the same way).
+    """
+
+    def __init__(self, session: "HyperspaceSession"):
+        self._session = session
+        self._views: Dict[str, object] = {}  # lower name -> LogicalPlan
+        self._tables: Dict[str, tuple] = {}  # lower name -> (fmt, paths, opts)
+
+    # -- registration --------------------------------------------------------
+    def create_or_replace_temp_view(self, name: str, df) -> None:
+        self._tables.pop(name.lower(), None)
+        self._views[name.lower()] = df.plan
+
+    def create_table(
+        self,
+        name: str,
+        *paths: str,
+        file_format: str = "parquet",
+        replace: bool = False,
+        **options: str,
+    ) -> None:
+        from .exceptions import HyperspaceException
+
+        key = name.lower()
+        if not replace and (key in self._tables or key in self._views):
+            raise HyperspaceException(f"Relation {name!r} already exists.")
+        self._views.pop(key, None)
+        self._tables[key] = (file_format, list(paths), dict(options))
+
+    def drop(self, name: str) -> bool:
+        key = name.lower()
+        return (
+            self._views.pop(key, None) is not None
+            or self._tables.pop(key, None) is not None
+        )
+
+    def list(self) -> List[str]:
+        return sorted(self._views) + sorted(self._tables)
+
+    # -- resolution ----------------------------------------------------------
+    def table(self, name: str):
+        from .dataframe import DataFrame
+        from .exceptions import HyperspaceException
+
+        key = name.lower()
+        if key in self._views:
+            return DataFrame(self._session, self._views[key])
+        if key in self._tables:
+            fmt, paths, options = self._tables[key]
+            reader = self._session.read
+            for k, v in options.items():
+                reader = reader.option(k, v)
+            return reader._load(fmt, list(paths))
+        raise HyperspaceException(f"Unknown table or view: {name!r}.")
+
+
 class HyperspaceSession:
     def __init__(self, conf: Optional[HyperspaceConf] = None, mesh=None):
         self.conf = conf or HyperspaceConf()
         self.mesh = mesh
         self.sources = FileBasedSourceProviderManager(self.conf)
+        self.catalog = Catalog(self)
         self._hyperspace_enabled = False
         self._collection_manager = None  # lazy (circular import)
+
+    def table(self, name: str):
+        """DataFrame over a registered view or table (Catalog.table)."""
+        return self.catalog.table(name)
 
     # -- rewrite toggle (package.scala:47-79) --------------------------------
     def enable_hyperspace(self) -> "HyperspaceSession":
