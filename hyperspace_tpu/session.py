@@ -41,6 +41,16 @@ class Catalog:
 
     # -- registration --------------------------------------------------------
     def create_or_replace_temp_view(self, name: str, df) -> None:
+        from .exceptions import HyperspaceException
+
+        if df.session is not self._session:
+            # table() re-tags the stored plan with THIS session; accepting
+            # a foreign DataFrame would launder it past DataFrame.join's
+            # cross-session guard
+            raise HyperspaceException(
+                "Cannot register a view over a DataFrame from a different "
+                "session."
+            )
         self._tables.pop(name.lower(), None)
         self._views[name.lower()] = df.plan
 
@@ -68,7 +78,7 @@ class Catalog:
         )
 
     def list(self) -> List[str]:
-        return sorted(self._views) + sorted(self._tables)
+        return sorted([*self._views, *self._tables])
 
     # -- resolution ----------------------------------------------------------
     def table(self, name: str):

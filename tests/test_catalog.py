@@ -131,3 +131,11 @@ def test_catalog_registry_semantics(env):
     assert not session.catalog.drop("t")
     with pytest.raises(HyperspaceException):
         session.table("t")
+
+
+def test_view_over_foreign_session_dataframe_rejected(env, tmp_workspace):
+    session, hs, ws = env
+    other = HyperspaceSession()
+    foreign = other.read.parquet(str(ws / "li"))
+    with pytest.raises(HyperspaceException):
+        session.catalog.create_or_replace_temp_view("v", foreign)
