@@ -44,6 +44,13 @@ def device_kernel_bench(
     """Per-kernel device timings at the end-to-end bench's shapes:
     ``chunk_rows`` mirrors the streamed build's chunk capacity,
     ``mask_rows`` a large scan file, ``smj_rows`` one bucket side."""
+    from ..utils.intmath import next_pow2
+
+    # pow2-quantize: every production path pads to powers of two, so a
+    # raw row count here would compile a shape nothing else ever uses
+    chunk_rows = next_pow2(chunk_rows)
+    mask_rows = next_pow2(mask_rows)
+    smj_rows = next_pow2(smj_rows)
     out: Dict[str, dict] = {}
     try:
         import jax
