@@ -175,6 +175,7 @@ def merge_join_indices_segmented(
     r_codes: np.ndarray,
     l_bounds: np.ndarray,
     r_bounds: np.ndarray,
+    presorted: Optional[Tuple[bool, bool]] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Join codes that are segment-aligned (segment k of the left joins
     only segment k of the right — the per-bucket decomposition). When the
@@ -189,9 +190,12 @@ def merge_join_indices_segmented(
     Falls back to the unsegmented path (argsort + kernel/host routing)
     when segments are not code-sorted (multi-key factorized codes, signed
     floats, or multi-file buckets after incremental refresh)."""
-    if _segments_sorted(r_codes, r_bounds) and _segments_sorted(
-        l_codes, l_bounds
-    ):
+    if presorted is None:
+        presorted = (
+            _segments_sorted(l_codes, l_bounds),
+            _segments_sorted(r_codes, r_bounds),
+        )
+    if presorted[0] and presorted[1]:
         # both sides ascending per segment (index data is, by construction):
         # the native two-pointer SMJ is O(n+m) with parallel segments, no
         # GIL, and parallel C++ pair expansion — kept as a special case
@@ -204,7 +208,7 @@ def merge_join_indices_segmented(
             metrics.incr("join.path.native_smj")
             return pairs
     lo, counts, r_order = segmented_join_ranges(
-        l_codes, r_codes, l_bounds, r_bounds
+        l_codes, r_codes, l_bounds, r_bounds, presorted=presorted
     )
     return _expand_ranges(lo, counts, r_order)
 
@@ -214,13 +218,21 @@ def segmented_join_ranges(
     r_codes: np.ndarray,
     l_bounds: np.ndarray,
     r_bounds: np.ndarray,
+    presorted: Optional[Tuple[bool, bool]] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """The ONE routing ladder producing (lo, counts, r_order) match
     ranges for segment-aligned codes — shared by the materializing join
-    (which expands) and the aggregate fusion (which never does)."""
-    if not _segments_sorted(r_codes, r_bounds):
+    (which expands) and the aggregate fusion (which never does).
+    ``presorted`` carries already-computed per-side sortedness so
+    callers' gates aren't re-scanned."""
+    if presorted is None:
+        presorted = (
+            _segments_sorted(l_codes, l_bounds),
+            _segments_sorted(r_codes, r_bounds),
+        )
+    if not presorted[1]:
         return merge_join_ranges(l_codes, r_codes)
-    if _segments_sorted(l_codes, l_bounds):
+    if presorted[0]:
         from .. import native
 
         res = native.smj_ranges(l_codes, r_codes, l_bounds, r_bounds)
@@ -330,8 +342,36 @@ def bucketed_join_pairs(
     if setup is None:
         return []
     l_all, r_all, l_codes, r_codes, l_bounds, r_bounds = setup
-    l_idx, r_idx = merge_join_indices_segmented(l_codes, r_codes, l_bounds, r_bounds)
-    out: Dict[str, Column] = {}
+    presorted = (
+        _segments_sorted(l_codes, l_bounds),
+        _segments_sorted(r_codes, r_bounds),
+    )
+    if presorted[0] and presorted[1]:
+        # fully-fused native path: range walk + output gather in one C++
+        # pass — the pair index arrays (16B per output row) and the numpy
+        # fancy-gathers they feed are never materialized
+        from .. import native
+
+        fused = native.smj_join_gather(
+            l_codes, r_codes, l_bounds, r_bounds,
+            {n: c.data for n, c in l_all.columns.items()},
+            {n: c.data for n, c in r_all.columns.items()},
+        )
+        if fused is not None:
+            metrics.incr("join.path.native_smj_gather")
+            l_out, r_out, total = fused
+            if total == 0:
+                return []
+            out: Dict[str, Column] = {}
+            for n, c in l_all.columns.items():
+                out[n] = Column(c.dtype_str, l_out[n], c.vocab)
+            for n, c in r_all.columns.items():
+                out[n] = Column(c.dtype_str, r_out[n], c.vocab)
+            return [ColumnarBatch(out)]
+    l_idx, r_idx = merge_join_indices_segmented(
+        l_codes, r_codes, l_bounds, r_bounds, presorted=presorted
+    )
+    out = {}
     out.update(l_all.take(l_idx).columns)
     out.update(r_all.take(r_idx).columns)
     j = ColumnarBatch(out)

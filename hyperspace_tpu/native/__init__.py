@@ -27,6 +27,7 @@ _LIB: Optional[ctypes.CDLL] = None
 _LIB_FAILED = False
 _HAS_SMJ = False
 _HAS_GROUP_AGG = False
+_HAS_EXPAND_GATHER = False
 
 
 def _build_dir() -> Path:
@@ -120,6 +121,21 @@ def _bind_symbols(lib: ctypes.CDLL) -> None:
         _HAS_SMJ = True
     except AttributeError:
         _HAS_SMJ = False
+    global _HAS_EXPAND_GATHER
+    try:
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        vpp = ctypes.POINTER(ctypes.c_void_p)
+        lib.hs_expand_gather.restype = None
+        lib.hs_expand_gather.argtypes = [
+            i64p, i64p, i64p, ctypes.c_int64,
+            vpp, i32p, ctypes.c_int32,
+            vpp, i32p, ctypes.c_int32,
+            vpp, vpp, ctypes.c_int32,
+        ]
+        _HAS_EXPAND_GATHER = True
+    except AttributeError:
+        _HAS_EXPAND_GATHER = False
     global _HAS_GROUP_AGG
     try:
         i64p = ctypes.POINTER(ctypes.c_int64)
@@ -272,21 +288,85 @@ def smj_ranges(
     lib = _load()
     if lib is None or not _HAS_SMJ:
         return None
+    lo, cnt, _off, _total, _n_l = _smj_ranges_raw(
+        l_codes, r_codes, l_bounds, r_bounds, n_threads, lib
+    )
+    return lo, cnt
+
+
+def _smj_ranges_raw(l_codes, r_codes, l_bounds, r_bounds, n_threads, lib):
+    """Shared phase A: contiguous conversion, segment validation, range
+    computation, and the exclusive output-offset prefix. Used by every
+    SMJ entry point so range-phase fixes can't drift between them."""
     l = np.ascontiguousarray(l_codes, dtype=np.int64)
     r = np.ascontiguousarray(r_codes, dtype=np.int64)
     lb = np.ascontiguousarray(l_bounds, dtype=np.int64)
     rb = np.ascontiguousarray(r_bounds, dtype=np.int64)
     n_seg = len(lb) - 1
     if n_seg != len(rb) - 1:
-        raise ValueError("smj_ranges: segment counts differ.")
+        raise ValueError("smj ranges: segment counts differ.")
     n_l = len(l)
     lo = np.empty(n_l, dtype=np.int64)
     cnt = np.empty(n_l, dtype=np.int64)
-    lib.hs_smj_ranges(
+    total = lib.hs_smj_ranges(
         _i64ptr(l), _i64ptr(r), _i64ptr(lb), _i64ptr(rb),
         np.int32(n_seg), _i64ptr(lo), _i64ptr(cnt), int(n_threads),
     )
-    return lo, cnt
+    off = np.empty(n_l + 1, dtype=np.int64)
+    off[0] = 0
+    np.cumsum(cnt, out=off[1:])
+    return lo, cnt, off, int(total), n_l
+
+
+def smj_join_gather(
+    l_codes: np.ndarray,
+    r_codes: np.ndarray,
+    l_bounds: np.ndarray,
+    r_bounds: np.ndarray,
+    l_arrays: dict,
+    r_arrays: dict,
+    n_threads: int = 0,
+):
+    """Segment-aligned SMJ with the output gather fused into the range
+    expansion: returns ({left name: joined array}, {right name: joined
+    array}, total) — the (l_idx, r_idx) pair arrays are never
+    materialized and no numpy fancy-gather runs. Arrays must be 4- or
+    8-byte fixed-width (int32 codes / int64 / float32/64). None when the
+    native library is unavailable or a width is unsupported."""
+    lib = _load()
+    if lib is None or not (_HAS_SMJ and _HAS_EXPAND_GATHER):
+        return None
+    for a in list(l_arrays.values()) + list(r_arrays.values()):
+        if a.dtype.itemsize not in (4, 8):
+            return None
+    lo, cnt, off, total, n_l = _smj_ranges_raw(
+        l_codes, r_codes, l_bounds, r_bounds, n_threads, lib
+    )
+
+    def pack(arrays: dict):
+        names = list(arrays)
+        srcs = [np.ascontiguousarray(arrays[n_]) for n_ in names]
+        outs = [np.empty(total, dtype=s.dtype) for s in srcs]
+        widths = (ctypes.c_int32 * len(names))(
+            *[s.dtype.itemsize for s in srcs]
+        )
+        src_ps = (ctypes.c_void_p * len(names))(
+            *[s.ctypes.data_as(ctypes.c_void_p).value for s in srcs]
+        )
+        dst_ps = (ctypes.c_void_p * len(names))(
+            *[o.ctypes.data_as(ctypes.c_void_p).value for o in outs]
+        )
+        return names, srcs, outs, widths, src_ps, dst_ps
+
+    ln, lsrcs, louts, lw, lsp, ldp = pack(l_arrays)
+    rn, rsrcs, routs, rw, rsp, rdp = pack(r_arrays)
+    if total:
+        lib.hs_expand_gather(
+            _i64ptr(lo), _i64ptr(cnt), _i64ptr(off), np.int64(n_l),
+            lsp, lw, np.int32(len(ln)), rsp, rw, np.int32(len(rn)),
+            ldp, rdp, int(n_threads),
+        )
+    return dict(zip(ln, louts)), dict(zip(rn, routs)), int(total)
 
 
 def smj_pairs(
@@ -304,23 +384,9 @@ def smj_pairs(
     lib = _load()
     if lib is None or not _HAS_SMJ:
         return None
-    l = np.ascontiguousarray(l_codes, dtype=np.int64)
-    r = np.ascontiguousarray(r_codes, dtype=np.int64)
-    lb = np.ascontiguousarray(l_bounds, dtype=np.int64)
-    rb = np.ascontiguousarray(r_bounds, dtype=np.int64)
-    n_seg = len(lb) - 1
-    if n_seg != len(rb) - 1:
-        raise ValueError("smj_pairs: segment counts differ.")
-    n_l = len(l)
-    lo = np.empty(n_l, dtype=np.int64)
-    cnt = np.empty(n_l, dtype=np.int64)
-    total = lib.hs_smj_ranges(
-        _i64ptr(l), _i64ptr(r), _i64ptr(lb), _i64ptr(rb),
-        np.int32(n_seg), _i64ptr(lo), _i64ptr(cnt), int(n_threads),
+    lo, cnt, off, total, n_l = _smj_ranges_raw(
+        l_codes, r_codes, l_bounds, r_bounds, n_threads, lib
     )
-    off = np.empty(n_l + 1, dtype=np.int64)
-    off[0] = 0
-    np.cumsum(cnt, out=off[1:])
     l_idx = np.empty(total, dtype=np.int64)
     r_idx = np.empty(total, dtype=np.int64)
     if total:

@@ -208,6 +208,70 @@ void hs_expand_pairs(const int64_t *lo, const int64_t *cnt, const int64_t *off,
   }
 }
 
+// Phase B fused with the output gather: expand ranges and write the
+// joined output columns directly — the (l_idx, r_idx) arrays (16 bytes
+// per output pair, written then immediately re-read by numpy gathers)
+// never exist. Columns are 4- or 8-byte fixed-width raw buffers (int32
+// codes / int64 / float as bits). Parallel over left-row chunks: each
+// row's output slots are disjoint.
+namespace {
+inline void copy_elem(void *dst, const void *src, int64_t di, int64_t si,
+                      int32_t w) {
+  if (w == 8)
+    static_cast<int64_t *>(dst)[di] = static_cast<const int64_t *>(src)[si];
+  else
+    static_cast<int32_t *>(dst)[di] = static_cast<const int32_t *>(src)[si];
+}
+} // namespace
+
+void hs_expand_gather(const int64_t *lo, const int64_t *cnt,
+                      const int64_t *off, int64_t n_l, const void **l_srcs,
+                      const int32_t *l_widths, int32_t n_lcols,
+                      const void **r_srcs, const int32_t *r_widths,
+                      int32_t n_rcols, void **l_dsts, void **r_dsts,
+                      int32_t n_threads) {
+  int32_t hw = static_cast<int32_t>(std::thread::hardware_concurrency());
+  int32_t workers = n_threads > 0 ? n_threads : (hw > 0 ? hw : 4);
+  if (workers < 1)
+    workers = 1;
+  const int64_t total = off[n_l];
+  auto body = [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      int64_t w = off[i];
+      const int64_t base = lo[i];
+      for (int64_t c = 0; c < cnt[i]; ++c, ++w) {
+        for (int32_t k = 0; k < n_lcols; ++k)
+          copy_elem(l_dsts[k], l_srcs[k], w, i, l_widths[k]);
+        for (int32_t k = 0; k < n_rcols; ++k)
+          copy_elem(r_dsts[k], r_srcs[k], w, base + c, r_widths[k]);
+      }
+    }
+  };
+  if (workers <= 1 || total < (1 << 16)) {
+    body(0, n_l);
+  } else {
+    // partition by OUTPUT position, not left-row count: a hot key whose
+    // matches dominate the output would otherwise land on one thread
+    std::vector<std::thread> pool;
+    int64_t prev_row = 0;
+    for (int32_t t = 0; t < workers && prev_row < n_l; ++t) {
+      const int64_t target = (total * (t + 1)) / workers;
+      int64_t row_end =
+          (t == workers - 1)
+              ? n_l
+              : std::upper_bound(off, off + n_l + 1, target) - off - 1;
+      if (row_end <= prev_row)
+        continue;
+      pool.emplace_back(body, prev_row, row_end);
+      prev_row = row_end;
+    }
+    if (prev_row < n_l)
+      pool.emplace_back(body, prev_row, n_l);
+    for (auto &t : pool)
+      t.join();
+  }
+}
+
 // ---------------------------------------------------------------------------
 // Fused group-by aggregate over SMJ match ranges (the Q17 hot path).
 //

@@ -282,3 +282,36 @@ def test_kernel_wide_tile_fixup(monkeypatch):
     cnt = np.searchsorted(r, l, "right") - lo
     np.testing.assert_array_equal(res[0], lo)
     np.testing.assert_array_equal(res[1], cnt)
+
+
+def test_native_smj_gather_parity(monkeypatch):
+    """The fully-fused native join (range walk + output gather, no pair
+    arrays) must emit exactly the rows the expand+take path emits —
+    including string (dict-coded) and float columns."""
+    from hyperspace_tpu import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    left, right = make_sides(3000, 1200, seed=21, with_strings=True)
+    nb = 8
+    lb = split_by_bucket(left, ["l_k"], nb)
+    rb = split_by_bucket(right, ["r_k"], nb)
+    # per-bucket key-sort both sides so the presorted fused path applies
+    for d in (lb, rb):
+        for b, part in list(d.items()):
+            key = "l_k" if "l_k" in part.column_names else "r_k"
+            d[b] = part.take(np.argsort(part.columns[key].data, kind="stable"))
+
+    metrics.reset()
+    parts = bucketed_join_pairs(lb, rb, ["l_k"], ["r_k"])
+    assert metrics.counter("join.path.native_smj_gather") == 1
+    got = rows_of(ColumnarBatch.concat(parts), ["l_k", "l_v", "l_s", "r_v", "r_s"])
+
+    monkeypatch.setattr(native, "smj_join_gather", lambda *a, **k: None)
+    metrics.reset()
+    parts_ref = bucketed_join_pairs(lb, rb, ["l_k"], ["r_k"])
+    assert metrics.counter("join.path.native_smj_gather") == 0
+    ref = rows_of(
+        ColumnarBatch.concat(parts_ref), ["l_k", "l_v", "l_s", "r_v", "r_s"]
+    )
+    assert got == ref and len(got) > 0
