@@ -315,3 +315,44 @@ def test_native_smj_gather_parity(monkeypatch):
         ColumnarBatch.concat(parts_ref), ["l_k", "l_v", "l_s", "r_v", "r_s"]
     )
     assert got == ref and len(got) > 0
+
+
+def test_native_smj_gather_skewed_hot_key():
+    """One hot key matching a huge right run dominates the output; the
+    gather's output-position thread partitioning must still emit exactly
+    the reference rows (a row is never split across workers)."""
+    from hyperspace_tpu import native
+
+    if not native.available():
+        pytest.skip("native runtime unavailable")
+    rng = np.random.default_rng(33)
+    hot = 7
+    l_k = np.concatenate(
+        [np.full(5, hot, dtype=np.int64), rng.integers(100, 400, 2000)]
+    ).astype(np.int64)
+    r_k = np.concatenate(
+        [np.full(60_000, hot, dtype=np.int64), rng.integers(100, 400, 1000)]
+    ).astype(np.int64)
+    left = ColumnarBatch.from_pydict(
+        {"l_k": l_k, "l_v": np.arange(len(l_k)).astype(np.int64)},
+        {"l_k": "int64", "l_v": "int64"},
+    )
+    right = ColumnarBatch.from_pydict(
+        {"r_k": r_k, "r_v": np.arange(len(r_k)).astype(np.int64)},
+        {"r_k": "int64", "r_v": "int64"},
+    )
+    nb = 4
+    lb = split_by_bucket(left, ["l_k"], nb)
+    rb = split_by_bucket(right, ["r_k"], nb)
+    for d, key in ((lb, "l_k"), (rb, "r_k")):
+        for b, part in list(d.items()):
+            d[b] = part.take(np.argsort(part.columns[key].data, kind="stable"))
+    metrics.reset()
+    parts = bucketed_join_pairs(lb, rb, ["l_k"], ["r_k"])
+    assert metrics.counter("join.path.native_smj_gather") == 1
+    j = ColumnarBatch.concat(parts)
+    # 5 hot left rows x 60k hot right rows dominate the output
+    assert j.num_rows >= 5 * 60_000
+    got = rows_of(j, ["l_k", "l_v", "r_v"])
+    whole = inner_join(left, right, ["l_k"], ["r_k"])
+    assert got == rows_of(whole, ["l_k", "l_v", "r_v"])
