@@ -169,10 +169,18 @@ def merge_sorted_runs(runs: List[ColumnarBatch], key_names: List[str]) -> Column
         return merged
     keys = [sort_encoding(merged.columns[k]) for k in key_names]
     if len(keys) == 1:
-        # single key: one stable argsort (radix for ints) beats lexsort
+        # one key: a stable argsort (radix for ints) is always valid and
+        # needs no packing passes
         order = np.argsort(keys[0], kind="stable")
     else:
-        order = np.lexsort(list(reversed(keys)))  # last key is primary
+        from ..ops.build import _pack_sort_keys
+
+        comp = _pack_sort_keys(keys, None, 0)
+        if comp is not None:
+            # packed keys: one stable argsort beats the multi-key lexsort
+            order = np.argsort(comp, kind="stable")
+        else:
+            order = np.lexsort(list(reversed(keys)))  # last key is primary
     return merged.take(order)
 
 

@@ -324,3 +324,41 @@ def test_empty_bucket_lookup_returns_empty(tmp_path):
     )
     assert got.num_rows == 0
     assert got.schema() == {"k": "int64", "v": "int64"}
+
+
+def test_pack_sort_keys_matches_lexsort():
+    """The bit-packed composite's ascending order must equal lexsort's
+    (bucket primary, then keys in order), including negative encodings
+    (float ordered-int64) and multi-key packs; unpackable widths -> None."""
+    import numpy as np
+
+    from hyperspace_tpu.ops.build import _pack_sort_keys
+
+    rng = np.random.default_rng(4)
+    n = 5000
+    k1 = rng.integers(-500, 500, n)  # negatives (f64 ordered-i64 analog)
+    k2 = rng.integers(0, 37, n)
+    bucket = rng.integers(0, 16, n)
+    comp = _pack_sort_keys([k1, k2], bucket, 16)
+    assert comp is not None
+    got = np.argsort(comp, kind="stable")
+    exp = np.lexsort((k2, k1, bucket))
+    np.testing.assert_array_equal(got, exp)
+    # no bucket: keys only
+    comp2 = _pack_sort_keys([k1, k2], None, 0)
+    np.testing.assert_array_equal(
+        np.argsort(comp2, kind="stable"), np.lexsort((k2, k1))
+    )
+    # width overflow falls back
+    wide = np.array([np.iinfo(np.int64).min, np.iinfo(np.int64).max])
+    assert _pack_sort_keys([wide, wide], None, 0) is None
+
+
+def test_pack_sort_keys_uint64_beyond_int64_falls_back():
+    import numpy as np
+
+    from hyperspace_tpu.ops.build import _pack_sort_keys
+
+    big = np.array([2**63 + 5, 2**63 + 1, 2**63 + 9], dtype=np.uint64)
+    assert _pack_sort_keys([big], None, 0) is None
+    assert _pack_sort_keys([big, big], None, 0) is None
