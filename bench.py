@@ -33,7 +33,10 @@ Prints exactly ONE JSON line:
 {"metric": ..., "value": N, "unit": "x", "vs_baseline": N, ...}
 
 Env knobs: BENCH_ROWS (default 2_000_000), BENCH_BUCKETS (default 64),
-BENCH_REPEATS (default 3).
+BENCH_REPEATS (default 5 — best-of; raised from 3 in round 3 because the
+single-core host's scheduling jitter put ±40% on individual query
+timings, and the recorded artifact should reflect the engines, not the
+noise floor; both sides of every ratio get the same repeats).
 """
 
 from __future__ import annotations
@@ -53,7 +56,7 @@ WORKDIR = REPO / ".bench_workspace"
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
 N_BUCKETS = int(os.environ.get("BENCH_BUCKETS", 64))
-REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
+REPEATS = int(os.environ.get("BENCH_REPEATS", 5))
 N_SOURCE_FILES = 8
 N_SKIP_FILES = int(os.environ.get("BENCH_SKIP_FILES", 64))
 
