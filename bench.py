@@ -246,6 +246,18 @@ def main() -> None:
     steady_s = snap["timers_s"].get("build.stream.steady", 0.0)
     if steady_rows and steady_s > 0:
         build_extras["build_rows_per_s"] = round(steady_rows / steady_s)
+    # provenance of the engine decision: a fresh machine probes live
+    # (probe timers appear); a warm one reads the cross-process disk memo
+    build_extras["build_engine"] = {
+        k.split(".")[-1]: v
+        for k, v in snap["counters"].items()
+        if k.startswith("build.engine.")
+    }
+    for t in ("probe_host", "probe_device", "probe_link"):
+        if f"build.engine.{t}" in snap["timers_s"]:
+            build_extras["build_engine"][f"{t}_s"] = round(
+                snap["timers_s"][f"build.engine.{t}"], 4
+            )
 
     # external build baseline: pyarrow doing the equivalent job — read the
     # three columns, partition rows into the same number of buckets on the
