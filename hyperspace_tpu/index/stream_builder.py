@@ -287,10 +287,12 @@ class StreamingIndexWriter:
         return self._decide_winner()
 
     def _link_rules_out_device(self, sample: ColumnarBatch) -> bool:
-        """True when a timed, compile-free device round trip of one
-        chunk's bytes (H2D + D2H of the sorted result is the device
-        path's unavoidable floor) already exceeds the measured host sort
-        time — the device engine cannot win, whatever its kernel speed."""
+        """True when a timed, compile-free device round trip of the
+        device path's unavoidable transfer floor — KEY columns H2D plus
+        a 4-byte-per-row permutation D2H (value columns never transit;
+        ops.build returns the sort permutation) — already exceeds the
+        measured host sort time: the device engine cannot win, whatever
+        its kernel speed."""
         host_s = self._probe.get("host_s")
         if host_s is None:
             return False
@@ -304,13 +306,21 @@ class StreamingIndexWriter:
             warm = jax.device_put(np.zeros(16, dtype=np.int32))
             warm.block_until_ready()
             np.asarray(warm)
+            # staged OUTSIDE the timed window: the real device path never
+            # uploads the permutation — only its D2H readback counts
+            perm_back = jax.device_put(
+                np.zeros(sample.num_rows, dtype=np.int32)
+            )
+            perm_back.block_until_ready()
             t0 = time.perf_counter()
             total = 0
-            for col in sample.columns.values():
+            for name in self.indexed_cols:
+                col = sample.columns[name]
                 arr = jax.device_put(col.data)
                 arr.block_until_ready()
-                np.asarray(arr)
                 total += col.data.nbytes
+            np.asarray(perm_back)
+            total += sample.num_rows * 4
             link_s = time.perf_counter() - t0
         except Exception:  # noqa: BLE001 - probing must never fail a build
             return False

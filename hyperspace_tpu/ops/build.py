@@ -108,6 +108,26 @@ def device_bucket_ids(
 # ---------------------------------------------------------------------------
 # single-device build kernel
 # ---------------------------------------------------------------------------
+def _ordered_sort_operand(x):
+    """Order-preserving integer view of a float sort operand, matching
+    ops.floatbits' HOST encodings bit-for-bit (including the -0.0
+    canonicalization): without it, lax.sort total-orders -0.0 strictly
+    before +0.0 while the host twin treats them as equal ties kept in
+    input order — the two engines would emit different row orders for
+    float keys containing both zeros. Integers pass through."""
+    if x.dtype == jnp.float32:
+        x = jnp.where(x == jnp.float32(0.0), jnp.float32(0.0), x)
+        bits = lax.bitcast_convert_type(x, jnp.int32)
+        top = jnp.int32(-(2**31))
+        return jnp.where(bits < 0, jnp.bitwise_xor(~bits, top), bits)
+    if x.dtype == jnp.float64:
+        x = jnp.where(x == jnp.float64(0.0), jnp.float64(0.0), x)
+        bits = lax.bitcast_convert_type(x, jnp.int64)
+        top = jnp.int64(-(2**63))
+        return jnp.where(bits < 0, jnp.bitwise_xor(~bits, top), bits)
+    return x
+
+
 def _sort_by_bucket_and_keys(
     arrays: Dict[str, "jax.Array"],
     bucket,
@@ -115,19 +135,25 @@ def _sort_by_bucket_and_keys(
     num_buckets: int,
 ):
     """Fused partition+sort: one lax.sort keyed on (bucket, keys..., iota).
-    Returns (sorted arrays incl. bucket, per-bucket counts)."""
+    Returns (sorted arrays incl. bucket, per-bucket counts, permutation).
+    Float key operands compare through their ordered-int encodings (see
+    _ordered_sort_operand) so order and ties match the host twin."""
     n = bucket.shape[0]
     iota = lax.iota(jnp.int32, n)
-    operands = [bucket] + [arrays[k] for k in key_names] + [iota]
+    operands = (
+        [bucket]
+        + [_ordered_sort_operand(arrays[k]) for k in key_names]
+        + [iota]
+    )
     sorted_ops = lax.sort(operands, num_keys=1 + len(key_names))
     perm = sorted_ops[-1]
     out = {name: arr[perm] for name, arr in arrays.items()}
     counts = jnp.bincount(bucket, length=num_buckets)
-    return out, sorted_ops[0], counts
+    return out, sorted_ops[0], counts, perm
 
 
-# One jitted closure per (schema, keys, num_buckets): jax.jit caches by
-# function object, so a closure defined inside build_partition_single
+# One jitted closure per (key schema, keys, num_buckets): jax.jit caches
+# by function object, so a closure defined inside build_partition_single
 # would RETRACE on every call — the persistent compile cache saves the
 # XLA compile but the per-call retrace (~100ms+) was still charged to
 # every streamed chunk and every device microbench repeat. Array shapes
@@ -135,8 +161,14 @@ def _sort_by_bucket_and_keys(
 _single_kernel_cache: dict = {}
 
 
-def _single_kernel(dtypes_key: tuple, key_names: tuple, num_buckets: int):
-    cache_key = (dtypes_key, key_names, num_buckets)
+def _single_perm_kernel(dtypes_key: tuple, key_names: tuple, num_buckets: int):
+    """Permutation-returning sort kernel: uploads ONLY key columns and
+    ships home a 4-byte-per-row permutation + bucket counts. The sorted
+    VALUE columns never transit the link — the host applies one gather
+    to data it already holds. Transfers drop from O(all columns × up +
+    all columns × down) to O(keys up + 4B/row down): the device engine's
+    floor on thin links is the transfer, not the sort."""
+    cache_key = ("perm", dtypes_key, key_names, num_buckets)
     fn = _single_kernel_cache.get(cache_key)
     if fn is not None:
         return fn
@@ -150,7 +182,12 @@ def _single_kernel(dtypes_key: tuple, key_names: tuple, num_buckets: int):
         bucket = jnp.where(
             lax.iota(jnp.int32, m) < n_valid, bucket, num_buckets
         )
-        return _sort_by_bucket_and_keys(arrays, bucket, keys, num_buckets)
+        # XLA dead-code-eliminates the unused gathered outputs: only the
+        # permutation and counts leave the device
+        _out, _sb, counts, perm = _sort_by_bucket_and_keys(
+            arrays, bucket, keys, num_buckets
+        )
+        return perm, counts
 
     if len(_single_kernel_cache) >= 64:
         _single_kernel_cache.pop(next(iter(_single_kernel_cache)))
@@ -182,9 +219,13 @@ def build_partition_single(
 
     ``defer=True`` returns a zero-arg ``finish()`` callable instead of the
     result: the kernel is dispatched (async — JAX returns futures) and
-    ``finish`` performs the blocking device→host fetch + decode. The
-    streaming writer calls finish() on its spill thread so D2H overlaps
-    the next chunk's H2D + compute."""
+    ``finish`` performs the blocking permutation fetch + the host gather.
+    The streaming writer calls finish() on its spill thread so D2H
+    overlaps the next chunk's H2D + compute. Only the KEY columns are
+    uploaded and only the 4-byte-per-row sort permutation comes back —
+    value columns never transit the link (4–6x less transfer than
+    shipping sorted columns; on thin links the transfer IS the device
+    engine's cost)."""
     dtypes = batch.schema()
     n = batch.num_rows
     from ..utils.intmath import next_pow2
@@ -192,11 +233,12 @@ def build_partition_single(
     n_pad = pad_to if pad_to is not None else next_pow2(n)
     if n_pad < n:
         raise HyperspaceException(f"pad_to={n_pad} smaller than batch rows {n}.")
+    # keys ONLY cross the link (see _single_perm_kernel)
     arrays = {
-        name: jnp.asarray(
-            np.pad(encode_for_device(batch.columns[name]), (0, n_pad - n))
+        k: jnp.asarray(
+            np.pad(encode_for_device(batch.columns[k]), (0, n_pad - n))
         )
-        for name in batch.column_names
+        for k in key_names
     }
     vh = {
         k: jnp.asarray(vocab_hashes(batch.columns[k]))
@@ -204,23 +246,24 @@ def build_partition_single(
         if is_string(dtypes[k])
     }
     n_dev = jnp.asarray(n, dtype=jnp.int32)
-    kernel = _single_kernel(
-        tuple(sorted(dtypes.items())), tuple(key_names), num_buckets
-    )
-    out_arrays, _sorted_bucket, counts_dev = kernel(arrays, vh, n_dev)
-    vocabs = {name: batch.columns[name].vocab for name in batch.column_names}
+    key_dtypes = tuple(sorted((k, dtypes[k]) for k in key_names))
+    kernel = _single_perm_kernel(key_dtypes, tuple(key_names), num_buckets)
+    perm_dev, counts_dev = kernel(arrays, vh, n_dev)
 
     def finish() -> Tuple[ColumnarBatch, np.ndarray]:
         counts = np.asarray(counts_dev)[:num_buckets]
-        cols = {
-            name: Column(
-                dtypes[name],
-                decode_from_device(dtypes[name], np.asarray(out_arrays[name])[:n]),
-                vocabs[name],
-            )
-            for name in dtypes
-        }
-        return ColumnarBatch(cols), counts
+        perm = np.asarray(perm_dev)[:n].astype(np.int64, copy=False)
+        out = batch.take(perm)
+        for name, col in out.columns.items():
+            if col.dtype_str == "float64":
+                # match the host twin and the old transit-encoded path:
+                # the f64 ordered-int64 encoding canonicalizes -0.0
+                out.columns[name] = Column(
+                    col.dtype_str,
+                    np.where(col.data == 0.0, 0.0, col.data),
+                    col.vocab,
+                )
+        return out, counts
 
     return finish if defer else finish()
 
@@ -365,7 +408,7 @@ def _sharded_build_fn(
         vflat = vmask.reshape(D * cap)
 
         masked_bucket = jnp.where(vflat, recv_bucket, num_buckets)
-        out, sorted_bucket, _ = _sort_by_bucket_and_keys(
+        out, sorted_bucket, _, _perm = _sort_by_bucket_and_keys(
             recv, masked_bucket, list(key_names), num_buckets
         )
         local_counts = jnp.bincount(masked_bucket, length=num_buckets)

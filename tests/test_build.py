@@ -362,3 +362,35 @@ def test_pack_sort_keys_uint64_beyond_int64_falls_back():
     big = np.array([2**63 + 5, 2**63 + 1, 2**63 + 9], dtype=np.uint64)
     assert _pack_sort_keys([big], None, 0) is None
     assert _pack_sort_keys([big, big], None, 0) is None
+
+
+def test_float_key_zero_tie_order_matches_host_twin():
+    """f32/f64 key columns containing both -0.0 and +0.0: the device sort
+    must treat them as EQUAL ties kept in input order, exactly like the
+    host twin (lax.sort would otherwise order -0.0 strictly first)."""
+    import numpy as np
+
+    from hyperspace_tpu.ops.build import (
+        build_partition_host,
+        build_partition_single,
+    )
+    from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+    for dt, np_dt in (("float32", np.float32), ("float64", np.float64)):
+        vals = np.array(
+            [0.0, -0.0, 1.5, -0.0, 0.0, -1.5, 0.0], dtype=np_dt
+        )
+        b = ColumnarBatch(
+            {
+                "k": Column(dt, vals),
+                "v": Column("int64", np.arange(len(vals))),
+            }
+        )
+        dev, dc = build_partition_single(b, ["k"], 4)
+        host, hc = build_partition_host(b, ["k"], 4)
+        np.testing.assert_array_equal(dc, hc)
+        np.testing.assert_array_equal(
+            dev.columns["v"].data, host.columns["v"].data, err_msg=dt
+        )
+        # bytes identical too (-0.0 canonicalized the same way)
+        assert dev.columns["k"].data.tobytes() == host.columns["k"].data.tobytes()
