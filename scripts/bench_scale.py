@@ -412,6 +412,82 @@ def main() -> None:
         q17_external_s=round(ext17_s, 3),
     )
 
+    # ---- lifecycle at scale: incremental refresh + optimize ----------------
+    # append ~8% fresh rows (5 of 60M) as new source files, then time
+    # refresh("incremental") — which must index ONLY the appended files
+    # (RefreshIncrementalAction semantics) — and a quick optimize pass.
+    # A point lookup must see the appended rows afterwards.
+    n_app = max(N_ROWS // 12, 1)
+    app_dir = WORKDIR / "lineitem"
+    rng = np.random.default_rng(99)
+    probe_key2 = lookup_key  # appended rows reuse the probed key
+    import pyarrow as pa
+    import pyarrow.parquet as _pq
+
+    try:
+        t_gen = time.perf_counter()
+        per = (n_app + 1) // 2
+        appended_hits = 0
+        for i in range(2):
+            m = min(per, n_app - i * per)
+            n_probe = min(m, 50)  # tiny SCALE_ROWS smoke runs have m < 50
+            okeys = np.concatenate(
+                [
+                    np.full(n_probe, probe_key2, dtype=np.int64),
+                    rng.integers(1, n_orders, m - n_probe).astype(np.int64),
+                ]
+            )
+            # the random tail can collide with the probe key too — count
+            # the ACTUAL hits, don't assume exactly n_probe per file
+            appended_hits += int((okeys == probe_key2).sum())
+            _pq.write_table(
+                pa.table(
+                    {
+                        "l_orderkey": okeys,
+                        "l_partkey": rng.integers(1, 2_000_000, m).astype(
+                            np.int64
+                        ),
+                        "l_suppkey": rng.integers(1, 100_000, m).astype(
+                            np.int64
+                        ),
+                        "l_quantity": rng.integers(1, 51, m).astype(np.int64),
+                        "l_extendedprice": np.round(
+                            rng.uniform(900.0, 105_000.0, m), 2
+                        ),
+                        "l_shipmode": pa.array(
+                            SHIP_MODES[rng.integers(0, 7, m)], type=pa.binary()
+                        ),
+                    }
+                ),
+                str(app_dir / f"part-app-{i:02d}.parquet"),
+            )
+        gen_append_s = time.perf_counter() - t_gen
+
+        before_rows = len(on)
+        t0 = time.perf_counter()
+        hs.refresh_index("li_idx", "incremental")
+        refresh_s = time.perf_counter() - t0
+        after = q2().collect()
+        if after.num_rows != before_rows + appended_hits:
+            _fail("incremental refresh lost or duplicated appended rows")
+        t0 = time.perf_counter()
+        hs.optimize_index("li_idx")
+        optimize_s = time.perf_counter() - t0
+        if q2().collect().num_rows != before_rows + appended_hits:
+            _fail("optimize changed query results")
+        extras.update(
+            refresh_appended_rows=n_app,
+            refresh_incremental_s=round(refresh_s, 2),
+            optimize_quick_s=round(optimize_s, 2),
+            gen_append_s=round(gen_append_s, 1),
+        )
+    finally:
+        # restore the source dir for reuse across runs, even when a
+        # parity gate exits early (a polluted workspace would corrupt
+        # every later run's source dataset)
+        for i in range(2):
+            (app_dir / f"part-app-{i:02d}.parquet").unlink(missing_ok=True)
+
     out = {
         "metric": "scale_build_rows_per_s",
         "value": build["build_rows_per_s_end_to_end"],
