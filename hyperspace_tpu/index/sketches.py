@@ -226,15 +226,44 @@ class BloomFilterSketch(SketchSpec):
         if pins is None:
             return True  # bloom answers equality only
         m, k = int(data["numBits"]), int(data["numHashes"])
-        bits = np.unpackbits(
-            np.frombuffer(base64.b64decode(data["bits"]), dtype=np.uint8)
-        )[:m].astype(bool)
+        # decode once per distinct bit array: the base64→bits decode was
+        # ~0.5ms × files × queries — 60% of a point query's rewrite time
+        # at 64 files. Keyed by the b64 CONTENT (not stashed on the dict:
+        # load_sketch_table's contract freezes the shared table, and a
+        # refresh serializes those dicts back to JSON).
+        b64 = data["bits"]
+        packed = _BLOOM_BITS_CACHE.get(b64)
+        if packed is None:
+            packed = np.frombuffer(base64.b64decode(b64), dtype=np.uint8)
+            global _BLOOM_BITS_CACHE_NBYTES
+            while (
+                _BLOOM_BITS_CACHE
+                and _BLOOM_BITS_CACHE_NBYTES + packed.nbytes
+                > _BLOOM_BITS_CACHE_CAP_BYTES
+            ):
+                _, old = _BLOOM_BITS_CACHE.popitem(last=False)
+                _BLOOM_BITS_CACHE_NBYTES -= old.nbytes
+            _BLOOM_BITS_CACHE[b64] = packed
+            _BLOOM_BITS_CACHE_NBYTES += packed.nbytes
         for v in pins:
             reprs = np.array([scalar_key_repr(v, dtype_str)], dtype=np.int64)
             pos = _bloom_positions(reprs, m, k)[0]
-            if bits[pos].all():
+            # packbits is MSB-first: global bit p = byte p>>3, bit 7-(p&7)
+            hit_bits = (packed[pos >> 3] >> (7 - (pos & 7))) & 1
+            if hit_bits.all():
                 return True  # might contain v
         return False
+
+
+# decoded (PACKED uint8) bloom arrays keyed by their base64 content; the
+# b64→bytes decode was ~0.5ms × files × queries. Byte-capped LRU: packed
+# form is 8x smaller than unpacked bools, and the cap bounds host memory
+# however many sketched files/versions a long-lived session touches.
+from collections import OrderedDict  # noqa: E402
+
+_BLOOM_BITS_CACHE: "OrderedDict[str, np.ndarray]" = OrderedDict()
+_BLOOM_BITS_CACHE_NBYTES = 0
+_BLOOM_BITS_CACHE_CAP_BYTES = 64 << 20
 
 
 _SKETCH_KINDS = {

@@ -111,6 +111,11 @@ class DataSkippingFilterRule:
                     kept = prune_files(entry, scan, predicate)
                     if kept is None or len(kept) == len(scan.relation.files):
                         continue
+                    from ...telemetry.metrics import metrics
+
+                    metrics.incr(
+                        "scan.sketch_pruned", len(scan.relation.files) - len(kept)
+                    )
                     new_rel = dc_replace(scan.relation, files=kept)
                     new_scan = Scan(new_rel)
                     new_node: LogicalPlan = Filter(predicate, new_scan)

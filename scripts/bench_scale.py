@@ -119,6 +119,12 @@ def _ensure_data(n_rows: int, n_orders: int) -> float:
     (0.0 when the cached workspace already matches)."""
     marker = WORKDIR / "source.json"
     want = {"rows": n_rows, "orders": n_orders, "files": N_LI_FILES, "gen": 3}
+    # a hard kill during the lifecycle phase can leave appended files the
+    # finally never removed; the marker would still validate, silently
+    # growing every later run's dataset — sweep them before trusting it
+    if (WORKDIR / "lineitem").is_dir():
+        for stray in (WORKDIR / "lineitem").glob("part-app-*.parquet"):
+            stray.unlink()
     if marker.exists():
         try:
             if json.loads(marker.read_text()) == want:
