@@ -1,0 +1,160 @@
+"""Hyperspace-TPU quickstart: the full index lifecycle in one script.
+
+Mirrors the reference's example app and "Hitchhiker's Guide" notebook
+(`examples/scala/src/main/scala/App.scala`, `notebooks/python/...ipynb`):
+data preparation, index creation, listing, query rewriting for filters /
+ranges / joins, explain, refresh after data changes, and the
+delete → restore → vacuum lifecycle — against generated sample data in a
+temp directory, runnable from a fresh checkout:
+
+    PYTHONPATH=. python examples/quickstart.py
+
+(Append to any preset PYTHONPATH rather than replacing it if your
+environment provides a jax plugin path.)
+"""
+
+import shutil
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+
+def main() -> None:
+    work = Path(tempfile.mkdtemp(prefix="hyperspace_quickstart_"))
+    try:
+        run(work)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def run(work: Path) -> None:
+    # ---- data preparation --------------------------------------------------
+    # two small tables, written as parquet the way any lake job would
+    rng = np.random.default_rng(0)
+    n_emp, n_dept = 100_000, 2_000
+    departments = ColumnarBatch(
+        {
+            "id": Column("int64", np.arange(1, n_dept + 1)),
+            "deptName": Column.from_values(
+                np.array(
+                    [f"Dept-{i % 40:02d}".encode() for i in range(n_dept)],
+                    dtype=object,
+                )
+            ),
+            "location": Column.from_values(
+                np.array([b"Seattle", b"Paris", b"Tokyo"], dtype=object)[
+                    rng.integers(0, 3, n_dept)
+                ]
+            ),
+        }
+    )
+    employees = ColumnarBatch(
+        {
+            "empId": Column("int64", np.arange(1, n_emp + 1)),
+            "empName": Column.from_values(
+                np.array(
+                    [f"emp{i}".encode() for i in range(n_emp)], dtype=object
+                )
+            ),
+            "deptId": Column("int64", rng.integers(1, n_dept + 1, n_emp)),
+        }
+    )
+    (work / "departments").mkdir(parents=True)
+    (work / "employees").mkdir(parents=True)
+    parquet_io.write_parquet(work / "departments" / "part-0.parquet", departments)
+    for i in range(4):
+        lo, hi = i * n_emp // 4, (i + 1) * n_emp // 4
+        parquet_io.write_parquet(
+            work / "employees" / f"part-{i}.parquet",
+            employees.take(np.arange(lo, hi)),
+        )
+
+    # ---- hello hyperspace --------------------------------------------------
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(work / "indexes"),
+            C.INDEX_NUM_BUCKETS: 16,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    dept_df = session.read.parquet(str(work / "departments"))
+    emp_df = session.read.parquet(str(work / "employees"))
+
+    # an index = indexed (key) columns + included (covered) columns
+    hs.create_index(dept_df, IndexConfig("deptIndex", ["id"], ["deptName"]))
+    hs.create_index(emp_df, IndexConfig("empIndex", ["deptId"], ["empName"]))
+    print("indexes after create:")
+    print(hs.indexes_df().to_string(index=False))
+
+    # ---- index usage: filters, ranges, joins -------------------------------
+    session.enable_hyperspace()
+
+    lookup = (
+        session.read.parquet(str(work / "departments"))
+        .filter(col("id") == lit(1234))
+        .select("id", "deptName")
+    )
+    print("\npoint lookup rows:", lookup.collect().num_rows)
+    print(hs.explain(lookup))
+
+    rng_q = (
+        session.read.parquet(str(work / "departments"))
+        .filter((col("id") >= lit(100)) & (col("id") <= lit(120)))
+        .select("id", "deptName")
+    )
+    print("range rows:", rng_q.collect().num_rows)
+
+    join_q = (
+        session.read.parquet(str(work / "employees"))
+        .join(
+            session.read.parquet(str(work / "departments")),
+            col("deptId") == col("id"),
+        )
+        .select("empName", "deptName")
+    )
+    joined = join_q.collect()
+    print("join rows:", joined.num_rows)
+    print(hs.explain(join_q))
+
+    # ---- refresh after data changes ----------------------------------------
+    # append a file the index has not seen, then refresh("full"); Hybrid
+    # Scan (see examples/hybrid_scan.py) can answer without refreshing
+    appended = employees.take(np.arange(0, 500))
+    parquet_io.write_parquet(work / "employees" / "part-appended.parquet", appended)
+    hs.refresh_index("empIndex", C.REFRESH_MODE_FULL)
+    # re-read: a DataFrame snapshots the file listing when constructed
+    fresh_join = (
+        session.read.parquet(str(work / "employees"))
+        .join(
+            session.read.parquet(str(work / "departments")),
+            col("deptId") == col("id"),
+        )
+        .select("empName", "deptName")
+    )
+    print("\nafter refresh, join rows:", fresh_join.collect().num_rows)
+
+    # ---- delete / restore / vacuum lifecycle -------------------------------
+    hs.delete_index("deptIndex")  # soft delete: recoverable
+    print("\nafter delete:", [ix.name for ix in hs.indexes()], "states:",
+          [ix.state for ix in hs.indexes()])
+    hs.restore_index("deptIndex")  # back to ACTIVE
+    print("after restore:", [(ix.name, ix.state) for ix in hs.indexes()])
+    hs.delete_index("deptIndex")
+    hs.vacuum_index("deptIndex")  # hard delete: files + metadata gone
+    print("after vacuum:", [(ix.name, ix.state) for ix in hs.indexes()])
+    print("\nquickstart OK")
+
+
+if __name__ == "__main__":
+    main()

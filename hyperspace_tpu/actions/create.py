@@ -179,6 +179,7 @@ class CreateActionBase:
                 extra_meta=extra_meta,
                 mesh=self.session.mesh,
                 engine=self.conf.build_engine(),
+                finalize_mode=self.conf.build_finalize_mode(),
             )
         batch = self.prepare_index_batch(relation, indexed, included, lineage, tracker)
         return write_index_data(

@@ -47,15 +47,35 @@ class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
         self._partition = None
 
     def _partition_files(self):
-        """(files to optimize, untouched files) by bucket and threshold
-        (OptimizeAction.scala:115-133). Cached: validate() and op() share
-        one content-tree walk."""
+        """(files to optimize, run files, untouched files) by bucket and
+        threshold (OptimizeAction.scala:115-133). Multi-bucket RUN files
+        (build finalizeMode=runs) are ALWAYS compacted regardless of size
+        or mode — optimize is the deferred half of their build's write
+        path (the small-file→optimize lifecycle). Cached: validate() and
+        op() share one content-tree walk."""
         if self._partition is not None:
             return self._partition
         threshold = self.conf.optimize_file_size_threshold()
         by_bucket: Dict[int, List] = {}
+        run_files: List = []
         for fi in self.previous_entry.content.file_infos():
-            by_bucket.setdefault(layout.bucket_of_file(fi.name), []).append(fi)
+            if layout.is_run_file(fi.name):
+                run_files.append(fi)
+            else:
+                by_bucket.setdefault(layout.bucket_of_file(fi.name), []).append(fi)
+        # which buckets actually hold rows in the run files: a footer
+        # read per run (cached) — buckets untouched by any run keep the
+        # single-file skip rule, and empty buckets never reach op()
+        run_buckets: set = set()
+        for fi in run_files:
+            offs = layout.run_bucket_offsets(layout.cached_reader(fi.name).footer)
+            if offs is None:
+                raise HyperspaceException(
+                    f"Run file {fi.name} carries no bucketCounts footer."
+                )
+            run_buckets.update(
+                b for b in range(len(offs) - 1) if offs[b + 1] > offs[b]
+            )
         to_optimize: Dict[int, List] = {}
         untouched: List = []
         for b, files in by_bucket.items():
@@ -64,12 +84,14 @@ class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
                 big = [f for f in files if f.size >= threshold]
             else:
                 small, big = list(files), []
-            if len(small) < 2:  # nothing to merge in this bucket (:126-131)
+            # a single small file still merges when run segments exist
+            # for its bucket; alone it is already compact (:126-131)
+            if len(small) < 2 and b not in run_buckets:
                 untouched.extend(files)
                 continue
             to_optimize[b] = small
             untouched.extend(big)
-        self._partition = (to_optimize, untouched)
+        self._partition = (to_optimize, run_files, run_buckets, untouched)
         return self._partition
 
     def validate(self) -> None:
@@ -82,8 +104,8 @@ class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
             raise HyperspaceException(
                 "Optimize is only supported in ACTIVE state."
             )
-        to_optimize, _ = self._partition_files()
-        if not to_optimize:
+        to_optimize, run_files, _, _ = self._partition_files()
+        if not to_optimize and not run_files:
             raise NoChangesException(
                 "No index files eligible for compaction "
                 f"(mode={self.mode})."
@@ -91,14 +113,28 @@ class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
 
     def op(self) -> None:
         prev = self.previous_entry
-        to_optimize, untouched = self._partition_files()
+        to_optimize, run_files, run_buckets, untouched = self._partition_files()
         version_dir = self.next_version_dir()
         indexed = prev.indexed_columns
         new_paths: List[str] = []
-        for b, files in sorted(to_optimize.items()):
-            merged = ColumnarBatch.concat(
-                [layout.read_batch(f.name) for f in files]
-            )
+        # per-run readers opened once; each contributes its bucket row
+        # ranges to every bucket's merge below
+        run_readers = [layout.TcbReader(fi.name) for fi in run_files]
+        run_offsets = [
+            layout.run_bucket_offsets(r.footer) for r in run_readers
+        ]
+        for b in sorted(set(to_optimize) | run_buckets):
+            parts = [
+                layout.read_batch(f.name) for f in to_optimize.get(b, [])
+            ]
+            for reader, offs in zip(run_readers, run_offsets):
+                if b < len(offs) - 1 and offs[b + 1] > offs[b]:
+                    parts.append(
+                        reader.read(row_range=(int(offs[b]), int(offs[b + 1])))
+                    )
+            if not parts:  # bucket emptied (e.g. lineage delete rewrote it)
+                continue
+            merged = parts[0] if len(parts) == 1 else ColumnarBatch.concat(parts)
             # restore per-bucket sort order on the indexed columns via the
             # shared order-preserving encodings (stream_builder.sort_encoding):
             # strings sort by unified dictionary codes, floats by their

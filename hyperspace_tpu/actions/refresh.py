@@ -214,16 +214,35 @@ class RefreshIncrementalAction(RefreshActionBase):
         if self.deleted_files:
             # Rewrite existing data excluding deleted lineage ids (:73-95);
             # per-file filtering preserves each file's bucket and order.
-            for f in prev.content.files():
-                b = layout.bucket_of_file(f)
+            # Multi-bucket run files rewrite as run files: the keep-mask
+            # preserves row order, so per-bucket counts just shrink.
+            del_arr = np.array(sorted(deleted_ids), dtype=np.int64)
+            for i, f in enumerate(prev.content.files()):
                 batch = layout.read_batch(f)
                 ids = batch.columns[C.DATA_FILE_NAME_ID].data
-                keep = ~np.isin(ids, np.array(sorted(deleted_ids), dtype=np.int64))
+                keep = ~np.isin(ids, del_arr)
                 kept = batch.take(np.flatnonzero(keep))
                 if kept.num_rows == 0:
                     continue
-                p = version_dir / layout.bucket_file_name(b)
-                layout.write_batch(p, kept, sorted_by=indexed, bucket=b)
+                if layout.is_run_file(f):
+                    offs = layout.run_bucket_offsets(
+                        layout.cached_reader(f).footer
+                    )
+                    counts = [
+                        int(keep[int(offs[b]) : int(offs[b + 1])].sum())
+                        for b in range(len(offs) - 1)
+                    ]
+                    p = version_dir / layout.run_file_name(i)
+                    layout.write_batch(
+                        p,
+                        kept,
+                        sorted_by=indexed,
+                        extra={"bucketCounts": counts},
+                    )
+                else:
+                    b = layout.bucket_of_file(f)
+                    p = version_dir / layout.bucket_file_name(b)
+                    layout.write_batch(p, kept, sorted_by=indexed, bucket=b)
                 new_files.append(p)
 
         self._entry = self.build_log_entry(

@@ -135,6 +135,19 @@ class HyperspaceConf:
     def build_chunk_rows(self) -> int:
         return int(self.get(C.BUILD_CHUNK_ROWS, C.BUILD_CHUNK_ROWS_DEFAULT))
 
+    def build_finalize_mode(self) -> str:
+        v = str(
+            self.get(C.BUILD_FINALIZE_MODE, C.BUILD_FINALIZE_MODE_DEFAULT)
+        ).lower()
+        if v not in C.BUILD_FINALIZE_MODES:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"Unsupported {C.BUILD_FINALIZE_MODE}={v!r}; supported: "
+                f"{C.BUILD_FINALIZE_MODES}."
+            )
+        return v
+
     def build_engine(self) -> str:
         v = str(self.get(C.BUILD_ENGINE, C.BUILD_ENGINE_DEFAULT)).lower()
         if v not in C.BUILD_ENGINES:

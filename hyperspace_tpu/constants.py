@@ -38,6 +38,20 @@ BUILD_MODES = (BUILD_MODE_AUTO, BUILD_MODE_INMEMORY, BUILD_MODE_STREAMING)
 BUILD_MODE_DEFAULT = BUILD_MODE_AUTO
 BUILD_CHUNK_ROWS = "hyperspace.index.build.chunkRows"
 BUILD_CHUNK_ROWS_DEFAULT = 1 << 21  # 2M rows per streamed chunk
+# What the streamed build does with its spilled sorted runs:
+#   merge — k-way-merge runs into one file per bucket at finalize (every
+#           row is written twice: spill + final — the round-3 write wall);
+#   runs  — promote the runs themselves to final multi-bucket data files
+#           (footer bucketCounts give per-bucket row ranges); queries read
+#           bucket segments and merge at execution time, and optimize()
+#           later compacts runs into per-bucket files — the reference's
+#           small-file→optimize lifecycle (OptimizeAction.scala:85-99)
+#           applied to build latency: rows are written ONCE at build time.
+BUILD_FINALIZE_MODE = "hyperspace.index.build.finalizeMode"
+BUILD_FINALIZE_MERGE = "merge"
+BUILD_FINALIZE_RUNS = "runs"
+BUILD_FINALIZE_MODES = (BUILD_FINALIZE_MERGE, BUILD_FINALIZE_RUNS)
+BUILD_FINALIZE_MODE_DEFAULT = BUILD_FINALIZE_MERGE
 # auto mode streams when the source files exceed this many bytes on disk
 BUILD_STREAMING_THRESHOLD_BYTES = "hyperspace.index.build.streamingThresholdBytes"
 BUILD_STREAMING_THRESHOLD_BYTES_DEFAULT = 256 * 1024 * 1024

@@ -26,6 +26,14 @@ class DataFrame(EventLogging):
 
     # -- transformations -----------------------------------------------------
     def filter(self, condition: Expr) -> "DataFrame":
+        # analyzer-style normalization: Col references resolve to the
+        # child schema's canonical case (Spark's case-insensitive
+        # resolution, which the reference inherits)
+        from .plan.expr import resolve_expr_columns
+
+        condition = resolve_expr_columns(
+            condition, self.plan.output_columns()
+        )
         return DataFrame(self.session, Filter(condition, self.plan))
 
     where = filter
@@ -46,6 +54,12 @@ class DataFrame(EventLogging):
     def join(self, other: "DataFrame", condition: Expr, how: str = "inner") -> "DataFrame":
         if self.session is not other.session:
             raise HyperspaceException("Cannot join DataFrames from different sessions.")
+        from .plan.expr import resolve_expr_columns
+
+        condition = resolve_expr_columns(
+            condition,
+            list(self.plan.output_columns()) + list(other.plan.output_columns()),
+        )
         return DataFrame(self.session, Join(self.plan, other.plan, condition, how))
 
     def create_or_replace_temp_view(self, name: str) -> None:

@@ -288,17 +288,25 @@ class Executor:
         from pathlib import Path
 
         from .distributed import distributed_filter
-        from .scan import prune_index_files
+        from .scan import (
+            _read_run_segments,
+            buckets_for_predicate,
+            prune_index_files,
+        )
 
         from ..telemetry.metrics import metrics
 
         entry = node.entry
+        pinned = buckets_for_predicate(
+            predicate, entry.indexed_columns, entry.schema, entry.num_buckets
+        )
         files = prune_index_files(
             [Path(p) for p in self._index_files(node)],
             predicate,
             entry.indexed_columns,
             entry.schema,
             entry.num_buckets,
+            pinned_buckets=pinned,
         )
         metrics.incr("scan.files_read", len(files))
         need = list(
@@ -306,8 +314,26 @@ class Executor:
                 list(node.required_columns) + sorted(predicate.columns())
             )
         )
-        batches = layout.read_batches(files, columns=need)
-        by_bucket = self._group_batches_by_bucket(files, batches)
+        # pinned-bucket equality over run files: read only those buckets'
+        # row ranges (the single-device path's rule) instead of shipping
+        # every bucket of every run to the mesh
+        seg_groups: Dict[int, List[ColumnarBatch]] = {}
+        bulk_files = list(files)
+        if pinned is not None:
+            bulk_files = [f for f in files if not layout.is_run_file(f)]
+            for f in files:
+                if layout.is_run_file(f):
+                    for b in sorted(pinned):
+                        part = _read_run_segments(f, need, {b})
+                        if part is not None and part.num_rows:
+                            seg_groups.setdefault(b, []).append(part)
+        batches = layout.read_batches(bulk_files, columns=need)
+        by_bucket = self._group_batches_by_bucket(bulk_files, batches)
+        for b, parts in seg_groups.items():
+            parts = ([by_bucket[b]] if b in by_bucket else []) + parts
+            by_bucket[b] = (
+                parts[0] if len(parts) == 1 else ColumnarBatch.concat(parts)
+            )
         if not by_bucket:
             from .scan import empty_batch_for
 
@@ -533,10 +559,27 @@ class Executor:
     def _group_batches_by_bucket(files, batches) -> Dict[int, ColumnarBatch]:
         """Group per-file batches by bucket id, one concat per bucket
         (accumulating pairwise concats would copy multi-file buckets
-        quadratically)."""
+        quadratically). Multi-bucket RUN files (finalizeMode=runs) are
+        split into their footer-described bucket segments; a bucket whose
+        rows span several runs concatenates piecewise-sorted segments —
+        the join layer detects unsorted segments and re-sorts, exactly as
+        it does for incremental-refresh multi-file buckets."""
         groups: Dict[int, List[ColumnarBatch]] = {}
         for f, batch in zip(files, batches):
-            if batch.num_rows == 0:
+            if batch is None or batch.num_rows == 0:
+                continue
+            if layout.is_run_file(f):
+                offs = layout.run_bucket_offsets(layout.cached_reader(f).footer)
+                if offs is None:
+                    raise HyperspaceException(
+                        f"Run file {f} carries no bucketCounts footer."
+                    )
+                for b in range(len(offs) - 1):
+                    s, e = int(offs[b]), int(offs[b + 1])
+                    if e > s:
+                        groups.setdefault(b, []).append(
+                            batch.take(np.arange(s, e))
+                        )
                 continue
             groups.setdefault(layout.bucket_of_file(f), []).append(batch)
         return {
@@ -551,12 +594,19 @@ class Executor:
         parallel IO runtime in one call (layout.read_batches; the same C++
         thread pool the filter scan uses) — the join side reads the most
         files, so serial per-file reads were the worst place to skip it
-        (round-1 verdict weak #4)."""
+        (round-1 verdict weak #4). Predicates apply AFTER bucket grouping:
+        run files are sliced into bucket segments by row offset, which a
+        pre-slicing filter would invalidate."""
         files = self._index_files(node)
         batches = layout.read_batches(files, columns=list(node.required_columns))
+        groups = self._group_batches_by_bucket(files, batches)
         if predicate is not None:
-            batches = [self._apply_predicate(b, predicate) for b in batches]
-        return self._group_batches_by_bucket(files, batches)
+            groups = {
+                b: filtered
+                for b, v in groups.items()
+                if (filtered := self._apply_predicate(v, predicate)).num_rows
+            }
+        return groups
 
     def _repartition_by_bucket(
         self, node: Repartition, predicate: Optional[Expr]

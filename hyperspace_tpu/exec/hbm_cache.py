@@ -68,8 +68,14 @@ from ..storage.columnar import Column, ColumnarBatch, is_string
 from ..telemetry.metrics import metrics
 
 BLOCK_ROWS = 8192  # count granularity: 4 B D2H per 8 K rows scanned
-_LANES = 128
-_TILE_ELEMS = 256 * _LANES  # MASK_BLOCK_SUBLANES * LANES (ops/kernels)
+
+# tile geometry MUST match the mask kernel's (a resident table padded to
+# a different tile than _build_mask_call's grid would truncate the mask's
+# tail tiles into garbage counts) — imported, not copied
+from ..ops.kernels import LANES as _LANES  # noqa: E402
+from ..ops.kernels import MASK_BLOCK_SUBLANES as _MASK_SUBLANES  # noqa: E402
+
+_TILE_ELEMS = _MASK_SUBLANES * _LANES
 
 
 def _budget_bytes() -> int:
@@ -143,27 +149,20 @@ def _encode_column(col: Column) -> Optional[Tuple[np.ndarray, str]]:
     """(int32 array, encoding) for a device-resident predicate column, or
     None when the dtype cannot ride the device exactly (float64, strings —
     whose dictionary codes are per-file and would collide across the
-    concatenated table — and out-of-range int64)."""
+    concatenated table — out-of-range int64, NaN float32). The narrowing
+    itself is ops.kernels.narrow_arrays_to_i32: the resident protocol's
+    correctness rests on the device encoding agreeing with what
+    narrow_expr_to_i32 assumes about literals, so there is exactly ONE
+    narrowing contract in the codebase."""
+    from ..ops.kernels import narrow_arrays_to_i32
+
     a = col.data
     if is_string(col.dtype_str) or col.dtype_str == "float64":
         return None
-    if a.dtype == np.int32:
-        return a, "int"
-    if a.dtype == np.bool_:
-        return a.astype(np.int32), "int"
-    if a.dtype.kind in ("i", "u"):
-        if a.size and (
-            a.min() < -(2**31) or a.max() > 2**31 - 2
-        ):
-            return None
-        return a.astype(np.int32), "int"
-    if a.dtype == np.float32:
-        if a.size and np.isnan(a).any():
-            return None  # encoded NaN would order above +inf
-        from ..ops.floatbits import f32_to_ordered_i32
-
-        return f32_to_ordered_i32(a), "float32"
-    return None
+    narrowed = narrow_arrays_to_i32({"c": a})
+    if narrowed is None:
+        return None
+    return narrowed["c"], ("float32" if a.dtype == np.float32 else "int")
 
 
 _counts_fn_cache: dict = {}
@@ -264,7 +263,7 @@ class HbmIndexCache:
             )
             if existing is not None:
                 return existing
-        table = self._build(paths, key, columns)
+        table, _ = self._build(paths, key, columns)
         if table is None:
             return None
         self._register(table)
@@ -332,13 +331,15 @@ class HbmIndexCache:
                         + (sorted(prior.columns) if prior else [])
                     )
                 )
-                table = self._build(paths, key, build_cols)
+                table, permanent = self._build(paths, key, build_cols)
                 if table is not None and set(columns) <= set(table.columns):
                     self._register(table)
-                else:
+                elif table is not None or permanent:
                     # partially-encodable tables are not registered from
                     # auto-population: they could never serve this
-                    # predicate and would be rebuilt on every touch
+                    # predicate and would be rebuilt on every touch.
+                    # Transient refusals (budget, IO, device) skip the
+                    # memo — a later touch may succeed.
                     failed = True
             except Exception:  # noqa: BLE001 - population must never fail a scan
                 # transient (IO hiccup, device loss): do NOT memoize — a
@@ -353,13 +354,19 @@ class HbmIndexCache:
                             self._failed.clear()
                         self._failed.add(memo)
 
-        threading.Thread(
+        t = threading.Thread(
             target=bg, daemon=True, name="hbm-cache-populate"
-        ).start()
+        )
+        self._track_for_exit(t)
+        t.start()
 
     def _build(
         self, paths: List[Path], key: tuple, columns: List[str]
-    ) -> Optional[ResidentTable]:
+    ) -> Tuple[Optional[ResidentTable], bool]:
+        """(table, permanent_refusal). ``permanent_refusal`` marks
+        structural conditions for this file version (nothing encodable,
+        empty) — budget and IO refusals are NOT permanent: the budget is
+        a runtime-tunable env knob and IO errors may be transient."""
         from ..storage import layout
         from ..utils.intmath import next_pow2  # noqa: F401 (doc anchor)
 
@@ -368,7 +375,7 @@ class HbmIndexCache:
         try:
             readers = [layout.cached_reader(p) for p in paths]
         except Exception:  # noqa: BLE001 - vanished file = no residency
-            return None
+            return None, False
         spans: List[Tuple[str, int, int]] = []
         start = 0
         for p, r in zip(paths, readers):
@@ -376,21 +383,34 @@ class HbmIndexCache:
             start += r.num_rows
         n_rows = start
         if n_rows == 0:
-            return None
+            return None, True
         n_pad = -(-n_rows // _TILE_ELEMS) * _TILE_ELEMS
         # budget pre-check BEFORE any read or upload: every resident
         # column costs exactly n_pad * 4 bytes, so an over-budget table
         # is knowable upfront — refusing after the H2D would waste the
-        # full multi-GB transfer on a thin link
-        if len(columns) * n_pad * 4 > _budget_bytes():
+        # full multi-GB transfer on a thin link. Only columns that could
+        # actually encode (footer dtype not string/float64) count.
+        dtype_of = {
+            m["name"]: m["dtype"] for m in readers[0].footer["columns"]
+        }
+        encodable = [
+            c
+            for c in columns
+            if c in dtype_of
+            and not is_string(dtype_of[c])
+            and dtype_of[c] != "float64"
+        ]
+        if not encodable:
+            return None, True
+        if len(encodable) * n_pad * 4 > _budget_bytes():
             metrics.incr("hbm.over_budget_refused")
-            return None
+            return None, False
 
         import jax
 
         cols: Dict[str, ResidentColumn] = {}
         nbytes = 0
-        for name in columns:
+        for name in encodable:
             parts = []
             enc = None
             ok = True
@@ -422,16 +442,16 @@ class HbmIndexCache:
             cols[name] = ResidentColumn(dev, dtype_str, enc, flat.nbytes)
             nbytes += flat.nbytes
         if not cols:
-            return None
+            return None, True  # nothing encoded (e.g. NaN float32 data)
         try:
             jax.block_until_ready([c.data for c in cols.values()])
         except Exception:  # noqa: BLE001 - device loss: no residency
-            return None
+            return None, False
         if nbytes > _budget_bytes():
             metrics.incr("hbm.over_budget_refused")
-            return None
+            return None, False
         metrics.record_time("hbm.prefetch", time.perf_counter() - t0)
-        return ResidentTable(key, spans, n_rows, n_pad, cols, nbytes)
+        return ResidentTable(key, spans, n_rows, n_pad, cols, nbytes), False
 
     def _register(self, table: ResidentTable) -> None:
         with self._lock:
@@ -471,6 +491,9 @@ class HbmIndexCache:
         column in ``columns`` resident, else None."""
         if not files:
             return None
+        with self._lock:
+            if not self._tables:
+                return None  # nothing resident: skip the per-file stats
         try:
             want = {str(Path(p)): _file_identity(Path(p)) for p in files}
         except OSError:
@@ -513,6 +536,27 @@ class HbmIndexCache:
         metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
         return counts[:n_blocks]
 
+    def _track_for_exit(self, t: threading.Thread) -> None:
+        """A daemon populate thread mid-device_put at interpreter
+        shutdown races the jax runtime's teardown; joining live uploads
+        at exit keeps teardown clean (same rationale as the scan gate's
+        probe join)."""
+        with self._lock:
+            threads = getattr(self, "_bg_threads", None)
+            if threads is None:
+                threads = self._bg_threads = []
+                import atexit
+
+                atexit.register(self._join_bg)
+            threads[:] = [x for x in threads if x.is_alive()]
+            threads.append(t)
+
+    def _join_bg(self) -> None:
+        with self._lock:
+            threads = list(getattr(self, "_bg_threads", ()))
+        for t in threads:
+            t.join(30.0)
+
     # -- observability -------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
@@ -537,6 +581,7 @@ class HbmIndexCache:
         with self._lock:
             self._tables.clear()
             self._pending.clear()
+            self._failed.clear()
 
 
 hbm_cache = HbmIndexCache()

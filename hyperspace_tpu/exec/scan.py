@@ -291,16 +291,26 @@ def prune_index_files(
     indexed_columns: Optional[List[str]] = None,
     dtypes: Optional[dict] = None,
     num_buckets: Optional[int] = None,
+    pinned_buckets: Optional[set] = None,
 ) -> List[Path]:
     """Hash-bucket pruning (equality predicates pin buckets) followed by
     footer zone-map pruning — shared by the single-device and distributed
-    scan paths; no file is opened for data."""
+    scan paths; no file is opened for data. Multi-bucket RUN files
+    (finalizeMode=runs) survive bucket pruning whole — their pinned
+    buckets become row-range reads in the scan itself. ``pinned_buckets``
+    lets a caller that already computed the pin set skip recomputing it."""
     if predicate is None:
         return files
-    if indexed_columns and dtypes and num_buckets:
-        buckets = buckets_for_predicate(predicate, indexed_columns, dtypes, num_buckets)
-        if buckets is not None:
-            files = [f for f in files if layout.bucket_of_file(f) in buckets]
+    if pinned_buckets is None and indexed_columns and dtypes and num_buckets:
+        pinned_buckets = buckets_for_predicate(
+            predicate, indexed_columns, dtypes, num_buckets
+        )
+    if pinned_buckets is not None:
+        files = [
+            f
+            for f in files
+            if layout.is_run_file(f) or layout.bucket_of_file(f) in pinned_buckets
+        ]
     # zone-map pruning on every column the predicate bounds
     for c in sorted(predicate.columns()):
         lo, hi = bounds_for_column(predicate, c)
@@ -326,8 +336,18 @@ def index_scan(
     index's bucketing, equality predicates prune to their hash buckets
     before any file is opened."""
     all_files = [Path(p) for p in data_files]
+    pinned = None
+    if predicate is not None and indexed_columns and dtypes and num_buckets:
+        pinned = buckets_for_predicate(
+            predicate, indexed_columns, dtypes, num_buckets
+        )
     files = prune_index_files(
-        all_files, predicate, indexed_columns, dtypes, num_buckets
+        all_files,
+        predicate,
+        indexed_columns,
+        dtypes,
+        num_buckets,
+        pinned_buckets=pinned,
     )
     metrics.incr("scan.files_read", len(files))
     need = list(dict.fromkeys(list(output_columns) + sorted(predicate.columns()))) if predicate else list(output_columns)
@@ -373,10 +393,25 @@ def index_scan(
     # byte loads, but the mmap fallback returns lazy views whose pages
     # fault in later during mask eval — dispatch time only, hence not
     # "scan.io".
+    # multi-bucket run files with pinned buckets are read at their bucket
+    # row ranges only (the run layout's replacement for file-level bucket
+    # pruning). These are synchronous mmap row-range slices (footer
+    # cached, page-granular IO) under their own timer — NOT inside
+    # io_dispatch, whose contract is dispatch-only time.
+    special: dict = {}
+    if pinned is not None and any(layout.is_run_file(f) for f in files):
+        with metrics.timer("scan.run_segment_io"):
+            for f in files:
+                if layout.is_run_file(f):
+                    special[f] = _read_run_segments(f, need, pinned)
+    bulk_files = [f for f in files if f not in special]
     with metrics.timer("scan.io_dispatch"):
-        batches = layout.read_batches(files, columns=need)
-    for f, batch in zip(files, batches):
-        if batch.num_rows == 0:
+        bulk = layout.read_batches(bulk_files, columns=need)
+    bmap = dict(zip(bulk_files, bulk))
+    bmap.update(special)
+    for f in files:
+        batch = bmap[f]
+        if batch is None or batch.num_rows == 0:
             continue
         if predicate is not None:
             mask = _routed_mask(predicate, batch, device, min_device_rows)
@@ -388,6 +423,28 @@ def index_scan(
     if not parts:
         return _empty_result(files, output_columns, dtypes)
     return ColumnarBatch.concat(parts)
+
+
+def _read_run_segments(
+    f: Path, need: List[str], pinned: set
+) -> Optional[ColumnarBatch]:
+    """The pinned buckets' row ranges of one run file (None when those
+    buckets hold no rows there) — an equality lookup over a runs-layout
+    index reads ~rows-per-bucket bytes per run, not the whole file."""
+    reader = layout.cached_reader(f)
+    offs = layout.run_bucket_offsets(reader.footer)
+    if offs is None:
+        return reader.read(need)
+    parts = []
+    for b in sorted(pinned):
+        if 0 <= b < len(offs) - 1 and offs[b + 1] > offs[b]:
+            parts.append(
+                reader.read(need, row_range=(int(offs[b]), int(offs[b + 1])))
+            )
+    if not parts:
+        return None
+    metrics.incr("scan.run_bucket_segments", len(parts))
+    return parts[0] if len(parts) == 1 else ColumnarBatch.concat(parts)
 
 
 def _empty_result(

@@ -117,6 +117,7 @@ class ScanGate:
             # wait_probe()/snapshot() must never miss the in-flight probe
             st["_probe_thread"] = t
         metrics.record_time("scan.gate.probe_host", host_s)
+        _join_bg_threads_at_exit()
         t.start()
 
     def _link_probe_bg(self, n_pad: int, arrays: dict, n_rows: int) -> None:
@@ -266,6 +267,22 @@ class ScanGate:
     def reset(self) -> None:
         with self._lock:
             self._state.clear()
+
+
+_atexit_registered = False
+
+
+def _join_bg_threads_at_exit() -> None:
+    """A daemon probe thread mid-device-transfer at interpreter shutdown
+    races the jax runtime's teardown (observed: terminate() from the
+    plugin). Joining in-flight probes at exit keeps teardown clean."""
+    global _atexit_registered
+    if _atexit_registered:
+        return
+    _atexit_registered = True
+    import atexit
+
+    atexit.register(lambda: scan_gate.wait_probe(timeout=30.0))
 
 
 scan_gate = ScanGate()

@@ -293,6 +293,34 @@ def eval_mask(expr: Expr, batch: ColumnarBatch, arrays=None):
     return ev(expr)
 
 
+def resolve_expr_columns(expr: Expr, available) -> Expr:
+    """Rewrite every ``Col`` reference to the canonical spelling from
+    ``available`` (case-insensitive — ResolverUtils.resolve semantics,
+    the analyzer normalization Spark gave the reference for free). Names
+    with no match keep their spelling: downstream execution raises its
+    usual unknown-column error, exactly as before."""
+    from ..utils import resolver
+
+    def walk(e: Expr) -> Expr:
+        if isinstance(e, And):
+            return And(walk(e.left), walk(e.right))
+        if isinstance(e, Or):
+            return Or(walk(e.left), walk(e.right))
+        if isinstance(e, Not):
+            return Not(walk(e.child))
+        if isinstance(e, Cmp):
+            return Cmp(e.op, walk(e.left), walk(e.right))
+        if isinstance(e, In):
+            child = walk(e.child)
+            return In(child, e.values) if child is not e.child else e
+        if isinstance(e, Col):
+            m = resolver.resolve(e.name, list(available))
+            return Col(m) if m is not None and m != e.name else e
+        return e
+
+    return walk(expr)
+
+
 def bind_string_literals(expr: Expr, batch: ColumnarBatch) -> Expr:
     """Rewrite ``expr`` so every string comparison becomes a pure code-space
     (int32) comparison against this batch's dictionary.

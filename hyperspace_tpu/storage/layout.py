@@ -43,9 +43,36 @@ def bucket_file_name(bucket: int) -> str:
     return f"b{bucket:05d}-{uuid.uuid4().hex[:12]}.tcb"
 
 
+def run_file_name(seq: int) -> str:
+    """A multi-bucket RUN file: one key-sorted, bucket-grouped spill run
+    promoted to a final data file (build finalizeMode=runs). Rows of every
+    bucket live in one file at the row ranges its footer's
+    ``bucketCounts`` describe; ``optimize()`` later compacts runs into
+    per-bucket ``b``-files — the reference's small-file→optimize lifecycle
+    (OptimizeAction.scala:85-99) applied to the build's write wall."""
+    return f"r{seq:05d}-{uuid.uuid4().hex[:12]}.tcb"
+
+
+def is_run_file(path: str | Path) -> bool:
+    name = Path(path).name
+    return name.startswith("r") and name.endswith(".tcb")
+
+
+def run_bucket_offsets(footer: Dict[str, Any]) -> Optional[np.ndarray]:
+    """Per-bucket cumulative row offsets of a run file (len num_buckets+1),
+    or None when the footer carries no bucket layout. Bucket b's rows are
+    ``[offsets[b], offsets[b+1])`` — a row-range read, not a file."""
+    counts = footer.get("extra", {}).get("bucketCounts")
+    if counts is None:
+        return None
+    return np.concatenate([[0], np.cumsum(np.asarray(counts, dtype=np.int64))])
+
+
 def bucket_of_file(path: str | Path) -> int:
     """Parse the bucket id back out of a data file name (the analog of
-    Spark's BucketingUtils.getBucketId used by OptimizeAction.scala:120)."""
+    Spark's BucketingUtils.getBucketId used by OptimizeAction.scala:120).
+    Run files (``r``-prefixed) hold ALL buckets — callers must check
+    ``is_run_file`` first and use ``run_bucket_offsets`` instead."""
     name = Path(path).name
     if not (name.startswith("b") and name.endswith(".tcb")):
         raise HyperspaceException(f"Not an index data file: {name}")

@@ -27,11 +27,29 @@ streaming pipeline (stream_builder.py) delivers the same
 arbitrarily-large-input property with bounded memory, and records peak RSS
 to show it.
 
+Round 4: the build runs with ``finalizeMode=runs`` by default — spilled
+sorted runs are PROMOTED to final multi-bucket data files instead of
+being re-read, re-merged and re-written per bucket (the round-3 write
+wall: 44s of the 74s 60M build was spill + merge writes). Queries run
+over the runs layout (measured), then the lifecycle phase's optimize()
+performs the deferred compaction (measured) and the queries re-run over
+the compacted layout (measured) — the reference's small-file→optimize
+lifecycle, with every leg timed. ``SCALE_COMPARE_MERGE=1`` (default) also
+times a second build in the old merge mode for the apples-to-apples
+build-latency comparison, then deletes it.
+
 Env knobs: SCALE_ROWS (60_000_000), SCALE_BUCKETS (128), SCALE_REPEATS (2),
 SCALE_WORKDIR (.bench_scale_workspace), SCALE_KEEP=1 keeps the workspace
-(generated source data is reused across runs automatically when present).
+(generated source data is reused across runs automatically when present),
+SCALE_FINALIZE (runs|merge), SCALE_COMPARE_MERGE (1|0),
+SCALE_PRUNE_OLD_VERSIONS=1 removes version dirs unreferenced by the
+latest entry after optimize (disk headroom for SF100), --out FILE writes
+the JSON artifact to a custom path.
 
 Run:  PYTHONPATH=/root/repo:/root/.axon_site python scripts/bench_scale.py --write
+SF100: SCALE_ROWS=600000000 SCALE_REPEATS=1 SCALE_COMPARE_MERGE=0 \
+       SCALE_PRUNE_OLD_VERSIONS=1 SCALE_WORKDIR=/root/.bench_sf100 \
+       python scripts/bench_scale.py --write --out BENCH_SCALE_SF100.json
 """
 
 from __future__ import annotations
@@ -169,7 +187,9 @@ def _fail(reason: str):
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--write", action="store_true",
-                    help="write BENCH_SCALE.json at the repo root")
+                    help="write the JSON artifact at the repo root")
+    ap.add_argument("--out", default="BENCH_SCALE.json",
+                    help="artifact file name (with --write)")
     args = ap.parse_args()
 
     import pyarrow.compute as pc
@@ -184,18 +204,26 @@ def main() -> None:
     from hyperspace_tpu.session import HyperspaceSession
     from hyperspace_tpu.telemetry.metrics import metrics
 
+    # this artifact measures the runs-layout + host engine paths; HBM
+    # auto-population would upload hundreds of MB on daemon threads
+    # DURING timed queries and silently flip repeats to the resident
+    # path mid-measurement (the resident story is bench.py's config 9)
+    os.environ["HYPERSPACE_TPU_HBM"] = "off"
+
     n_orders = max(N_ROWS // 4, 2)
     gen_s = _ensure_data(N_ROWS, n_orders)
     rss_after_gen = _rss_gb()
 
     # a fresh index tree per run: the BUILD is the thing under test
     shutil.rmtree(WORKDIR / "indexes", ignore_errors=True)
+    finalize_mode = os.environ.get("SCALE_FINALIZE", C.BUILD_FINALIZE_RUNS)
     conf = HyperspaceConf(
         {
             C.INDEX_SYSTEM_PATH: str(WORKDIR / "indexes"),
             C.INDEX_NUM_BUCKETS: N_BUCKETS,
             C.BUILD_MODE: C.BUILD_MODE_STREAMING,
             C.BUILD_CHUNK_ROWS: 1 << 22,  # 4M-row chunks -> 15 chunks at 60M
+            C.BUILD_FINALIZE_MODE: finalize_mode,
         }
     )
     session = HyperspaceSession(conf)
@@ -230,6 +258,8 @@ def main() -> None:
         "phase_merge_sort_s": round(timers.get("build.stream.merge_sort", 0.0), 2),
         "phase_merge_write_s": round(timers.get("build.stream.merge_write", 0.0), 2),
     }
+    build["build_finalize_mode"] = finalize_mode
+    build["build_run_files"] = counters.get("build.stream.run_files", 0)
     steady_rows = counters.get("build.stream.steady_rows", 0)
     steady_s = timers.get("build.stream.steady", 0.0)
     if steady_rows and steady_s > 0:
@@ -276,6 +306,27 @@ def main() -> None:
     build["build_external_s"] = round(time.perf_counter() - t0, 2)
     build["rss_after_external_gb"] = _rss_gb()
     shutil.rmtree(WORKDIR / "ext_build", ignore_errors=True)
+
+    # apples-to-apples: the SAME build through the old merge-finalize
+    # path, timed then deleted — the write-wall fix's measured margin
+    if os.environ.get("SCALE_COMPARE_MERGE", "1") != "0" and (
+        finalize_mode == C.BUILD_FINALIZE_RUNS
+    ):
+        session.conf.set(C.BUILD_FINALIZE_MODE, C.BUILD_FINALIZE_MERGE)
+        t0 = time.perf_counter()
+        hs.create_index(
+            df_li,
+            IndexConfig(
+                "li_cmp_idx", ["l_orderkey"], ["l_partkey", "l_extendedprice"]
+            ),
+        )
+        build["build_merge_mode_s"] = round(time.perf_counter() - t0, 2)
+        build["build_runs_vs_merge"] = round(
+            build["build_merge_mode_s"] / build_s, 2
+        )
+        hs.delete_index("li_cmp_idx")
+        hs.vacuum_index("li_cmp_idx")
+        session.conf.set(C.BUILD_FINALIZE_MODE, finalize_mode)
 
     # second-side index for the join configs (warm: probe memo + compile
     # already paid)
@@ -418,6 +469,37 @@ def main() -> None:
         q17_external_s=round(ext17_s, 3),
     )
 
+    # ---- deferred compaction: optimize the runs layout ---------------------
+    # optimize() is the second half of the runs-mode build (the deferred
+    # merge); timing it HERE — before the append lifecycle — keeps every
+    # sibling index fresh, so the post-compaction query timings isolate
+    # the layout change and nothing else.
+    def _prune_versions(name: str) -> None:
+        entry = hs._manager._existing_log_manager(name).get_latest_stable_log()
+        referenced = {Path(f).parent for f in entry.content.files()}
+        idx_dir = Path(hs.index(name).index_location)
+        for vdir in idx_dir.glob("v__=*"):
+            if vdir not in referenced:
+                shutil.rmtree(vdir, ignore_errors=True)
+
+    if finalize_mode == C.BUILD_FINALIZE_RUNS:
+        t0 = time.perf_counter()
+        hs.optimize_index("li_idx")
+        hs.optimize_index("li_q3_idx")
+        extras["optimize_runs_compaction_s"] = round(time.perf_counter() - t0, 2)
+        if os.environ.get("SCALE_PRUNE_OLD_VERSIONS"):
+            _prune_versions("li_idx")
+            _prune_versions("li_q3_idx")
+        post_on = q2().to_pandas().sort_values("l_partkey").reset_index(drop=True)
+        if not off.equals(post_on):
+            _fail("post-compaction filter parity violated")
+        extras["filter_postopt_s"] = round(_time(lambda: q2().collect(), REPEATS), 4)
+        q3_post = q3().collect()
+        if q3_post.num_rows != q3_on.num_rows:
+            _fail("post-compaction q3 parity violated")
+        extras["q3_postopt_s"] = round(_time(lambda: q3().collect(), REPEATS), 3)
+        extras["q17_postopt_s"] = round(_time(lambda: q17().collect(), REPEATS), 3)
+
     # ---- lifecycle at scale: incremental refresh + optimize ----------------
     # append ~8% fresh rows (5 of 60M) as new source files, then time
     # refresh("incremental") — which must index ONLY the appended files
@@ -481,6 +563,8 @@ def main() -> None:
         optimize_s = time.perf_counter() - t0
         if q2().collect().num_rows != before_rows + appended_hits:
             _fail("optimize changed query results")
+        if os.environ.get("SCALE_PRUNE_OLD_VERSIONS"):
+            _prune_versions("li_idx")
         extras.update(
             refresh_appended_rows=n_app,
             refresh_incremental_s=round(refresh_s, 2),
@@ -511,7 +595,7 @@ def main() -> None:
         "final_rss_gb": _rss_gb(),
     }
     if args.write:
-        (REPO / "BENCH_SCALE.json").write_text(json.dumps(out, indent=1) + "\n")
+        (REPO / args.out).write_text(json.dumps(out, indent=1) + "\n")
     print(json.dumps(out))
     if not os.environ.get("SCALE_KEEP"):
         shutil.rmtree(WORKDIR / "indexes", ignore_errors=True)

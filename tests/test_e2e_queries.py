@@ -330,3 +330,69 @@ def test_dataframe_show(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "k" in out and "v" in out
     assert "(25 more rows)" in out
+
+
+def test_mixed_case_column_references_resolve(tmp_path):
+    """Spark's analyzer resolves column case for the reference; our
+    DataFrame boundary must too — filter/join conditions and projections
+    spelled in the wrong case answer identically through BOTH the source
+    path and the index rewrite (round-4: previously the rules matched
+    case-insensitively but execution raised KeyError)."""
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.plan.expr import lit
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+
+    rng = np.random.default_rng(3)
+    b = ColumnarBatch.from_pydict(
+        {
+            "OrderKey": rng.integers(0, 500, 4000).astype(np.int64),
+            "Qty": rng.integers(0, 50, 4000).astype(np.int64),
+        }
+    )
+    src = tmp_path / "src"
+    src.mkdir()
+    parquet_io.write_parquet(src / "p.parquet", b)
+    session = HyperspaceSession(
+        HyperspaceConf(
+            {C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"), C.INDEX_NUM_BUCKETS: 4}
+        )
+    )
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(src)),
+        IndexConfig("ci", ["orderkey"], ["qty"]),  # lower-case config
+    )
+    key = int(b.columns["OrderKey"].data[7])
+    wrong_case = lambda: (  # noqa: E731
+        session.read.parquet(str(src))
+        .filter(col("ORDERKEY") == lit(key))
+        .select("orderkey", "QTY")
+    )
+    truth = (
+        session.read.parquet(str(src))
+        .filter(col("OrderKey") == lit(key))
+        .select("OrderKey", "Qty")
+        .collect()
+    )
+    got_source = wrong_case().collect()
+    assert got_source.num_rows == truth.num_rows
+    session.enable_hyperspace()
+    got_index = wrong_case().collect()
+    assert got_index.num_rows == truth.num_rows
+    assert "ci" in hs.explain(wrong_case())
+    # join condition in the wrong case resolves across both sides
+    right = ColumnarBatch.from_pydict(
+        {"rk": np.arange(500, dtype=np.int64), "rv": np.arange(500, dtype=np.int64)}
+    )
+    rsrc = tmp_path / "rsrc"
+    rsrc.mkdir()
+    parquet_io.write_parquet(rsrc / "r.parquet", right)
+    j = (
+        session.read.parquet(str(src))
+        .join(session.read.parquet(str(rsrc)), col("orderKEY") == col("RK"))
+        .select("qty", "rv")
+    )
+    assert j.collect().num_rows == 4000  # every key in [0,500) matches
