@@ -483,13 +483,22 @@ def main() -> None:
                 shutil.rmtree(vdir, ignore_errors=True)
 
     if finalize_mode == C.BUILD_FINALIZE_RUNS:
+        # prune each index's superseded version right after its own
+        # compaction: at SF100 two indexes' old+new versions coexisting
+        # would double-count ~30GB of disk at the peak
+        # pruning stays OUTSIDE the timed regions: the metric is the
+        # compaction, not the bench harness's disk housekeeping
         t0 = time.perf_counter()
         hs.optimize_index("li_idx")
-        hs.optimize_index("li_q3_idx")
-        extras["optimize_runs_compaction_s"] = round(time.perf_counter() - t0, 2)
+        opt_s = time.perf_counter() - t0
         if os.environ.get("SCALE_PRUNE_OLD_VERSIONS"):
             _prune_versions("li_idx")
+        t0 = time.perf_counter()
+        hs.optimize_index("li_q3_idx")
+        opt_s += time.perf_counter() - t0
+        if os.environ.get("SCALE_PRUNE_OLD_VERSIONS"):
             _prune_versions("li_q3_idx")
+        extras["optimize_runs_compaction_s"] = round(opt_s, 2)
         post_on = q2().to_pandas().sort_values("l_partkey").reset_index(drop=True)
         if not off.equals(post_on):
             _fail("post-compaction filter parity violated")
