@@ -516,13 +516,9 @@ class Executor:
         l_by_bucket, l_node, l_project = lload
         r_by_bucket, r_node, r_project = rload
         if l_project is not None:
-            l_by_bucket = {
-                b: v.select(list(l_project.columns)) for b, v in l_by_bucket.items()
-            }
+            l_by_bucket = _project_groups(l_by_bucket, list(l_project.columns))
         if r_project is not None:
-            r_by_bucket = {
-                b: v.select(list(r_project.columns)) for b, v in r_by_bucket.items()
-            }
+            r_by_bucket = _project_groups(r_by_bucket, list(r_project.columns))
         # merge runs in index order (compatible_pairs alignment), as in
         # _try_bucketed_join
         k2k = {a.lower(): b for a, b in zip(lk, rk)}
@@ -596,10 +592,27 @@ class Executor:
         files, so serial per-file reads were the worst place to skip it
         (round-1 verdict weak #4). Predicates apply AFTER bucket grouping:
         run files are sliced into bucket segments by row offset, which a
-        pre-slicing filter would invalidate."""
+        pre-slicing filter would invalidate.
+
+        The PRE-predicate groups are cached across queries keyed by file
+        identity (index files are immutable): repeat joins skip the read,
+        per-bucket concat, and dictionary unification entirely and start
+        at the SMJ — the host-memory analog of the HBM-resident scan
+        cache. Predicate filtering builds fresh batches (take), so the
+        cached groups are never mutated."""
         files = self._index_files(node)
-        batches = layout.read_batches(files, columns=list(node.required_columns))
-        groups = self._group_batches_by_bucket(files, batches)
+        groups = _cached_bucket_groups(files, list(node.required_columns))
+        if groups is None:
+            batches = layout.read_batches(
+                files, columns=list(node.required_columns)
+            )
+            groups = self._group_batches_by_bucket(files, batches)
+            groups = (
+                _store_bucket_groups(
+                    files, list(node.required_columns), groups
+                )
+                or groups
+            )
         if predicate is not None:
             groups = {
                 b: filtered
@@ -644,10 +657,7 @@ class Executor:
             if inner is None:
                 return None
             by_bucket, idx = inner
-            return (
-                {b: v.select(list(node.columns)) for b, v in by_bucket.items()},
-                idx,
-            )
+            return _project_groups(by_bucket, list(node.columns)), idx
         if isinstance(node, Repartition):
             inner_idx = None
             by_bucket = self._repartition_by_bucket(node, predicate)
@@ -733,13 +743,9 @@ class Executor:
         l_keys = list(l_node.entry.indexed_columns)
         r_keys = [l2r[k.lower()] for k in l_keys]
         if l_project is not None:
-            l_by_bucket = {
-                b: v.select(list(l_project.columns)) for b, v in l_by_bucket.items()
-            }
+            l_by_bucket = _project_groups(l_by_bucket, list(l_project.columns))
         if r_project is not None:
-            r_by_bucket = {
-                b: v.select(list(r_project.columns)) for b, v in r_by_bucket.items()
-            }
+            r_by_bucket = _project_groups(r_by_bucket, list(r_project.columns))
         total_rows = sum(b.num_rows for b in l_by_bucket.values()) + sum(
             b.num_rows for b in r_by_bucket.values()
         )
@@ -784,3 +790,125 @@ class Executor:
                 f"by index {idx_node.entry.name}'s schema."
             )
         return empty
+
+
+# ---------------------------------------------------------------------------
+# Cross-query bucket-groups cache (join sides)
+# ---------------------------------------------------------------------------
+# Index files are immutable, so the bucket-grouped, dictionary-unified
+# arrays a join side loads are a pure function of (file identities,
+# projection). Repeat joins were re-paying the read + concat + vocab
+# unification every query; this LRU keeps the PRE-predicate groups hot —
+# the host-memory analog of the HBM-resident scan cache (and of the OS
+# page cache the reference leans on under Spark's FileSourceScanExec).
+# Byte-capped via HYPERSPACE_TPU_JOIN_CACHE_MB (0 disables).
+from collections import OrderedDict as _OrderedDict  # noqa: E402
+from threading import Lock as _Lock  # noqa: E402
+import os as _os  # noqa: E402
+
+_GROUPS_CACHE: "_OrderedDict[tuple, tuple]" = _OrderedDict()
+_GROUPS_CACHE_NBYTES = 0
+_GROUPS_CACHE_LOCK = _Lock()
+
+
+class BucketGroups(dict):
+    """A bucket→batch dict carrying the identity it was cached under.
+    The token marks the object as PRISTINE (exactly the bytes of those
+    immutable index files, no predicate applied) — joins.py keys its
+    cross-query setup cache on it. Every filtering/transforming path
+    builds plain dicts, which silently opt out."""
+
+    cache_token: tuple = None
+
+
+def _groups_cache_cap() -> int:
+    return int(_os.environ.get("HYPERSPACE_TPU_JOIN_CACHE_MB", "512")) << 20
+
+
+def _groups_key(files, columns) -> Optional[tuple]:
+    # ONE file-identity rule for every cross-query cache: hbm_cache owns
+    # it — hardening it there (e.g. adding inode) must cover this cache
+    from .hbm_cache import _file_identity
+
+    try:
+        idents = [_file_identity(Path(f)) for f in files]
+    except OSError:
+        return None
+    return (tuple(sorted(idents)), tuple(columns))
+
+
+def _batch_nbytes(batch) -> int:
+    """Real memory footprint of a batch INCLUDING string dictionaries —
+    code arrays alone undercount string-heavy sides by the whole vocab
+    heap, which would let the byte cap admit sides it cannot afford."""
+    n = 0
+    for c in batch.columns.values():
+        n += c.data.nbytes
+        if c.vocab is not None:
+            # bytes objects + ~50B python overhead per entry
+            n += sum(len(v) + 50 for v in c.vocab)
+    return n
+
+
+def _cached_bucket_groups(files, columns):
+    from ..telemetry.metrics import metrics
+
+    key = _groups_key(files, columns)
+    if key is None:
+        return None
+    with _GROUPS_CACHE_LOCK:
+        hit = _GROUPS_CACHE.get(key)
+        if hit is None:
+            metrics.incr("join.cache.miss")
+            return None
+        _GROUPS_CACHE.move_to_end(key)
+        metrics.incr("join.cache.hit")
+        return hit[0]
+
+
+def _store_bucket_groups(files, columns, groups):
+    """Cache and return the tagged groups (None when not cached), so the
+    FIRST query's join already runs over the token-carrying object."""
+    global _GROUPS_CACHE_NBYTES
+    cap = _groups_cache_cap()
+    if cap <= 0:
+        return None
+    key = _groups_key(files, columns)
+    if key is None:
+        return None
+    nbytes = sum(_batch_nbytes(g) for g in groups.values())
+    if nbytes > cap:
+        return None  # one oversized side must not evict the whole cache
+    tagged = BucketGroups(groups)
+    tagged.cache_token = key
+    with _GROUPS_CACHE_LOCK:
+        if key in _GROUPS_CACHE:
+            return _GROUPS_CACHE[key][0]
+        while _GROUPS_CACHE and _GROUPS_CACHE_NBYTES + nbytes > cap:
+            _, (_, old_bytes) = _GROUPS_CACHE.popitem(last=False)
+            _GROUPS_CACHE_NBYTES -= old_bytes
+        _GROUPS_CACHE[key] = (tagged, nbytes)
+        _GROUPS_CACHE_NBYTES += nbytes
+    return tagged
+
+
+def reset_groups_cache() -> None:
+    global _GROUPS_CACHE_NBYTES
+    with _GROUPS_CACHE_LOCK:
+        _GROUPS_CACHE.clear()
+        _GROUPS_CACHE_NBYTES = 0
+
+
+def _project_groups(by_bucket, columns):
+    """Select ``columns`` in every bucket batch, PRESERVING the pristine
+    cache token when present: a projection of immutable cached groups is
+    still a pure function of the files (select shares the underlying
+    column buffers — no copy to go stale), so the join setup cache keeps
+    working through Project nodes."""
+    out = {b: v.select(columns) for b, v in by_bucket.items()}
+    tok = getattr(by_bucket, "cache_token", None)
+    if tok is not None:
+        tagged = BucketGroups(out)
+        tagged.cache_token = (tok, tuple(columns))
+        return tagged
+    return out

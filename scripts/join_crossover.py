@@ -14,7 +14,7 @@ size it times
   result (inputs pre-uploaded: the HBM-residency best case);
 * ``device_counts_d2h_s`` — the same plus bringing the match ranges home,
   which any host-side consumption of the join (gather, aggregate) needs:
-  16 bytes per left row of D2H.
+  two int32 arrays, 8 bytes per (padded) left row of D2H.
 
 The decision the numbers encode: even with BOTH sides HBM-resident, the
 device SMJ's output is O(rows) match ranges — on a thin link their D2H

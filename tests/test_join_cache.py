@@ -1,0 +1,137 @@
+"""Cross-query join caches (executor groups cache + joins setup cache):
+repeat joins skip load/concat/unification, predicates bypass, and a new
+index version invalidates by file identity."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exec import executor as EX
+from hyperspace_tpu.exec import joins as J
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_JOIN_CACHE_MB", "512")
+    EX.reset_groups_cache()
+    J.reset_setup_cache()
+    yield
+    EX.reset_groups_cache()
+    J.reset_setup_cache()
+
+
+def _setup(tmp_path, n=30_000, n_r=8_000):
+    rng = np.random.default_rng(4)
+    left = ColumnarBatch(
+        {
+            "lk": Column("int64", rng.integers(0, n_r, n)),
+            "lv": Column("int64", rng.integers(0, 100, n)),
+        }
+    )
+    right = ColumnarBatch(
+        {
+            "rk": Column("int64", np.arange(n_r, dtype=np.int64)),
+            "rv": Column("int64", rng.integers(0, 100, n_r)),
+        }
+    )
+    for name, b in (("l", left), ("r", right)):
+        (tmp_path / name).mkdir()
+        parquet_io.write_parquet(tmp_path / name / "p.parquet", b)
+    session = HyperspaceSession(
+        HyperspaceConf(
+            {C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"), C.INDEX_NUM_BUCKETS: 8}
+        )
+    )
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(tmp_path / "l")), IndexConfig("jl", ["lk"], ["lv"])
+    )
+    hs.create_index(
+        session.read.parquet(str(tmp_path / "r")), IndexConfig("jr", ["rk"], ["rv"])
+    )
+    session.enable_hyperspace()
+    q = lambda: (  # noqa: E731
+        session.read.parquet(str(tmp_path / "l"))
+        .join(session.read.parquet(str(tmp_path / "r")), col("lk") == col("rk"))
+        .select("lv", "rv")
+    )
+    return session, hs, q
+
+
+def test_repeat_join_hits_both_caches_with_parity(tmp_path):
+    session, hs, q = _setup(tmp_path)
+    metrics.reset()
+    first = q().collect()
+    second = q().collect()
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("join.cache.hit", 0) >= 2  # both sides on the repeat
+    assert snap.get("join.setup_cache.hit", 0) >= 1
+    assert first.num_rows == second.num_rows
+    assert int(first.columns["lv"].data.sum()) == int(
+        second.columns["lv"].data.sum()
+    )
+    # truth vs the disabled path
+    session.disable_hyperspace()
+    truth = q().collect()
+    assert truth.num_rows == second.num_rows
+
+
+def test_filtered_sides_bypass_setup_cache(tmp_path):
+    session, hs, q = _setup(tmp_path)
+    qf = lambda: (  # noqa: E731
+        session.read.parquet(str(tmp_path / "l"))
+        .filter(col("lv") > lit(50))
+        .join(session.read.parquet(str(tmp_path / "r")), col("lk") == col("rk"))
+        .select("lv", "rv")
+    )
+    metrics.reset()
+    a = qf().collect()
+    b = qf().collect()
+    snap = metrics.snapshot()["counters"]
+    # groups cache may hit (pre-predicate load) but the filtered sides are
+    # plain dicts: the setup cache must never serve them
+    assert snap.get("join.setup_cache.hit", 0) == 0
+    assert a.num_rows == b.num_rows
+    session.disable_hyperspace()
+    truth = qf().collect()
+    assert truth.num_rows == a.num_rows
+    assert int(truth.columns["lv"].data.sum()) == int(a.columns["lv"].data.sum())
+
+
+def test_refresh_invalidates_by_file_identity(tmp_path):
+    session, hs, q = _setup(tmp_path)
+    before = q().collect()
+    # append source rows and refresh: new version dir, new file identities
+    extra = ColumnarBatch(
+        {
+            "lk": Column("int64", np.zeros(500, dtype=np.int64)),
+            "lv": Column("int64", np.arange(500, dtype=np.int64)),
+        }
+    )
+    parquet_io.write_parquet(tmp_path / "l" / "p2.parquet", extra)
+    hs.refresh_index("jl", C.REFRESH_MODE_FULL)
+    after = q().collect()
+    # key 0 exists in right side: all 500 appended rows join
+    assert after.num_rows == before.num_rows + 500
+    session.disable_hyperspace()
+    truth = q().collect()
+    assert truth.num_rows == after.num_rows
+
+
+def test_cache_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_JOIN_CACHE_MB", "0")
+    session, hs, q = _setup(tmp_path)
+    metrics.reset()
+    q().collect()
+    q().collect()
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("join.cache.hit", 0) == 0
+    assert snap.get("join.setup_cache.hit", 0) == 0
