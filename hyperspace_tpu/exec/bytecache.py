@@ -1,0 +1,90 @@
+"""Byte-capped LRU shared by the cross-query caches (executor bucket
+groups, joins setup): ONE implementation of the eviction/accounting
+machinery and ONE vocab-aware byte heuristic, so hardening either
+happens in exactly one place (the same single-source rule as the file
+identity in exec.hbm_cache)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+
+def env_mb(name: str, default_mb: int) -> int:
+    """Byte budget from an env var holding megabytes; malformed values
+    fall back to the default instead of failing the query that touched
+    the cache (the `_min_device_rows` env-knob discipline)."""
+    try:
+        return int(os.environ.get(name, default_mb)) << 20
+    except (TypeError, ValueError):
+        return default_mb << 20
+
+
+def batch_nbytes(batch) -> int:
+    """Memory footprint of a ColumnarBatch INCLUDING string dictionaries
+    — code arrays alone undercount string-heavy data by the whole vocab
+    heap (bytes objects + ~50B python overhead per entry)."""
+    n = 0
+    for c in batch.columns.values():
+        n += c.data.nbytes
+        if c.vocab is not None:
+            n += sum(len(v) + 50 for v in c.vocab)
+    return n
+
+
+class ByteCappedLru:
+    """Thread-safe LRU bounded by a byte budget (re-read per put so env
+    changes apply live) and optionally an entry cap. Values are stored
+    with their accounted size; oversized entries are refused rather than
+    evicting the world."""
+
+    def __init__(self, budget_fn, entry_cap: Optional[int] = None):
+        self._budget_fn = budget_fn
+        self._entry_cap = entry_cap
+        self._d: "OrderedDict[object, tuple]" = OrderedDict()
+        self._nbytes = 0
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            hit = self._d.get(key)
+            if hit is None:
+                return None
+            self._d.move_to_end(key)
+            return hit[0]
+
+    def put(self, key, value, nbytes: int):
+        """Insert (idempotent: an existing key wins and is returned);
+        returns the stored value, or None when refused (zero/over-budget
+        size or zero budget)."""
+        budget = self._budget_fn()
+        if budget <= 0 or nbytes <= 0 or nbytes > budget:
+            return None
+        with self._lock:
+            existing = self._d.get(key)
+            if existing is not None:
+                return existing[0]
+            while self._d and (
+                self._nbytes + nbytes > budget
+                or (self._entry_cap and len(self._d) >= self._entry_cap)
+            ):
+                _, (_, old) = self._d.popitem(last=False)
+                self._nbytes -= old
+            self._d[key] = (value, nbytes)
+            self._nbytes += nbytes
+            return value
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._d
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._d.clear()
+            self._nbytes = 0

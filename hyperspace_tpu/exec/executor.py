@@ -601,18 +601,14 @@ class Executor:
         cache. Predicate filtering builds fresh batches (take), so the
         cached groups are never mutated."""
         files = self._index_files(node)
-        groups = _cached_bucket_groups(files, list(node.required_columns))
+        cache_key = _groups_key(files, list(node.required_columns))
+        groups = _cached_bucket_groups(cache_key)
         if groups is None:
             batches = layout.read_batches(
                 files, columns=list(node.required_columns)
             )
             groups = self._group_batches_by_bucket(files, batches)
-            groups = (
-                _store_bucket_groups(
-                    files, list(node.required_columns), groups
-                )
-                or groups
-            )
+            groups = _store_bucket_groups(cache_key, groups) or groups
         if predicate is not None:
             groups = {
                 b: filtered
@@ -801,14 +797,17 @@ class Executor:
 # unification every query; this LRU keeps the PRE-predicate groups hot —
 # the host-memory analog of the HBM-resident scan cache (and of the OS
 # page cache the reference leans on under Spark's FileSourceScanExec).
-# Byte-capped via HYPERSPACE_TPU_JOIN_CACHE_MB (0 disables).
-from collections import OrderedDict as _OrderedDict  # noqa: E402
-from threading import Lock as _Lock  # noqa: E402
-import os as _os  # noqa: E402
+# Byte-capped via HYPERSPACE_TPU_JOIN_CACHE_MB (0 disables); the LRU
+# machinery and vocab-aware byte accounting live in exec.bytecache (one
+# implementation for every cross-query cache).
+from .bytecache import ByteCappedLru, batch_nbytes, env_mb  # noqa: E402
 
-_GROUPS_CACHE: "_OrderedDict[tuple, tuple]" = _OrderedDict()
-_GROUPS_CACHE_NBYTES = 0
-_GROUPS_CACHE_LOCK = _Lock()
+
+def _groups_cache_cap() -> int:
+    return env_mb("HYPERSPACE_TPU_JOIN_CACHE_MB", 512)
+
+
+_GROUPS_CACHE = ByteCappedLru(_groups_cache_cap)
 
 
 class BucketGroups(dict):
@@ -819,10 +818,6 @@ class BucketGroups(dict):
     builds plain dicts, which silently opt out."""
 
     cache_token: tuple = None
-
-
-def _groups_cache_cap() -> int:
-    return int(_os.environ.get("HYPERSPACE_TPU_JOIN_CACHE_MB", "512")) << 20
 
 
 def _groups_key(files, columns) -> Optional[tuple]:
@@ -837,66 +832,29 @@ def _groups_key(files, columns) -> Optional[tuple]:
     return (tuple(sorted(idents)), tuple(columns))
 
 
-def _batch_nbytes(batch) -> int:
-    """Real memory footprint of a batch INCLUDING string dictionaries —
-    code arrays alone undercount string-heavy sides by the whole vocab
-    heap, which would let the byte cap admit sides it cannot afford."""
-    n = 0
-    for c in batch.columns.values():
-        n += c.data.nbytes
-        if c.vocab is not None:
-            # bytes objects + ~50B python overhead per entry
-            n += sum(len(v) + 50 for v in c.vocab)
-    return n
-
-
-def _cached_bucket_groups(files, columns):
+def _cached_bucket_groups(key):
     from ..telemetry.metrics import metrics
 
-    key = _groups_key(files, columns)
     if key is None:
         return None
-    with _GROUPS_CACHE_LOCK:
-        hit = _GROUPS_CACHE.get(key)
-        if hit is None:
-            metrics.incr("join.cache.miss")
-            return None
-        _GROUPS_CACHE.move_to_end(key)
-        metrics.incr("join.cache.hit")
-        return hit[0]
+    hit = _GROUPS_CACHE.get(key)
+    metrics.incr("join.cache.hit" if hit is not None else "join.cache.miss")
+    return hit
 
 
-def _store_bucket_groups(files, columns, groups):
+def _store_bucket_groups(key, groups):
     """Cache and return the tagged groups (None when not cached), so the
     FIRST query's join already runs over the token-carrying object."""
-    global _GROUPS_CACHE_NBYTES
-    cap = _groups_cache_cap()
-    if cap <= 0:
-        return None
-    key = _groups_key(files, columns)
-    if key is None:
-        return None
-    nbytes = sum(_batch_nbytes(g) for g in groups.values())
-    if nbytes > cap:
-        return None  # one oversized side must not evict the whole cache
+    if key is None or key in _GROUPS_CACHE:
+        return _GROUPS_CACHE.get(key) if key is not None else None
+    nbytes = sum(batch_nbytes(g) for g in groups.values())
     tagged = BucketGroups(groups)
     tagged.cache_token = key
-    with _GROUPS_CACHE_LOCK:
-        if key in _GROUPS_CACHE:
-            return _GROUPS_CACHE[key][0]
-        while _GROUPS_CACHE and _GROUPS_CACHE_NBYTES + nbytes > cap:
-            _, (_, old_bytes) = _GROUPS_CACHE.popitem(last=False)
-            _GROUPS_CACHE_NBYTES -= old_bytes
-        _GROUPS_CACHE[key] = (tagged, nbytes)
-        _GROUPS_CACHE_NBYTES += nbytes
-    return tagged
+    return _GROUPS_CACHE.put(key, tagged, nbytes)
 
 
 def reset_groups_cache() -> None:
-    global _GROUPS_CACHE_NBYTES
-    with _GROUPS_CACHE_LOCK:
-        _GROUPS_CACHE.clear()
-        _GROUPS_CACHE_NBYTES = 0
+    _GROUPS_CACHE.reset()
 
 
 def _project_groups(by_bucket, columns):

@@ -385,42 +385,31 @@ def bucketed_join_pairs(
 # (~40% of a warm 2M⋈500k join) every query. Keyed by the sides' cache
 # TOKENS (exec.executor attaches them ONLY to pristine cached groups —
 # any predicate filtering yields plain dicts and skips this cache).
-from collections import OrderedDict as _OrderedDict  # noqa: E402
-from threading import Lock as _Lock  # noqa: E402
-
-_SETUP_CACHE: "_OrderedDict[tuple, tuple]" = _OrderedDict()
-_SETUP_CACHE_CAP = 4  # whole-side concats are big; a few hot joins suffice
-_SETUP_CACHE_NBYTES = 0
-_SETUP_CACHE_LOCK = _Lock()
+# Budget: the same HYPERSPACE_TPU_JOIN_CACHE_MB as the groups cache,
+# bounded independently (total join-cache memory <= 2x the knob); setups
+# hold fresh whole-side concats, so an entry cap alone could pin GBs.
+from .bytecache import ByteCappedLru, batch_nbytes as _batch_nbytes, env_mb as _env_mb  # noqa: E402
 
 
 def _setup_cache_budget() -> int:
-    """Same env budget as the executor's groups cache
-    (HYPERSPACE_TPU_JOIN_CACHE_MB): each cache is bounded by it
-    independently, so total join-cache memory is at most 2x the knob.
-    Setups hold fresh whole-side concats (not views of the groups), so an
-    entry cap alone would let four big joins pin several GB."""
-    import os as _os
+    return _env_mb("HYPERSPACE_TPU_JOIN_CACHE_MB", 512)
 
-    return int(_os.environ.get("HYPERSPACE_TPU_JOIN_CACHE_MB", "512")) << 20
+
+_SETUP_CACHE = ByteCappedLru(_setup_cache_budget, entry_cap=4)
 
 
 def _setup_nbytes(setup) -> int:
     l_all, r_all, l_codes, r_codes, _lb, _rb = setup
-    n = l_codes.nbytes + r_codes.nbytes
-    for batch in (l_all, r_all):
-        for c in batch.columns.values():
-            n += c.data.nbytes
-            if c.vocab is not None:
-                n += sum(len(v) + 50 for v in c.vocab)
-    return n
+    return (
+        l_codes.nbytes
+        + r_codes.nbytes
+        + _batch_nbytes(l_all)
+        + _batch_nbytes(r_all)
+    )
 
 
 def reset_setup_cache() -> None:
-    global _SETUP_CACHE_NBYTES
-    with _SETUP_CACHE_LOCK:
-        _SETUP_CACHE.clear()
-        _SETUP_CACHE_NBYTES = 0
+    _SETUP_CACHE.reset()
 
 
 def _bucketed_join_setup(left_by_bucket, right_by_bucket, l_keys, r_keys):
@@ -434,12 +423,10 @@ def _bucketed_join_setup(left_by_bucket, right_by_bucket, l_keys, r_keys):
     cache_key = None
     if l_token is not None and r_token is not None:
         cache_key = (l_token, r_token, tuple(l_keys), tuple(r_keys))
-        with _SETUP_CACHE_LOCK:
-            hit = _SETUP_CACHE.get(cache_key)
-            if hit is not None:
-                _SETUP_CACHE.move_to_end(cache_key)
-                metrics.incr("join.setup_cache.hit")
-                return hit[0]
+        hit = _SETUP_CACHE.get(cache_key)
+        if hit is not None:
+            metrics.incr("join.setup_cache.hit")
+            return hit
     common = sorted(set(left_by_bucket) & set(right_by_bucket))
     if not common:
         metrics.incr("join.path.no_common_buckets")
@@ -459,21 +446,8 @@ def _bucketed_join_setup(left_by_bucket, right_by_bucket, l_keys, r_keys):
     r_bounds = np.cumsum([0] + [b.num_rows for b in r_batches])
     setup = (l_all, r_all, l_codes, r_codes, l_bounds, r_bounds)
     if cache_key is not None:
-        global _SETUP_CACHE_NBYTES
-        nbytes = _setup_nbytes(setup)
-        budget = _setup_cache_budget()
-        if 0 < nbytes <= budget:
-            with _SETUP_CACHE_LOCK:
-                if cache_key not in _SETUP_CACHE:
-                    while _SETUP_CACHE and (
-                        len(_SETUP_CACHE) >= _SETUP_CACHE_CAP
-                        or _SETUP_CACHE_NBYTES + nbytes > budget
-                    ):
-                        _, (_, old_bytes) = _SETUP_CACHE.popitem(last=False)
-                        _SETUP_CACHE_NBYTES -= old_bytes
-                    _SETUP_CACHE[cache_key] = (setup, nbytes)
-                    _SETUP_CACHE_NBYTES += nbytes
-                    metrics.incr("join.setup_cache.stored")
+        if _SETUP_CACHE.put(cache_key, setup, _setup_nbytes(setup)) is setup:
+            metrics.incr("join.setup_cache.stored")
     return setup
 
 

@@ -77,6 +77,23 @@ def random_predicate(rng, batch, allowed_cols=None):
     return p
 
 
+def _random_build_mode(rng):
+    """~40% of seeds build through the streaming pipeline, half of those
+    promoting spill runs to final multi-bucket files (finalizeMode=runs)
+    — the round-4 layout rides the same parity fuzz as everything else,
+    including lifecycle sequences (refresh/optimize over run files)."""
+    r = rng.random()
+    if r < 0.6:
+        return {}
+    out = {
+        C.BUILD_MODE: C.BUILD_MODE_STREAMING,
+        C.BUILD_CHUNK_ROWS: int(rng.choice([256, 1024, 4096])),
+    }
+    if r < 0.8:
+        out[C.BUILD_FINALIZE_MODE] = C.BUILD_FINALIZE_RUNS
+    return out
+
+
 def rows_key(batch):
     cols = sorted(batch.column_names)
     mats = []
@@ -104,6 +121,7 @@ def test_filter_parity_fuzz(tmp_path, seed):
             C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
             C.INDEX_NUM_BUCKETS: int(rng.choice([1, 2, 7, 16, 64])),
             C.INDEX_LINEAGE_ENABLED: bool(rng.random() < 0.5),
+            **_random_build_mode(rng),
         }
     )
     session = HyperspaceSession(conf)
@@ -154,6 +172,7 @@ def test_aggregate_parity_fuzz(tmp_path, seed):
         {
             C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
             C.INDEX_NUM_BUCKETS: int(rng.choice([2, 8, 16])),
+            **_random_build_mode(rng),
         }
     )
     session = HyperspaceSession(conf)
@@ -245,7 +264,8 @@ def test_join_parity_fuzz(tmp_path, seed):
     parquet_io.write_parquet(tmp_path / "r" / "p.parquet", right)
     conf = HyperspaceConf(
         {C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
-         C.INDEX_NUM_BUCKETS: int(rng.choice([1, 4, 32]))}
+         C.INDEX_NUM_BUCKETS: int(rng.choice([1, 4, 32])),
+         **_random_build_mode(rng)}
     )
     session = HyperspaceSession(conf)
     hs = Hyperspace(session)
@@ -293,6 +313,7 @@ def test_hybrid_parity_fuzz(tmp_path, seed):
             C.INDEX_NUM_BUCKETS: int(rng.choice([2, 8, 32])),
             C.INDEX_LINEAGE_ENABLED: True,
             C.INDEX_HYBRID_SCAN_ENABLED: True,
+            **_random_build_mode(rng),
         }
     )
     session = HyperspaceSession(conf)
@@ -405,6 +426,7 @@ def test_lifecycle_sequence_fuzz(tmp_path, seed):
             C.INDEX_NUM_BUCKETS: int(rng.choice([2, 8])),
             C.INDEX_LINEAGE_ENABLED: lineage,
             C.INDEX_HYBRID_SCAN_ENABLED: bool(rng.random() < 0.8),
+            **_random_build_mode(rng),
         }
     )
     session = HyperspaceSession(conf)
