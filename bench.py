@@ -870,52 +870,73 @@ def main() -> None:
         r_host = q9().collect()
         rhost_s = _time(lambda: q9().collect(), REPEATS, extras, "resident_host")
 
+        # the host-side comparison is complete regardless of what the
+        # device does next — record it now so a prefetch failure below
+        # never orphans the already-spent timed runs
+        extras["resident_rows"] = RES_ROWS
+        extras["resident_fullscan_s"] = round(roff_s, 4)
+        extras["resident_host_s"] = round(rhost_s, 4)
+
         # DEVICE side: explicit prefetch (timed — the once-per-version
         # upload), then the same query repeats resident
         res_files = sorted(
             Path(hs.index("li_res_idx").index_location).glob("v__=*/*.tcb")
         )
+        if not res_files:
+            _fail("config9 index produced no data files")  # layout bug
         os.environ["HYPERSPACE_TPU_HBM"] = "auto"
         t0 = time.perf_counter()
         res_table = hbm_cache.prefetch(res_files, ["r_k", "r_q"])
         extras["resident_prefetch_s"] = round(time.perf_counter() - t0, 3)
         if res_table is None:
-            _fail("config9 resident prefetch refused")
-        _indexed_run_begin()
-        r_dev = q9().collect()
-        rdev_s = _time(lambda: q9().collect(), REPEATS, extras, "resident_device")
-        _indexed_run_end()
+            # this config's columns are int64-in-range and far under the
+            # default HBM budget, so a refusal here means the device/link
+            # is unusable (or the operator shrank the budget) — an
+            # ENVIRONMENT failure: record it and keep the artifact.
+            # Parity violations below, by contrast, still fail the whole
+            # bench — they are bugs.
+            extras["resident_error"] = (
+                "prefetch refused (device/link down, or HBM budget override)"
+            )
+        else:
+            _indexed_run_begin()
+            r_dev = q9().collect()
+            rdev_s = _time(
+                lambda: q9().collect(), REPEATS, extras, "resident_device"
+            )
+            _indexed_run_end()
         if _prev_hbm is None:
             del os.environ["HYPERSPACE_TPU_HBM"]
         else:
             os.environ["HYPERSPACE_TPU_HBM"] = _prev_hbm
-        if engine_paths.get("scan.path.resident_device", 0) <= 0:
-            _fail("config9 resident device path never fired")
-        if r_dev.num_rows != r_host.num_rows or r_dev.num_rows != r_off.num_rows:
-            _fail("config9 resident row parity violated")
-        if int(r_dev.columns["r_v"].data.sum()) != int(
-            r_host.columns["r_v"].data.sum()
-        ):
-            _fail("config9 resident checksum parity violated")
-        ext9 = lambda: _ext_filter(  # noqa: E731
-            WORKDIR / "resident",
-            (pc.field("r_k") >= r_lo)
-            & (pc.field("r_k") <= r_hi)
-            & (pc.field("r_q") != 7),
-            ["r_k", "r_v"],
-        )
-        if ext9().num_rows != r_dev.num_rows:
-            _fail("config9 external row parity violated")
-        ext9_s = _time(ext9, REPEATS, extras, "resident_external")
-        speedups["resident_scan"] = roff_s / rdev_s
-        ext_speedups["resident_scan"] = ext9_s / rdev_s
-        extras["resident_rows"] = RES_ROWS
-        extras["resident_fullscan_s"] = round(roff_s, 4)
-        extras["resident_host_s"] = round(rhost_s, 4)
-        extras["resident_device_s"] = round(rdev_s, 4)
-        extras["resident_device_vs_host"] = round(rhost_s / rdev_s, 3)
-        extras["resident_external_s"] = round(ext9_s, 4)
-        extras["hbm"] = hbm_cache.snapshot()
+        if res_table is not None:
+            if engine_paths.get("scan.path.resident_device", 0) <= 0:
+                _fail("config9 resident device path never fired")
+            if (
+                r_dev.num_rows != r_host.num_rows
+                or r_dev.num_rows != r_off.num_rows
+            ):
+                _fail("config9 resident row parity violated")
+            if int(r_dev.columns["r_v"].data.sum()) != int(
+                r_host.columns["r_v"].data.sum()
+            ):
+                _fail("config9 resident checksum parity violated")
+            ext9 = lambda: _ext_filter(  # noqa: E731
+                WORKDIR / "resident",
+                (pc.field("r_k") >= r_lo)
+                & (pc.field("r_k") <= r_hi)
+                & (pc.field("r_q") != 7),
+                ["r_k", "r_v"],
+            )
+            if ext9().num_rows != r_dev.num_rows:
+                _fail("config9 external row parity violated")
+            ext9_s = _time(ext9, REPEATS, extras, "resident_external")
+            speedups["resident_scan"] = roff_s / rdev_s
+            ext_speedups["resident_scan"] = ext9_s / rdev_s
+            extras["resident_device_s"] = round(rdev_s, 4)
+            extras["resident_device_vs_host"] = round(rhost_s / rdev_s, 3)
+            extras["resident_external_s"] = round(ext9_s, 4)
+            extras["hbm"] = hbm_cache.snapshot()
 
     # ---- device-kernel microbench (north star evidence) --------------------
     # warm per-kernel device throughput at the bench's shapes, recorded even
