@@ -204,3 +204,29 @@ def test_unnarrowable_predicate_routes_host(tmp_path):
     assert metrics.counter("scan.path.resident_device") == 0
     assert metrics.counter("scan.path.host_mask") == 1
     assert out.num_rows == 3000
+
+
+def test_nan_float32_column_refused_but_query_exact(tmp_path):
+    """NaN float32 data cannot ride the ordered-int32 encoding (encoded
+    NaN would order above +inf); the column is refused at prefetch and
+    predicates on it answer on the host path with numpy NaN semantics."""
+    rng = np.random.default_rng(1)
+    n = 3000
+    f = rng.normal(0, 1, n).astype(np.float32)
+    f[::7] = np.nan
+    batch = ColumnarBatch(
+        {
+            "f": Column("float32", f),
+            "k": Column("int64", np.sort(rng.integers(0, 10_000, n))),
+        }
+    )
+    p = tmp_path / "b00000-abcdef012345.tcb"
+    layout.write_batch(p, batch, sorted_by=["k"], bucket=0)
+    t = hbm_cache.prefetch([p], ["f", "k"])
+    assert t is not None and set(t.columns) == {"k"}  # f refused (NaN)
+    pred = col("f") > lit(0.5)
+    metrics.reset()
+    out = index_scan([p], ["k"], pred, device=True)
+    assert metrics.counter("scan.path.resident_device") == 0
+    truth = int((f > 0.5).sum())  # NaN > 0.5 is False, as numpy says
+    assert out.num_rows == truth
