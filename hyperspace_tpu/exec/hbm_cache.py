@@ -110,14 +110,21 @@ def _auto_enabled() -> bool:
 
 
 _MAX_FAILED_MEMO = 1024  # per-file-version keys; bounded paranoia
+# string columns with more combined dictionary entries than this never
+# become resident: they are id-like, their global vocab would pin
+# unbounded host memory, and dictionary compares stop paying anyway
+_MAX_VOCAB = 1 << 22
 
 
 @dataclass
 class ResidentColumn:
     data: object  # jax.Array, (n_pad // 128, 128) int32, device-resident
     dtype_str: str  # source dtype
-    enc: str  # 'int' | 'float32' (ordered-int32 encoding)
+    enc: str  # 'int' | 'float32' (ordered-i32) | 'string' (global codes)
     nbytes: int
+    # string columns only: the table-GLOBAL sorted vocab the device codes
+    # index into (host-side — literals bind against it, it never uploads)
+    vocab: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -386,19 +393,17 @@ class HbmIndexCache:
             return None, True
         n_pad = -(-n_rows // _TILE_ELEMS) * _TILE_ELEMS
         # budget pre-check BEFORE any read or upload: every resident
-        # column costs exactly n_pad * 4 bytes, so an over-budget table
-        # is knowable upfront — refusing after the H2D would waste the
-        # full multi-GB transfer on a thin link. Only columns that could
-        # actually encode (footer dtype not string/float64) count.
+        # column costs exactly n_pad * 4 bytes on device (string columns
+        # upload CODES only — the global vocab stays host-side), so an
+        # over-budget table is knowable upfront — refusing after the H2D
+        # would waste the full multi-GB transfer on a thin link. Only
+        # columns that could actually encode (footer dtype not float64)
+        # count.
         dtype_of = {
             m["name"]: m["dtype"] for m in readers[0].footer["columns"]
         }
         encodable = [
-            c
-            for c in columns
-            if c in dtype_of
-            and not is_string(dtype_of[c])
-            and dtype_of[c] != "float64"
+            c for c in columns if c in dtype_of and dtype_of[c] != "float64"
         ]
         if not encodable:
             return None, True
@@ -411,36 +416,75 @@ class HbmIndexCache:
         cols: Dict[str, ResidentColumn] = {}
         nbytes = 0
         for name in encodable:
-            parts = []
             enc = None
-            ok = True
-            for r in readers:
-                if not any(m["name"] == name for m in r.footer["columns"]):
-                    ok = False
-                    break
-                e = _encode_column(r.read([name]).columns[name])
-                if e is None:
-                    ok = False
-                    break
-                a, this_enc = e
-                if enc is None:
-                    enc = this_enc
-                elif enc != this_enc:
-                    ok = False  # mixed encodings across files: refuse
-                    break
-                parts.append(a)
-            if not ok or enc is None:
+            vocab = None
+            present = all(
+                any(m["name"] == name for m in r.footer["columns"])
+                for r in readers
+            )
+            if not present:
                 continue
+            if is_string(dtype_of[name]):
+                # per-file dictionaries would collide across the
+                # concatenated table — re-encode every file onto ONE
+                # sorted global vocab at prefetch (order-preserving, so
+                # eq/range compares in code space match byte-wise string
+                # compares; NULL -1 survives the re-encode). Every file
+                # must agree the column is a string (a dtype mismatch
+                # refuses the column, like the numeric branch) and the
+                # combined dictionary must be dictionary-SIZED: id-like
+                # vocabs would pin unbounded host RAM for the global
+                # vocab and pay an O(V log V) object sort per build.
+                metas = [
+                    next(m for m in r.footer["columns"] if m["name"] == name)
+                    for r in readers
+                ]
+                if not all(is_string(m["dtype"]) for m in metas):
+                    continue  # mixed dtypes across files: refuse
+                if sum(len(m.get("vocab", ())) for m in metas) > _MAX_VOCAB:
+                    metrics.incr("hbm.vocab_too_large_refused")
+                    continue
+                from ..storage.columnar import unify_dictionaries
+
+                raw = [r.read([name]).columns[name] for r in readers]
+                unified = unify_dictionaries(raw)
+                parts = [u.data.astype(np.int32, copy=False) for u in unified]
+                vocab = next(
+                    (u.vocab for u in unified if u.vocab is not None), None
+                )
+                if vocab is None:
+                    continue
+                enc = "string"
+            else:
+                parts = []
+                ok = True
+                for r in readers:
+                    e = _encode_column(r.read([name]).columns[name])
+                    if e is None:
+                        ok = False
+                        break
+                    a, this_enc = e
+                    if enc is None:
+                        enc = this_enc
+                    elif enc != this_enc:
+                        ok = False  # mixed encodings across files: refuse
+                        break
+                    parts.append(a)
+                if not ok or enc is None:
+                    continue
             flat = np.zeros(n_pad, dtype=np.int32)
             flat[:n_rows] = np.concatenate(parts) if len(parts) > 1 else parts[0]
             dev = jax.device_put(flat.reshape(n_pad // _LANES, _LANES))
-            dtype_str = next(
-                m["dtype"]
-                for m in readers[0].footer["columns"]
-                if m["name"] == name
+            # accounted bytes include the HOST-side vocab heap: the LRU
+            # and budget then bound the table's total footprint, not just
+            # its device half
+            col_bytes = flat.nbytes + (
+                sum(len(v) + 50 for v in vocab) if vocab is not None else 0
             )
-            cols[name] = ResidentColumn(dev, dtype_str, enc, flat.nbytes)
-            nbytes += flat.nbytes
+            cols[name] = ResidentColumn(
+                dev, dtype_of[name], enc, col_bytes, vocab
+            )
+            nbytes += col_bytes
         if not cols:
             return None, True  # nothing encoded (e.g. NaN float32 data)
         try:
@@ -517,6 +561,29 @@ class HbmIndexCache:
         names = tuple(sorted(predicate.columns()))
         if any(n not in table.columns for n in names):
             return None
+        # string predicate columns: bind literals against the table's
+        # GLOBAL vocab first (the same transform bind_string_literals
+        # performs per batch on the host path) — the bound expression is
+        # pure int arithmetic over the resident code columns
+        str_cols = {
+            n: table.columns[n]
+            for n in names
+            if table.columns[n].enc == "string"
+        }
+        if str_cols:
+            from ..plan.expr import bind_string_literals
+
+            shim = ColumnarBatch(
+                {
+                    n: Column(
+                        rc.dtype_str,
+                        np.empty(0, dtype=np.int32),
+                        rc.vocab,
+                    )
+                    for n, rc in str_cols.items()
+                }
+            )
+            predicate = bind_string_literals(predicate, shim)
         f32 = {
             n: "float32" for n in names if table.columns[n].enc == "float32"
         }

@@ -173,7 +173,9 @@ def test_budget_eviction(tmp_path, monkeypatch):
     assert snap["tables"] == 1
 
 
-def test_string_and_f64_columns_refused(tmp_path):
+def test_f64_refused_strings_resident(tmp_path):
+    """float64 never rides the device; strings DO (global-vocab codes,
+    vocab host-side) — a mixed request registers what encodes."""
     rng = np.random.default_rng(0)
     n = 2000
     vocab = np.array([b"x", b"y", b"z"], dtype=object)
@@ -186,10 +188,55 @@ def test_string_and_f64_columns_refused(tmp_path):
     )
     p = tmp_path / "b00000-feedbeef.tcb"
     layout.write_batch(p, batch, sorted_by=["k"], bucket=0)
-    assert hbm_cache.prefetch([p], ["s"]) is None
     assert hbm_cache.prefetch([p], ["d"]) is None
-    t = hbm_cache.prefetch([p], ["s", "d", "k"])  # k alone is encodable
-    assert t is not None and set(t.columns) == {"k"}
+    t = hbm_cache.prefetch([p], ["s", "d", "k"])
+    assert t is not None and set(t.columns) == {"k", "s"}
+    assert t.columns["s"].enc == "string" and t.columns["s"].vocab is not None
+
+
+def test_string_predicate_resident_parity_across_vocabs(tmp_path):
+    """Files with DIFFERENT per-file dictionaries: prefetch re-encodes
+    onto one sorted global vocab, and eq/range/missing-literal string
+    predicates answer identically to the host path through the resident
+    device mask."""
+    rng = np.random.default_rng(7)
+    vocabs = [
+        np.array([b"apple", b"cherry", b"mango"], dtype=object),
+        np.array([b"banana", b"cherry", b"zucchini"], dtype=object),
+        np.array([b"apple", b"kiwi"], dtype=object),
+    ]
+    paths = []
+    for i, vv in enumerate(vocabs):
+        n = 4000
+        batch = ColumnarBatch(
+            {
+                "k": Column(
+                    "int64",
+                    np.sort(rng.integers(i * 10_000, (i + 1) * 10_000, n)),
+                ),
+                "s": Column.from_values(vv[rng.integers(0, len(vv), n)]),
+                "v": Column("int64", rng.integers(0, 100, n)),
+            }
+        )
+        p = tmp_path / f"b{i:05d}-cafe{i:04x}.tcb"
+        layout.write_batch(p, batch, sorted_by=["k"], bucket=i)
+        paths.append(p)
+    t = hbm_cache.prefetch(paths, ["s", "k"])
+    assert t is not None and t.columns["s"].enc == "string"
+    for pred in (
+        col("s") == lit("cherry"),
+        (col("s") >= lit("banana")) & (col("s") < lit("mango")),
+        col("s") == lit("nope-not-present"),
+        (col("s") != lit("apple")) & (col("k") < lit(15_000)),
+    ):
+        host = index_scan(paths, ["k", "v"], pred, device=False)
+        metrics.reset()
+        dev = index_scan(paths, ["k", "v"], pred, device=True)
+        assert metrics.counter("scan.path.resident_device") == 1, repr(pred)
+        assert dev.num_rows == host.num_rows, repr(pred)
+        assert int(dev.columns["v"].data.sum()) == int(
+            host.columns["v"].data.sum()
+        ), repr(pred)
 
 
 def test_unnarrowable_predicate_routes_host(tmp_path):
@@ -230,3 +277,69 @@ def test_nan_float32_column_refused_but_query_exact(tmp_path):
     assert metrics.counter("scan.path.resident_device") == 0
     truth = int((f > 0.5).sum())  # NaN > 0.5 is False, as numpy says
     assert out.num_rows == truth
+
+
+def test_string_nulls_resident_parity(tmp_path):
+    """NULL string codes (-1) through the resident device path: device
+    and host must agree NULL never matches — including != and range
+    predicates, where treating -1 as an ordinary small code would
+    spuriously match."""
+    rng = np.random.default_rng(3)
+    paths = []
+    for i, vv in enumerate(
+        (np.array([b"aa", b"cc"], dtype=object), np.array([b"bb", b"cc"], dtype=object))
+    ):
+        n = 3000
+        codes = rng.integers(0, len(vv), n).astype(np.int32)
+        codes[:: 5] = -1  # 20% NULLs
+        batch = ColumnarBatch(
+            {
+                "k": Column(
+                    "int64", np.sort(rng.integers(i * 5000, (i + 1) * 5000, n))
+                ),
+                "s": Column("string", codes, vv),
+                "v": Column("int64", rng.integers(0, 100, n)),
+            }
+        )
+        p = tmp_path / f"b{i:05d}-0dd0{i:04x}.tcb"
+        layout.write_batch(p, batch, sorted_by=["k"], bucket=i)
+        paths.append(p)
+    t = hbm_cache.prefetch(paths, ["s", "k"])
+    assert t is not None and t.columns["s"].enc == "string"
+    for pred in (
+        col("s") != lit("cc"),
+        col("s") == lit("cc"),
+        (col("s") >= lit("aa")) & (col("s") <= lit("zz")),
+        col("s") < lit("bb"),
+    ):
+        host = index_scan(paths, ["k", "v"], pred, device=False)
+        metrics.reset()
+        dev = index_scan(paths, ["k", "v"], pred, device=True)
+        assert metrics.counter("scan.path.resident_device") == 1, repr(pred)
+        assert dev.num_rows == host.num_rows, repr(pred)
+        assert int(dev.columns["v"].data.sum()) == int(
+            host.columns["v"].data.sum()
+        ), repr(pred)
+
+
+def test_mixed_string_int_dtype_across_files_refused(tmp_path):
+    """The same column name stored as string in one file and int64 in
+    another cannot form a resident column — refused, never raised."""
+    b1 = ColumnarBatch(
+        {
+            "c": Column.from_values(np.array([b"x", b"y"] * 50, dtype=object)),
+            "k": Column("int64", np.arange(100, dtype=np.int64)),
+        }
+    )
+    b2 = ColumnarBatch(
+        {
+            "c": Column("int64", np.arange(100, dtype=np.int64)),
+            "k": Column("int64", np.arange(100, 200, dtype=np.int64)),
+        }
+    )
+    p1 = tmp_path / "b00000-aaaa1111.tcb"
+    p2 = tmp_path / "b00001-bbbb2222.tcb"
+    layout.write_batch(p1, b1, sorted_by=["k"], bucket=0)
+    layout.write_batch(p2, b2, sorted_by=["k"], bucket=1)
+    t = hbm_cache.prefetch([p1, p2], ["c", "k"])
+    assert t is not None and set(t.columns) == {"k"}  # c refused, no raise
