@@ -817,6 +817,10 @@ def main() -> None:
         rngr = np.random.default_rng(11)
         from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
 
+        res_modes = np.array(
+            [b"AIR", b"SHIP", b"RAIL", b"MAIL", b"TRUCK", b"FOB", b"REG AIR"],
+            dtype=object,
+        )
         resident_tbl = ColumnarBatch(
             {
                 "r_k": Column.from_values(
@@ -824,6 +828,9 @@ def main() -> None:
                 ),
                 "r_q": Column.from_values(
                     rngr.integers(0, 100, RES_ROWS).astype(np.int64)
+                ),
+                "r_m": Column.from_values(
+                    res_modes[rngr.integers(0, 7, RES_ROWS)]
                 ),
                 "r_v": Column.from_values(
                     rngr.integers(0, 1 << 30, RES_ROWS).astype(np.int64)
@@ -839,7 +846,7 @@ def main() -> None:
         t0 = time.perf_counter()
         hs.create_index(
             session.read.parquet(str(WORKDIR / "resident")),
-            IndexConfig("li_res_idx", ["r_k"], ["r_q", "r_v"]),
+            IndexConfig("li_res_idx", ["r_k"], ["r_q", "r_m", "r_v"]),
         )
         extras["resident_build_s"] = round(time.perf_counter() - t0, 3)
         session.conf.set(C.INDEX_NUM_BUCKETS, str(N_BUCKETS))
@@ -848,12 +855,17 @@ def main() -> None:
         k_sorted = np.sort(resident_tbl.columns["r_k"].data)
         r_lo = int(k_sorted[RES_ROWS // 2])
         r_hi = int(k_sorted[RES_ROWS // 2 + 5000])
+        # the predicate mixes int range, int !=, and a STRING != — the
+        # string conjunct rides residency through the global-vocab code
+        # re-encode (round-4 capability), visible as the same
+        # scan.path.pallas_mask counter
         q9 = lambda: (  # noqa: E731
             session.read.parquet(str(WORKDIR / "resident"))
             .filter(
                 (col("r_k") >= lit(r_lo))
                 & (col("r_k") <= lit(r_hi))
                 & (col("r_q") != lit(7))
+                & (col("r_m") != lit("REG AIR"))
             )
             .select("r_k", "r_v")
         )
@@ -886,7 +898,7 @@ def main() -> None:
             _fail("config9 index produced no data files")  # layout bug
         os.environ["HYPERSPACE_TPU_HBM"] = "auto"
         t0 = time.perf_counter()
-        res_table = hbm_cache.prefetch(res_files, ["r_k", "r_q"])
+        res_table = hbm_cache.prefetch(res_files, ["r_k", "r_q", "r_m"])
         extras["resident_prefetch_s"] = round(time.perf_counter() - t0, 3)
         if res_table is None:
             # this config's columns are int64-in-range and far under the
@@ -925,7 +937,8 @@ def main() -> None:
                 WORKDIR / "resident",
                 (pc.field("r_k") >= r_lo)
                 & (pc.field("r_k") <= r_hi)
-                & (pc.field("r_q") != 7),
+                & (pc.field("r_q") != 7)
+                & (pc.field("r_m") != b"REG AIR"),
                 ["r_k", "r_v"],
             )
             if ext9().num_rows != r_dev.num_rows:

@@ -48,7 +48,11 @@ Env knobs (module-level, matching the scan gate's style):
                                configured platform is TPU; force: any
                                backend (tests); off: explicit prefetch
                                only — never auto-populate.
-  HYPERSPACE_TPU_HBM_BUDGET_MB device-byte budget (default 4096)
+  HYPERSPACE_TPU_HBM_BUDGET_MB table-footprint budget (default 4096):
+                               device code/column bytes PLUS the
+                               host-side global vocab heap of resident
+                               string columns — one knob bounds the
+                               cache's total memory, both sides
   HYPERSPACE_TPU_HBM_MIN_ROWS  auto-population floor (default 2**21)
 """
 
@@ -407,7 +411,21 @@ class HbmIndexCache:
         ]
         if not encodable:
             return None, True
-        if len(encodable) * n_pad * 4 > _budget_bytes():
+        # string columns add their (host-side) vocab heap to the account;
+        # the per-file footers carry the vocab values, so a safe upper
+        # bound (concat >= union) costs nothing and keeps the wasted-H2D
+        # window closed for string-heavy tables too
+        vocab_est = 0
+        for c in encodable:
+            if is_string(dtype_of[c]):
+                for r in readers:
+                    m = next(
+                        (x for x in r.footer["columns"] if x["name"] == c),
+                        None,
+                    )
+                    if m is not None:
+                        vocab_est += sum(len(v) + 50 for v in m.get("vocab", ()))
+        if len(encodable) * n_pad * 4 + vocab_est > _budget_bytes():
             metrics.incr("hbm.over_budget_refused")
             return None, False
 
@@ -583,7 +601,13 @@ class HbmIndexCache:
                     for n, rc in str_cols.items()
                 }
             )
-            predicate = bind_string_literals(predicate, shim)
+            try:
+                predicate = bind_string_literals(predicate, shim)
+            except Exception:  # noqa: BLE001
+                # unbindable predicate SHAPE (e.g. string col-col compare
+                # across distinct vocabs) — not a device problem: decline
+                # so the caller routes host, keeping the table resident
+                return None
         f32 = {
             n: "float32" for n in names if table.columns[n].enc == "float32"
         }

@@ -343,3 +343,40 @@ def test_mixed_string_int_dtype_across_files_refused(tmp_path):
     layout.write_batch(p2, b2, sorted_by=["k"], bucket=1)
     t = hbm_cache.prefetch([p1, p2], ["c", "k"])
     assert t is not None and set(t.columns) == {"k"}  # c refused, no raise
+
+
+def test_string_col_col_predicate_declines_without_dropping_table(tmp_path):
+    """A string col-col compare can't bind against two distinct global
+    vocabs — block_counts must DECLINE (route host) without evicting the
+    healthy resident table or counting a device failure."""
+    rng = np.random.default_rng(9)
+    n = 2000
+    v1 = np.array([b"p", b"q", b"r"], dtype=object)
+    v2 = np.array([b"q", b"r", b"zz"], dtype=object)  # DISTINCT vocab
+    batch = ColumnarBatch(
+        {
+            "s1": Column.from_values(v1[rng.integers(0, 3, n)]),
+            "s2": Column.from_values(v2[rng.integers(0, 3, n)]),
+            "k": Column("int64", np.sort(rng.integers(0, 10_000, n))),
+        }
+    )
+    p = tmp_path / "b00000-c01c01c0.tcb"
+    layout.write_batch(p, batch, sorted_by=["k"], bucket=0)
+    t = hbm_cache.prefetch([p], ["s1", "s2", "k"])
+    assert t is not None and {"s1", "s2"} <= set(t.columns)
+    pred = col("s1") == col("s2")
+    # distinct-vocab string col-col compares are unsupported by the
+    # engine on EVERY path (expr.py raises); the resident layer must
+    # surface the same error — by declining, not by misreading the
+    # predicate-shape problem as device loss
+    from hyperspace_tpu.exceptions import HyperspaceException
+
+    with pytest.raises(HyperspaceException, match="unified dictionary"):
+        index_scan([p], ["k"], pred, device=False)
+    metrics.reset()
+    with pytest.raises(HyperspaceException, match="unified dictionary"):
+        index_scan([p], ["k"], pred, device=True)
+    assert metrics.counter("scan.path.resident_device") == 0
+    assert metrics.counter("scan.resident.device_failed") == 0
+    # the table survived the declined predicate
+    assert hbm_cache.resident_for([p], ["s1"]) is t
