@@ -889,18 +889,20 @@ def main() -> None:
         extras["resident_fullscan_s"] = round(roff_s, 4)
         extras["resident_host_s"] = round(rhost_s, 4)
 
-        # DEVICE side: explicit prefetch (timed — the once-per-version
-        # upload), then the same query repeats resident
-        res_files = sorted(
+        # DEVICE side: explicit prefetch through the facade verb (timed
+        # — the once-per-version upload), then the same query repeats
+        # resident. An index version with no data files is a LAYOUT bug,
+        # not an environment failure — fail hard before the prefetch so
+        # it can't masquerade as a flaky device.
+        if not sorted(
             Path(hs.index("li_res_idx").index_location).glob("v__=*/*.tcb")
-        )
-        if not res_files:
-            _fail("config9 index produced no data files")  # layout bug
+        ):
+            _fail("config9 index produced no data files")
         os.environ["HYPERSPACE_TPU_HBM"] = "auto"
         t0 = time.perf_counter()
-        res_table = hbm_cache.prefetch(res_files, ["r_k", "r_q", "r_m"])
+        prefetched = hs.prefetch_index("li_res_idx", ["r_k", "r_q", "r_m"])
         extras["resident_prefetch_s"] = round(time.perf_counter() - t0, 3)
-        if res_table is None:
+        if not prefetched:
             # this config's columns are int64-in-range and far under the
             # default HBM budget, so a refusal here means the device/link
             # is unusable (or the operator shrank the budget) — an
@@ -921,7 +923,7 @@ def main() -> None:
             del os.environ["HYPERSPACE_TPU_HBM"]
         else:
             os.environ["HYPERSPACE_TPU_HBM"] = _prev_hbm
-        if res_table is not None:
+        if prefetched:
             if engine_paths.get("scan.path.resident_device", 0) <= 0:
                 _fail("config9 resident device path never fired")
             if (

@@ -66,12 +66,26 @@ class Hyperspace:
     def index(self, name: str) -> IndexStatistics:
         return self._manager.index(name)
 
+    def prefetch_index(self, name: str, columns=None) -> bool:
+        """Upload an index's predicate columns into device HBM NOW (the
+        once-per-version cost first-touch population pays lazily), so the
+        next query already runs the resident device mask. ``columns``
+        defaults to the indexed (key) columns — the usual predicate
+        targets; include covered columns you filter on. True when the
+        table is resident afterwards; False when the index is not an
+        ACTIVE covering index, nothing was encodable, or no usable
+        device exists. TPU-native API with no reference analog (Spark's
+        warm path is the OS page cache); see
+        docs/05-scale-and-distribution.md "HBM residency"."""
+        return self._manager.prefetch(name, columns)
+
     def explain(self, df: DataFrame, verbose: bool = False) -> str:
         from .plananalysis.plan_analyzer import explain_string
 
         return explain_string(df, verbose=verbose)
 
     # camelCase aliases for reference-API parity
+    prefetchIndex = prefetch_index
     createIndex = create_index
     deleteIndex = delete_index
     restoreIndex = restore_index

@@ -200,6 +200,26 @@ class IndexCollectionManager:
         entry = self._existing_log_manager(name).get_latest_log()
         return IndexStatistics.from_entry(entry, extended=True)
 
+    def prefetch(self, name: str, columns=None) -> bool:
+        """Upload the index's predicate columns into device HBM (see
+        exec.hbm_cache): only an ACTIVE covering index qualifies — a
+        DELETED index's files still exist on disk but no query will ever
+        be rewritten to them, and a data-skipping index has no TCB data
+        to make resident. ``columns`` defaults to the indexed columns."""
+        from ..actions import states
+        from ..exec.hbm_cache import hbm_cache
+
+        entry = self._existing_log_manager(name).get_latest_stable_log()
+        if entry is None or entry.state != states.ACTIVE:
+            return False
+        if entry.derived_dataset.kind != "CoveringIndex":
+            return False
+        files = entry.content.files()
+        cols = (
+            list(columns) if columns is not None else list(entry.indexed_columns)
+        )
+        return hbm_cache.prefetch(files, cols) is not None
+
 
 class CachingIndexCollectionManager(IndexCollectionManager):
     """TTL cache over get_indexes; mutating verbs clear it

@@ -380,3 +380,46 @@ def test_string_col_col_predicate_declines_without_dropping_table(tmp_path):
     assert metrics.counter("scan.resident.device_failed") == 0
     # the table survived the declined predicate
     assert hbm_cache.resident_for([p], ["s1"]) is t
+
+
+def test_prefetch_index_facade_verb(tmp_path):
+    """hs.prefetch_index uploads the latest stable version's predicate
+    columns without the caller touching exec internals; the next query
+    runs resident."""
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+
+    rng = np.random.default_rng(5)
+    n = 50_000
+    batch = ColumnarBatch(
+        {
+            "k": Column("int64", rng.integers(0, 100_000, n)),
+            "v": Column("int64", rng.integers(0, 100, n)),
+        }
+    )
+    src = tmp_path / "src"
+    src.mkdir()
+    parquet_io.write_parquet(src / "p.parquet", batch)
+    session = HyperspaceSession(
+        HyperspaceConf(
+            {C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"), C.INDEX_NUM_BUCKETS: 4}
+        )
+    )
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("pi", ["k"], ["v"]))
+    assert hs.prefetch_index("pi") is True  # defaults to indexed columns
+    session.enable_hyperspace()
+    key = int(batch.columns["k"].data[3])
+    metrics.reset()
+    got = (
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(key))
+        .select("k", "v")
+        .collect()
+    )
+    assert metrics.counter("scan.path.resident_device") == 1
+    assert got.num_rows == int((batch.columns["k"].data == key).sum())
