@@ -899,6 +899,11 @@ def main() -> None:
         ):
             _fail("config9 index produced no data files")
         os.environ["HYPERSPACE_TPU_HBM"] = "auto"
+        if hs.index("li_res_idx").state != "ACTIVE":
+            # non-ACTIVE after a successful create is a lifecycle bug —
+            # it must not masquerade as a refused-prefetch environment
+            # failure below
+            _fail("config9 index not ACTIVE after create")
         t0 = time.perf_counter()
         prefetched = hs.prefetch_index("li_res_idx", ["r_k", "r_q", "r_m"])
         extras["resident_prefetch_s"] = round(time.perf_counter() - t0, 3)

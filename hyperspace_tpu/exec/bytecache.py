@@ -12,14 +12,20 @@ from collections import OrderedDict
 from typing import Optional
 
 
-def env_mb(name: str, default_mb: int) -> int:
-    """Byte budget from an env var holding megabytes; malformed values
-    fall back to the default instead of failing the query that touched
-    the cache (the `_min_device_rows` env-knob discipline)."""
+def env_int(name: str, default: int) -> int:
+    """Integer env knob; malformed values fall back to the default
+    instead of failing the operation that touched the cache (the
+    `_min_device_rows` env-knob discipline) — the single implementation
+    for every cache/threshold knob."""
     try:
-        return int(os.environ.get(name, default_mb)) << 20
-    except (TypeError, ValueError):
-        return default_mb << 20
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_mb(name: str, default_mb: int) -> int:
+    """Byte budget from an env var holding megabytes."""
+    return env_int(name, default_mb) << 20
 
 
 def batch_nbytes(batch) -> int:

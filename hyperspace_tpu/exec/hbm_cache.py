@@ -18,7 +18,9 @@ chip and keeps the GATHER at home:
 
 1. predicate columns live in HBM as int32 tiles (int64 range-narrowed,
    float32 through the order-preserving int32 encoding — the same
-   contracts as ops/kernels);
+   contracts as ops/kernels; strings as codes into ONE sorted
+   table-global vocab built at prefetch, the vocab itself staying
+   host-side for literal binding);
 2. one fused jitted call evaluates the predicate mask (Pallas kernel
    when eligible, XLA otherwise) and reduces it to per-8192-row-block
    match COUNTS — the only D2H is that count vector (4 B per 8 K rows:
@@ -83,11 +85,15 @@ _TILE_ELEMS = _MASK_SUBLANES * _LANES
 
 
 def _budget_bytes() -> int:
-    return int(os.environ.get("HYPERSPACE_TPU_HBM_BUDGET_MB", "4096")) << 20
+    from .bytecache import env_mb  # malformed env falls back, never raises
+
+    return env_mb("HYPERSPACE_TPU_HBM_BUDGET_MB", 4096)
 
 
 def _min_auto_rows() -> int:
-    return int(os.environ.get("HYPERSPACE_TPU_HBM_MIN_ROWS", str(1 << 21)))
+    from .bytecache import env_int
+
+    return env_int("HYPERSPACE_TPU_HBM_MIN_ROWS", 1 << 21)
 
 
 def residency_mode() -> str:

@@ -215,9 +215,16 @@ class IndexCollectionManager:
         if entry.derived_dataset.kind != "CoveringIndex":
             return False
         files = entry.content.files()
-        cols = (
-            list(columns) if columns is not None else list(entry.indexed_columns)
-        )
+        if columns is None:
+            cols = list(entry.indexed_columns)
+        else:
+            # the user-facing boundary resolves column case everywhere
+            # else (DataFrame filter/select); the prefetch verb must too,
+            # or a miscased name silently ends up non-resident
+            from ..utils import resolver
+
+            schema_cols = list(entry.schema)
+            cols = [resolver.resolve(c, schema_cols) or c for c in columns]
         return hbm_cache.prefetch(files, cols) is not None
 
 
