@@ -169,6 +169,11 @@ _KILL_BUILD_WORKER = r"""
 import os, sys, time
 import numpy as np
 sys.path.insert(0, sys.argv[2])
+# Pin CPU at the config level as well: the axon TPU plugin overrides the
+# JAX_PLATFORMS env var at interpreter start, and a cold real-chip probe
+# (compiles included) can outlast this worker's kill timeout.
+import jax
+jax.config.update("jax_platforms", "cpu")
 ws = sys.argv[1]
 import pyarrow as pa, pyarrow.parquet as pq
 rng = np.random.default_rng(0)
