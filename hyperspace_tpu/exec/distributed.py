@@ -167,6 +167,9 @@ def distributed_filter(
     sig = tuple((name, str(packed[name].dtype)) for name in names)
     fn = _dist_mask_fn(mesh, repr(bound), bound, shim, sig)
     sharding = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0], None))
+    metrics.incr(
+        "dist.h2d_bytes", sum(a.nbytes for a in packed.values())
+    )  # per-query shipping cost the mesh-resident path avoids
     dev_arrays = {n: jax.device_put(a, sharding) for n, a in packed.items()}
     mask2d = np.asarray(fn(dev_arrays))
     metrics.incr("scan.path.distributed")
@@ -385,6 +388,12 @@ def distributed_filter_aggregate(
     axis = mesh.axis_names[0]
     sh1 = NamedSharding(mesh, PartitionSpec(axis, None))
     sh3 = NamedSharding(mesh, PartitionSpec(None, axis, None))
+    metrics.incr(
+        "dist.h2d_bytes",
+        codes2.nbytes
+        + vals3.nbytes
+        + sum(v.nbytes for v in packed_pred.values()),
+    )
     ints_out, floats_out = fn(
         jax.device_put(codes2, sh1),
         jax.device_put(vals3, sh3),
@@ -611,6 +620,7 @@ def distributed_bucketed_join(
 
     fn = _dist_join_fn(mesh, cap_l, cap_r)
     sharding = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0], None))
+    metrics.incr("dist.h2d_bytes", l2.nbytes + r2.nbytes)
     lt2, eq2, r_ord2 = fn(
         jax.device_put(l2, sharding), jax.device_put(r2, sharding)
     )

@@ -314,6 +314,50 @@ class Executor:
                 list(node.required_columns) + sorted(predicate.columns())
             )
         )
+        # mesh-sharded HBM residency: if this version's predicate columns
+        # already live as mesh shards, serve the query from them — one
+        # shard_map mask+count call, count-matrix D2H, host reads only the
+        # matching blocks. Zero per-query H2D (exec.mesh_cache design
+        # note); the ship-per-query path below is the fallback.
+        if files:
+            from .mesh_cache import mesh_cache
+            from .scan import empty_batch_for as _ebf
+
+            pred_cols = sorted(predicate.columns())
+            table = mesh_cache.resident_for(files, pred_cols, self.mesh)
+            if table is not None:
+                try:
+                    counts = mesh_cache.block_counts(table, predicate)
+                except Exception:  # noqa: BLE001 - device loss degrades
+                    mesh_cache.drop(table)
+                    metrics.incr("scan.resident_mesh.device_failed")
+                    counts = None
+                if counts is not None:
+                    parts = mesh_cache.collect_parts(
+                        table,
+                        files,
+                        list(node.required_columns),
+                        predicate,
+                        counts,
+                    )
+                    if parts:
+                        return ColumnarBatch.concat(parts)
+                    empty = _ebf(list(node.required_columns), entry.schema)
+                    if empty is not None:
+                        return empty
+                    eb = layout.read_batch(
+                        files[0], columns=list(node.required_columns)
+                    )
+                    return eb.take(np.array([], dtype=np.int64))
+            elif mesh_cache.auto_enabled():
+                # populate over the version's FULL file list so one table
+                # covers every future query's pruned subset (hbm_cache
+                # note_touch rationale)
+                mesh_cache.note_touch(
+                    [Path(p) for p in self._index_files(node)],
+                    pred_cols,
+                    self.mesh,
+                )
         # pinned-bucket equality over run files: read only those buckets'
         # row ranges (the single-device path's rule) instead of shipping
         # every bucket of every run to the mesh
@@ -422,6 +466,41 @@ class Executor:
                 return None
             return hash_aggregate(empty, group_by, aggs)
         metrics.incr("scan.files_read", len(files))
+        # mesh residency: a selective filtered aggregate reads ONLY the
+        # blocks the resident mask counted matches in (then aggregates
+        # exactly on host) instead of shipping every row to the mesh per
+        # query — same protocol as the resident filter scan
+        if pred is not None:
+            from .mesh_cache import mesh_cache
+
+            pred_cols = sorted(pred.columns())
+            table = mesh_cache.resident_for(files, pred_cols, self.mesh)
+            if table is not None:
+                try:
+                    counts = mesh_cache.block_counts(table, pred)
+                except Exception:  # noqa: BLE001 - device loss degrades
+                    mesh_cache.drop(table)
+                    metrics.incr("scan.resident_mesh.device_failed")
+                    counts = None
+                if counts is not None:
+                    parts = mesh_cache.collect_parts(
+                        table, files, need, pred, counts
+                    )
+                    metrics.incr("aggregate.path.resident_mesh")
+                    if not parts:
+                        empty = ColumnarBatch.empty(
+                            {c: entry.schema[c] for c in need}
+                        )
+                        return hash_aggregate(empty, group_by, aggs)
+                    return hash_aggregate(
+                        ColumnarBatch.concat(parts), group_by, aggs
+                    )
+            elif mesh_cache.auto_enabled():
+                mesh_cache.note_touch(
+                    [Path(p) for p in self._index_files(node)],
+                    pred_cols,
+                    self.mesh,
+                )
         batches = layout.read_batches(files, columns=need)
         by_bucket = self._group_batches_by_bucket(files, batches)
 

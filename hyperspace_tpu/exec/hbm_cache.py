@@ -230,12 +230,16 @@ def _counts_fn(narrowed: Expr, names: tuple, n_rows128: int, use_pallas: bool):
     return fn
 
 
-class HbmIndexCache:
-    """Device-side column cache over immutable TCB index files, LRU-bounded
-    by an HBM byte budget."""
+class ResidentCacheBase:
+    """Shared plumbing of the single-chip and mesh resident caches: table
+    registry + LRU-against-budget, pending/failed population memos, and
+    the atexit join of background upload threads. Subclasses provide the
+    table build and query protocols."""
+
+    _metric_prefix = "hbm"
 
     def __init__(self) -> None:
-        self._tables: List[ResidentTable] = []
+        self._tables: list = []
         self._pending: set = set()
         # (file-set key, frozenset(columns)) that can never materialize
         # (unencodable columns, too small, over budget): without this
@@ -251,11 +255,61 @@ class HbmIndexCache:
         residency can never trigger."""
         return _auto_enabled()
 
-    def drop(self, table: ResidentTable) -> None:
+    def drop(self, table) -> None:
         """Unregister a table (device loss mid-query): later queries
         route through the gate instead of retrying a dead device."""
         with self._lock:
             self._tables = [t for t in self._tables if t is not table]
+
+    def _register(self, table) -> None:
+        with self._lock:
+            # replace any table over the same file set (e.g. widened
+            # column set); then evict LRU until the budget fits
+            self._tables = [t for t in self._tables if t.key != table.key]
+            self._tables.append(table)
+            total = sum(t.nbytes for t in self._tables)
+            budget = _budget_bytes()
+            while total > budget and len(self._tables) > 1:
+                victim = min(
+                    (t for t in self._tables if t is not table),
+                    key=lambda t: t.last_used,
+                )
+                self._tables.remove(victim)
+                total -= victim.nbytes
+                metrics.incr(f"{self._metric_prefix}.evicted")
+            metrics.incr(f"{self._metric_prefix}.tables_registered")
+
+    def _track_for_exit(self, t: threading.Thread) -> None:
+        """A daemon populate thread mid-device_put at interpreter
+        shutdown races the jax runtime's teardown; joining live uploads
+        at exit keeps teardown clean (same rationale as the scan gate's
+        probe join)."""
+        with self._lock:
+            threads = getattr(self, "_bg_threads", None)
+            if threads is None:
+                threads = self._bg_threads = []
+                import atexit
+
+                atexit.register(self._join_bg)
+            threads[:] = [x for x in threads if x.is_alive()]
+            threads.append(t)
+
+    def _join_bg(self) -> None:
+        with self._lock:
+            threads = list(getattr(self, "_bg_threads", ()))
+        for t in threads:
+            t.join(30.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tables.clear()
+            self._pending.clear()
+            self._failed.clear()
+
+
+class HbmIndexCache(ResidentCacheBase):
+    """Device-side column cache over immutable TCB index files, LRU-bounded
+    by an HBM byte budget."""
 
     # -- population ----------------------------------------------------------
     def prefetch(
@@ -521,24 +575,6 @@ class HbmIndexCache:
         metrics.record_time("hbm.prefetch", time.perf_counter() - t0)
         return ResidentTable(key, spans, n_rows, n_pad, cols, nbytes), False
 
-    def _register(self, table: ResidentTable) -> None:
-        with self._lock:
-            # replace any table over the same file set (e.g. widened
-            # column set); then evict LRU until the budget fits
-            self._tables = [t for t in self._tables if t.key != table.key]
-            self._tables.append(table)
-            total = sum(t.nbytes for t in self._tables)
-            budget = _budget_bytes()
-            while total > budget and len(self._tables) > 1:
-                victim = min(
-                    (t for t in self._tables if t is not table),
-                    key=lambda t: t.last_used,
-                )
-                self._tables.remove(victim)
-                total -= victim.nbytes
-                metrics.incr("hbm.evicted")
-            metrics.incr("hbm.tables_registered")
-
     # -- lookup --------------------------------------------------------------
     def _covering_locked(
         self, want_files: dict, want_cols: set
@@ -633,27 +669,6 @@ class HbmIndexCache:
         metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
         return counts[:n_blocks]
 
-    def _track_for_exit(self, t: threading.Thread) -> None:
-        """A daemon populate thread mid-device_put at interpreter
-        shutdown races the jax runtime's teardown; joining live uploads
-        at exit keeps teardown clean (same rationale as the scan gate's
-        probe join)."""
-        with self._lock:
-            threads = getattr(self, "_bg_threads", None)
-            if threads is None:
-                threads = self._bg_threads = []
-                import atexit
-
-                atexit.register(self._join_bg)
-            threads[:] = [x for x in threads if x.is_alive()]
-            threads.append(t)
-
-    def _join_bg(self) -> None:
-        with self._lock:
-            threads = list(getattr(self, "_bg_threads", ()))
-        for t in threads:
-            t.join(30.0)
-
     # -- observability -------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
@@ -673,12 +688,5 @@ class HbmIndexCache:
                     for t in self._tables
                 ],
             }
-
-    def reset(self) -> None:
-        with self._lock:
-            self._tables.clear()
-            self._pending.clear()
-            self._failed.clear()
-
 
 hbm_cache = HbmIndexCache()

@@ -1,0 +1,616 @@
+"""Mesh-sharded HBM residency: the round-4 single-chip win carried to the
+device mesh.
+
+Round-4 verdict missing #1: the distributed query path re-shipped every
+column host→device on every query (``exec/distributed.py`` ``device_put``
+per call) — exactly the per-query-reshipping architecture the single-chip
+resident cache (exec/hbm_cache.py) was built to kill. The reference gets
+cross-query locality for free: Spark executors hold their partitions hot
+in the OS page cache and ``BucketUnionExec.outputPartitioning`` preserves
+placement across operators (BucketUnionExec.scala:104-121). Here the
+equivalent is physical: index files are immutable, so an index version's
+predicate columns upload ONCE into mesh-sharded HBM and every later
+distributed query runs against the resident shards.
+
+Layout: bucket b of the index lives on device ``owner_of_bucket(b, D) =
+b % D`` — the SAME placement rule the sharded build writes with
+(parallel.mesh), so residency preserves the build's partitioning and the
+bucketed operators stay collective-free. Each device's shard is the
+concatenation of its owned buckets' row segments (bucket-ascending, then
+file-path order), padded to a static power-of-two capacity; columns ride
+as int32 planes under the one narrowing contract (ops.kernels
+narrow_arrays_to_i32 — int64 range-narrowed, float32 order-preserving,
+strings as codes into one table-global sorted vocab that never uploads).
+
+The resident query protocol is the single-chip one, vectorized over the
+mesh: ONE shard_map call evaluates the predicate mask per device and
+reduces it to per-block match counts; the only D2H is the (D, n_blocks)
+int32 count matrix; the host then reads ONLY the matching blocks from
+mmap, re-evaluates the predicate exactly there, and serves the output
+columns locally — result bytes never cross the link, and repeat queries
+pay ZERO per-query H2D (the ``dist.h2d_bytes`` counter that meters the
+non-resident path stays flat).
+
+Correctness does not rest on the device mask: the host re-evaluates every
+candidate block exactly, and the narrowed encodings are order-preserving
+(ops.kernels contracts), so device and host agree on which blocks can
+contain matches. Pad rows (beyond a device's real rows) can only add
+false-positive counts in tail blocks, which the host's segment mapping
+clips away.
+
+Env knobs are shared with the single-chip cache (HYPERSPACE_TPU_HBM,
+.._BUDGET_MB, .._MIN_ROWS — hbm_cache module docstring): a session runs
+either the single-device or the mesh engine, so the one budget bounds
+whichever cache that session actually feeds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..plan.expr import Expr, eval_mask
+from ..storage import layout
+from ..storage.columnar import Column, ColumnarBatch, is_string
+from ..telemetry.metrics import metrics
+from .hbm_cache import (
+    BLOCK_ROWS,
+    _MAX_FAILED_MEMO,
+    _MAX_VOCAB,
+    _auto_enabled,
+    _budget_bytes,
+    _encode_column,
+    _file_identity,
+    _min_auto_rows,
+    ResidentCacheBase,
+)
+
+
+@dataclass
+class MeshResidentColumn:
+    data: object  # jax.Array, (D, cap) int32, NamedSharding over the mesh
+    dtype_str: str
+    enc: str  # 'int' | 'float32' (ordered-i32) | 'string' (global codes)
+    nbytes: int
+    vocab: Optional[np.ndarray] = None  # host-side global vocab (strings)
+
+
+# one device's slice of one file: rows [file_lo, file_hi) of ``path`` live
+# at device-local rows [dev_off, dev_off + (file_hi - file_lo))
+Segment = Tuple[str, int, int, int]
+
+
+@dataclass
+class MeshResidentTable:
+    key: tuple  # ((path, size, mtime_ns), ...) sorted by path
+    mesh: object  # jax.sharding.Mesh the shards live on
+    n_devices: int
+    cap: int  # padded per-device rows (pow2, one static shape per table)
+    block: int  # count granularity (min(BLOCK_ROWS, cap))
+    dev_rows: List[int]  # real rows per device
+    segments: List[List[Segment]]  # per device, dev_off-ascending
+    columns: Dict[str, MeshResidentColumn]
+    n_rows: int
+    nbytes: int
+    last_used: float = field(default_factory=time.monotonic)
+
+    @property
+    def n_blocks(self) -> int:
+        return self.cap // self.block
+
+
+def _bucket_segments(paths: List[Path]) -> Dict[int, List[Tuple[str, int, int]]]:
+    """bucket -> [(path, file_row_lo, file_row_hi), ...] in path-sorted
+    order, from per-bucket file names and run-file footers — the same
+    bucket derivation the executor's group-by-bucket uses."""
+    out: Dict[int, List[Tuple[str, int, int]]] = {}
+    for p in paths:  # caller pre-sorts
+        if layout.is_run_file(p):
+            offs = layout.run_bucket_offsets(layout.cached_reader(p).footer)
+            if offs is None:
+                raise HyperspaceException(
+                    f"Run file {p} carries no bucketCounts footer."
+                )
+            for b in range(len(offs) - 1):
+                s, e = int(offs[b]), int(offs[b + 1])
+                if e > s:
+                    out.setdefault(b, []).append((str(p), s, e))
+        else:
+            n = layout.cached_reader(p).num_rows
+            if n:
+                out.setdefault(layout.bucket_of_file(p), []).append(
+                    (str(p), 0, n)
+                )
+    return out
+
+
+_counts_fn_cache: dict = {}
+_counts_fn_lock = threading.Lock()
+
+
+def _mesh_counts_fn(mesh, bound_repr: str, bound: Expr, names: tuple,
+                    cap: int, block: int):
+    """Jitted shard_map: (dict of (D, cap) i32) -> (D, cap // block) i32
+    per-block match counts, one device round trip for the whole mesh."""
+    key = (mesh, bound_repr, names, cap, block)
+    with _counts_fn_lock:
+        fn = _counts_fn_cache.get(key)
+        if fn is not None:
+            return fn
+
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec
+
+    shim = ColumnarBatch(
+        {name: Column("int32", np.empty(0, dtype=np.int32)) for name in names}
+    )
+    axis = mesh.axis_names[0]
+
+    def shard_fn(arrays):
+        flat = {n: a.reshape(-1) for n, a in arrays.items()}
+        m = eval_mask(bound, shim, flat)
+        return jnp.sum(
+            m.reshape(cap // block, block).astype(jnp.int32), axis=1
+        )[None]
+
+    spec = {name: PartitionSpec(axis, None) for name in names}
+    fn = jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=PartitionSpec(axis, None),
+            check_vma=False,
+        )
+    )
+    with _counts_fn_lock:
+        if len(_counts_fn_cache) >= 128:
+            _counts_fn_cache.pop(next(iter(_counts_fn_cache)))
+        _counts_fn_cache[key] = fn
+    return fn
+
+
+class MeshHbmCache(ResidentCacheBase):
+    """Mesh-sharded resident-table cache over immutable TCB index files,
+    LRU-bounded by the shared HBM byte budget (registry/LRU/background-
+    thread plumbing inherited from ResidentCacheBase)."""
+
+    _metric_prefix = "hbm.mesh"
+
+    # -- population ----------------------------------------------------------
+    def prefetch(
+        self, files: List[str | Path], columns: List[str], mesh
+    ) -> Optional[MeshResidentTable]:
+        """Synchronously build and register a mesh-sharded resident table.
+        Idempotent; returns None when nothing encodes or the table exceeds
+        the budget (same refusal semantics as the single-chip cache)."""
+        paths = sorted(Path(p) for p in files)
+        if not paths:
+            return None
+        try:
+            key = tuple(_file_identity(p) for p in paths)
+        except OSError:
+            return None
+        with self._lock:
+            existing = self._covering_locked(
+                {k[0]: k for k in key}, set(columns), mesh
+            )
+            if existing is not None:
+                return existing
+        table, _ = self._build(paths, key, columns, mesh)
+        if table is None:
+            return None
+        self._register(table)
+        return table
+
+    def note_touch(
+        self,
+        files: List[Path],
+        columns: List[str],
+        mesh,
+        n_rows_hint: Optional[int] = None,
+    ) -> None:
+        """First-touch population: background upload of this file set's
+        predicate columns as mesh shards so REPEAT distributed queries go
+        resident. Never blocks, never throws (hbm_cache.note_touch
+        contract)."""
+        if not _auto_enabled() or not files or not columns:
+            return
+        if n_rows_hint is not None and n_rows_hint < _min_auto_rows():
+            return
+        paths = sorted(Path(p) for p in files)
+        try:
+            key = tuple(_file_identity(p) for p in paths)
+        except OSError:
+            return
+        memo = (key, frozenset(columns))
+        with self._lock:
+            if key in self._pending or memo in self._failed:
+                return
+            if (
+                self._covering_locked({k[0]: k for k in key}, set(columns), mesh)
+                is not None
+            ):
+                return
+            self._pending.add(key)
+
+        def bg():
+            failed = False
+            try:
+                if n_rows_hint is None:
+                    total = sum(
+                        layout.cached_reader(p).num_rows for p in paths
+                    )
+                    if total < _min_auto_rows():
+                        failed = True
+                        return
+                with self._lock:
+                    prior = next(
+                        (t for t in self._tables if t.key == key), None
+                    )
+                build_cols = list(
+                    dict.fromkeys(
+                        list(columns)
+                        + (sorted(prior.columns) if prior else [])
+                    )
+                )
+                table, permanent = self._build(paths, key, build_cols, mesh)
+                if table is not None and set(columns) <= set(table.columns):
+                    self._register(table)
+                elif table is not None or permanent:
+                    failed = True
+            except Exception:  # noqa: BLE001 - population must never fail a scan
+                metrics.incr("hbm.mesh.populate_failed")
+            finally:
+                with self._lock:
+                    self._pending.discard(key)
+                    if failed:
+                        if len(self._failed) >= _MAX_FAILED_MEMO:
+                            self._failed.clear()
+                        self._failed.add(memo)
+
+        t = threading.Thread(
+            target=bg, daemon=True, name="hbm-mesh-populate"
+        )
+        self._track_for_exit(t)
+        t.start()
+
+    def _build(
+        self, paths: List[Path], key: tuple, columns: List[str], mesh
+    ) -> Tuple[Optional[MeshResidentTable], bool]:
+        """(table, permanent_refusal) — hbm_cache._build semantics, with
+        the concat order replaced by the bucket-per-device packing."""
+        from ..utils.intmath import next_pow2
+
+        t0 = time.perf_counter()
+        try:
+            by_bucket = _bucket_segments(paths)
+        except HyperspaceException:
+            return None, True
+        except Exception:  # noqa: BLE001 - vanished file = no residency
+            return None, False
+        if not by_bucket:
+            return None, True
+        D = int(mesh.devices.size)
+        from ..parallel.mesh import owner_of_bucket
+
+        # device-local layouts: owned buckets ascending, segments in path
+        # order inside each bucket
+        dev_segs: List[List[Segment]] = [[] for _ in range(D)]
+        dev_rows = [0] * D
+        for b in sorted(by_bucket):
+            d = owner_of_bucket(b, D)
+            for path, lo, hi in by_bucket[b]:
+                dev_segs[d].append((path, lo, hi, dev_rows[d]))
+                dev_rows[d] += hi - lo
+        n_rows = sum(dev_rows)
+        if n_rows == 0:
+            return None, True
+        cap = next_pow2(max(dev_rows))
+
+        # budget pre-check before any read or upload (hbm_cache rationale)
+        readers = {str(p): layout.cached_reader(p) for p in paths}
+        first = readers[str(paths[0])]
+        dtype_of = {m["name"]: m["dtype"] for m in first.footer["columns"]}
+        encodable = [
+            c for c in columns if c in dtype_of and dtype_of[c] != "float64"
+        ]
+        if not encodable:
+            return None, True
+        vocab_est = 0
+        for c in encodable:
+            if is_string(dtype_of[c]):
+                for r in readers.values():
+                    m = next(
+                        (x for x in r.footer["columns"] if x["name"] == c),
+                        None,
+                    )
+                    if m is not None:
+                        vocab_est += sum(len(v) + 50 for v in m.get("vocab", ()))
+        if len(encodable) * D * cap * 4 + vocab_est > _budget_bytes():
+            metrics.incr("hbm.mesh.over_budget_refused")
+            return None, False
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(
+            mesh, PartitionSpec(mesh.axis_names[0], None)
+        )
+
+        def read_seg(path: str, lo: int, hi: int, name: str) -> Column:
+            return readers[path].read([name], row_range=(lo, hi)).columns[name]
+
+        cols: Dict[str, MeshResidentColumn] = {}
+        nbytes = 0
+        for name in encodable:
+            present = all(
+                any(m["name"] == name for m in r.footer["columns"])
+                for r in readers.values()
+            )
+            if not present:
+                continue
+            enc: Optional[str] = None
+            vocab = None
+            packed = np.zeros((D, cap), dtype=np.int32)
+            if is_string(dtype_of[name]):
+                metas = [
+                    next(m for m in r.footer["columns"] if m["name"] == name)
+                    for r in readers.values()
+                ]
+                if not all(is_string(m["dtype"]) for m in metas):
+                    continue
+                if sum(len(m.get("vocab", ())) for m in metas) > _MAX_VOCAB:
+                    metrics.incr("hbm.mesh.vocab_too_large_refused")
+                    continue
+                from ..storage.columnar import unify_dictionaries
+
+                flat_segs = [
+                    (d, seg) for d in range(D) for seg in dev_segs[d]
+                ]
+                raw = [
+                    read_seg(path, lo, hi, name)
+                    for _, (path, lo, hi, _off) in flat_segs
+                ]
+                unified = unify_dictionaries(raw)
+                vocab = next(
+                    (u.vocab for u in unified if u.vocab is not None), None
+                )
+                if vocab is None:
+                    continue
+                for (d, (_p, lo, hi, off)), u in zip(flat_segs, unified):
+                    packed[d, off : off + (hi - lo)] = u.data.astype(
+                        np.int32, copy=False
+                    )
+                enc = "string"
+            else:
+                ok = True
+                for d in range(D):
+                    for path, lo, hi, off in dev_segs[d]:
+                        e = _encode_column(read_seg(path, lo, hi, name))
+                        if e is None:
+                            ok = False
+                            break
+                        a, this_enc = e
+                        if enc is None:
+                            enc = this_enc
+                        elif enc != this_enc:
+                            ok = False
+                            break
+                        packed[d, off : off + (hi - lo)] = a
+                    if not ok:
+                        break
+                if not ok or enc is None:
+                    continue
+            dev = jax.device_put(packed, sharding)
+            col_bytes = packed.nbytes + (
+                sum(len(v) + 50 for v in vocab) if vocab is not None else 0
+            )
+            cols[name] = MeshResidentColumn(
+                dev, dtype_of[name], enc, col_bytes, vocab
+            )
+            nbytes += col_bytes
+        if not cols:
+            return None, True
+        try:
+            jax.block_until_ready([c.data for c in cols.values()])
+        except Exception:  # noqa: BLE001 - device loss: no residency
+            return None, False
+        if nbytes > _budget_bytes():
+            metrics.incr("hbm.mesh.over_budget_refused")
+            return None, False
+        metrics.record_time("hbm.mesh.prefetch", time.perf_counter() - t0)
+        return (
+            MeshResidentTable(
+                key,
+                mesh,
+                D,
+                cap,
+                min(BLOCK_ROWS, cap),
+                dev_rows,
+                dev_segs,
+                cols,
+                n_rows,
+                nbytes,
+            ),
+            False,
+        )
+
+    # -- lookup --------------------------------------------------------------
+    def _covering_locked(
+        self, want_files: dict, want_cols: set, mesh
+    ) -> Optional[MeshResidentTable]:
+        for t in reversed(self._tables):
+            if t.mesh is not mesh:
+                continue
+            have = {k[0]: k for k in t.key}
+            if all(
+                p in have and have[p] == ident
+                for p, ident in want_files.items()
+            ) and want_cols <= set(t.columns):
+                return t
+        return None
+
+    def resident_for(
+        self, files: List[Path], columns: List[str], mesh
+    ) -> Optional[MeshResidentTable]:
+        if not files:
+            return None
+        with self._lock:
+            if not self._tables:
+                return None
+        try:
+            want = {str(Path(p)): _file_identity(Path(p)) for p in files}
+        except OSError:
+            return None
+        with self._lock:
+            t = self._covering_locked(want, set(columns), mesh)
+            if t is not None:
+                t.last_used = time.monotonic()
+            return t
+
+    # -- the resident query --------------------------------------------------
+    def block_counts(
+        self, table: MeshResidentTable, predicate: Expr
+    ) -> Optional[np.ndarray]:
+        """(D, n_blocks) per-block match counts in ONE mesh round trip.
+        None when the predicate does not narrow to the resident encodings
+        (caller routes the ship-per-query path)."""
+        from ..ops import kernels as K
+
+        names = tuple(sorted(predicate.columns()))
+        if any(n not in table.columns for n in names):
+            return None
+        str_cols = {
+            n: table.columns[n]
+            for n in names
+            if table.columns[n].enc == "string"
+        }
+        if str_cols:
+            from ..plan.expr import bind_string_literals
+
+            shim = ColumnarBatch(
+                {
+                    n: Column(
+                        rc.dtype_str, np.empty(0, dtype=np.int32), rc.vocab
+                    )
+                    for n, rc in str_cols.items()
+                }
+            )
+            try:
+                predicate = bind_string_literals(predicate, shim)
+            except Exception:  # noqa: BLE001 - unbindable shape: route host
+                return None
+        f32 = {
+            n: "float32" for n in names if table.columns[n].enc == "float32"
+        }
+        narrowed = K.narrow_expr_to_i32(predicate, f32 or None)
+        if narrowed is None:
+            return None
+        fn = _mesh_counts_fn(
+            table.mesh, repr(narrowed), narrowed, names, table.cap, table.block
+        )
+        cols = {n: table.columns[n].data for n in names}
+        t0 = time.perf_counter()
+        with K._x32():
+            counts = np.asarray(fn(cols))
+        metrics.record_time(
+            "scan.resident_mesh.device", time.perf_counter() - t0
+        )
+        metrics.incr("scan.resident_mesh.d2h_bytes", int(counts.nbytes))
+        return counts
+
+    # -- host-side collection ------------------------------------------------
+    def collect_parts(
+        self,
+        table: MeshResidentTable,
+        files: List[Path],
+        output_columns: List[str],
+        predicate: Expr,
+        counts: np.ndarray,
+    ) -> List[ColumnarBatch]:
+        """Read ONLY the blocks the device counted matches in, re-evaluate
+        the predicate exactly there, gather output columns from mmap —
+        the single-chip _resident_parts protocol per device shard,
+        restricted to the query's (pruned) ``files``."""
+        wanted = {str(Path(f)) for f in files}
+        metrics.incr("scan.path.resident_device_mesh")
+        metrics.incr(
+            "scan.resident_mesh.blocks_touched",
+            int(np.count_nonzero(counts)),
+        )
+        metrics.incr("scan.resident_mesh.blocks_total", int(counts.size))
+        need = list(
+            dict.fromkeys(list(output_columns) + sorted(predicate.columns()))
+        )
+        keyed: List[Tuple[Tuple[str, int], ColumnarBatch]] = []
+        for d in range(table.n_devices):
+            cand = np.flatnonzero(counts[d])
+            if cand.size == 0:
+                continue
+            # merge adjacent candidate blocks into device-local row runs,
+            # clipped to the device's real rows
+            runs: List[List[int]] = []
+            for blk in cand:
+                lo = int(blk) * table.block
+                hi = min((int(blk) + 1) * table.block, table.dev_rows[d])
+                if lo >= hi:
+                    continue  # pad-only tail block
+                if runs and runs[-1][1] == lo:
+                    runs[-1][1] = hi
+                else:
+                    runs.append([lo, hi])
+            for lo, hi in runs:
+                for path, flo, fhi, off in table.segments[d]:
+                    seg_len = fhi - flo
+                    a = max(lo, off)
+                    b = min(hi, off + seg_len)
+                    if a >= b or path not in wanted:
+                        continue
+                    r_lo = flo + (a - off)
+                    r_hi = flo + (b - off)
+                    batch = layout.cached_reader(path).read(
+                        need, row_range=(r_lo, r_hi)
+                    )
+                    mask = np.asarray(eval_mask(predicate, batch))
+                    idx = np.flatnonzero(mask)
+                    if idx.size:
+                        keyed.append(
+                            (
+                                (path, r_lo),
+                                batch.take(idx).select(output_columns),
+                            )
+                        )
+        keyed.sort(key=lambda kv: kv[0])
+        return [b for _, b in keyed]
+
+    # -- observability -------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "tables": len(self._tables),
+                "resident_mb": round(
+                    sum(t.nbytes for t in self._tables) / 1e6, 1
+                ),
+                "budget_mb": _budget_bytes() >> 20,
+                "per_table": [
+                    {
+                        "devices": t.n_devices,
+                        "rows": t.n_rows,
+                        "cap": t.cap,
+                        "columns": sorted(t.columns),
+                        "mb": round(t.nbytes / 1e6, 1),
+                    }
+                    for t in self._tables
+                ],
+            }
+
+mesh_cache = MeshHbmCache()

@@ -225,6 +225,17 @@ class IndexCollectionManager:
 
             schema_cols = list(entry.schema)
             cols = [resolver.resolve(c, schema_cols) or c for c in columns]
+        mesh = getattr(self.session, "mesh", None)
+        if mesh is not None and mesh.devices.size > 1:
+            # matches the Executor's own gate (a 1-device "mesh" executes
+            # single-device, so ITS queries consult the single-chip cache)
+            # mesh sessions execute queries through the shard_map engine
+            # (exec.distributed), so residency must be mesh-sharded —
+            # bucket-per-device, the build's placement rule — not a
+            # single-device table no distributed query would ever consult
+            from ..exec.mesh_cache import mesh_cache
+
+            return mesh_cache.prefetch(files, cols, mesh) is not None
         return hbm_cache.prefetch(files, cols) is not None
 
 

@@ -1,0 +1,281 @@
+"""Mesh-sharded HBM residency (exec.mesh_cache) on the 8-device virtual
+CPU mesh: resident tables shard bucket-per-device with the build's
+``b % D`` placement, distributed queries serve from the shards with ZERO
+per-query H2D (the ``dist.h2d_bytes`` counter that meters the
+ship-per-query path stays flat), and results are row-identical to
+single-device execution — force mode, same contract as test_hbm_cache.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exec.executor import Executor
+from hyperspace_tpu.exec.mesh_cache import mesh_cache
+from hyperspace_tpu.parallel.mesh import make_mesh, owner_of_bucket
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.ir import Filter, IndexScan, Project, Scan
+from hyperspace_tpu.plan.rules import apply_hyperspace_rules
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+from tests.e2e_utils import assert_row_parity, build_index, write_source
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _force_residency(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    mesh_cache.reset()
+    yield
+    mesh_cache.reset()
+
+
+def _sample(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 500, n).astype(np.int64),
+            "v": rng.integers(0, 10**6, n).astype(np.int64),
+            "s": rng.choice([b"aa", b"bb", b"cc", b"dd"], n).astype(object),
+        },
+        {"k": "int64", "v": "int64", "s": "string"},
+    )
+
+
+def _indexed(tmp_path, batch, name="mi", num_buckets=16):
+    rel = write_source(tmp_path / "src", batch, n_files=3)
+    entry = build_index(
+        name, rel, ["k"], ["v", "s"], tmp_path / "idx", num_buckets=num_buckets
+    )
+    return rel, entry
+
+
+def test_prefetch_builds_bucket_per_device_shards(tmp_path, mesh):
+    batch = _sample()
+    _, entry = _indexed(tmp_path, batch)
+    files = entry.content.files()
+    table = mesh_cache.prefetch(files, ["k", "s"], mesh)
+    assert table is not None
+    assert table.n_rows == batch.num_rows
+    assert table.n_devices == 8
+    assert set(table.columns) == {"k", "s"}
+    assert table.columns["s"].enc == "string"
+    # placement: every segment's file bucket must be owned by its device
+    from hyperspace_tpu.storage import layout
+
+    for d in range(8):
+        for path, _lo, _hi, _off in table.segments[d]:
+            assert owner_of_bucket(layout.bucket_of_file(path), 8) == d
+    # idempotent: second prefetch returns the SAME registered table
+    assert mesh_cache.prefetch(files, ["k"], mesh) is table
+
+
+def test_resident_filter_parity_and_zero_h2d(tmp_path, mesh):
+    batch = _sample(seed=2)
+    rel, entry = _indexed(tmp_path, batch)
+    conf = HyperspaceConf()
+    assert mesh_cache.prefetch(entry.content.files(), ["k", "s"], mesh)
+    for pred in (
+        col("k") == 42,
+        (col("k") >= 50) & (col("k") < 220),
+        (col("s") == "bb") & (col("k") < 400),
+    ):
+        plan = Project(("k", "v", "s"), Filter(pred, Scan(rel)))
+        rewritten, applied = apply_hyperspace_rules(plan, [entry], conf)
+        assert applied and rewritten.collect(lambda n: isinstance(n, IndexScan))
+        single = Executor(conf).execute(rewritten)
+        before_res = metrics.counter("scan.path.resident_device_mesh")
+        before_h2d = metrics.counter("dist.h2d_bytes")
+        multi = Executor(conf, mesh=mesh, dist_min_rows=0).execute(rewritten)
+        assert (
+            metrics.counter("scan.path.resident_device_mesh")
+            == before_res + 1
+        )
+        # the whole point: repeat distributed queries ship NOTHING up
+        assert metrics.counter("dist.h2d_bytes") == before_h2d
+        assert_row_parity(single, multi)
+        assert multi.num_rows > 0
+
+
+def test_unresolvable_predicate_routes_shipping_path(tmp_path, mesh):
+    """A predicate the resident encodings can't express (int64 literal
+    beyond int32) must fall back to the ship-per-query path — same rows,
+    H2D paid."""
+    batch = _sample(seed=3)
+    rel, entry = _indexed(tmp_path, batch)
+    conf = HyperspaceConf()
+    assert mesh_cache.prefetch(entry.content.files(), ["k", "v"], mesh)
+    pred = col("v") >= (1 << 40)  # narrows to None
+    plan = Filter(pred | (col("k") == 3), Scan(rel))
+    rewritten, applied = apply_hyperspace_rules(plan, [entry], conf)
+    assert applied
+    before_h2d = metrics.counter("dist.h2d_bytes")
+    single = Executor(conf).execute(rewritten)
+    multi = Executor(conf, mesh=mesh, dist_min_rows=0).execute(rewritten)
+    assert metrics.counter("dist.h2d_bytes") > before_h2d
+    assert_row_parity(single, multi)
+
+
+def test_first_touch_population_backgrounds(tmp_path, mesh):
+    batch = _sample(seed=4)
+    rel, entry = _indexed(tmp_path, batch)
+    conf = HyperspaceConf()
+    plan = Filter(col("k") == 11, Scan(rel))
+    rewritten, applied = apply_hyperspace_rules(plan, [entry], conf)
+    assert applied
+    ex = Executor(conf, mesh=mesh, dist_min_rows=0)
+    first = ex.execute(rewritten)  # miss -> note_touch schedules upload
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if mesh_cache.snapshot()["tables"]:
+            break
+        time.sleep(0.05)
+    assert mesh_cache.snapshot()["tables"] == 1
+    before = metrics.counter("scan.path.resident_device_mesh")
+    second = ex.execute(rewritten)
+    assert metrics.counter("scan.path.resident_device_mesh") == before + 1
+    assert_row_parity(first, second)
+
+
+def test_resident_aggregate_reads_matching_blocks_only(tmp_path, mesh):
+    from hyperspace_tpu.plan.aggregates import agg_count, agg_sum
+    from hyperspace_tpu.plan.ir import Aggregate
+
+    batch = _sample(seed=5)
+    rel, entry = _indexed(tmp_path, batch)
+    conf = HyperspaceConf()
+    assert mesh_cache.prefetch(entry.content.files(), ["k"], mesh)
+    plan = Aggregate(
+        ("s",),
+        (agg_sum("v", "sv"), agg_count()),
+        Filter(col("k") < 100, Scan(rel)),
+    )
+    rewritten, applied = apply_hyperspace_rules(plan, [entry], conf)
+    assert applied
+    single = Executor(conf).execute(rewritten)
+    before = metrics.counter("aggregate.path.resident_mesh")
+    before_h2d = metrics.counter("dist.h2d_bytes")
+    multi = Executor(conf, mesh=mesh, dist_min_rows=0).execute(rewritten)
+    assert metrics.counter("aggregate.path.resident_mesh") == before + 1
+    assert metrics.counter("dist.h2d_bytes") == before_h2d
+    assert_row_parity(single, multi)
+
+
+def test_session_runs_layout_facade_prefetch(tmp_path, mesh):
+    """End-to-end through the public API on a mesh session with
+    finalizeMode=runs: hs.prefetch_index routes to the MESH cache, run
+    files shard by their footer bucket ranges, and the repeat query is
+    served resident with row parity."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+
+    rng = np.random.default_rng(6)
+    n = 20_000
+    src = tmp_path / "li"
+    src.mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "k": rng.integers(0, 800, n).astype(np.int64),
+                "v": rng.integers(0, 10**6, n).astype(np.int64),
+            }
+        ),
+        src / "a.parquet",
+    )
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.INDEX_NUM_BUCKETS: 16,
+            C.BUILD_MODE: C.BUILD_MODE_STREAMING,
+            C.BUILD_CHUNK_ROWS: 1 << 12,
+            C.BUILD_FINALIZE_MODE: C.BUILD_FINALIZE_RUNS,
+            C.TPU_DISTRIBUTED_MIN_ROWS: 0,
+        }
+    )
+    session = HyperspaceSession(conf, mesh=mesh)
+    hs = Hyperspace(session)
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("runs_i", ["k"], ["v"]))
+    from hyperspace_tpu.storage import layout as L
+
+    from pathlib import Path as _P
+
+    files = sorted(
+        str(p)
+        for p in _P(hs.index("runs_i").index_location).glob("v__=*/*.tcb")
+    )
+    assert files and any(L.is_run_file(f) for f in files)
+    assert hs.prefetch_index("runs_i", ["k"])
+    assert mesh_cache.snapshot()["tables"] == 1
+
+    q = lambda: (  # noqa: E731
+        session.read.parquet(str(src))
+        .filter((col("k") >= 100) & (col("k") < 140))
+        .select("k", "v")
+    )
+    session.disable_hyperspace()
+    expected = q().collect()
+    session.enable_hyperspace()
+    before = metrics.counter("scan.path.resident_device_mesh")
+    before_h2d = metrics.counter("dist.h2d_bytes")
+    got = q().collect()
+    assert metrics.counter("scan.path.resident_device_mesh") == before + 1
+    assert metrics.counter("dist.h2d_bytes") == before_h2d
+    assert_row_parity(expected, got)
+    assert got.num_rows > 0
+
+
+def test_stale_version_never_matches(tmp_path, mesh):
+    batch = _sample(seed=7)
+    _, entry = _indexed(tmp_path, batch)
+    files = entry.content.files()
+    assert mesh_cache.prefetch(files, ["k"], mesh)
+    # touch one file: identity (mtime_ns) changes -> covering lookup must miss
+    import os
+
+    p = files[0]
+    st = os.stat(p)
+    os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    from pathlib import Path
+
+    assert mesh_cache.resident_for([Path(f) for f in files], ["k"], mesh) is None
+
+
+def test_budget_eviction_lru(tmp_path, mesh, monkeypatch):
+    b1 = _sample(2000, seed=8)
+    b2 = _sample(2000, seed=9)
+    _, e1 = _indexed(tmp_path / "a", b1, name="m1")
+    _, e2 = _indexed(tmp_path / "b", b2, name="m2")
+    t1 = mesh_cache.prefetch(e1.content.files(), ["k"], mesh)
+    assert t1 is not None
+    import hyperspace_tpu.exec.hbm_cache as base_mod
+    import hyperspace_tpu.exec.mesh_cache as mod
+
+    # the LRU lives in ResidentCacheBase (hbm_cache module globals); the
+    # pre-build budget check resolves mesh_cache's imported name — patch both
+    monkeypatch.setattr(base_mod, "_budget_bytes", lambda: t1.nbytes * 3 // 2)
+    monkeypatch.setattr(mod, "_budget_bytes", lambda: t1.nbytes * 3 // 2)
+    t2 = mesh_cache.prefetch(e2.content.files(), ["k"], mesh)
+    assert t2 is not None
+    snap = mesh_cache.snapshot()
+    assert snap["tables"] == 1  # LRU evicted t1
+    from pathlib import Path
+
+    assert (
+        mesh_cache.resident_for(
+            [Path(f) for f in e2.content.files()], ["k"], mesh
+        )
+        is t2
+    )
