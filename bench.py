@@ -832,6 +832,9 @@ def main() -> None:
                 "r_m": Column.from_values(
                     res_modes[rngr.integers(0, 7, RES_ROWS)]
                 ),
+                "r_f": Column.from_values(
+                    np.round(rngr.uniform(0.0, 1000.0, RES_ROWS), 6)
+                ),
                 "r_v": Column.from_values(
                     rngr.integers(0, 1 << 30, RES_ROWS).astype(np.int64)
                 ),
@@ -846,7 +849,7 @@ def main() -> None:
         t0 = time.perf_counter()
         hs.create_index(
             session.read.parquet(str(WORKDIR / "resident")),
-            IndexConfig("li_res_idx", ["r_k"], ["r_q", "r_m", "r_v"]),
+            IndexConfig("li_res_idx", ["r_k"], ["r_q", "r_m", "r_f", "r_v"]),
         )
         extras["resident_build_s"] = round(time.perf_counter() - t0, 3)
         session.conf.set(C.INDEX_NUM_BUCKETS, str(N_BUCKETS))
@@ -855,10 +858,11 @@ def main() -> None:
         k_sorted = np.sort(resident_tbl.columns["r_k"].data)
         r_lo = int(k_sorted[RES_ROWS // 2])
         r_hi = int(k_sorted[RES_ROWS // 2 + 5000])
-        # the predicate mixes int range, int !=, and a STRING != — the
-        # string conjunct rides residency through the global-vocab code
-        # re-encode (round-4 capability), visible as the same
-        # scan.path.pallas_mask counter
+        # the predicate mixes int range, int !=, a STRING != (global-vocab
+        # code re-encode, round-4 capability) and an F64 range conjunct
+        # (two-plane ordered-i64 encoding, round-5 capability — an f64
+        # conjunct no longer evicts the predicate to host), all riding the
+        # same scan.path.pallas_mask counter
         q9 = lambda: (  # noqa: E731
             session.read.parquet(str(WORKDIR / "resident"))
             .filter(
@@ -866,6 +870,7 @@ def main() -> None:
                 & (col("r_k") <= lit(r_hi))
                 & (col("r_q") != lit(7))
                 & (col("r_m") != lit("REG AIR"))
+                & (col("r_f") >= lit(250.0))
             )
             .select("r_k", "r_v")
         )
@@ -905,7 +910,9 @@ def main() -> None:
             # failure below
             _fail("config9 index not ACTIVE after create")
         t0 = time.perf_counter()
-        prefetched = hs.prefetch_index("li_res_idx", ["r_k", "r_q", "r_m"])
+        prefetched = hs.prefetch_index(
+            "li_res_idx", ["r_k", "r_q", "r_m", "r_f"]
+        )
         extras["resident_prefetch_s"] = round(time.perf_counter() - t0, 3)
         if not prefetched:
             # this config's columns are int64-in-range and far under the
@@ -945,7 +952,8 @@ def main() -> None:
                 (pc.field("r_k") >= r_lo)
                 & (pc.field("r_k") <= r_hi)
                 & (pc.field("r_q") != 7)
-                & (pc.field("r_m") != b"REG AIR"),
+                & (pc.field("r_m") != b"REG AIR")
+                & (pc.field("r_f") >= 250.0),
                 ["r_k", "r_v"],
             )
             if ext9().num_rows != r_dev.num_rows:

@@ -225,19 +225,28 @@ class RefreshIncrementalAction(RefreshActionBase):
                 if kept.num_rows == 0:
                     continue
                 if layout.is_run_file(f):
-                    offs = layout.run_bucket_offsets(
-                        layout.cached_reader(f).footer
-                    )
+                    src_footer = layout.cached_reader(f).footer
+                    offs = layout.run_bucket_offsets(src_footer)
                     counts = [
                         int(keep[int(offs[b]) : int(offs[b + 1])].sum())
                         for b in range(len(offs) - 1)
                     ]
                     p = version_dir / layout.run_file_name(i)
+                    # carry the source run's footer extra (index-level
+                    # metadata stream_builder propagates into every run,
+                    # e.g. indexName) — only bucketCounts is recomputed
                     layout.write_batch(
                         p,
                         kept,
                         sorted_by=indexed,
-                        extra={"bucketCounts": counts},
+                        extra={
+                            **{
+                                k: v
+                                for k, v in src_footer.get("extra", {}).items()
+                                if k != "bucketCounts"
+                            },
+                            "bucketCounts": counts,
+                        },
                     )
                 else:
                     b = layout.bucket_of_file(f)

@@ -130,11 +130,14 @@ _MAX_VOCAB = 1 << 22
 class ResidentColumn:
     data: object  # jax.Array, (n_pad // 128, 128) int32, device-resident
     dtype_str: str  # source dtype
-    enc: str  # 'int' | 'float32' (ordered-i32) | 'string' (global codes)
+    # 'int' | 'float32' (ordered-i32) | 'string' (global codes) |
+    # 'f64' (two-plane ordered-i64: ``data`` = high plane, ``data2`` = low)
+    enc: str
     nbytes: int
     # string columns only: the table-GLOBAL sorted vocab the device codes
     # index into (host-side — literals bind against it, it never uploads)
     vocab: Optional[np.ndarray] = None
+    data2: Optional[object] = None  # f64 low plane (ops.floatbits)
 
 
 @dataclass
@@ -164,13 +167,14 @@ def _file_identity(path: Path) -> tuple:
 
 def _encode_column(col: Column) -> Optional[Tuple[np.ndarray, str]]:
     """(int32 array, encoding) for a device-resident predicate column, or
-    None when the dtype cannot ride the device exactly (float64, strings —
-    whose dictionary codes are per-file and would collide across the
-    concatenated table — out-of-range int64, NaN float32). The narrowing
-    itself is ops.kernels.narrow_arrays_to_i32: the resident protocol's
-    correctness rests on the device encoding agreeing with what
-    narrow_expr_to_i32 assumes about literals, so there is exactly ONE
-    narrowing contract in the codebase."""
+    None when the dtype cannot ride the device exactly (strings — whose
+    dictionary codes are per-file and would collide across the
+    concatenated table — out-of-range int64, NaN float32; float64 rides
+    the TWO-plane path, _encode_f64). The narrowing itself is
+    ops.kernels.narrow_arrays_to_i32: the resident protocol's correctness
+    rests on the device encoding agreeing with what narrow_expr_to_i32
+    assumes about literals, so there is exactly ONE narrowing contract in
+    the codebase."""
     from ..ops.kernels import narrow_arrays_to_i32
 
     a = col.data
@@ -180,6 +184,79 @@ def _encode_column(col: Column) -> Optional[Tuple[np.ndarray, str]]:
     if narrowed is None:
         return None
     return narrowed["c"], ("float32" if a.dtype == np.float32 else "int")
+
+
+def _encode_f64(a: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(hi, lo) int32 planes of a float64 column through the
+    order-preserving i64 encoding (ops.floatbits), or None for NaN data
+    (encoded NaN would order above +inf instead of comparing false —
+    the same refusal as the f32 narrowing)."""
+    from ..ops.floatbits import f64_to_ordered_i64, ordered_i64_planes
+
+    a = np.asarray(a)
+    if a.dtype != np.float64 or (a.size and np.isnan(a).any()):
+        return None
+    return ordered_i64_planes(f64_to_ordered_i64(a))
+
+
+def prepare_resident_predicate(
+    columns: Dict[str, "ResidentColumn"], predicate: Expr
+):
+    """The shared bind→expand→narrow pipeline of both resident caches:
+    bind string literals against the table-global vocabs, expand f64
+    comparisons into two-plane int32 expressions (ops.floatbits), and
+    narrow every literal to int32. Returns (narrowed expr, names tuple)
+    where ``names`` may contain f64 plane names, or None when the
+    predicate cannot ride the resident encodings (caller routes host)."""
+    from ..ops import kernels as K
+
+    names = tuple(sorted(predicate.columns()))
+    if any(n not in columns for n in names):
+        return None
+    str_cols = {
+        n: columns[n] for n in names if columns[n].enc == "string"
+    }
+    if str_cols:
+        from ..plan.expr import bind_string_literals
+
+        shim = ColumnarBatch(
+            {
+                n: Column(rc.dtype_str, np.empty(0, dtype=np.int32), rc.vocab)
+                for n, rc in str_cols.items()
+            }
+        )
+        try:
+            predicate = bind_string_literals(predicate, shim)
+        except Exception:  # noqa: BLE001 - unbindable shape: route host
+            return None
+    f64_cols = {n for n in names if columns[n].enc == "f64"}
+    if f64_cols:
+        from ..ops.floatbits import expand_f64_predicate
+
+        predicate = expand_f64_predicate(predicate, f64_cols)
+        if predicate is None:
+            return None
+    f32 = {n: "float32" for n in names if columns[n].enc == "float32"}
+    narrowed = K.narrow_expr_to_i32(predicate, f32 or None)
+    if narrowed is None:
+        return None
+    return narrowed, tuple(sorted(narrowed.columns()))
+
+
+def resident_arrays_for(
+    columns: Dict[str, "ResidentColumn"], names: Tuple[str, ...]
+) -> list:
+    """Device arrays for (possibly plane-suffixed) resident names, in
+    ``names`` order."""
+    out = []
+    for n in names:
+        if "\x00" in n:
+            base, plane = n.split("\x00", 1)
+            rc = columns[base]
+            out.append(rc.data if plane == "hi" else rc.data2)
+        else:
+            out.append(columns[n].data)
+    return out
 
 
 _counts_fn_cache: dict = {}
@@ -460,15 +537,13 @@ class HbmIndexCache(ResidentCacheBase):
         # column costs exactly n_pad * 4 bytes on device (string columns
         # upload CODES only — the global vocab stays host-side), so an
         # over-budget table is knowable upfront — refusing after the H2D
-        # would waste the full multi-GB transfer on a thin link. Only
-        # columns that could actually encode (footer dtype not float64)
-        # count.
+        # would waste the full multi-GB transfer on a thin link. float64
+        # columns cost TWO int32 planes (ops.floatbits two-plane ordered
+        # encoding).
         dtype_of = {
             m["name"]: m["dtype"] for m in readers[0].footer["columns"]
         }
-        encodable = [
-            c for c in columns if c in dtype_of and dtype_of[c] != "float64"
-        ]
+        encodable = [c for c in columns if c in dtype_of]
         if not encodable:
             return None, True
         # string columns add their (host-side) vocab heap to the account;
@@ -485,7 +560,10 @@ class HbmIndexCache(ResidentCacheBase):
                     )
                     if m is not None:
                         vocab_est += sum(len(v) + 50 for v in m.get("vocab", ()))
-        if len(encodable) * n_pad * 4 + vocab_est > _budget_bytes():
+        planes = sum(
+            2 if dtype_of[c] == "float64" else 1 for c in encodable
+        )
+        if planes * n_pad * 4 + vocab_est > _budget_bytes():
             metrics.incr("hbm.over_budget_refused")
             return None, False
 
@@ -533,6 +611,38 @@ class HbmIndexCache(ResidentCacheBase):
                 if vocab is None:
                     continue
                 enc = "string"
+            elif dtype_of[name] == "float64":
+                hi_parts, lo_parts = [], []
+                ok = True
+                for r in readers:
+                    e = _encode_f64(r.read([name]).columns[name].data)
+                    if e is None:
+                        ok = False  # NaN data (or dtype drift): refuse
+                        break
+                    hi_parts.append(e[0])
+                    lo_parts.append(e[1])
+                if not ok:
+                    continue
+                flat_hi = np.zeros(n_pad, dtype=np.int32)
+                flat_lo = np.zeros(n_pad, dtype=np.int32)
+                flat_hi[:n_rows] = (
+                    np.concatenate(hi_parts)
+                    if len(hi_parts) > 1
+                    else hi_parts[0]
+                )
+                flat_lo[:n_rows] = (
+                    np.concatenate(lo_parts)
+                    if len(lo_parts) > 1
+                    else lo_parts[0]
+                )
+                dev_hi = jax.device_put(flat_hi.reshape(n_pad // _LANES, _LANES))
+                dev_lo = jax.device_put(flat_lo.reshape(n_pad // _LANES, _LANES))
+                col_bytes = flat_hi.nbytes + flat_lo.nbytes
+                cols[name] = ResidentColumn(
+                    dev_hi, "float64", "f64", col_bytes, None, dev_lo
+                )
+                nbytes += col_bytes
+                continue
             else:
                 parts = []
                 ok = True
@@ -566,7 +676,10 @@ class HbmIndexCache(ResidentCacheBase):
         if not cols:
             return None, True  # nothing encoded (e.g. NaN float32 data)
         try:
-            jax.block_until_ready([c.data for c in cols.values()])
+            jax.block_until_ready(
+                [c.data for c in cols.values()]
+                + [c.data2 for c in cols.values() if c.data2 is not None]
+            )
         except Exception:  # noqa: BLE001 - device loss: no residency
             return None, False
         if nbytes > _budget_bytes():
@@ -618,47 +731,16 @@ class HbmIndexCache(ResidentCacheBase):
         routes host)."""
         from ..ops import kernels as K
 
-        names = tuple(sorted(predicate.columns()))
-        if any(n not in table.columns for n in names):
+        # bind (string vocab) -> expand (f64 two-plane) -> narrow (i32):
+        # the shared resident pipeline; None = predicate can't ride the
+        # resident encodings, caller routes host
+        prepared = prepare_resident_predicate(table.columns, predicate)
+        if prepared is None:
             return None
-        # string predicate columns: bind literals against the table's
-        # GLOBAL vocab first (the same transform bind_string_literals
-        # performs per batch on the host path) — the bound expression is
-        # pure int arithmetic over the resident code columns
-        str_cols = {
-            n: table.columns[n]
-            for n in names
-            if table.columns[n].enc == "string"
-        }
-        if str_cols:
-            from ..plan.expr import bind_string_literals
-
-            shim = ColumnarBatch(
-                {
-                    n: Column(
-                        rc.dtype_str,
-                        np.empty(0, dtype=np.int32),
-                        rc.vocab,
-                    )
-                    for n, rc in str_cols.items()
-                }
-            )
-            try:
-                predicate = bind_string_literals(predicate, shim)
-            except Exception:  # noqa: BLE001
-                # unbindable predicate SHAPE (e.g. string col-col compare
-                # across distinct vocabs) — not a device problem: decline
-                # so the caller routes host, keeping the table resident
-                return None
-        f32 = {
-            n: "float32" for n in names if table.columns[n].enc == "float32"
-        }
-        narrowed = K.narrow_expr_to_i32(predicate, f32 or None)
-        if narrowed is None:
-            return None
+        narrowed, names = prepared
         use_pallas = K.kernels_mode() != "off"
         fn = _counts_fn(narrowed, names, table.n_pad // _LANES, use_pallas)
-        cols = [table.columns[n].data for n in names]
+        cols = resident_arrays_for(table.columns, names)
         t0 = time.perf_counter()
         with K._x32():
             counts = np.asarray(fn(cols))

@@ -76,9 +76,12 @@ from .hbm_cache import (
 class MeshResidentColumn:
     data: object  # jax.Array, (D, cap) int32, NamedSharding over the mesh
     dtype_str: str
-    enc: str  # 'int' | 'float32' (ordered-i32) | 'string' (global codes)
+    # 'int' | 'float32' (ordered-i32) | 'string' (global codes) |
+    # 'f64' (two-plane ordered-i64: ``data`` = high plane, ``data2`` = low)
+    enc: str
     nbytes: int
     vocab: Optional[np.ndarray] = None  # host-side global vocab (strings)
+    data2: Optional[object] = None  # f64 low plane (ops.floatbits)
 
 
 # one device's slice of one file: rows [file_lo, file_hi) of ``path`` live
@@ -320,9 +323,7 @@ class MeshHbmCache(ResidentCacheBase):
         readers = {str(p): layout.cached_reader(p) for p in paths}
         first = readers[str(paths[0])]
         dtype_of = {m["name"]: m["dtype"] for m in first.footer["columns"]}
-        encodable = [
-            c for c in columns if c in dtype_of and dtype_of[c] != "float64"
-        ]
+        encodable = [c for c in columns if c in dtype_of]
         if not encodable:
             return None, True
         vocab_est = 0
@@ -335,7 +336,10 @@ class MeshHbmCache(ResidentCacheBase):
                     )
                     if m is not None:
                         vocab_est += sum(len(v) + 50 for v in m.get("vocab", ()))
-        if len(encodable) * D * cap * 4 + vocab_est > _budget_bytes():
+        planes = sum(
+            2 if dtype_of[c] == "float64" else 1 for c in encodable
+        )
+        if planes * D * cap * 4 + vocab_est > _budget_bytes():
             metrics.incr("hbm.mesh.over_budget_refused")
             return None, False
 
@@ -391,6 +395,31 @@ class MeshHbmCache(ResidentCacheBase):
                         np.int32, copy=False
                     )
                 enc = "string"
+            elif dtype_of[name] == "float64":
+                from .hbm_cache import _encode_f64
+
+                packed_lo = np.zeros((D, cap), dtype=np.int32)
+                ok = True
+                for d in range(D):
+                    for path, lo, hi, off in dev_segs[d]:
+                        e = _encode_f64(read_seg(path, lo, hi, name).data)
+                        if e is None:
+                            ok = False  # NaN data: refuse the column
+                            break
+                        packed[d, off : off + (hi - lo)] = e[0]
+                        packed_lo[d, off : off + (hi - lo)] = e[1]
+                    if not ok:
+                        break
+                if not ok:
+                    continue
+                dev_hi = jax.device_put(packed, sharding)
+                dev_lo = jax.device_put(packed_lo, sharding)
+                col_bytes = packed.nbytes + packed_lo.nbytes
+                cols[name] = MeshResidentColumn(
+                    dev_hi, "float64", "f64", col_bytes, None, dev_lo
+                )
+                nbytes += col_bytes
+                continue
             else:
                 ok = True
                 for d in range(D):
@@ -421,7 +450,10 @@ class MeshHbmCache(ResidentCacheBase):
         if not cols:
             return None, True
         try:
-            jax.block_until_ready([c.data for c in cols.values()])
+            jax.block_until_ready(
+                [c.data for c in cols.values()]
+                + [c.data2 for c in cols.values() if c.data2 is not None]
+            )
         except Exception:  # noqa: BLE001 - device loss: no residency
             return None, False
         if nbytes > _budget_bytes():
@@ -485,40 +517,20 @@ class MeshHbmCache(ResidentCacheBase):
         None when the predicate does not narrow to the resident encodings
         (caller routes the ship-per-query path)."""
         from ..ops import kernels as K
+        from .hbm_cache import prepare_resident_predicate, resident_arrays_for
 
-        names = tuple(sorted(predicate.columns()))
-        if any(n not in table.columns for n in names):
+        # bind (string vocab) -> expand (f64 two-plane) -> narrow (i32):
+        # the shared resident pipeline (hbm_cache)
+        prepared = prepare_resident_predicate(table.columns, predicate)
+        if prepared is None:
             return None
-        str_cols = {
-            n: table.columns[n]
-            for n in names
-            if table.columns[n].enc == "string"
-        }
-        if str_cols:
-            from ..plan.expr import bind_string_literals
-
-            shim = ColumnarBatch(
-                {
-                    n: Column(
-                        rc.dtype_str, np.empty(0, dtype=np.int32), rc.vocab
-                    )
-                    for n, rc in str_cols.items()
-                }
-            )
-            try:
-                predicate = bind_string_literals(predicate, shim)
-            except Exception:  # noqa: BLE001 - unbindable shape: route host
-                return None
-        f32 = {
-            n: "float32" for n in names if table.columns[n].enc == "float32"
-        }
-        narrowed = K.narrow_expr_to_i32(predicate, f32 or None)
-        if narrowed is None:
-            return None
+        narrowed, names = prepared
         fn = _mesh_counts_fn(
             table.mesh, repr(narrowed), narrowed, names, table.cap, table.block
         )
-        cols = {n: table.columns[n].data for n in names}
+        cols = dict(
+            zip(names, resident_arrays_for(table.columns, names))
+        )
         t0 = time.perf_counter()
         with K._x32():
             counts = np.asarray(fn(cols))

@@ -434,7 +434,13 @@ def _read_run_segments(
     reader = layout.cached_reader(f)
     offs = layout.run_bucket_offsets(reader.footer)
     if offs is None:
-        return reader.read(need)
+        # matches _group_batches_by_bucket: a run file without its
+        # bucketCounts footer is corrupt — a whole-file fallback here
+        # would duplicate the file into EVERY pinned bucket's group on
+        # the per-bucket distributed call path
+        raise HyperspaceException(
+            f"Run file {f} carries no bucketCounts footer."
+        )
     parts = []
     for b in sorted(pinned):
         if 0 <= b < len(offs) - 1 and offs[b + 1] > offs[b]:

@@ -573,7 +573,7 @@ def build_partition_sharded_multihost(
     each process feeds its local devices via
     ``jax.make_array_from_process_local_data`` and the hash repartition
     rides the same all_to_all program (ICI within a slice, DCN across
-    hosts; parallel/distributed.py documents the seam this lifts).
+    hosts; parallel.mesh.initialize_multihost is the control-plane seam; docs/05 the story).
 
     Returns ``(per_local_device, global_counts)``: this process's devices'
     (batch, bucket_ids) pairs — grouped by bucket, key-sorted — plus the

@@ -389,6 +389,11 @@ def resident_smj_amortized(
     ``prepared`` (a ``resident_sorted_intersect`` runner) reuses its
     compiled call and already-resident operands instead of re-planning
     and re-uploading them."""
+    if iters < 2:
+        raise ValueError(
+            "resident_smj_amortized needs iters >= 2 (it differences a "
+            f"{iters}-iteration loop against a 1-iteration one)"
+        )
     import jax
     import jax.numpy as jnp
 

@@ -51,3 +51,37 @@ def owner_of_bucket(bucket: int, n_devices: int) -> int:
     """The bucket→device placement rule. Build and query must agree (the
     analog of the reference's BucketSpec-driven task placement)."""
     return bucket % n_devices
+
+
+# -- multi-controller (one process per host) ---------------------------------
+# The DCN/ICI scale-out story lives in docs/05-scale-and-distribution.md;
+# the multi-controller build itself is ops.build.build_partition_sharded_
+# multihost (proven by tests/test_multihost.py). These two helpers are the
+# whole control-plane seam.
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the JAX distributed (DCN) control plane so every host's
+    devices appear in ``jax.devices()``. Call once per process, before any
+    other JAX API. No-ops when already initialized."""
+    if jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def process_info() -> dict:
+    """This process's place in the job (single-process: 1 process, id 0)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

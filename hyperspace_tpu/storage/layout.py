@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import uuid
 from pathlib import Path
 from typing import Any, Dict, Iterable, List, Optional
@@ -53,9 +54,14 @@ def run_file_name(seq: int) -> str:
     return f"r{seq:05d}-{uuid.uuid4().hex[:12]}.tcb"
 
 
+_RUN_FILE_RE = re.compile(r"^r\d{5,}-[0-9a-f]{12}\.tcb$")  # {5,}: seq >= 100000 widens the field
+
+
 def is_run_file(path: str | Path) -> bool:
-    name = Path(path).name
-    return name.startswith("r") and name.endswith(".tcb")
+    """Matches exactly the names ``run_file_name`` generates — a bare
+    'r' prefix would also claim spill scratch ('run-*.tcb') and any
+    future r-named file class."""
+    return bool(_RUN_FILE_RE.match(Path(path).name))
 
 
 def run_bucket_offsets(footer: Dict[str, Any]) -> Optional[np.ndarray]:

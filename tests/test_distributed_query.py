@@ -275,7 +275,7 @@ def test_executor_mesh_aggregate_e2e(tmp_path, mesh):
 
 
 def test_process_info_single_controller(mesh):
-    from hyperspace_tpu.parallel.distributed import process_info
+    from hyperspace_tpu.parallel.mesh import process_info
 
     info = process_info()
     assert info["process_count"] == 1

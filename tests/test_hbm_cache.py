@@ -173,25 +173,118 @@ def test_budget_eviction(tmp_path, monkeypatch):
     assert snap["tables"] == 1
 
 
-def test_f64_refused_strings_resident(tmp_path):
-    """float64 never rides the device; strings DO (global-vocab codes,
-    vocab host-side) — a mixed request registers what encodes."""
+def test_f64_two_plane_resident_parity(tmp_path):
+    """float64 rides the device as TWO ordered-int32 planes (round-5;
+    previously an f64 conjunct evicted the whole predicate to host).
+    eq/ne/range/IN against negative, zero, and fractional literals must
+    answer identically to the exact host path — and the device path must
+    actually FIRE."""
     rng = np.random.default_rng(0)
-    n = 2000
+    n = 4000
     vocab = np.array([b"x", b"y", b"z"], dtype=object)
+    d = np.round(rng.normal(0, 100.0, n), 3)
+    d[:5] = [0.0, -0.0, -250.125, 1e-300, 7.5]
     batch = ColumnarBatch(
         {
             "s": Column.from_values(vocab[rng.integers(0, 3, n)]),
-            "d": Column("float64", rng.normal(0, 1, n)),
+            "d": Column("float64", d),
             "k": Column("int64", np.sort(rng.integers(0, 10_000, n))),
         }
     )
     p = tmp_path / "b00000-feedbeef.tcb"
     layout.write_batch(p, batch, sorted_by=["k"], bucket=0)
-    assert hbm_cache.prefetch([p], ["d"]) is None
     t = hbm_cache.prefetch([p], ["s", "d", "k"])
-    assert t is not None and set(t.columns) == {"k", "s"}
-    assert t.columns["s"].enc == "string" and t.columns["s"].vocab is not None
+    assert t is not None and set(t.columns) == {"k", "s", "d"}
+    assert t.columns["d"].enc == "f64" and t.columns["d"].data2 is not None
+    from hyperspace_tpu.plan.expr import is_in
+
+    for pred in (
+        (col("d") >= lit(-50.0)) & (col("d") < lit(75.25)) & (col("k") < lit(8000)),
+        col("d") == lit(7.5),
+        (col("d") != lit(0.0)) & (col("d") <= lit(0.5)),
+        (col("d") > lit(-250.125)) & (col("s") == lit("y")),
+        is_in(col("d"), [7.5, -250.125, 123456.789]),
+    ):
+        host = index_scan([p], ["k", "d"], pred, device=False)
+        metrics.reset()
+        dev = index_scan([p], ["k", "d"], pred, device=True)
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("scan.path.resident_device") == 1, (pred, snap)
+        assert dev.num_rows == host.num_rows, pred
+        assert np.array_equal(
+            np.sort(dev.columns["d"].data), np.sort(host.columns["d"].data)
+        )
+
+
+def test_f64_nan_data_refused_query_exact(tmp_path):
+    """NaN float64 data cannot ride the ordered encoding (encoded NaN
+    would order above +inf instead of comparing false): the column is
+    refused, the query still answers exactly via host."""
+    rng = np.random.default_rng(1)
+    n = 2000
+    d = rng.normal(0, 1, n)
+    d[7] = np.nan
+    batch = ColumnarBatch(
+        {
+            "d": Column("float64", d),
+            "k": Column("int64", np.sort(rng.integers(0, 10_000, n))),
+        }
+    )
+    p = tmp_path / "b00000-0badcafe.tcb"
+    layout.write_batch(p, batch, sorted_by=["k"], bucket=0)
+    assert hbm_cache.prefetch([p], ["d"]) is None
+    t = hbm_cache.prefetch([p], ["d", "k"])
+    assert t is not None and set(t.columns) == {"k"}
+    pred = (col("d") > lit(0.0)) & (col("k") < lit(9000))
+    host = index_scan([p], ["k"], pred, device=False)
+    dev = index_scan([p], ["k"], pred, device=True)
+    assert dev.num_rows == host.num_rows
+
+
+def test_expand_f64_predicate_equivalence():
+    """Property check of the two-plane rewrite: for random f64 data and
+    every comparison op, evaluating the EXPANDED int32-plane expression
+    over the plane arrays equals evaluating the original predicate over
+    the float column."""
+    from hyperspace_tpu.ops.floatbits import (
+        expand_f64_predicate,
+        f64_to_ordered_i64,
+        ordered_i64_planes,
+        plane_names,
+    )
+    from hyperspace_tpu.plan.expr import eval_mask
+
+    rng = np.random.default_rng(2)
+    d = np.concatenate(
+        [
+            rng.normal(0, 1e6, 500),
+            rng.normal(0, 1e-6, 500),
+            [0.0, -0.0, np.inf, -np.inf, 1.5, -1.5],
+        ]
+    )
+    hi, lo = ordered_i64_planes(f64_to_ordered_i64(d))
+    nh, nl = plane_names("d")
+    shim = ColumnarBatch(
+        {nh: Column("int32", hi), nl: Column("int32", lo)}
+    )
+    fbatch = ColumnarBatch({"d": Column("float64", d)})
+    for v in (0.0, -1.5, 1.5, 3.25e5, -7.125e-7):
+        for pred in (
+            col("d") == lit(v),
+            col("d") != lit(v),
+            col("d") < lit(v),
+            col("d") <= lit(v),
+            col("d") > lit(v),
+            col("d") >= lit(v),
+            lit(v) > col("d"),
+        ):
+            ex = expand_f64_predicate(pred, {"d"})
+            assert ex is not None, (pred, v)
+            got = np.asarray(eval_mask(ex, shim))
+            exp = np.asarray(eval_mask(pred, fbatch))
+            assert np.array_equal(got, exp), (pred, v)
+    # f64 col-col compares don't expand (route host)
+    assert expand_f64_predicate(col("d") < col("d"), {"d"}) is None
 
 
 def test_string_predicate_resident_parity_across_vocabs(tmp_path):

@@ -237,6 +237,40 @@ def test_session_runs_layout_facade_prefetch(tmp_path, mesh):
     assert got.num_rows > 0
 
 
+def test_mesh_f64_two_plane_resident_parity(tmp_path, mesh):
+    """float64 conjuncts ride the MESH resident path through the same
+    two-plane ordered-i64 encoding as the single-chip cache."""
+    rng = np.random.default_rng(12)
+    n = 4000
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 500, n).astype(np.int64),
+            "d": np.round(rng.normal(0, 100.0, n), 3),
+            "v": rng.integers(0, 10**6, n).astype(np.int64),
+        },
+        {"k": "int64", "d": "float64", "v": "int64"},
+    )
+    rel = write_source(tmp_path / "src", batch, n_files=3)
+    entry = build_index(
+        "mf", rel, ["k"], ["d", "v"], tmp_path / "idx", num_buckets=16
+    )
+    conf = HyperspaceConf()
+    table = mesh_cache.prefetch(entry.content.files(), ["k", "d"], mesh)
+    assert table is not None and table.columns["d"].enc == "f64"
+    pred = (col("d") >= -50.0) & (col("d") < 75.25) & (col("k") < 400)
+    plan = Filter(pred, Scan(rel))
+    rewritten, applied = apply_hyperspace_rules(plan, [entry], conf)
+    assert applied
+    single = Executor(conf).execute(rewritten)
+    before = metrics.counter("scan.path.resident_device_mesh")
+    before_h2d = metrics.counter("dist.h2d_bytes")
+    multi = Executor(conf, mesh=mesh, dist_min_rows=0).execute(rewritten)
+    assert metrics.counter("scan.path.resident_device_mesh") == before + 1
+    assert metrics.counter("dist.h2d_bytes") == before_h2d
+    assert_row_parity(single, multi)
+    assert multi.num_rows > 0
+
+
 def test_stale_version_never_matches(tmp_path, mesh):
     batch = _sample(seed=7)
     _, entry = _indexed(tmp_path, batch)
