@@ -101,22 +101,18 @@ def residency_mode() -> str:
     return mode if mode in ("auto", "off", "force") else "auto"
 
 
-def _platform() -> str:
-    """Configured jax platform WITHOUT backend init (cold init on a
-    tunneled chip costs seconds — index/stream_builder._engine_cache_key
-    rationale)."""
-    from ..index.stream_builder import _engine_cache_key
-
-    return _engine_cache_key(0)[0]
-
-
 def _auto_enabled() -> bool:
     mode = residency_mode()
     if mode == "off":
         return False
     if mode == "force":
         return True
-    return _platform() == "tpu"
+    # no-backend-init platform resolution, accepting plugin TPU names —
+    # under the tunneled 'axon' platform a bare == "tpu" check left
+    # first-touch population permanently off (round-5 fix)
+    from ..ops import is_tpu_platform
+
+    return is_tpu_platform()
 
 
 _MAX_FAILED_MEMO = 1024  # per-file-version keys; bounded paranoia

@@ -89,6 +89,10 @@ def join_codes(
 # factorized codes, multi-file buckets after incremental refresh).
 # Tunable via HYPERSPACE_TPU_MIN_DEVICE_JOIN_ROWS.
 MIN_DEVICE_JOIN_ROWS = 1 << 18
+# latched after a device-kernel dispatch failure (e.g. configured-but-
+# absent TPU): later joins skip straight to searchsorted instead of
+# re-raising per batch
+_device_kernel_dead = [False]
 
 
 def _min_device_rows() -> int:
@@ -136,8 +140,16 @@ def merge_join_ranges(
             and min(len(l_codes), len(r_codes)) >= _min_device_rows()
         )
     lo = counts = None
-    if device and _k.kernels_mode() != "off":
-        res = _k.sorted_intersect_counts(l_codes, r_sorted)
+    if device and _k.kernels_mode() != "off" and not _device_kernel_dead[0]:
+        # kernels_mode trusts the CONFIGURED platform (no backend init);
+        # if the actual backend can't run the kernel (configured-but-
+        # absent TPU), degrade to searchsorted and stop retrying
+        try:
+            res = _k.sorted_intersect_counts(l_codes, r_sorted)
+        except Exception:  # noqa: BLE001 - device loss degrades, not fails
+            res = None
+            _device_kernel_dead[0] = True
+            metrics.incr("join.path.device_kernel_failed")
         if res is not None:
             lo, counts = res
             metrics.incr("join.path.device_kernel")

@@ -63,18 +63,9 @@ def _engine_cache_key(chunk_capacity: int) -> tuple:
     "host" would charge every pure-host build the device tax the memo
     exists to avoid. The configured platform string (env / jax.config) is
     a faithful proxy — it is what decides which backend WOULD initialize."""
-    platform = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
-    if not platform:
-        try:
-            import jax
+    from ..ops import configured_platform
 
-            cfg = getattr(jax.config, "jax_platforms", None)
-            platform = (
-                cfg.split(",")[0].strip() if cfg else jax.default_backend()
-            )
-        except Exception:  # noqa: BLE001 - cache key only
-            platform = "unknown"
-    return (platform, chunk_capacity)
+    return (configured_platform(), chunk_capacity)
 
 
 def _probe_cache_path() -> Optional[Path]:

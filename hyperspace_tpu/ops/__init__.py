@@ -17,6 +17,34 @@ def ensure_x64() -> None:
     _enable_persistent_compile_cache(jax)
 
 
+def configured_platform() -> str:
+    """The jax platform that WOULD initialize, resolved without backend
+    init: cold init on a tunneled chip costs seconds (and hangs forever
+    when the tunnel is wedged), so pure-host code paths must never pay it
+    just to ask where they are. Env var first, then jax.config; only a
+    fully unconfigured process falls back to jax.default_backend()."""
+    import os
+
+    platform = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    if platform:
+        return platform
+    try:
+        import jax
+
+        cfg = getattr(jax.config, "jax_platforms", None)
+        return cfg.split(",")[0].strip() if cfg else jax.default_backend()
+    except Exception:  # noqa: BLE001 - advisory only
+        return "unknown"
+
+
+def is_tpu_platform() -> bool:
+    """Whether the configured platform is a TPU-class backend. TPU plugin
+    platforms can carry their own names (e.g. the tunneled 'axon' chip)
+    while still being TPUs — semantic is-this-a-TPU checks must accept
+    them, or TPU-only features silently stay off under a plugin."""
+    return configured_platform() in ("tpu", "axon")
+
+
 def _enable_persistent_compile_cache(jax) -> None:
     """TPU compiles of the build/query kernels cost tens of seconds (AOT
     through the runtime helper); the persistent cache makes every process

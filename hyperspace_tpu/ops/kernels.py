@@ -57,13 +57,16 @@ _I32_MAX = 2**31 - 1
 def kernels_mode() -> str:
     """'tpu' | 'interpret' | 'off' — resolved from HYPERSPACE_TPU_KERNELS
     (auto: on for TPU backends, off elsewhere; 'interpret' forces the
-    Pallas interpreter, used by the CPU test suite)."""
+    Pallas interpreter, used by the CPU test suite). Auto resolves the
+    platform WITHOUT backend init (ops.is_tpu_platform): this is called
+    from pure-host paths, and a cold/wedged tunnel must not be paid — or
+    hung on — to learn the answer is 'off'."""
     mode = os.environ.get("HYPERSPACE_TPU_KERNELS", "auto").lower()
     if mode in ("interpret", "off", "tpu"):
         return mode
-    import jax
+    from . import is_tpu_platform
 
-    return "tpu" if jax.default_backend() == "tpu" else "off"
+    return "tpu" if is_tpu_platform() else "off"
 
 
 def _interpret() -> bool:
