@@ -350,24 +350,34 @@ def bucketed_join_pairs(
     Pallas sorted-intersect kernel actually fire at realistic bucket sizes
     (round-1 verdict weak #3: 64 buckets × ~31k rows never crossed the
     per-bucket gate)."""
-    setup = _bucketed_join_setup(left_by_bucket, right_by_bucket, l_keys, r_keys)
+    setup, cache_key = _bucketed_join_setup(
+        left_by_bucket, right_by_bucket, l_keys, r_keys
+    )
     if setup is None:
         return []
-    l_all, r_all, l_codes, r_codes, l_bounds, r_bounds = setup
-    presorted = (
-        _segments_sorted(l_codes, l_bounds),
-        _segments_sorted(r_codes, r_bounds),
-    )
-    if presorted[0] and presorted[1]:
-        # fully-fused native path: range walk + output gather in one C++
-        # pass — the pair index arrays (16B per output row) and the numpy
-        # fancy-gathers they feed are never materialized
-        from .. import native
+    l_all, r_all, l_codes, r_codes, l_bounds, r_bounds, presorted = setup
+    l_data = {n: c.data for n, c in l_all.columns.items()}
+    r_data = {n: c.data for n, c in r_all.columns.items()}
+    from .. import native
 
+    if (
+        presorted[0]
+        and presorted[1]
+        and native.smj_gather_supported(l_data, r_data)
+    ):
+        # fully-fused native path: cached range walk + output gather in
+        # one C++ pass — the pair index arrays (16B per output row) and
+        # the numpy fancy-gathers they feed are never materialized.
+        # Eligibility is checked FIRST so an ineligible join never pays
+        # (or caches) a range walk the gather can't consume.
+        ranges = _cached_smj_ranges(
+            cache_key, l_codes, r_codes, l_bounds, r_bounds
+        )
         fused = native.smj_join_gather(
             l_codes, r_codes, l_bounds, r_bounds,
-            {n: c.data for n, c in l_all.columns.items()},
-            {n: c.data for n, c in r_all.columns.items()},
+            l_data,
+            r_data,
+            ranges=ranges,
         )
         if fused is not None:
             metrics.incr("join.path.native_smj_gather")
@@ -407,11 +417,13 @@ def _setup_cache_budget() -> int:
     return _env_mb("HYPERSPACE_TPU_JOIN_CACHE_MB", 512)
 
 
-_SETUP_CACHE = ByteCappedLru(_setup_cache_budget, entry_cap=4)
+# entry cap covers setup + ranges entries per distinct join (2 each);
+# byte budget is the real bound
+_SETUP_CACHE = ByteCappedLru(_setup_cache_budget, entry_cap=8)
 
 
 def _setup_nbytes(setup) -> int:
-    l_all, r_all, l_codes, r_codes, _lb, _rb = setup
+    l_all, r_all, l_codes, r_codes, _lb, _rb, _ps = setup
     return (
         l_codes.nbytes
         + r_codes.nbytes
@@ -422,6 +434,27 @@ def _setup_nbytes(setup) -> int:
 
 def reset_setup_cache() -> None:
     _SETUP_CACHE.reset()
+
+
+def _cached_smj_ranges(cache_key, l_codes, r_codes, l_bounds, r_bounds):
+    """Native (lo, cnt, off, total, n_l) ranges for a CACHED setup:
+    ranges are a pure function of the immutable setup, so warm joins and
+    warm aggregate fusions skip the whole range walk (~45% of a warm
+    2M⋈500k join) and pay only the gather. Shares the byte-budgeted
+    setup cache. None when the native runtime is unavailable."""
+    from .. import native
+
+    rk = (cache_key, "ranges") if cache_key is not None else None
+    if rk is not None:
+        hit = _SETUP_CACHE.get(rk)
+        if hit is not None:
+            metrics.incr("join.ranges_cache.hit")
+            return hit
+    ranges = native.smj_ranges_full(l_codes, r_codes, l_bounds, r_bounds)
+    if ranges is not None and rk is not None:
+        lo, cnt, off, _total, _n_l = ranges
+        _SETUP_CACHE.put(rk, ranges, lo.nbytes + cnt.nbytes + off.nbytes)
+    return ranges
 
 
 def _bucketed_join_setup(left_by_bucket, right_by_bucket, l_keys, r_keys):
@@ -438,11 +471,11 @@ def _bucketed_join_setup(left_by_bucket, right_by_bucket, l_keys, r_keys):
         hit = _SETUP_CACHE.get(cache_key)
         if hit is not None:
             metrics.incr("join.setup_cache.hit")
-            return hit
+            return hit, cache_key
     common = sorted(set(left_by_bucket) & set(right_by_bucket))
     if not common:
         metrics.incr("join.path.no_common_buckets")
-        return None
+        return None, None
     l_batches = [left_by_bucket[b] for b in common]
     r_batches = [right_by_bucket[b] for b in common]
     l_all = ColumnarBatch.concat(l_batches)
@@ -456,11 +489,18 @@ def _bucketed_join_setup(left_by_bucket, right_by_bucket, l_keys, r_keys):
     l_codes, r_codes = join_codes(l_all, r_all, l_keys, r_keys)
     l_bounds = np.cumsum([0] + [b.num_rows for b in l_batches])
     r_bounds = np.cumsum([0] + [b.num_rows for b in r_batches])
-    setup = (l_all, r_all, l_codes, r_codes, l_bounds, r_bounds)
+    # per-side segment sortedness is a pure function of the (immutable)
+    # setup — computing it here puts it under the cross-query cache
+    # instead of re-scanning both full code arrays every warm join
+    presorted = (
+        _segments_sorted(l_codes, l_bounds),
+        _segments_sorted(r_codes, r_bounds),
+    )
+    setup = (l_all, r_all, l_codes, r_codes, l_bounds, r_bounds, presorted)
     if cache_key is not None:
         if _SETUP_CACHE.put(cache_key, setup, _setup_nbytes(setup)) is setup:
             metrics.incr("join.setup_cache.stored")
-    return setup
+    return setup, cache_key
 
 
 @metrics.timer("join.bucketed_ranges")
@@ -478,11 +518,21 @@ def bucketed_join_ranges(
     (32MB of indices at 2M matches, plus the gathers they feed) are pure
     waste; sums/counts over match ranges need only prefix arithmetic.
     Returns None when there are no common buckets."""
-    setup = _bucketed_join_setup(left_by_bucket, right_by_bucket, l_keys, r_keys)
+    setup, cache_key = _bucketed_join_setup(
+        left_by_bucket, right_by_bucket, l_keys, r_keys
+    )
     if setup is None:
         return None
-    l_all, r_all, l_codes, r_codes, l_bounds, r_bounds = setup
+    l_all, r_all, l_codes, r_codes, l_bounds, r_bounds, presorted = setup
+    if presorted[0] and presorted[1]:
+        ranges = _cached_smj_ranges(
+            cache_key, l_codes, r_codes, l_bounds, r_bounds
+        )
+        if ranges is not None:
+            lo, counts, _off, _total, _n_l = ranges
+            metrics.incr("join.path.native_smj_ranges")
+            return l_all, r_all, lo, counts, None
     lo, counts, r_order = segmented_join_ranges(
-        l_codes, r_codes, l_bounds, r_bounds
+        l_codes, r_codes, l_bounds, r_bounds, presorted=presorted
     )
     return l_all, r_all, lo, counts, r_order
