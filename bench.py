@@ -1010,6 +1010,88 @@ def main() -> None:
             extras["resident_external_s"] = round(ext9_s, 4)
             extras["hbm"] = hbm_cache.snapshot()
 
+            # selectivity EROSION CURVE (round-4 verdict weak #5): sweep
+            # match density over the sorted key and record device vs host
+            # per point, plus the zone-gate's pre-dispatch estimate — the
+            # committed evidence behind the gate's threshold. The gate is
+            # disabled during the sweep (both engines must actually run).
+            if os.environ.get("BENCH_RESIDENT_CURVE", "1") != "0":
+                from hyperspace_tpu.exec.hbm_cache import (
+                    zone_block_fraction,
+                )
+
+                k_span = int(k_sorted[-1]) - int(k_sorted[0])
+                curve = []
+                creps = max(min(REPEATS, 3), 1)
+                prev_gate = os.environ.get(
+                    "HYPERSPACE_TPU_HBM_MAX_BLOCK_FRAC"
+                )
+                prev_mode = os.environ.get("HYPERSPACE_TPU_HBM")
+                os.environ["HYPERSPACE_TPU_HBM_MAX_BLOCK_FRAC"] = "1.0"
+                try:
+                    for frac in (0.0002, 0.01, 0.1, 0.5):
+                        c_lo = int(k_sorted[0])
+                        c_hi = c_lo + max(int(k_span * frac), 1)
+                        cpred = (col("r_k") >= lit(c_lo)) & (
+                            col("r_k") < lit(c_hi)
+                        )
+                        cq = lambda: (  # noqa: E731
+                            session.read.parquet(str(WORKDIR / "resident"))
+                            .filter(
+                                (col("r_k") >= lit(c_lo))
+                                & (col("r_k") < lit(c_hi))
+                            )
+                            .select("r_k")
+                        )
+                        tbl = hbm_cache.resident_for(
+                            sorted(
+                                Path(
+                                    hs.index("li_res_idx").index_location
+                                ).glob("v__=*/*.tcb")
+                            ),
+                            ["r_k"],
+                        )
+                        zf = (
+                            zone_block_fraction(tbl, cpred)
+                            if tbl is not None
+                            else None
+                        )
+                        os.environ["HYPERSPACE_TPU_HBM"] = "auto"
+                        r_d = cq().collect()
+                        d_s = _time(lambda: cq().collect(), creps)
+                        os.environ["HYPERSPACE_TPU_HBM"] = "off"
+                        r_h = cq().collect()
+                        h_s = _time(lambda: cq().collect(), creps)
+                        os.environ["HYPERSPACE_TPU_HBM"] = "auto"
+                        if r_d.num_rows != r_h.num_rows:
+                            _fail("resident curve parity violated")
+                        curve.append(
+                            {
+                                "key_frac": frac,
+                                "zone_block_frac": None
+                                if zf is None
+                                else round(zf, 4),
+                                "rows": int(r_d.num_rows),
+                                "device_s": round(d_s, 4),
+                                "host_s": round(h_s, 4),
+                                "device_wins": bool(d_s < h_s),
+                            }
+                        )
+                finally:
+                    if prev_gate is None:
+                        os.environ.pop(
+                            "HYPERSPACE_TPU_HBM_MAX_BLOCK_FRAC", None
+                        )
+                    else:
+                        os.environ[
+                            "HYPERSPACE_TPU_HBM_MAX_BLOCK_FRAC"
+                        ] = prev_gate
+                    if prev_mode is None:
+                        os.environ.pop("HYPERSPACE_TPU_HBM", None)
+                    else:
+                        os.environ["HYPERSPACE_TPU_HBM"] = prev_mode
+                extras["resident_selectivity_curve"] = curve
+
     # ---- mesh-path A/B (round-4 verdict next-round #1 "done" criterion) ----
     # run on the virtual 8-device CPU mesh in a subprocess (the bench host
     # has ONE physical chip; per-query link-bytes under each architecture

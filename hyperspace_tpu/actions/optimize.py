@@ -123,18 +123,25 @@ class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
         run_offsets = [
             layout.run_bucket_offsets(r.footer) for r in run_readers
         ]
+        from ..telemetry.metrics import metrics
+
         for b in sorted(set(to_optimize) | run_buckets):
-            parts = [
-                layout.read_batch(f.name) for f in to_optimize.get(b, [])
-            ]
-            for reader, offs in zip(run_readers, run_offsets):
-                if b < len(offs) - 1 and offs[b + 1] > offs[b]:
-                    parts.append(
-                        reader.read(row_range=(int(offs[b]), int(offs[b + 1])))
-                    )
-            if not parts:  # bucket emptied (e.g. lineage delete rewrote it)
-                continue
-            merged = parts[0] if len(parts) == 1 else ColumnarBatch.concat(parts)
+            with metrics.timer("optimize.bucket_read"):
+                parts = [
+                    layout.read_batch(f.name) for f in to_optimize.get(b, [])
+                ]
+                for reader, offs in zip(run_readers, run_offsets):
+                    if b < len(offs) - 1 and offs[b + 1] > offs[b]:
+                        parts.append(
+                            reader.read(
+                                row_range=(int(offs[b]), int(offs[b + 1]))
+                            )
+                        )
+                if not parts:  # bucket emptied (e.g. lineage delete)
+                    continue
+                merged = (
+                    parts[0] if len(parts) == 1 else ColumnarBatch.concat(parts)
+                )
             # restore per-bucket sort order on the indexed columns via the
             # shared order-preserving encodings (stream_builder.sort_encoding):
             # strings sort by unified dictionary codes, floats by their
@@ -142,11 +149,15 @@ class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
             # hash and float32 by raw bit pattern (negatives reversed)
             from ..index.stream_builder import sort_encoding
 
-            reprs = [sort_encoding(merged.columns[c]) for c in indexed]
-            order = np.lexsort(list(reversed(reprs)))
-            merged = merged.take(order)
-            p = version_dir / layout.bucket_file_name(b)
-            layout.write_batch(p, merged, sorted_by=list(indexed), bucket=b)
+            with metrics.timer("optimize.bucket_sort"):
+                reprs = [sort_encoding(merged.columns[c]) for c in indexed]
+                order = np.lexsort(list(reversed(reprs)))
+                merged = merged.take(order)
+            with metrics.timer("optimize.bucket_write"):
+                p = version_dir / layout.bucket_file_name(b)
+                layout.write_batch(
+                    p, merged, sorted_by=list(indexed), bucket=b
+                )
             new_paths.append(str(p))
 
         tracker = FileIdTracker()

@@ -494,7 +494,10 @@ class MeshHbmCache(ResidentCacheBase):
     def resident_for(
         self, files: List[Path], columns: List[str], mesh
     ) -> Optional[MeshResidentTable]:
-        if not files:
+        from .hbm_cache import residency_mode
+
+        # mode "off" disables serving too (hbm_cache.resident_for rationale)
+        if not files or residency_mode() == "off":
             return None
         with self._lock:
             if not self._tables:

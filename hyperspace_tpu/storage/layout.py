@@ -103,16 +103,21 @@ def write_batch(
     path = Path(path)
     columns_meta: List[Dict[str, Any]] = []
     offset = 0
-    buffers: List[bytes] = []
+    # (contiguous array, pad bytes) per column: the arrays are handed to
+    # write() as memoryviews — a .tobytes() here would memcpy the whole
+    # batch through user space first, and on this class of host the write
+    # path is the compaction bottleneck (~150 MB/s syscall ceiling;
+    # optimize() at 60M spent 15.5s of 18.2s writing)
+    buffers: List[Tuple[np.ndarray, int]] = []
     for name, col in batch.columns.items():
         data = np.ascontiguousarray(col.data)
-        raw = data.tobytes()
-        pad = _pad(len(raw))
+        nbytes = data.nbytes
+        pad = _pad(nbytes)
         meta: Dict[str, Any] = {
             "name": name,
             "dtype": col.dtype_str,
             "offset": offset,
-            "nbytes": len(raw),
+            "nbytes": nbytes,
         }
         mm = col.min_max()
         if mm is not None:
@@ -120,8 +125,8 @@ def write_batch(
         if is_string(col.dtype_str):
             meta["vocab"] = [v.decode("utf-8", "surrogateescape") for v in col.vocab]
         columns_meta.append(meta)
-        buffers.append(raw + b"\0" * pad)
-        offset += len(raw) + pad
+        buffers.append((data, pad))
+        offset += nbytes + pad
     footer = {
         "version": 1,
         "numRows": batch.num_rows,
@@ -133,13 +138,21 @@ def write_batch(
     footer_bytes = json.dumps(footer).encode("utf-8")
     trailer = footer_bytes + len(footer_bytes).to_bytes(8, "little") + MAGIC
     if fs is not None:
-        fs.write(str(path), b"".join(buffers) + trailer)
+        fs.write(
+            str(path),
+            b"".join(
+                a.tobytes() + b"\0" * pad for a, pad in buffers
+            )
+            + trailer,
+        )
         return
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.parent / f".{path.name}.tmp"
     with open(tmp, "wb") as f:
-        for buf in buffers:
-            f.write(buf)
+        for a, pad in buffers:
+            f.write(memoryview(a).cast("B"))
+            if pad:
+                f.write(b"\0" * pad)
         f.write(trailer)
     os.replace(tmp, path)
 

@@ -68,6 +68,17 @@ import numpy as np
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+# The scale bench is a HOST-side artifact (streaming build + host query
+# engines; the measured routers pick host at these shapes regardless).
+# Pin CPU at the jax-CONFIG level: the TPU plugin overrides the env var
+# alone, and the build engine's inline link check would then touch the
+# real chip — a cold tunnel costs seconds, a wedged one hangs the whole
+# run (observed; same dance as tests/conftest.py and dryrun_multichip).
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 N_ROWS = int(os.environ.get("SCALE_ROWS", 60_000_000))
 N_BUCKETS = int(os.environ.get("SCALE_BUCKETS", 128))
 REPEATS = int(os.environ.get("SCALE_REPEATS", 2))
@@ -333,10 +344,12 @@ def main() -> None:
     t0 = time.perf_counter()
     hs.create_index(df_or, IndexConfig("or_idx", ["o_orderkey"], ["o_totalprice"]))
     build["build_orders_warm_s"] = round(time.perf_counter() - t0, 2)
+    t0 = time.perf_counter()
     hs.create_index(
         df_li,
         IndexConfig("li_q3_idx", ["l_orderkey"], ["l_partkey", "l_quantity"]),
     )
+    build["build_li_q3_warm_s"] = round(time.perf_counter() - t0, 2)
 
     speed, ext_speed, extras = {}, {}, {}
 
@@ -490,15 +503,18 @@ def main() -> None:
         # compaction, not the bench harness's disk housekeeping
         t0 = time.perf_counter()
         hs.optimize_index("li_idx")
-        opt_s = time.perf_counter() - t0
+        opt_li_s = time.perf_counter() - t0
         if os.environ.get("SCALE_PRUNE_OLD_VERSIONS"):
             _prune_versions("li_idx")
         t0 = time.perf_counter()
         hs.optimize_index("li_q3_idx")
-        opt_s += time.perf_counter() - t0
+        opt_q3_s = time.perf_counter() - t0
+        opt_s = opt_li_s + opt_q3_s
         if os.environ.get("SCALE_PRUNE_OLD_VERSIONS"):
             _prune_versions("li_q3_idx")
         extras["optimize_runs_compaction_s"] = round(opt_s, 2)
+        extras["optimize_li_idx_s"] = round(opt_li_s, 2)
+        extras["optimize_li_q3_idx_s"] = round(opt_q3_s, 2)
         post_on = q2().to_pandas().sort_values("l_partkey").reset_index(drop=True)
         if not off.equals(post_on):
             _fail("post-compaction filter parity violated")
@@ -508,6 +524,30 @@ def main() -> None:
             _fail("post-compaction q3 parity violated")
         extras["q3_postopt_s"] = round(_time(lambda: q3().collect(), REPEATS), 3)
         extras["q17_postopt_s"] = round(_time(lambda: q17().collect(), REPEATS), 3)
+        # time-to-first-competitive-query (round-4 verdict next-round #4):
+        # from the start of the Q3-relevant index builds to the first
+        # moment Q3 beats the external engine — on the runs layout when
+        # its ratio already clears 1x, else after li_q3_idx's compaction.
+        # Every leg is measured above; this field just assembles the story.
+        q3_builds_s = build["build_li_q3_warm_s"] + build["build_orders_warm_s"]
+        runs_ratio = ext3_s / q3on_s
+        extras["timeline"] = {
+            "q3_index_builds_s": round(q3_builds_s, 2),
+            "q3_runs_layout_ratio_vs_external": round(runs_ratio, 2),
+            "q3_compaction_s": round(opt_q3_s, 2),
+            "q3_postopt_ratio_vs_external": round(
+                ext3_s / float(extras["q3_postopt_s"]), 2
+            ),
+            # None = Q3 never beats external on either layout (honesty
+            # over a fabricated time-to-competitive)
+            "first_competitive_q3_s": (
+                round(q3_builds_s, 2)
+                if runs_ratio >= 1.0
+                else round(q3_builds_s + opt_q3_s, 2)
+                if ext3_s / float(extras["q3_postopt_s"]) >= 1.0
+                else None
+            ),
+        }
 
     # ---- lifecycle at scale: incremental refresh + optimize ----------------
     # append ~8% fresh rows (5 of 60M) as new source files, then time

@@ -173,12 +173,15 @@ def test_budget_eviction(tmp_path, monkeypatch):
     assert snap["tables"] == 1
 
 
-def test_f64_two_plane_resident_parity(tmp_path):
+def test_f64_two_plane_resident_parity(tmp_path, monkeypatch):
     """float64 rides the device as TWO ordered-int32 planes (round-5;
     previously an f64 conjunct evicted the whole predicate to host).
     eq/ne/range/IN against negative, zero, and fractional literals must
     answer identically to the exact host path — and the device path must
-    actually FIRE."""
+    actually FIRE. (The data here is deliberately UNclustered, so the
+    selectivity zone gate would correctly route host — disable it; its
+    own behavior is pinned by the gate tests below.)"""
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MAX_BLOCK_FRAC", "1.0")
     rng = np.random.default_rng(0)
     n = 4000
     vocab = np.array([b"x", b"y", b"z"], dtype=object)
@@ -239,6 +242,75 @@ def test_f64_nan_data_refused_query_exact(tmp_path):
     host = index_scan([p], ["k"], pred, device=False)
     dev = index_scan([p], ["k"], pred, device=True)
     assert dev.num_rows == host.num_rows
+
+
+def test_selectivity_gate_routes_broad_predicates_host(tmp_path, monkeypatch):
+    """The prefetch-time zone vectors must (a) keep selective predicates
+    on the device path, (b) route a predicate that touches ~every block
+    to host BEFORE any dispatch (round-4 verdict weak #5), with identical
+    results either way."""
+    paths = _write_index_files(tmp_path, rows_per_file=2 * BLOCK_ROWS)
+    t = hbm_cache.prefetch(paths, ["k", "v"])
+    assert t is not None and "k" in t.zones and "v" in t.zones
+
+    from hyperspace_tpu.exec.hbm_cache import zone_block_fraction
+
+    narrow = (col("k") >= lit(5_000)) & (col("k") <= lit(9_000))
+    broad = (col("k") >= lit(0)) & (col("v") >= lit(0))
+    f_narrow = zone_block_fraction(t, narrow)
+    f_broad = zone_block_fraction(t, broad)
+    assert f_narrow is not None and f_narrow < 0.2
+    assert f_broad == 1.0
+    # no usable bounds -> no information -> None (dispatch)
+    assert zone_block_fraction(t, col("k") != lit(3)) is None
+
+    host = index_scan(paths, ["k", "v"], broad, device=False)
+    metrics.reset()
+    dev = index_scan(paths, ["k", "v"], broad, device=True)
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("scan.gate.resident_selectivity") == 1
+    assert snap.get("scan.path.resident_device") is None  # never dispatched
+    assert dev.num_rows == host.num_rows
+
+    metrics.reset()
+    dev2 = index_scan(paths, ["k", "v"], narrow, device=True)
+    assert metrics.snapshot()["counters"].get("scan.path.resident_device") == 1
+    assert dev2.num_rows == index_scan(paths, ["k", "v"], narrow, device=False).num_rows
+
+    # knob: a 1.0 threshold disables the gate entirely
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MAX_BLOCK_FRAC", "1.0")
+    metrics.reset()
+    index_scan(paths, ["k", "v"], broad, device=True)
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("scan.path.resident_device") == 1
+
+
+def test_f64_zone_vectors_gate_conservatively(tmp_path):
+    """f64 zones live in ordered-i64 space; bound encoding must stay
+    conservative (never exclude a block that could match)."""
+    rng = np.random.default_rng(3)
+    n = BLOCK_ROWS * 3
+    d = np.sort(rng.normal(0, 1000.0, n))  # sorted -> tight per-block zones
+    batch = ColumnarBatch(
+        {
+            "d": Column("float64", d),
+            "k": Column("int64", np.arange(n, dtype=np.int64)),
+        }
+    )
+    p = tmp_path / "b00000-abcdef12.tcb"
+    layout.write_batch(p, batch, sorted_by=["k"], bucket=0)
+    t = hbm_cache.prefetch([p], ["d", "k"])
+    assert t is not None and t.zones["d"][0] == "f64ord"
+    from hyperspace_tpu.exec.hbm_cache import zone_block_fraction
+
+    lo_val = float(d[BLOCK_ROWS])  # second block's first value
+    pred = (col("d") >= lit(lo_val)) & (col("d") <= lit(float(d[BLOCK_ROWS + 10])))
+    f = zone_block_fraction(t, pred)
+    assert f is not None and f <= 2 / 3  # at most blocks 1 (+ 0 boundary)
+    # parity through the full scan with the gate live
+    host = index_scan([p], ["k"], pred, device=False)
+    dev = index_scan([p], ["k"], pred, device=True)
+    assert dev.num_rows == host.num_rows > 0
 
 
 def test_expand_f64_predicate_equivalence():
