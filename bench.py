@@ -1037,10 +1037,7 @@ def main() -> None:
                         )
                         cq = lambda: (  # noqa: E731
                             session.read.parquet(str(WORKDIR / "resident"))
-                            .filter(
-                                (col("r_k") >= lit(c_lo))
-                                & (col("r_k") < lit(c_hi))
-                            )
+                            .filter(cpred)
                             .select("r_k")
                         )
                         tbl = hbm_cache.resident_for(

@@ -232,6 +232,13 @@ def zone_block_fraction(
         lo, hi = bounds_for_column(predicate, c)
         if lo is None and hi is None:
             continue
+        # NaN bounds carry no information (NaN never compares true) —
+        # skip the column rather than crash or mis-prune; +-inf bounds
+        # stay as floats (numpy int-vs-inf compares are exact)
+        if (lo is not None and math.isnan(lo)) or (
+            hi is not None and math.isnan(hi)
+        ):
+            continue
         if space == "f64ord":
             from ..ops.floatbits import f64_to_ordered_i64
 
@@ -245,9 +252,11 @@ def zone_block_fraction(
 
             lo = enc(lo, -1) if lo is not None else None
             hi = enc(hi, +1) if hi is not None else None
-        else:  # integer value space: round float bounds inward (exact)
-            lo = math.ceil(lo) if lo is not None else None
-            hi = math.floor(hi) if hi is not None else None
+        else:  # integer value space: round finite float bounds inward
+            if lo is not None and math.isfinite(lo):
+                lo = math.ceil(lo)
+            if hi is not None and math.isfinite(hi):
+                hi = math.floor(hi)
         ok = np.ones(len(zlo), dtype=bool)
         if lo is not None:
             ok &= zhi >= lo

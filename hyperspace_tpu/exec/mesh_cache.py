@@ -133,6 +133,16 @@ def _bucket_segments(paths: List[Path]) -> Dict[int, List[Tuple[str, int, int]]]
     return out
 
 
+# NOTE — no selectivity gate on the MESH resident path, deliberately.
+# The single-chip gate (exec.scan) routes broad predicates to a host
+# fallback that is genuinely cheaper there: an mmap scan with no device
+# work at all. On a mesh session the fallback is the SHIP-per-query path
+# (full column re-upload + the same dispatch + full-result compaction),
+# which the resident path strictly dominates at every match density —
+# the resident query's cost is one dispatch plus reads of matching
+# blocks, a subset of the ship path's work. Zone vectors would gate
+# nothing, so none are built.
+
 _counts_fn_cache: dict = {}
 _counts_fn_lock = threading.Lock()
 

@@ -645,3 +645,98 @@ def sorted_intersect_counts(
             lt[s:e] = np.searchsorted(r32, q, side="left")
             eq[s:e] = np.searchsorted(r32, q, side="right") - lt[s:e]
     return lt, eq
+
+
+# ---------------------------------------------------------------------------
+# Kernel 3: device-fused aggregate-over-join (the Q17 engine candidate)
+# ---------------------------------------------------------------------------
+_fused_agg_cache: dict = {}
+
+
+def resident_fused_agg_over_join(
+    l_keys: np.ndarray,
+    r_sorted: np.ndarray,
+    r_vals_sorted: np.ndarray,
+    l_groups: np.ndarray,
+    n_groups: int,
+):
+    """ONE-dispatch Q17-shaped engine over device-resident join operands:
+    sorted-intersect match counts + per-left-row right-value range sums
+    (prefix-difference arithmetic — exact int64, wraparound cancels) +
+    dense per-group accumulation, all inside a single jitted program. The
+    D2H is the per-group partial table (2 × n_groups int64), NOT the
+    O(rows) match ranges whose link cost ruled the plain device SMJ out
+    (JOIN_CROSSOVER round-4 decision; this kernel re-litigates it with
+    the one output shape that sidesteps that D2H term —
+    JoinIndexRule.scala:39-50 is why the bucketed join is the marquee op).
+
+    Returns a zero-arg callable dispatching against pre-uploaded operands
+    and returning DEVICE ``(group_pair_counts, group_value_sums)`` int64
+    arrays of length ``n_groups`` — sum/count/avg per group derive on
+    host; min/max are out of scope (range-min needs a different
+    program). None when the inputs refuse (empty sides, non-int dtypes,
+    group codes out of range)."""
+    n_l, n_r = len(l_keys), len(r_sorted)
+    if n_l == 0 or n_r == 0 or n_groups <= 0:
+        return None
+    if l_keys.dtype.kind not in "iu" or r_sorted.dtype.kind not in "iu":
+        return None
+    if r_vals_sorted.dtype.kind not in "iu" or len(r_vals_sorted) != n_r:
+        return None
+    if len(l_groups) != n_l:
+        return None
+    # range-check BEFORE the int32 cast: a 2^32-offset code would wrap
+    # into range and silently corrupt the aggregation
+    if len(l_groups) and (
+        int(np.min(l_groups)) < 0 or int(np.max(l_groups)) >= n_groups
+    ):
+        return None
+    g = np.ascontiguousarray(l_groups, dtype=np.int32)
+    from ..utils.intmath import next_pow2
+
+    import jax
+    import jax.numpy as jnp
+
+    n_pad = next_pow2(n_l)
+    l_pad = np.full(n_pad, np.iinfo(np.int64).max, dtype=np.int64)
+    l_pad[:n_l] = l_keys
+    g_pad = np.zeros(n_pad, dtype=np.int32)
+    g_pad[:n_l] = g  # pad keys match nothing, so group 0 gains zeros
+    # prefix sums host-side once (operand prep, amortized with the
+    # uploads); int64 wraparound in the cumsum cancels in the difference
+    rvc = np.zeros(n_r + 1, dtype=np.int64)
+    np.cumsum(r_vals_sorted.astype(np.int64), out=rvc[1:])
+
+    key = (n_pad, n_r + 1, int(n_groups))
+    fn = _fused_agg_cache.get(key)
+    if fn is None:
+
+        def prog(l, grp, r, rvc_d):
+            lt = jnp.searchsorted(r, l, side="left")
+            le = jnp.searchsorted(r, l, side="right")
+            cnt = le - lt
+            rsum = rvc_d[le] - rvc_d[lt]
+            gc = jax.ops.segment_sum(cnt, grp, num_segments=n_groups)
+            gs = jax.ops.segment_sum(rsum, grp, num_segments=n_groups)
+            return gc, gs
+
+        fn = jax.jit(prog)
+        if len(_fused_agg_cache) >= 64:
+            _fused_agg_cache.pop(next(iter(_fused_agg_cache)))
+        _fused_agg_cache[key] = fn
+
+    d_args = [
+        jax.device_put(a)
+        for a in (
+            l_pad,
+            g_pad,
+            np.ascontiguousarray(r_sorted, dtype=np.int64),
+            rvc,
+        )
+    ]
+    jax.block_until_ready(d_args)
+
+    def run():
+        return fn(*d_args)
+
+    return run

@@ -42,6 +42,17 @@ import numpy as np
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+# honor an explicit JAX_PLATFORMS at the CONFIG level: the TPU plugin
+# overrides the env var alone, so a CPU smoke of this script would
+# otherwise initialize (and on a wedged tunnel, hang on) the real chip
+import os  # noqa: E402
+
+_env_platform = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+if _env_platform:
+    import jax as _jax  # noqa: E402
+
+    _jax.config.update("jax_platforms", _env_platform)
+
 
 def _timed(fn, repeats=3):
     fn()
@@ -89,7 +100,14 @@ def main() -> None:
         )
 
         row = {"rows_per_side": n, "host_smj_s": round(host_s, 4)}
-        run = K.resident_sorted_intersect(l_keys, r_keys)
+        try:
+            run = (
+                K.resident_sorted_intersect(l_keys, r_keys)
+                if K.kernels_mode() != "off"
+                else None
+            )
+        except Exception:  # noqa: BLE001 - backend can't run the kernel
+            run = None
         if run is None:
             row["device"] = "kernel declined"
         else:
@@ -106,6 +124,75 @@ def main() -> None:
             row["winner"] = (
                 "host"
                 if host_s <= row["device_counts_d2h_s"]
+                else "device"
+            )
+
+        # --- the device-FUSED aggregate-over-join (round-4 verdict
+        # next-round #2): one dispatch computes per-group (pair counts,
+        # right-value sums) from the resident operands — D2H is the
+        # per-group partial table, not the O(rows) ranges that lose the
+        # link above. Host comparison: the engine's actual Q17 fusion
+        # (range walk + native group-agg, no pair expansion).
+        n_groups = max(n >> 4, 1)  # Q17-ish: ~6% distinct groups
+        l_groups = rng.integers(0, n_groups, n).astype(np.int64)
+        from hyperspace_tpu.exec.aggregate import aggregate_join_ranges
+        from hyperspace_tpu.exec.joins import bucketed_join_ranges
+        from hyperspace_tpu.plan.aggregates import agg_count, agg_sum
+        from hyperspace_tpu.storage.columnar import (
+            Column as _C,
+            ColumnarBatch as _CB,
+        )
+
+        left_g = {
+            0: _CB(
+                {
+                    "k": _C("int64", l_keys),
+                    "g": _C("int64", l_groups),
+                }
+            )
+        }
+
+        def host_fused():
+            rj = bucketed_join_ranges(left_g, right, ["k"], ["k2"])
+            l_all, r_all, lo, cnts, r_order = rj
+            return aggregate_join_ranges(
+                l_all,
+                r_all,
+                ["g"],
+                [agg_sum("rv", "s"), agg_count()],
+                lo,
+                cnts,
+                r_order,
+            )
+
+        host_ref = host_fused()
+        row["host_fused_agg_s"] = round(_timed(host_fused), 4)
+        fused = K.resident_fused_agg_over_join(
+            l_keys, r_keys, r_vals.astype(np.int64), l_groups, n_groups
+        )
+        if fused is None:
+            row["device_fused_agg"] = "kernel declined"
+        else:
+            row["device_fused_agg_s"] = round(
+                _timed(lambda: jax.block_until_ready(fused())), 4
+            )
+
+            def fused_d2h():
+                gc, gs = fused()
+                np.asarray(gc)
+                np.asarray(gs)
+
+            row["device_fused_agg_d2h_s"] = round(_timed(fused_d2h), 4)
+            row["fused_d2h_bytes"] = 2 * 8 * n_groups
+            # parity: per-group sums must agree with the host engine
+            gc, gs = (np.asarray(a) for a in fused())
+            hd = host_ref.to_pandas().set_index("g").sort_index()
+            nz = np.flatnonzero(gc)
+            assert np.array_equal(nz, hd.index.to_numpy()), "group parity"
+            assert np.array_equal(gs[nz], hd["s"].to_numpy()), "sum parity"
+            row["fused_winner"] = (
+                "host"
+                if row["host_fused_agg_s"] <= row["device_fused_agg_d2h_s"]
                 else "device"
             )
         out["sizes"].append(row)
