@@ -679,9 +679,23 @@ def resident_fused_agg_over_join(
     n_l, n_r = len(l_keys), len(r_sorted)
     if n_l == 0 or n_r == 0 or n_groups <= 0:
         return None
-    if l_keys.dtype.kind not in "iu" or r_sorted.dtype.kind not in "iu":
+
+    def _int64_safe(a: np.ndarray) -> bool:
+        # signed ints embed exactly; unsigned only up to 32 bits (uint64
+        # >= 2**63 would wrap negative in the int64 cast and de-sort the
+        # operands into silently wrong aggregates)
+        return a.dtype.kind == "i" or (
+            a.dtype.kind == "u" and a.dtype.itemsize <= 4
+        )
+
+    if not (_int64_safe(l_keys) and _int64_safe(r_sorted)):
         return None
-    if r_vals_sorted.dtype.kind not in "iu" or len(r_vals_sorted) != n_r:
+    if not _int64_safe(r_vals_sorted) or len(r_vals_sorted) != n_r:
+        return None
+    if int(r_sorted[-1]) == np.iinfo(np.int64).max:
+        # the left-pad sentinel is int64-max; a real right key equal to
+        # it would let pad rows silently inflate group 0 (same guard
+        # rationale as _plan_sorted_intersect's range normalization)
         return None
     if len(l_groups) != n_l:
         return None
