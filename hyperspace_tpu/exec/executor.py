@@ -689,11 +689,22 @@ class Executor:
             groups = self._group_batches_by_bucket(files, batches)
             groups = _store_bucket_groups(cache_key, groups) or groups
         if predicate is not None:
-            groups = {
+            out = {
                 b: filtered
                 for b, v in groups.items()
                 if (filtered := self._apply_predicate(v, predicate)).num_rows
             }
+            tok = getattr(groups, "cache_token", None)
+            if tok is not None:
+                # a DERIVED token: the filtered side is a pure function of
+                # (immutable files, projection, predicate) — repr of the
+                # expression tree is deterministic — so repeat FILTERED
+                # joins (the Q3/Q17 shape) hit the cross-query setup and
+                # ranges caches too, not just unfiltered ones
+                tagged = BucketGroups(out)
+                tagged.cache_token = (tok, ("pred", repr(predicate)))
+                return tagged
+            return out
         return groups
 
     def _repartition_by_bucket(
@@ -890,11 +901,15 @@ _GROUPS_CACHE = ByteCappedLru(_groups_cache_cap)
 
 
 class BucketGroups(dict):
-    """A bucket→batch dict carrying the identity it was cached under.
-    The token marks the object as PRISTINE (exactly the bytes of those
-    immutable index files, no predicate applied) — joins.py keys its
-    cross-query setup cache on it. Every filtering/transforming path
-    builds plain dicts, which silently opt out."""
+    """A bucket→batch dict carrying the identity it was cached under —
+    joins.py keys its cross-query setup cache on it. The token is sound
+    iff the groups are a PURE FUNCTION of it: pristine loads carry
+    (file identities, projection); projections extend the token with the
+    column list; predicate filtering extends it with the expression repr
+    (deterministic, value-based — round 5). Any transform whose output
+    is NOT derivable from the token alone (e.g. hybrid-scan merges with
+    dynamic appended data) must build a plain dict, which silently opts
+    out of every cross-query cache."""
 
     cache_token: tuple = None
 

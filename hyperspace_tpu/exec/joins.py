@@ -417,9 +417,11 @@ def _setup_cache_budget() -> int:
     return _env_mb("HYPERSPACE_TPU_JOIN_CACHE_MB", 512)
 
 
-# entry cap covers setup + ranges entries per distinct join (2 each);
-# byte budget is the real bound
-_SETUP_CACHE = ByteCappedLru(_setup_cache_budget, entry_cap=8)
+# entry cap covers setup + ranges entries (2 each) per distinct
+# (join, projection, predicate) shape — derived tokens multiply the key
+# space by predicate variant (round 5), so the cap leaves headroom for a
+# dozen live shapes; the byte budget is the real bound
+_SETUP_CACHE = ByteCappedLru(_setup_cache_budget, entry_cap=24)
 
 
 def _setup_nbytes(setup) -> int:

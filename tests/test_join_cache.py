@@ -84,26 +84,40 @@ def test_repeat_join_hits_both_caches_with_parity(tmp_path):
     assert truth.num_rows == second.num_rows
 
 
-def test_filtered_sides_bypass_setup_cache(tmp_path):
+def test_filtered_sides_cache_under_derived_token(tmp_path):
+    """Round 5: predicate-filtered sides carry a DERIVED token (pristine
+    token + predicate repr) — a pure function of the immutable files —
+    so repeat filtered joins hit the setup cache under their OWN key
+    (previously they opted out entirely), a DIFFERENT predicate misses,
+    and results always match the hyperspace-off truth."""
     session, hs, q = _setup(tmp_path)
-    qf = lambda: (  # noqa: E731
-        session.read.parquet(str(tmp_path / "l"))
-        .filter(col("lv") > lit(50))
-        .join(session.read.parquet(str(tmp_path / "r")), col("lk") == col("rk"))
-        .select("lv", "rv")
-    )
+
+    def qf(cut):
+        return (
+            session.read.parquet(str(tmp_path / "l"))
+            .filter(col("lv") > lit(cut))
+            .join(
+                session.read.parquet(str(tmp_path / "r")),
+                col("lk") == col("rk"),
+            )
+            .select("lv", "rv")
+        )
+
     metrics.reset()
-    a = qf().collect()
-    b = qf().collect()
+    a = qf(50).collect()
+    b = qf(50).collect()
     snap = metrics.snapshot()["counters"]
-    # groups cache may hit (pre-predicate load) but the filtered sides are
-    # plain dicts: the setup cache must never serve them
-    assert snap.get("join.setup_cache.hit", 0) == 0
+    assert snap.get("join.setup_cache.hit", 0) == 1
     assert a.num_rows == b.num_rows
+    # different predicate -> different derived token -> no stale serve
+    c = qf(90).collect()
+    assert c.num_rows < a.num_rows
     session.disable_hyperspace()
-    truth = qf().collect()
+    truth = qf(50).collect()
     assert truth.num_rows == a.num_rows
     assert int(truth.columns["lv"].data.sum()) == int(a.columns["lv"].data.sum())
+    truth90 = qf(90).collect()
+    assert truth90.num_rows == c.num_rows
 
 
 def test_refresh_invalidates_by_file_identity(tmp_path):
@@ -135,3 +149,74 @@ def test_cache_disabled_by_env(tmp_path, monkeypatch):
     snap = metrics.snapshot()["counters"]
     assert snap.get("join.cache.hit", 0) == 0
     assert snap.get("join.setup_cache.hit", 0) == 0
+
+
+def test_filtered_join_sides_hit_setup_cache(tmp_path):
+    """Q3-shaped repeat joins (predicate-filtered sides) must reuse the
+    cross-query setup/ranges caches through the DERIVED token — round 5;
+    previously any filter opted the whole join out of the caches."""
+    import numpy as np
+
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.exec.executor import Executor
+    from hyperspace_tpu.plan.expr import col
+    from hyperspace_tpu.plan.ir import Filter, Join, Project, Scan
+    from hyperspace_tpu.plan.rules import apply_hyperspace_rules
+    from hyperspace_tpu.storage.columnar import ColumnarBatch
+    from hyperspace_tpu.telemetry.metrics import metrics
+    from tests.e2e_utils import assert_row_parity, build_index, write_source
+
+    rng = np.random.default_rng(21)
+    li = ColumnarBatch.from_pydict(
+        {
+            "l_k": rng.integers(0, 200, 4000).astype(np.int64),
+            "l_q": rng.integers(1, 50, 4000).astype(np.int64),
+            "l_v": rng.integers(0, 10**6, 4000).astype(np.int64),
+        }
+    )
+    orders = ColumnarBatch.from_pydict(
+        {
+            "o_k": (rng.permutation(600) % 200).astype(np.int64),
+            "o_t": rng.integers(0, 9000, 600).astype(np.int64),
+        }
+    )
+    l_rel = write_source(tmp_path / "li", li, n_files=2)
+    o_rel = write_source(tmp_path / "or", orders, n_files=2)
+    l_entry = build_index("lj", l_rel, ["l_k"], ["l_q", "l_v"], tmp_path / "ix")
+    o_entry = build_index("oj", o_rel, ["o_k"], ["o_t"], tmp_path / "ix")
+    conf = HyperspaceConf()
+    plan = Project(
+        ("l_v", "o_t"),
+        Join(
+            Filter(col("l_q") > 25, Scan(l_rel)),
+            Filter(col("o_t") < 5000, Scan(o_rel)),
+            col("l_k") == col("o_k"),
+            "inner",
+        ),
+    )
+    rewritten, applied = apply_hyperspace_rules(plan, [l_entry, o_entry], conf)
+    assert len(applied) == 2
+    ex = Executor(conf)
+    first = ex.execute(rewritten)
+    before_hit = metrics.counter("join.setup_cache.hit")
+    second = ex.execute(rewritten)
+    assert metrics.counter("join.setup_cache.hit") == before_hit + 1
+    assert_row_parity(first, second)
+    assert first.num_rows > 0
+
+    # a DIFFERENT predicate must not hit the same entry (derived token
+    # includes the expression repr)
+    plan2 = Project(
+        ("l_v", "o_t"),
+        Join(
+            Filter(col("l_q") > 40, Scan(l_rel)),
+            Filter(col("o_t") < 5000, Scan(o_rel)),
+            col("l_k") == col("o_k"),
+            "inner",
+        ),
+    )
+    rewritten2, _ = apply_hyperspace_rules(plan2, [l_entry, o_entry], conf)
+    before_hit = metrics.counter("join.setup_cache.hit")
+    r2 = ex.execute(rewritten2)
+    assert metrics.counter("join.setup_cache.hit") == before_hit
+    assert 0 < r2.num_rows < first.num_rows
