@@ -400,13 +400,15 @@ def bucketed_join_pairs(
     return [j] if j.num_rows else []
 
 
-# Setup results for UNFILTERED joins over cross-query-cached sides are
-# themselves a pure function of (side file identities, projections, join
+# Setup results for joins over cross-query-cached sides are themselves a
+# pure function of (side file identities, projections, predicate, join
 # keys) — index files are immutable. Repeat joins were re-paying the
 # common-bucket concat, dictionary unification, and code derivation
 # (~40% of a warm 2M⋈500k join) every query. Keyed by the sides' cache
-# TOKENS (exec.executor attaches them ONLY to pristine cached groups —
-# any predicate filtering yields plain dicts and skips this cache).
+# TOKENS (exec.executor attaches them to pristine cached groups and,
+# since round 5, to predicate-filtered views via DERIVED tokens that
+# fold in the expression repr; transforms not derivable from a token
+# yield plain dicts and opt out).
 # Budget: the same HYPERSPACE_TPU_JOIN_CACHE_MB as the groups cache,
 # bounded independently (total join-cache memory <= 2x the knob); setups
 # hold fresh whole-side concats, so an entry cap alone could pin GBs.

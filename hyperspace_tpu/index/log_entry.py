@@ -125,12 +125,16 @@ class Directory:
     # -- builders ------------------------------------------------------------
     @staticmethod
     def from_leaf_files(
-        paths: Iterable[str], tracker: "FileIdTracker"
+        paths: Iterable[str],
+        tracker: "FileIdTracker",
+        stats: Optional[Dict[str, Tuple[int, int]]] = None,
     ) -> Optional["Directory"]:
         """Build a rooted tree from absolute leaf-file paths, assigning file
         ids via ``tracker`` (IndexLogEntry.scala:238-316). Returns None for
         an empty input. Paths must be absolute; the root of the returned
-        tree is the filesystem root ("/")."""
+        tree is the filesystem root ("/"). ``stats`` (path -> (size,
+        mtime_ms)) lets a caller that already statted the tree (one
+        scandir pass) avoid a second stat per file."""
         paths = sorted(str(p) for p in paths)
         if not paths:
             return None
@@ -139,8 +143,15 @@ class Directory:
             pp = PurePosixPath(p)
             if not pp.is_absolute():
                 raise HyperspaceException(f"from_leaf_files requires absolute paths: {p}")
-            st = os.stat(p)
-            size, mtime = st.st_size, int(st.st_mtime * 1000)
+            pre = stats.get(p) if stats is not None else None
+            if pre is not None:
+                size, mtime = pre
+            else:
+                st = os.stat(p)
+                # ns-derived ms, NOT int(st_mtime * 1000): the float form
+                # rounds differently by up to 1ms, and a grain mismatch
+                # between stat sites would read as a phantom modification
+                size, mtime = st.st_size, st.st_mtime_ns // 1_000_000
             fid = tracker.add_file(p, size, mtime)
             node = root
             for part in pp.parts[1:-1]:
@@ -209,9 +220,11 @@ class Content:
 
     @staticmethod
     def from_leaf_files(
-        paths: Iterable[str], tracker: "FileIdTracker"
+        paths: Iterable[str],
+        tracker: "FileIdTracker",
+        stats: Optional[Dict[str, Tuple[int, int]]] = None,
     ) -> Optional["Content"]:
-        root = Directory.from_leaf_files(paths, tracker)
+        root = Directory.from_leaf_files(paths, tracker, stats)
         return Content(root) if root is not None else None
 
 

@@ -39,11 +39,102 @@ def _infer_schema(file_format: str, sample_path: str) -> Dict[str, str]:
     return batch.schema()
 
 
+# Per-file-signature snapshot memo: every DataFrame construction
+# re-lists its source (fresh-snapshot semantics), and at 64-file sources
+# the FileInfo/content-tree construction plus downstream per-call work
+# dominates sub-5ms indexed queries. The listing + one stat per file
+# ALWAYS happen (so in-place rewrites, appends, and deletes are all
+# seen — the signature staleness detection the hybrid scan rests on is
+# unaffected); only the derived construction is memoized, keyed by the
+# exact (path, size, mtime_ns) tuple it is a pure function of. Opt out
+# with HYPERSPACE_TPU_SNAPSHOT_MEMO=off.
+_SNAPSHOT_MEMO: dict = {}
+_SNAPSHOT_MEMO_MAX = 64
+
+
+def _walk_stats(root_paths: List[str]):
+    """One scandir pass collecting (path, size, mtime_ns) for every leaf
+    file, with the same hidden/underscore skip rules and global path sort
+    as file_utils.list_leaf_files (DirEntry stats ride the directory read
+    — one syscall pass instead of walk + stat-per-file)."""
+    import os as _os
+
+    out = []
+    for p in file_utils.expand_globs(root_paths):
+        if p.is_file():
+            st = p.stat()
+            out.append((str(p), st.st_size, st.st_mtime_ns))
+            continue
+        stack = [str(p)]
+        while stack:
+            d = stack.pop()
+            with _os.scandir(d) as entries:
+                for e in entries:
+                    if e.name.startswith((".", "_")):
+                        continue
+                    if e.is_dir(follow_symlinks=False):
+                        stack.append(e.path)
+                    elif e.is_file():
+                        st = e.stat()
+                        out.append((e.path, st.st_size, st.st_mtime_ns))
+    out.sort()
+    return out
+
+
 def _snapshot_files(root_paths: List[str]) -> List[FileInfo]:
+    import os as _os
+
+    try:
+        stats = _walk_stats(root_paths)
+    except OSError:
+        stats = None
+    if stats is None:  # unstatable mid-walk: the slow exact path decides
+        paths = [str(p) for p in file_utils.list_leaf_files(root_paths)]
+        sig = None
+        pre = None
+    else:
+        paths = [p for p, _, _ in stats]
+        sig = tuple(stats)
+        # mtime in ms: the FileInfo identity grain (the memo signature
+        # keeps full ns precision)
+        pre = {p: (size, mt_ns // 1_000_000) for p, size, mt_ns in stats}
+    if (
+        sig is not None
+        and _os.environ.get("HYPERSPACE_TPU_SNAPSHOT_MEMO", "on").lower()
+        != "off"
+    ):
+        key = tuple(str(p) for p in root_paths)
+        hit = _SNAPSHOT_MEMO.get(key)
+        if hit is not None and hit[0] == sig:
+            return list(hit[1])  # defensive copy: callers own their list
+    else:
+        key = None
     tracker = FileIdTracker()
-    paths = [str(p) for p in file_utils.list_leaf_files(root_paths)]
-    content = Content.from_leaf_files(paths, tracker)
-    return content.file_infos() if content else []
+    content = Content.from_leaf_files(paths, tracker, pre)
+    files = content.file_infos() if content else []
+    if key is not None:
+        if len(_SNAPSHOT_MEMO) >= _SNAPSHOT_MEMO_MAX:
+            _SNAPSHOT_MEMO.pop(next(iter(_SNAPSHOT_MEMO)))
+        _SNAPSHOT_MEMO[key] = (sig, files)
+    return list(files) if key is not None else files
+
+
+# schema inference reads a sample file (parquet footer / avro header) —
+# per-call it was the bulk of sub-5ms indexed queries' fixed cost. The
+# result is a pure function of the sample file's exact identity.
+_SCHEMA_MEMO: dict = {}
+
+
+def _infer_schema_memoized(file_format: str, sample: FileInfo):
+    key = (file_format, sample.name, sample.size, sample.modified_time)
+    hit = _SCHEMA_MEMO.get(key)
+    if hit is not None:
+        return dict(hit)
+    schema = _infer_schema(file_format, sample.name)
+    if len(_SCHEMA_MEMO) >= _SNAPSHOT_MEMO_MAX:
+        _SCHEMA_MEMO.pop(next(iter(_SCHEMA_MEMO)))
+    _SCHEMA_MEMO[key] = dict(schema)
+    return schema
 
 
 def _concrete_bases(root_paths) -> List[str]:
@@ -136,7 +227,7 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
                 raise HyperspaceException(
                     f"Cannot infer schema: no files under {root_paths}."
                 )
-            schema = _infer_schema(file_format, files[0].name)
+            schema = _infer_schema_memoized(file_format, files[0])
             if spec is not None:
                 clash = [n for n in spec.names if n in schema]
                 if clash:

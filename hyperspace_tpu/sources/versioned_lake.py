@@ -107,7 +107,7 @@ class VersionedLakeTable:
         parquet_io.write_parquet(p, batch)
         st = p.stat()
         return self.commit(
-            [{"path": name, "size": st.st_size, "mtime": int(st.st_mtime * 1000)}],
+            [{"path": name, "size": st.st_size, "mtime": st.st_mtime_ns // 1_000_000}],
             [],
         )
 

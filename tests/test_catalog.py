@@ -139,3 +139,85 @@ def test_view_over_foreign_session_dataframe_rejected(env, tmp_workspace):
     foreign = other.read.parquet(str(ws / "li"))
     with pytest.raises(HyperspaceException):
         session.catalog.create_or_replace_temp_view("v", foreign)
+
+
+def test_snapshot_memo_sees_every_mutation(tmp_path, monkeypatch):
+    """The snapshot memo (sources.default) must never weaken freshness:
+    appends, deletes, AND in-place rewrites (no rename — pyarrow's write
+    path) all invalidate; =off disables; _walk_stats matches
+    list_leaf_files on nested trees with hidden/underscore entries."""
+    import numpy as np
+
+    from hyperspace_tpu.sources import default as D
+    from hyperspace_tpu.storage import parquet_io
+    from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+    from hyperspace_tpu.utils import file_utils
+
+    src = tmp_path / "src"
+    (src / "nested").mkdir(parents=True)
+    (src / "_hidden").mkdir()
+    b = ColumnarBatch({"k": Column("int64", np.arange(10, dtype=np.int64))})
+    parquet_io.write_parquet(src / "a.parquet", b)
+    parquet_io.write_parquet(src / "nested" / "b.parquet", b)
+    parquet_io.write_parquet(src / "_hidden" / "skip.parquet", b)
+    (src / ".dotfile").write_bytes(b"x")
+
+    # _walk_stats parity with list_leaf_files (filtering + order)
+    walked = [p for p, _, _ in D._walk_stats([str(src)])]
+    assert walked == [str(p) for p in file_utils.list_leaf_files([str(src)])]
+
+    f1 = D._snapshot_files([str(src)])
+    f2 = D._snapshot_files([str(src)])
+    assert [x.name for x in f1] == [x.name for x in f2]
+    assert f2 is not f1  # defensive copy, never the cached list itself
+
+    # in-place rewrite (same name, direct open — no rename)
+    b2 = ColumnarBatch(
+        {"k": Column("int64", np.arange(20, dtype=np.int64))}
+    )
+    parquet_io.write_parquet(src / "a.parquet", b2)
+    f3 = D._snapshot_files([str(src)])
+    info1 = {x.name: (x.size, x.modified_time) for x in f1}
+    info3 = {x.name: (x.size, x.modified_time) for x in f3}
+    changed = str(src / "a.parquet")
+    assert info1[changed] != info3[changed]
+
+    # append + delete
+    parquet_io.write_parquet(src / "c.parquet", b)
+    assert len(D._snapshot_files([str(src)])) == len(f3) + 1
+    (src / "c.parquet").unlink()
+    assert len(D._snapshot_files([str(src)])) == len(f3)
+
+    # knob: off bypasses the memo entirely (fresh construction each call)
+    monkeypatch.setenv("HYPERSPACE_TPU_SNAPSHOT_MEMO", "off")
+    f_off = D._snapshot_files([str(src)])
+    assert [x.name for x in f_off] == [x.name for x in f3]
+
+
+def test_schema_memo_invalidates_on_sample_change(tmp_path):
+    import numpy as np
+
+    from hyperspace_tpu.sources import default as D
+    from hyperspace_tpu.storage import parquet_io
+    from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+    src = tmp_path / "s"
+    src.mkdir()
+    parquet_io.write_parquet(
+        src / "a.parquet",
+        ColumnarBatch({"k": Column("int64", np.arange(5, dtype=np.int64))}),
+    )
+    files = D._snapshot_files([str(src)])
+    s1 = D._infer_schema_memoized("parquet", files[0])
+    assert s1 == {"k": "int64"}
+    s1["poison"] = "x"  # memo must hand out copies
+    assert D._infer_schema_memoized("parquet", files[0]) == {"k": "int64"}
+    # rewrite with a different schema: new identity -> re-inferred
+    parquet_io.write_parquet(
+        src / "a.parquet",
+        ColumnarBatch(
+            {"v": Column("float64", np.ones(5))}
+        ),
+    )
+    files2 = D._snapshot_files([str(src)])
+    assert D._infer_schema_memoized("parquet", files2[0]) == {"v": "float64"}
