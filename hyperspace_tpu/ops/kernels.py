@@ -355,12 +355,7 @@ def resident_sorted_intersect(l_keys: np.ndarray, r_sorted: np.ndarray):
     import jax
 
     with _x32():
-        fn = _smj_call_cache.get(key)
-        if fn is None:
-            fn = _build_smj_call(*key[:3])
-            if len(_smj_call_cache) >= 256:
-                _smj_call_cache.pop(next(iter(_smj_call_cache)))
-            _smj_call_cache[key] = fn
+        fn = _get_smj_call(key)
         d_args = [jax.device_put(a) for a in (s_tile, span, base, l2, r2)]
         jax.block_until_ready(d_args)
 
@@ -412,12 +407,7 @@ def resident_smj_amortized(
         if wide.any():
             return None
         with _x32():
-            fn = _smj_call_cache.get(key)
-            if fn is None:
-                fn = _build_smj_call(*key[:3])
-                if len(_smj_call_cache) >= 256:
-                    _smj_call_cache.pop(next(iter(_smj_call_cache)))
-                _smj_call_cache[key] = fn
+            fn = _get_smj_call(key)
             d = [jax.device_put(a) for a in (s_tile, span, base, l2, r2)]
             jax.block_until_ready(d)
 
@@ -433,8 +423,14 @@ def resident_smj_amortized(
             )
 
         one, many = loop(1), loop(iters)
-        _, w1 = timer(lambda: jax.block_until_ready(one()), repeats)
-        _, wk = timer(lambda: jax.block_until_ready(many()), repeats)
+        # fence by MATERIALIZING the scalar, not block_until_ready: the
+        # tunneled backend acknowledges enqueue before execution (a
+        # block-fenced 33-iteration loop measured 0.0s; the materialized
+        # one 3ms/iter), and only a D2H read observes completion. The
+        # round trip this adds is identical in w1 and wk and cancels in
+        # the difference.
+        _, w1 = timer(lambda: np.asarray(one()), repeats)
+        _, wk = timer(lambda: np.asarray(many()), repeats)
     return max(wk - w1, 1e-9) / (iters - 1)
 
 
@@ -443,6 +439,18 @@ def resident_smj_amortized(
 # ---------------------------------------------------------------------------
 
 _smj_call_cache: dict = {}
+
+
+def _get_smj_call(key):
+    """Compiled SMJ pallas call for a plan key, via the bounded cache.
+    Call under ``_x32()`` — the build traces 32-bit index maps."""
+    fn = _smj_call_cache.get(key)
+    if fn is None:
+        fn = _build_smj_call(*key[:3])
+        if len(_smj_call_cache) >= 256:
+            _smj_call_cache.pop(next(iter(_smj_call_cache)))
+        _smj_call_cache[key] = fn
+    return fn
 
 
 def _tile_min_max(a32: np.ndarray, tile: int, n_tiles: int):
@@ -629,12 +637,7 @@ def sorted_intersect_counts(
     s_tile, span, base, l2, r2, key, l32, r32, wide = plan
     l_tile = SMJ_L_SUBLANES * LANES
     with _x32():
-        fn = _smj_call_cache.get(key)
-        if fn is None:
-            fn = _build_smj_call(*key[:3])
-            if len(_smj_call_cache) >= 256:
-                _smj_call_cache.pop(next(iter(_smj_call_cache)))
-            _smj_call_cache[key] = fn
+        fn = _get_smj_call(key)
         lt, eq = fn(s_tile, span, base, l2, r2)
     lt = np.asarray(lt).reshape(-1)[:n_l].astype(np.int64)
     eq = np.asarray(eq).reshape(-1)[:n_l].astype(np.int64)
@@ -669,6 +672,14 @@ def resident_fused_agg_over_join(
     (JOIN_CROSSOVER round-4 decision; this kernel re-litigates it with
     the one output shape that sidesteps that D2H term —
     JoinIndexRule.scala:39-50 is why the bucketed join is the marquee op).
+
+    Engine selection inside: when the Pallas sorted-intersect plan
+    accepts the operands (int32-narrowable, no wide tiles), the match
+    counts come from the same VPU dense-compare kernel the plain device
+    SMJ uses, chained into a jitted gather/segment-sum epilogue — two
+    dispatches, zero intermediate D2H. Otherwise the whole program runs
+    as XLA ``searchsorted`` + ``segment_sum`` (one dispatch, s64 binary
+    search — correct everywhere, slow on TPU where s64 is emulated).
 
     Returns a zero-arg callable dispatching against pre-uploaded operands
     and returning DEVICE ``(group_pair_counts, group_value_sums)`` int64
@@ -711,16 +722,73 @@ def resident_fused_agg_over_join(
     import jax
     import jax.numpy as jnp
 
-    n_pad = next_pow2(n_l)
-    l_pad = np.full(n_pad, np.iinfo(np.int64).max, dtype=np.int64)
-    l_pad[:n_l] = l_keys
-    g_pad = np.zeros(n_pad, dtype=np.int32)
-    g_pad[:n_l] = g  # pad keys match nothing, so group 0 gains zeros
     # prefix sums host-side once (operand prep, amortized with the
     # uploads); int64 wraparound in the cumsum cancels in the difference
     rvc = np.zeros(n_r + 1, dtype=np.int64)
     np.cumsum(r_vals_sorted.astype(np.int64), out=rvc[1:])
 
+    # --- Pallas path: VPU dense-compare counts + jitted epilogue -------
+    plan = None
+    if kernels_mode() != "off":
+        plan = _plan_sorted_intersect(l_keys, r_sorted)
+        if plan is not None and plan[-1].any():
+            plan = None  # wide tiles need the host fixup; keep XLA path
+    if plan is not None:
+        s_tile, span, base, l2, r2, smj_key, _l32, _r32, _wide = plan
+        with _x32():
+            smj = _get_smj_call(smj_key)
+
+        # The aggregation layout is static across dispatches (resident
+        # operands), so the segmented reduction is precomputed on host:
+        # a stable group-sort permutation turns the per-group sums into
+        # cumsum + boundary differences — an unsorted s64 segment_sum
+        # (scatter-add) measured ~3x slower than this on the v5e (s64 is
+        # software-emulated on TPU; the wraparound in the s64 cumsum
+        # cancels in the boundary difference, same trick as ``rvc``).
+        perm = np.argsort(g, kind="stable").astype(np.int32)
+        g_sorted = g[perm]
+        grid = np.arange(n_groups, dtype=g_sorted.dtype)
+        seg_st = np.searchsorted(g_sorted, grid, side="left").astype(np.int32)
+        seg_en = np.searchsorted(g_sorted, grid, side="right").astype(np.int32)
+
+        epi_key = ("epi", n_l, int(n_groups))
+        epi = _fused_agg_cache.get(epi_key)
+        if epi is None:
+
+            def epi_prog(lt2, eq2, rvc_d, perm_d, st_d, en_d):
+                lt = lt2.reshape(-1)[:n_l]
+                eq = eq2.reshape(-1)[:n_l]
+                le = lt + eq
+                rsum = rvc_d[le] - rvc_d[lt]
+                c = eq[perm_d].astype(jnp.int64)
+                r = rsum[perm_d]
+                z = jnp.zeros(1, jnp.int64)
+                cc = jnp.concatenate([z, jnp.cumsum(c)])
+                rc = jnp.concatenate([z, jnp.cumsum(r)])
+                return cc[en_d] - cc[st_d], rc[en_d] - rc[st_d]
+
+            epi = jax.jit(epi_prog)
+            if len(_fused_agg_cache) >= 64:
+                _fused_agg_cache.pop(next(iter(_fused_agg_cache)))
+            _fused_agg_cache[epi_key] = epi
+
+        d_smj = [jax.device_put(a) for a in (s_tile, span, base, l2, r2)]
+        d_epi = [jax.device_put(a) for a in (rvc, perm, seg_st, seg_en)]
+        jax.block_until_ready(d_smj + d_epi)
+
+        def run_pallas():
+            with _x32():
+                lt2, eq2 = smj(*d_smj)
+            return epi(lt2, eq2, *d_epi)
+
+        return run_pallas
+
+    # --- XLA fallback: s64 binary search, one dispatch -----------------
+    n_pad = next_pow2(n_l)
+    l_pad = np.full(n_pad, np.iinfo(np.int64).max, dtype=np.int64)
+    l_pad[:n_l] = l_keys
+    g_pad = np.zeros(n_pad, dtype=np.int32)
+    g_pad[:n_l] = g  # pad keys match nothing, so group 0 gains zeros
     key = (n_pad, n_r + 1, int(n_groups))
     fn = _fused_agg_cache.get(key)
     if fn is None:
