@@ -773,7 +773,13 @@ class HbmIndexCache(ResidentCacheBase):
         if not cols:
             return None, True  # nothing encoded (e.g. NaN float32 data)
         try:
-            jax.block_until_ready(
+            # materializing chain fence: on the tunneled backend
+            # block_until_ready acks enqueue, which would close the
+            # prefetch timer before the uploads land (and miss a dead
+            # device until the first query); one probe fences them all
+            from ..ops import fence_chain
+
+            fence_chain(
                 [c.data for c in cols.values()]
                 + [c.data2 for c in cols.values() if c.data2 is not None]
             )

@@ -460,7 +460,13 @@ class MeshHbmCache(ResidentCacheBase):
         if not cols:
             return None, True
         try:
-            jax.block_until_ready(
+            # materializing chain fence: on the tunneled backend
+            # block_until_ready acks enqueue, which would close the
+            # prefetch timer before the uploads land (and miss a dead
+            # device until the first query); one probe fences them all
+            from ..ops import fence_chain
+
+            fence_chain(
                 [c.data for c in cols.values()]
                 + [c.data2 for c in cols.values() if c.data2 is not None]
             )

@@ -61,6 +61,27 @@ def fence_materialize(*arrays) -> None:
         np.asarray(a[tuple(slice(0, 1) for _ in range(a.ndim))])
 
 
+def fence_chain(arrays) -> None:
+    """One materializing fence over MANY independent device arrays (e.g.
+    a batch of uploads): chains a 1-element probe through every array so
+    completion of all is observed with a single link round trip, where
+    per-array ``fence_materialize`` would pay one round trip each. Also
+    the device-loss detector for prefetch: a dead device raises here."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    acc = None
+    for a in arrays:
+        # slice BEFORE ravel: an eager ravel materializes a full-size
+        # copy of the array, which would transiently double the largest
+        # resident columns in HBM at the worst moment (prefetch)
+        v = a[tuple(slice(0, 1) for _ in range(a.ndim))]
+        v = v.ravel().astype(jnp.float32)
+        acc = v if acc is None else acc + v
+    if acc is not None:
+        np.asarray(acc)
+
+
 def _enable_persistent_compile_cache(jax) -> None:
     """TPU compiles of the build/query kernels cost tens of seconds (AOT
     through the runtime helper); the persistent cache makes every process

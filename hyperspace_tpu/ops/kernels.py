@@ -293,7 +293,8 @@ def resident_mask_fn(bound: Expr, arrays: Dict[str, np.ndarray]):
     ``arrays`` ONCE, returning ``(fn, cols)`` where ``cols`` are the
     device-resident tiled columns and ``fn(cols)`` dispatches the mask
     kernel and returns the DEVICE int8 mask (no host readback — callers
-    fence with ``block_until_ready`` or compose further device ops).
+    fence by materializing a result element — ``ops.fence_materialize``;
+    ``block_until_ready`` acks enqueue only on the tunneled backend).
     ``(None, None)`` when the predicate/data do not narrow to int32.
 
     This is the on-chip timing primitive for the microbench and the mask
@@ -357,7 +358,9 @@ def resident_sorted_intersect(l_keys: np.ndarray, r_sorted: np.ndarray):
     with _x32():
         fn = _get_smj_call(key)
         d_args = [jax.device_put(a) for a in (s_tile, span, base, l2, r2)]
-        jax.block_until_ready(d_args)
+    from . import fence_chain
+
+    fence_chain(d_args)  # block_until_ready acks enqueue only
 
     def run():
         with _x32():
@@ -409,7 +412,9 @@ def resident_smj_amortized(
         with _x32():
             fn = _get_smj_call(key)
             d = [jax.device_put(a) for a in (s_tile, span, base, l2, r2)]
-            jax.block_until_ready(d)
+        from . import fence_chain
+
+        fence_chain(d)  # block_until_ready acks enqueue only
 
     with _x32():
 
@@ -772,9 +777,11 @@ def resident_fused_agg_over_join(
                 _fused_agg_cache.pop(next(iter(_fused_agg_cache)))
             _fused_agg_cache[epi_key] = epi
 
+        from . import fence_chain
+
         d_smj = [jax.device_put(a) for a in (s_tile, span, base, l2, r2)]
         d_epi = [jax.device_put(a) for a in (rvc, perm, seg_st, seg_en)]
-        jax.block_until_ready(d_smj + d_epi)
+        fence_chain(d_smj + d_epi)  # block_until_ready acks enqueue only
 
         def run_pallas():
             with _x32():
@@ -807,6 +814,8 @@ def resident_fused_agg_over_join(
             _fused_agg_cache.pop(next(iter(_fused_agg_cache)))
         _fused_agg_cache[key] = fn
 
+    from . import fence_chain
+
     d_args = [
         jax.device_put(a)
         for a in (
@@ -816,7 +825,7 @@ def resident_fused_agg_over_join(
             rvc,
         )
     ]
-    jax.block_until_ready(d_args)
+    fence_chain(d_args)  # block_until_ready acks enqueue only
 
     def run():
         return fn(*d_args)
