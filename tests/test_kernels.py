@@ -207,3 +207,89 @@ def test_resident_fused_agg_over_join_parity():
     assert resident_fused_agg_over_join(
         l_keys, r_keys, r_vals, bad, n_g
     ) is None
+
+
+def test_resident_fused_agg_edge_shapes():
+    """Both fused-aggregate branches (Pallas counts + permcum epilogue,
+    and the s64-searchsorted XLA fallback) agree with numpy across edge
+    shapes: tiny inputs, one group, disjoint key ranges, negative keys
+    and sums, uint32 keys, and the i32-unnarrowable range that forces
+    the fallback."""
+    import jax
+
+    from hyperspace_tpu.ops.kernels import resident_fused_agg_over_join
+
+    def ref(l_keys, r_keys, r_vals, groups, n_g):
+        lo = np.searchsorted(r_keys, l_keys, side="left")
+        hi = np.searchsorted(r_keys, l_keys, side="right")
+        rvc = np.concatenate([[0], np.cumsum(r_vals.astype(np.int64))])
+        exp_c = np.zeros(n_g, dtype=np.int64)
+        exp_s = np.zeros(n_g, dtype=np.int64)
+        np.add.at(exp_c, groups.astype(np.int64), hi - lo)
+        np.add.at(exp_s, groups.astype(np.int64), rvc[hi] - rvc[lo])
+        return exp_c, exp_s
+
+    rng = np.random.default_rng(11)
+    cases = []
+    # tiny (heavy tile padding), one group
+    cases.append((
+        np.array([5, 1, 9], dtype=np.int64),
+        np.array([1, 1, 5, 7], dtype=np.int64),
+        np.array([10, -20, 30, 40], dtype=np.int64),
+        np.zeros(3, dtype=np.int64),
+        1,
+    ))
+    # disjoint key ranges: zero matches everywhere
+    cases.append((
+        rng.integers(0, 100, 500).astype(np.int64),
+        np.sort(rng.integers(10_000, 20_000, 400)).astype(np.int64),
+        rng.integers(-50, 50, 400).astype(np.int64),
+        rng.integers(0, 8, 500).astype(np.int64),
+        8,
+    ))
+    # negative keys and values
+    cases.append((
+        rng.integers(-5000, -1000, 2000).astype(np.int64),
+        np.sort(rng.integers(-5000, -1000, 1500)).astype(np.int64),
+        rng.integers(-(1 << 30), 1 << 30, 1500).astype(np.int64),
+        rng.integers(0, 16, 2000).astype(np.int64),
+        16,
+    ))
+    # uint32 keys (int64-safe embed)
+    cases.append((
+        rng.integers(0, 1 << 31, 1000).astype(np.uint32),
+        np.sort(rng.integers(0, 1 << 31, 800).astype(np.uint32)),
+        rng.integers(0, 100, 800).astype(np.int64),
+        rng.integers(0, 4, 1000).astype(np.int64),
+        4,
+    ))
+    # range too wide for i32 narrowing -> XLA fallback branch
+    wide_l = rng.integers(0, 1 << 33, 1000).astype(np.int64)
+    cases.append((
+        wide_l,
+        np.sort(rng.integers(0, 1 << 33, 900)).astype(np.int64),
+        rng.integers(-100, 100, 900).astype(np.int64),
+        rng.integers(0, 7, 1000).astype(np.int64),
+        7,
+    ))
+    for i, (lk, rk, rv, g, ng) in enumerate(cases):
+        run = resident_fused_agg_over_join(lk, rk, rv, g, ng)
+        assert run is not None, f"case {i} declined"
+        gc, gs = (np.asarray(a) for a in jax.block_until_ready(run()))
+        exp_c, exp_s = ref(np.asarray(lk, dtype=np.int64),
+                           np.asarray(rk, dtype=np.int64), rv, g, ng)
+        assert np.array_equal(gc, exp_c), f"case {i} counts"
+        assert np.array_equal(gs, exp_s), f"case {i} sums"
+
+    # guard refusals: uint64 values >= 2^63 would wrap; a right key equal
+    # to the int64-max pad sentinel would let pad rows match
+    big_vals = np.full(4, 1 << 63, dtype=np.uint64)
+    assert resident_fused_agg_over_join(
+        np.arange(4, dtype=np.int64), np.arange(4, dtype=np.int64),
+        big_vals, np.zeros(4, dtype=np.int64), 1,
+    ) is None
+    sentinel = np.array([0, np.iinfo(np.int64).max], dtype=np.int64)
+    assert resident_fused_agg_over_join(
+        np.arange(2, dtype=np.int64), sentinel,
+        np.ones(2, dtype=np.int64), np.zeros(2, dtype=np.int64), 1,
+    ) is None
