@@ -98,6 +98,13 @@ class SketchSpec:
     def build(self, col: Column) -> Dict[str, Any]:
         raise NotImplementedError
 
+    def prepare_test(self, dtype_str: str, bounds, pins):
+        """Normalize the predicate ONCE and return ``test(data) -> bool``
+        for per-file evaluation — literal conversion (and bloom position
+        hashing) are loop-invariant across a file list, and at 64-file
+        sources doing them per file dominated the rule's rewrite time."""
+        raise NotImplementedError
+
     def can_match(
         self,
         data: Dict[str, Any],
@@ -106,7 +113,7 @@ class SketchSpec:
         pins: Optional[set],  # from expr.pinned_values; None = not pinned
     ) -> bool:
         """False only when NO row of the file can satisfy the predicate."""
-        raise NotImplementedError
+        return self.prepare_test(dtype_str, bounds, pins)(data)
 
 
 @dataclass(frozen=True)
@@ -129,21 +136,33 @@ class MinMaxSketch(SketchSpec):
             "max": _json_value(col.data.max(), col.dtype_str),
         }
 
-    def can_match(self, data, dtype_str, bounds, pins) -> bool:
-        lo_f, hi_f = data.get("min"), data.get("max")
-        if lo_f is None or hi_f is None:
-            return False  # empty file: nothing can match
-        if pins is not None:
-            vals = [_lit_comparable(v, dtype_str) for v in pins]
-            if all(v < lo_f or v > hi_f for v in vals):
-                return False
+    def prepare_test(self, dtype_str, bounds, pins):
+        pin_vals = (
+            [_lit_comparable(v, dtype_str) for v in pins]
+            if pins is not None
+            else None
+        )
+        lo = hi = None
         if bounds is not None:
-            lo, hi = bounds
-            if lo is not None and _lit_comparable(lo, dtype_str) > hi_f:
+            b_lo, b_hi = bounds
+            lo = _lit_comparable(b_lo, dtype_str) if b_lo is not None else None
+            hi = _lit_comparable(b_hi, dtype_str) if b_hi is not None else None
+
+        def test(data) -> bool:
+            lo_f, hi_f = data.get("min"), data.get("max")
+            if lo_f is None or hi_f is None:
+                return False  # empty file: nothing can match
+            if pin_vals is not None and all(
+                v < lo_f or v > hi_f for v in pin_vals
+            ):
                 return False
-            if hi is not None and _lit_comparable(hi, dtype_str) < lo_f:
+            if lo is not None and lo > hi_f:
                 return False
-        return True
+            if hi is not None and hi < lo_f:
+                return False
+            return True
+
+        return test
 
 
 @dataclass(frozen=True)
@@ -164,27 +183,33 @@ class ValueListSketch(SketchSpec):
             return {"values": None}  # too wide: sketch abstains
         return {"values": [_json_value(v, col.dtype_str) for v in uniq]}
 
-    def can_match(self, data, dtype_str, bounds, pins) -> bool:
-        values = data.get("values")
-        if values is None:
-            return True  # abstained at build time
-        if pins is not None:
-            present = set(values)
-            if not any(_lit_comparable(v, dtype_str) in present for v in pins):
+    def prepare_test(self, dtype_str, bounds, pins):
+        pin_vals = (
+            {_lit_comparable(v, dtype_str) for v in pins}
+            if pins is not None
+            else None
+        )
+        lo = hi = None
+        if bounds is not None:
+            b_lo, b_hi = bounds
+            lo = _lit_comparable(b_lo, dtype_str) if b_lo is not None else None
+            hi = _lit_comparable(b_hi, dtype_str) if b_hi is not None else None
+
+        def test(data) -> bool:
+            values = data.get("values")
+            if values is None:
+                return True  # abstained at build time
+            if not values:
+                return False  # empty file: nothing can match
+            if pin_vals is not None and pin_vals.isdisjoint(values):
                 return False
-        if bounds is not None and values:
-            lo, hi = bounds
-            if lo is not None and all(
-                v < _lit_comparable(lo, dtype_str) for v in values
-            ):
+            if lo is not None and all(v < lo for v in values):
                 return False
-            if hi is not None and all(
-                v > _lit_comparable(hi, dtype_str) for v in values
-            ):
+            if hi is not None and all(v > hi for v in values):
                 return False
-        if not values:
-            return False
-        return True
+            return True
+
+        return test
 
 
 @dataclass(frozen=True)
@@ -222,44 +247,30 @@ class BloomFilterSketch(SketchSpec):
             "bits": base64.b64encode(packed.tobytes()).decode("ascii"),
         }
 
-    def can_match(self, data, dtype_str, bounds, pins) -> bool:
+    def prepare_test(self, dtype_str, bounds, pins):
         if pins is None:
-            return True  # bloom answers equality only
-        m, k = int(data["numBits"]), int(data["numHashes"])
-        # decode once per distinct bit array: the base64→bits decode was
-        # ~0.5ms × files × queries — 60% of a point query's rewrite time
-        # at 64 files. Keyed by the b64 CONTENT (not stashed on the dict:
-        # load_sketch_table's contract freezes the shared table, and a
-        # refresh serializes those dicts back to JSON).
-        b64 = data["bits"]
-        with _BLOOM_BITS_CACHE_LOCK:
-            packed = _BLOOM_BITS_CACHE.get(b64)
-        if packed is None:
-            packed = np.frombuffer(base64.b64decode(b64), dtype=np.uint8)
-            global _BLOOM_BITS_CACHE_NBYTES
-            # oversize entries bypass the cache entirely: evicting the
-            # whole cache to admit something that still busts the cap
-            # would just thrash
-            if packed.nbytes <= _BLOOM_BITS_CACHE_CAP_BYTES:
-                with _BLOOM_BITS_CACHE_LOCK:
-                    while (
-                        _BLOOM_BITS_CACHE
-                        and _BLOOM_BITS_CACHE_NBYTES + packed.nbytes
-                        > _BLOOM_BITS_CACHE_CAP_BYTES
-                    ):
-                        _, old = _BLOOM_BITS_CACHE.popitem(last=False)
-                        _BLOOM_BITS_CACHE_NBYTES -= old.nbytes
-                    if b64 not in _BLOOM_BITS_CACHE:
-                        _BLOOM_BITS_CACHE[b64] = packed
-                        _BLOOM_BITS_CACHE_NBYTES += packed.nbytes
-        for v in pins:
-            reprs = np.array([scalar_key_repr(v, dtype_str)], dtype=np.int64)
-            pos = _bloom_positions(reprs, m, k)[0]
+            return lambda data: True  # bloom answers equality only
+        # pin hashing is file-invariant; positions depend on the stored
+        # (numBits, numHashes), identical across a sketch's files — cache
+        # per distinct geometry so a 64-file prune hashes the pins once
+        reprs = np.array(
+            [scalar_key_repr(v, dtype_str) for v in pins], dtype=np.int64
+        )
+        pos_by_geom: Dict[tuple, np.ndarray] = {}
+
+        def test(data) -> bool:
+            m, k = int(data["numBits"]), int(data["numHashes"])
+            pos = pos_by_geom.get((m, k))
+            if pos is None:
+                pos = _bloom_positions(reprs, m, k)  # (n_pins, k)
+                pos_by_geom[(m, k)] = pos
+            packed = _decoded_bloom_bits(data["bits"])
             # packbits is MSB-first: global bit p = byte p>>3, bit 7-(p&7)
             hit_bits = (packed[pos >> 3] >> (7 - (pos & 7))) & 1
-            if hit_bits.all():
-                return True  # might contain v
-        return False
+            # might contain v ⇔ all k bits set for some pin v
+            return bool(hit_bits.all(axis=1).any())
+
+        return test
 
 
 # decoded (PACKED uint8) bloom arrays keyed by their base64 content; the
@@ -273,6 +284,34 @@ _BLOOM_BITS_CACHE: "OrderedDict[str, np.ndarray]" = OrderedDict()
 _BLOOM_BITS_CACHE_NBYTES = 0
 _BLOOM_BITS_CACHE_CAP_BYTES = 64 << 20
 _BLOOM_BITS_CACHE_LOCK = Lock()  # union sides execute concurrently
+
+
+def _decoded_bloom_bits(b64: str) -> np.ndarray:
+    """Decode once per distinct bit array: the base64→bits decode was
+    ~0.5ms × files × queries — 60% of a point query's rewrite time at 64
+    files. Keyed by the b64 CONTENT (not stashed on the sketch dict:
+    load_sketch_table's contract freezes the shared table, and a refresh
+    serializes those dicts back to JSON)."""
+    with _BLOOM_BITS_CACHE_LOCK:
+        packed = _BLOOM_BITS_CACHE.get(b64)
+    if packed is None:
+        packed = np.frombuffer(base64.b64decode(b64), dtype=np.uint8)
+        global _BLOOM_BITS_CACHE_NBYTES
+        # oversize entries bypass the cache entirely: evicting the whole
+        # cache to admit something that still busts the cap would thrash
+        if packed.nbytes <= _BLOOM_BITS_CACHE_CAP_BYTES:
+            with _BLOOM_BITS_CACHE_LOCK:
+                while (
+                    _BLOOM_BITS_CACHE
+                    and _BLOOM_BITS_CACHE_NBYTES + packed.nbytes
+                    > _BLOOM_BITS_CACHE_CAP_BYTES
+                ):
+                    _, old = _BLOOM_BITS_CACHE.popitem(last=False)
+                    _BLOOM_BITS_CACHE_NBYTES -= old.nbytes
+                if b64 not in _BLOOM_BITS_CACHE:
+                    _BLOOM_BITS_CACHE[b64] = packed
+                    _BLOOM_BITS_CACHE_NBYTES += packed.nbytes
+    return packed
 
 
 _SKETCH_KINDS = {

@@ -38,7 +38,8 @@ def prune_files(entry: IndexLogEntry, scan: Scan, predicate) -> Optional[List]:
     # The predicate's own column spelling drives bounds/pins extraction —
     # sketch columns carry the source schema's case, which may differ.
     pred_col_by_lower = {c.lower(): c for c in predicate.columns()}
-    # (spec, key, bounds, pins) — all loop-invariant per file
+    # (key, prepared test) — bounds/pin extraction, literal normalization,
+    # and bloom pin-hashing are all loop-invariant per file (prepare_test)
     active = []
     for spec in specs:
         qcol = pred_col_by_lower.get(spec.column.lower())
@@ -50,7 +51,8 @@ def prune_files(entry: IndexLogEntry, scan: Scan, predicate) -> Optional[List]:
         pins = pinned_values(predicate, qcol)
         if bounds is None and pins is None:
             continue  # predicate gives this sketch nothing to test
-        active.append((spec, sketch_key(spec.to_json_dict()), bounds, pins))
+        test = spec.prepare_test(dtypes[spec.column], bounds, pins)
+        active.append((sketch_key(spec.to_json_dict()), test))
     if not active:
         return None
     kept = []
@@ -60,10 +62,9 @@ def prune_files(entry: IndexLogEntry, scan: Scan, predicate) -> Optional[List]:
             kept.append(f)  # unsketched file (e.g. appended): cannot prune
             continue
         might = True
-        for spec, key, bounds, pins in active:
-            if key not in data:
-                continue
-            if not spec.can_match(data[key], dtypes[spec.column], bounds, pins):
+        for key, test in active:
+            sk = data.get(key)
+            if sk is not None and not test(sk):
                 might = False
                 break
         if might:

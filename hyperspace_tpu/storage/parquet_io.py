@@ -14,6 +14,7 @@ from typing import Iterable, List, Optional
 import numpy as np
 
 from ..exceptions import HyperspaceException
+from ..utils.memo import bounded_memo_put
 from .columnar import ColumnarBatch
 
 
@@ -33,6 +34,31 @@ def _read_with(
             table = table.select(columns)
         batches.append(ColumnarBatch.from_arrow(table))
     return ColumnarBatch.concat(batches)
+
+
+# Parquet FOOTER memo (metadata parse only — row data is re-decoded every
+# read, so repeat-query timings stay honest), keyed by (path, size,
+# mtime_ns) and revalidated by stat on every hit. FileMetaData is
+# immutable, so each read constructs a fresh ParquetFile around the cached
+# footer (no shared file handle → concurrent union sides stay safe). The
+# open + footer parse was ~20% of a pruned single-file read on sub-3ms
+# queries.
+_PQ_META_MEMO: dict = {}
+_PQ_META_MEMO_MAX = 128
+
+
+def _parquet_file(path: str):
+    import os
+
+    import pyarrow.parquet as pq
+
+    st = os.stat(path)
+    key = (path, st.st_size, st.st_mtime_ns)
+    meta = _PQ_META_MEMO.get(key)
+    pf = pq.ParquetFile(path, metadata=meta)
+    if meta is None:
+        bounded_memo_put(_PQ_META_MEMO, key, pf.metadata, _PQ_META_MEMO_MAX)
+    return pf
 
 
 def read_parquet(
@@ -56,7 +82,7 @@ def read_parquet(
                 return pq.read_table(p, columns=columns, filters=arrow_filter)
             except Exception:  # noqa: BLE001 - pushdown is an optimization
                 pass
-        return pq.read_table(p, columns=columns)
+        return _parquet_file(p).read(columns=columns)
 
     # column pushdown at the parquet reader; projection re-applied uniformly
     return _read_with(reader, "parquet", paths, columns)

@@ -1,0 +1,69 @@
+"""Bounded-memo helper + the derived-metadata memos it backs.
+
+The snapshot/schema/partition-spec/parquet-footer memos share one
+eviction helper (utils.memo.bounded_memo_put); these tests pin its cap
+behavior and the correctness contracts of the memos added for sub-3ms
+indexed queries: identical inputs reuse the cached derivation, any
+input change recomputes.
+"""
+
+import numpy as np
+
+from hyperspace_tpu.index.log_entry import FileInfo
+from hyperspace_tpu.index.sketches import BloomFilterSketch, MinMaxSketch
+from hyperspace_tpu.sources.default import _discover_spec
+from hyperspace_tpu.storage.columnar import Column
+from hyperspace_tpu.utils.memo import bounded_memo_put
+
+
+def test_bounded_memo_put_caps_and_evicts_oldest():
+    memo = {}
+    for i in range(10):
+        bounded_memo_put(memo, i, i * 10, cap=4)
+    assert len(memo) == 4
+    assert list(memo) == [6, 7, 8, 9]  # FIFO: oldest evicted first
+    # at-cap insert of an existing key still lands
+    bounded_memo_put(memo, 9, 99, cap=4)
+    assert memo[9] == 99 and len(memo) <= 4
+
+
+def test_bounded_memo_put_cap_one():
+    memo = {}
+    bounded_memo_put(memo, "a", 1, cap=1)
+    bounded_memo_put(memo, "b", 2, cap=1)
+    assert memo == {"b": 2}
+
+
+def _fi(path):
+    return FileInfo(path, 1, 1, 0)
+
+
+def test_discover_spec_memo_reuses_and_invalidates(tmp_path):
+    files = [_fi(str(tmp_path / "date=1/a.parquet"))]
+    spec1 = _discover_spec(files, [str(tmp_path)], None, None)
+    spec2 = _discover_spec(files, [str(tmp_path)], None, None)
+    assert spec1 is spec2  # memo hit: same frozen instance
+    assert spec1.names == ["date"]
+    # a new file changes the snapshot key -> fresh discovery
+    more = files + [_fi(str(tmp_path / "date=2/b.parquet"))]
+    spec3 = _discover_spec(more, [str(tmp_path)], None, None)
+    assert spec3 is not spec1 and spec3.names == ["date"]
+    # declared schema participates in the key (pins the dtype)
+    spec4 = _discover_spec(files, [str(tmp_path)], None, {"date": "string"})
+    assert spec4.schema()["date"] == "string"
+    assert spec1.schema()["date"] == "int64"
+
+
+def test_prepared_sketch_tests_match_can_match_across_files():
+    mm = MinMaxSketch("k")
+    bloom = BloomFilterSketch("k", expected_items=1000)
+    per_file = []
+    for lo in (0, 500, 2000):
+        col = Column("int64", np.arange(lo, lo + 100, dtype=np.int64))
+        per_file.append((mm.build(col), bloom.build(col)))
+    for bounds, pins in [((40, 60), None), (None, {550}), ((None, 10), {2050})]:
+        mm_test = mm.prepare_test("int64", bounds, pins)
+        bl_test = bloom.prepare_test("int64", bounds, pins)
+        for mm_data, bl_data in per_file:
+            assert mm_test(mm_data) == mm.can_match(mm_data, "int64", bounds, pins)
+            assert bl_test(bl_data) == bloom.can_match(bl_data, "int64", bounds, pins)
