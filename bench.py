@@ -182,27 +182,14 @@ def _fail(reason: str):
 
 
 def _device_reachable(timeout_s: int = 150) -> bool:
-    """Probe the accelerator in a SUBPROCESS with a hard timeout: a wedged
-    device tunnel hangs jax.devices() indefinitely (observed on the axon
-    tunnel), and an in-process hang would take the whole scored artifact
-    with it. On failure the bench degrades to host-only configs — the
-    external ratios still get recorded."""
-    import subprocess
+    """Probe the accelerator (shared helper, utils/deviceprobe.py): a
+    wedged device tunnel hangs jax.devices() indefinitely, and an
+    in-process hang would take the whole scored artifact with it. On
+    failure the bench degrades to host-only configs — the external
+    ratios still get recorded."""
+    from hyperspace_tpu.utils.deviceprobe import device_reachable
 
-    try:
-        p = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax; jax.devices(); print('ok')",
-            ],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-        return p.returncode == 0 and "ok" in p.stdout
-    except Exception:  # noqa: BLE001 - timeout or spawn failure
-        return False
+    return device_reachable(timeout_s)
 
 
 def main() -> None:

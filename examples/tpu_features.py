@@ -22,18 +22,15 @@ os.environ.setdefault("HYPERSPACE_TPU_HBM", "force")
 os.environ.setdefault("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
 
 
-def _pin_cpu_if_device_unreachable(timeout_s: int = 150) -> None:
+def _pin_cpu_if_device_unreachable() -> None:
     """A wedged accelerator tunnel hangs the first in-process
-    ``jax.devices()`` indefinitely (the bench probes for the same reason,
-    bench.py:_device_reachable) — probe in a subprocess with a hard
-    timeout and fall back to the CPU backend so the tour always runs.
-    Both the env var AND the jax config must be pinned: the TPU plugin
-    re-sets ``jax_platforms`` programmatically at interpreter start.
-    The timeout matches bench.py's (a cold device runtime can take >60s
-    to come up); set HYPERSPACE_TPU_DEVICE_PROBE=off to skip the probe
-    and its duplicate backend bring-up when the device is known good."""
-    import subprocess
-    import sys
+    ``jax.devices()`` indefinitely — probe it with the shared subprocess
+    helper (utils/deviceprobe, the same probe bench.py uses) and fall
+    back to the CPU backend so the tour always runs. Both the env var
+    AND the jax config must be pinned: the TPU plugin re-sets
+    ``jax_platforms`` programmatically at interpreter start. Set
+    HYPERSPACE_TPU_DEVICE_PROBE=off to skip the probe and its duplicate
+    backend bring-up when the device is known good."""
 
     def pin_cpu() -> None:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -46,17 +43,10 @@ def _pin_cpu_if_device_unreachable(timeout_s: int = 150) -> None:
         return
     if os.environ.get("HYPERSPACE_TPU_DEVICE_PROBE", "on").lower() == "off":
         return
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-        if p.returncode == 0 and "ok" in p.stdout:
-            return
-    except Exception:  # noqa: BLE001 - timeout or spawn failure
-        pass
+    from hyperspace_tpu.utils.deviceprobe import device_reachable
+
+    if device_reachable():
+        return
     print("accelerator unreachable: running the tour on the CPU backend")
     pin_cpu()
 

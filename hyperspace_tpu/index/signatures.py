@@ -19,6 +19,15 @@ from typing import Optional
 from ..exceptions import HyperspaceException
 from ..plan.ir import LogicalPlan, Scan
 from ..utils.hashing import md5_hex
+from ..utils.memo import bounded_memo_put
+
+# Per-scan fold memo: the md5 chain over one relation's file snapshot is a
+# pure function of (incoming accumulator, per-file stats) and query rules
+# recompute it on every fresh plan (with_cached_tag caches per plan, and
+# plans are rebuilt per query). The ALGORITHM is unchanged — signatures are
+# persisted in index log entries, so only the recomputation is skipped.
+_FOLD_MEMO: dict = {}
+_FOLD_MEMO_MAX = 256
 
 
 class LogicalPlanSignatureProvider:
@@ -42,8 +51,20 @@ class FileBasedSignatureProvider(LogicalPlanSignatureProvider):
             return None
         acc = ""
         for scan in scans:
-            for f in sorted(scan.relation.files, key=lambda f: f.name):
-                acc = md5_hex(acc + f"{f.name}:{f.size}:{f.modified_time}")
+            # sort once: the fold is name-ordered, and a name-ordered key
+            # makes the memo insensitive to discovery order
+            files = sorted(scan.relation.files, key=lambda f: f.name)
+            key = (
+                acc,
+                tuple((f.name, f.size, f.modified_time) for f in files),
+            )
+            hit = _FOLD_MEMO.get(key)
+            if hit is None:
+                for f in files:
+                    acc = md5_hex(acc + f"{f.name}:{f.size}:{f.modified_time}")
+                bounded_memo_put(_FOLD_MEMO, key, acc, _FOLD_MEMO_MAX)
+            else:
+                acc = hit
         return acc
 
 

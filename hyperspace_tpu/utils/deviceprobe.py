@@ -1,0 +1,29 @@
+"""Accelerator reachability probe, shared by bench.py and the examples.
+
+A wedged device tunnel hangs the first in-process ``jax.devices()``
+indefinitely (observed on the tunneled TPU backend), so anything that
+wants to *optionally* use the accelerator must probe it in a SUBPROCESS
+with a hard timeout first — an in-process hang would take the caller
+with it. Callers degrade to host/CPU paths on failure.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+def device_reachable(timeout_s: int = 150) -> bool:
+    """True when a fresh process can initialize jax and list devices
+    within ``timeout_s`` (generous: a cold device runtime can take >60s
+    to come up)."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        return p.returncode == 0 and "ok" in p.stdout
+    except Exception:  # noqa: BLE001 - timeout or spawn failure
+        return False

@@ -67,3 +67,28 @@ def test_prepared_sketch_tests_match_can_match_across_files():
         for mm_data, bl_data in per_file:
             assert mm_test(mm_data) == mm.can_match(mm_data, "int64", bounds, pins)
             assert bl_test(bl_data) == bloom.can_match(bl_data, "int64", bounds, pins)
+
+
+def test_file_signature_memo_tracks_snapshot_changes():
+    from hyperspace_tpu.index.signatures import FileBasedSignatureProvider
+    from hyperspace_tpu.plan.ir import Scan
+    from hyperspace_tpu.sources.relation import FileRelation
+
+    def plan_for(files):
+        rel = FileRelation(
+            root_paths=["/d"], file_format="parquet",
+            schema={"k": "int64"}, files=files, options={},
+        )
+        return Scan(rel)
+
+    prov = FileBasedSignatureProvider()
+    files = [FileInfo("/d/a.parquet", 10, 100, 0), FileInfo("/d/b.parquet", 20, 200, 1)]
+    s1 = prov.signature(plan_for(files))
+    assert prov.signature(plan_for(list(files))) == s1  # memo hit, same value
+    # any stat change must change the signature (staleness detection)
+    touched = [FileInfo("/d/a.parquet", 10, 999, 0), files[1]]
+    assert prov.signature(plan_for(touched)) != s1
+    # memoized value matches a from-scratch fold (algorithm unchanged)
+    from hyperspace_tpu.index import signatures as S
+    S._FOLD_MEMO.clear()
+    assert prov.signature(plan_for(files)) == s1
