@@ -598,7 +598,16 @@ class HbmIndexCache(ResidentCacheBase):
         empty) — budget and IO refusals are NOT permanent: the budget is
         a runtime-tunable env knob and IO errors may be transient."""
         from ..storage import layout
+        from ..utils.deviceprobe import first_device_touch_ok
         from ..utils.intmath import next_pow2  # noqa: F401 (doc anchor)
+
+        # a WEDGED accelerator tunnel blocks the process's first device
+        # touch forever; the watchdog bounds it and quietly disables
+        # residency for the process (not permanent per file version:
+        # a restarted tunnel heals on the next process)
+        if not first_device_touch_ok():
+            metrics.incr("hbm.device_unreachable")
+            return None, False
 
         t0 = time.perf_counter()
         readers = []

@@ -301,7 +301,14 @@ class MeshHbmCache(ResidentCacheBase):
     ) -> Tuple[Optional[MeshResidentTable], bool]:
         """(table, permanent_refusal) — hbm_cache._build semantics, with
         the concat order replaced by the bucket-per-device packing."""
+        from ..utils.deviceprobe import first_device_touch_ok
         from ..utils.intmath import next_pow2
+
+        # bounded first-touch: a wedged tunnel must not hang a prefetch
+        # (hbm_cache._build has the same guard and rationale)
+        if not first_device_touch_ok():
+            metrics.incr("hbm.mesh.device_unreachable")
+            return None, False
 
         t0 = time.perf_counter()
         try:

@@ -293,16 +293,20 @@ class StreamingIndexWriter:
         host_s = self._probe.get("host_s")
         if host_s is None:
             return False
+        # the process's FIRST device touch pays one-time backend init —
+        # and on a WEDGED tunnel it blocks forever. The watchdog turns
+        # that into a bounded wait and a host verdict (it also serves as
+        # the untimed warmup: timing backend init as link bandwidth would
+        # permanently rule out the device engine on hosts where it wins
+        # after warmup).
+        from ..utils.deviceprobe import first_device_touch_ok
+
+        if not first_device_touch_ok():
+            metrics.incr("build.engine.device_unreachable")
+            self._probe["unreachable"] = True
+            return True  # unreachable: the device engine cannot win
         try:
             import jax
-
-            # untimed warmup: the process's FIRST device_put pays one-time
-            # backend/allocator init (seconds on a cold tunnel) that is not
-            # link bandwidth; timing it would permanently rule out the
-            # device engine on hosts where it wins after warmup
-            warm = jax.device_put(np.zeros(16, dtype=np.int32))
-            warm.block_until_ready()
-            np.asarray(warm)
             # staged OUTSIDE the timed window: the real device path never
             # uploads the permutation — only its D2H readback counts
             perm_back = jax.device_put(
@@ -326,11 +330,16 @@ class StreamingIndexWriter:
 
     def _publish_winner(self, choice: str, by_link: bool = False) -> None:
         """The ONE place the probe verdict is recorded: probe state, the
-        per-(platform, capacity) memo, and the observability counters."""
+        per-(platform, capacity) memo, and the observability counters.
+        An UNREACHABLE-device verdict latches in-process only — it is a
+        transient tunnel condition, not a measured link property, and
+        persisting it would rule the device engine out machine-wide for
+        the probe cache's 24h TTL after a one-session wedge."""
         self._probe["winner"] = 1.0 if choice == "host" else 0.0
         key = _engine_cache_key(self.chunk_capacity)
         _ENGINE_CACHE[key] = choice
-        _persist_winner(key, choice)
+        if not self._probe.get("unreachable"):
+            _persist_winner(key, choice)
         metrics.incr(f"build.engine.auto_chose_{choice}")
         if by_link:
             metrics.incr("build.engine.auto_chose_host_by_link")
@@ -457,7 +466,13 @@ class StreamingIndexWriter:
         if self._t_first_add is None:
             self._t_first_add = time.perf_counter()
         t0 = time.perf_counter()
-        if self.mesh is not None and self.mesh.devices.size > 1:
+        from ..utils.deviceprobe import first_device_touch_ok
+
+        if (
+            self.mesh is not None
+            and self.mesh.devices.size > 1
+            and first_device_touch_ok()
+        ):
             # multi-chip chunk: shard_map bucketize + ICI all_to_all, then
             # spill each device's (bucket-grouped) shard as its own run
             # (synchronous — per-device results come back materialized)
@@ -474,6 +489,17 @@ class StreamingIndexWriter:
                 self._spill_run(dev_batch, counts)
         else:
             engine = self._route_engine(batch.num_rows)
+            if engine in ("device", "probe-device") and not first_device_touch_ok():
+                # any device-flavored verdict — explicit config, the
+                # in-process memo, or a persisted 24h "device" winner —
+                # would now make its first UNGUARDED device touch; on a
+                # wedged tunnel that blocks forever. Route this process
+                # host-side instead (in-process latch only: the disk
+                # verdict stays, a restarted tunnel heals next process).
+                metrics.incr("build.engine.device_unreachable")
+                self._probe["unreachable"] = True
+                _ENGINE_CACHE[_engine_cache_key(self.chunk_capacity)] = "host"
+                engine = "host"
             if engine in ("host", "probe-host"):
                 from ..ops.build import build_partition_host
 

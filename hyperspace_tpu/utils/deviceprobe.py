@@ -9,8 +9,10 @@ with it. Callers degrade to host/CPU paths on failure.
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
+import threading
 
 
 def device_reachable(timeout_s: int = 150) -> bool:
@@ -27,3 +29,55 @@ def device_reachable(timeout_s: int = 150) -> bool:
         return p.returncode == 0 and "ok" in p.stdout
     except Exception:  # noqa: BLE001 - timeout or spawn failure
         return False
+
+
+# Process-wide first-touch verdict. Latched: once the watchdog times out,
+# every later caller in this process routes host immediately instead of
+# re-paying the timeout.
+_FIRST_TOUCH_LOCK = threading.Lock()
+_FIRST_TOUCH: dict = {}
+
+
+def first_device_touch_ok(timeout_s: float | None = None) -> bool:
+    """Perform this process's first in-process device touch (one tiny
+    ``device_put`` round trip — backend init rides it) under a WATCHDOG:
+    a wedged tunnel blocks backend init forever with the GIL released, so
+    running it on a daemon thread with a join timeout turns an infinite
+    hang into a bounded one. Returns False on timeout or error; the
+    blocked daemon thread is leaked deliberately (it cannot be cancelled
+    and does not block process exit). Callers treat False as "route
+    host-side". Timeout default 120s (cold device runtimes take tens of
+    seconds; the first touch does not compile anything), overridable via
+    ``HYPERSPACE_TPU_FIRST_TOUCH_TIMEOUT_S``."""
+    if timeout_s is None:
+        try:
+            timeout_s = float(
+                os.environ.get("HYPERSPACE_TPU_FIRST_TOUCH_TIMEOUT_S", "120")
+            )
+        except ValueError:
+            timeout_s = 120.0
+    with _FIRST_TOUCH_LOCK:
+        if "ok" in _FIRST_TOUCH:
+            return _FIRST_TOUCH["ok"]
+        result: dict = {}
+
+        def touch() -> None:
+            try:
+                import jax
+                import numpy as np
+
+                arr = jax.device_put(np.zeros(16, dtype=np.int32))
+                arr.block_until_ready()
+                np.asarray(arr)
+                result["ok"] = True
+            except Exception:  # noqa: BLE001 - any init failure = no device
+                result["ok"] = False
+
+        t = threading.Thread(
+            target=touch, daemon=True, name="hyperspace-device-first-touch"
+        )
+        t.start()
+        t.join(timeout_s)
+        ok = result.get("ok", False)
+        _FIRST_TOUCH["ok"] = ok
+        return ok

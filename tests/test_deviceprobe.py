@@ -1,0 +1,101 @@
+"""Watchdog first-touch: a wedged accelerator tunnel blocks backend init
+forever (GIL released), so the first in-process device touch runs on a
+daemon thread with a join timeout and latches a per-process verdict."""
+
+import time
+
+import pytest
+
+from hyperspace_tpu.utils import deviceprobe
+
+
+@pytest.fixture(autouse=True)
+def fresh_latch():
+    saved = dict(deviceprobe._FIRST_TOUCH)
+    deviceprobe._FIRST_TOUCH.clear()
+    yield
+    deviceprobe._FIRST_TOUCH.clear()
+    deviceprobe._FIRST_TOUCH.update(saved)
+
+
+def test_first_touch_ok_on_cpu_backend():
+    # conftest pins the CPU backend: the touch completes immediately
+    assert deviceprobe.first_device_touch_ok(timeout_s=30.0) is True
+    assert deviceprobe._FIRST_TOUCH["ok"] is True
+
+
+def test_first_touch_times_out_and_latches(monkeypatch):
+    import jax
+
+    def hang(*a, **k):
+        time.sleep(10)
+        raise AssertionError("unreachable")
+
+    monkeypatch.setattr(jax, "device_put", hang)
+    t0 = time.perf_counter()
+    assert deviceprobe.first_device_touch_ok(timeout_s=0.2) is False
+    assert time.perf_counter() - t0 < 5
+    # verdict latched: later callers do not re-pay the timeout even with
+    # the touch restored
+    monkeypatch.undo()
+    assert deviceprobe.first_device_touch_ok(timeout_s=30.0) is False
+
+
+def test_first_touch_error_is_false(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(
+        jax, "device_put", lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom"))
+    )
+    assert deviceprobe.first_device_touch_ok(timeout_s=5.0) is False
+
+
+def test_env_timeout_parse(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_FIRST_TOUCH_TIMEOUT_S", "not-a-number")
+    # falls back to the default instead of raising; CPU touch succeeds
+    assert deviceprobe.first_device_touch_ok() is True
+
+
+def test_build_routes_host_and_does_not_persist_when_unreachable(
+    tmp_path, monkeypatch
+):
+    import numpy as np
+
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index import stream_builder as SB
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+    from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    deviceprobe._FIRST_TOUCH["ok"] = False  # simulate a wedged tunnel
+    probe_file = tmp_path / "probe.json"
+    monkeypatch.setenv("HYPERSPACE_TPU_PROBE_CACHE", str(probe_file))
+    SB._ENGINE_CACHE.clear()
+    n = 1 << 17
+    batch = ColumnarBatch({
+        "k": Column("int64", np.arange(n, dtype=np.int64)),
+        "v": Column("int64", np.arange(n, dtype=np.int64)),
+    })
+    parquet_io.write_parquet(tmp_path / "src" / "p0.parquet", batch)
+    conf = HyperspaceConf({
+        C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
+        C.INDEX_NUM_BUCKETS: 8,
+        C.BUILD_MODE: C.BUILD_MODE_STREAMING,
+        C.BUILD_CHUNK_ROWS: n // 4,
+        C.BUILD_ENGINE: "device",  # explicit device must still not hang
+    })
+    session = HyperspaceSession(conf)
+    metrics.reset()
+    Hyperspace(session).create_index(
+        session.read.parquet(str(tmp_path / "src")), IndexConfig("i", ["k"], ["v"])
+    )
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("build.engine.device_unreachable", 0) >= 1
+    assert counters.get("build.engine.device", 0) == 0  # no device dispatch
+    assert counters.get("build.engine.host", 0) >= 1
+    assert not probe_file.exists()  # transient verdict never hits disk
+    SB._ENGINE_CACHE.clear()
