@@ -83,6 +83,18 @@ def main() -> None:
                     help="log2 rows per side")
     args = ap.parse_args()
 
+    # watchdog first touch (not the subprocess probe): respects this
+    # script's cpu smoke mode — the module-level config pin makes the
+    # touch instant on cpu — and on a healthy device IS the in-process
+    # backend warmup; a wedged tunnel exits bounded instead of hanging
+    from hyperspace_tpu.utils.deviceprobe import first_device_touch_ok
+
+    if not first_device_touch_ok():
+        raise SystemExit(
+            "accelerator unreachable (wedged tunnel?) — the crossover "
+            "measures the real device path; re-run when the device answers"
+        )
+
     import jax
 
     from hyperspace_tpu.exec.joins import bucketed_join_pairs

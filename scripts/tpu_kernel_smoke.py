@@ -9,16 +9,30 @@ asserts bit-exact agreement with the host oracles.
 Run:  PYTHONPATH=/root/repo:/root/.axon_site python scripts/tpu_kernel_smoke.py
 """
 
+import sys
 import time
+from pathlib import Path
 
 import numpy as np
 
-from hyperspace_tpu.ops import kernels as K
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from hyperspace_tpu.ops import kernels as K  # noqa: E402
 from hyperspace_tpu.plan.expr import col, eval_mask
 from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
 
 
 def main() -> None:
+    # watchdog first touch: doubles as the in-process backend warmup on a
+    # healthy device, and bounds the otherwise-infinite hang on a wedged
+    # tunnel (no throwaway subprocess init)
+    from hyperspace_tpu.utils.deviceprobe import first_device_touch_ok
+
+    if not first_device_touch_ok():
+        raise SystemExit(
+            "accelerator unreachable (wedged tunnel?) — the smoke needs "
+            "the real chip; re-run when the device answers"
+        )
     import jax
 
     platform = jax.devices()[0].platform
