@@ -92,3 +92,59 @@ def test_file_signature_memo_tracks_snapshot_changes():
     from hyperspace_tpu.index import signatures as S
     S._FOLD_MEMO.clear()
     assert prov.signature(plan_for(files)) == s1
+
+
+def test_bounded_memo_put_concurrent_hammer():
+    # union sides execute on threads; eviction must never raise and the
+    # cap must hold (within a small transient overshoot bound)
+    import threading
+
+    memo: dict = {}
+    errors = []
+
+    def worker(tid):
+        try:
+            for i in range(2000):
+                bounded_memo_put(memo, (tid, i % 37), i, cap=16)
+                memo.get((tid, (i + 5) % 37))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(memo) <= 16 + 8  # cap plus at most one in-flight per thread
+
+
+def test_concurrent_parquet_reads_share_footer_memo(tmp_path):
+    import threading
+
+    from hyperspace_tpu.storage import parquet_io
+    from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+
+    for i in range(4):
+        parquet_io.write_parquet(
+            tmp_path / f"f{i}.parquet",
+            ColumnarBatch({"k": Column("int64", np.arange(1000, dtype=np.int64) + i)}),
+        )
+    paths = sorted(str(p) for p in tmp_path.glob("*.parquet"))
+    results, errors = [], []
+
+    def reader():
+        try:
+            for _ in range(20):
+                b = parquet_io.read_parquet(paths, columns=["k"])
+                results.append(b.num_rows)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert set(results) == {4000}
