@@ -92,13 +92,27 @@ def main() -> None:
             best = min(best, time.perf_counter() - t0)
         return out, best
 
+    def measure(plan_r, path_counter=None):
+        """One A/B leg: warm run, then ``repeats`` timed executions.
+        Returns (result, best_s, h2d_bytes/query, d2h_bytes/query).
+        ``path_counter`` asserts the measured path fired on EVERY timed
+        repeat — '>' would be satisfied by the warm run alone and miss a
+        mid-measurement fallback to the ship path."""
+        out, _ = timed(lambda: ex.execute(plan_r), 1)  # warm compile
+        h0 = metrics.counter("dist.h2d_bytes")
+        d0 = metrics.counter("scan.resident_mesh.d2h_bytes")
+        c0 = metrics.counter(path_counter) if path_counter else 0
+        out, best = timed(lambda: ex.execute(plan_r), repeats)
+        if path_counter is not None:
+            assert metrics.counter(path_counter) == c0 + repeats, path_counter
+        h2d = (metrics.counter("dist.h2d_bytes") - h0) / repeats
+        d2h = (metrics.counter("scan.resident_mesh.d2h_bytes") - d0) / repeats
+        return out, best, h2d, d2h
+
     # A: ship-per-query (residency disabled so note_touch can't flip paths
     # mid-measurement)
     os.environ["HYPERSPACE_TPU_HBM"] = "off"
-    r_ship, _ = timed(lambda: ex.execute(rewritten), 1)  # warm compile
-    h0 = metrics.counter("dist.h2d_bytes")
-    r_ship, ship_s = timed(lambda: ex.execute(rewritten), repeats)
-    ship_h2d = (metrics.counter("dist.h2d_bytes") - h0) / repeats
+    r_ship, ship_s, ship_h2d, _ = measure(rewritten)
 
     # B: mesh-resident
     os.environ["HYPERSPACE_TPU_HBM"] = "force"
@@ -106,17 +120,8 @@ def main() -> None:
     table = mesh_cache.prefetch(entry.content.files(), ["k", "q"], mesh)
     prefetch_s = time.perf_counter() - t0
     assert table is not None
-    r_res, _ = timed(lambda: ex.execute(rewritten), 1)  # warm compile
-    h0 = metrics.counter("dist.h2d_bytes")
-    d0 = metrics.counter("scan.resident_mesh.d2h_bytes")
-    res0 = metrics.counter("scan.path.resident_device_mesh")
-    r_res, res_s = timed(lambda: ex.execute(rewritten), repeats)
-    res_h2d = (metrics.counter("dist.h2d_bytes") - h0) / repeats
-    res_d2h = (
-        metrics.counter("scan.resident_mesh.d2h_bytes") - d0
-    ) / repeats
-    assert (
-        metrics.counter("scan.path.resident_device_mesh") == res0 + repeats
+    r_res, res_s, res_h2d, res_d2h = measure(
+        rewritten, path_counter="scan.path.resident_device_mesh"
     )
 
     # parity between the two engines is part of the artifact's claim
@@ -124,6 +129,41 @@ def main() -> None:
     assert int(r_ship.columns["v"].data.sum()) == int(
         r_res.columns["v"].data.sum()
     )
+
+    # the same A/B for the AGGREGATE shape (distributed two-phase
+    # aggregate over the filtered scan — the Q17-style consumer of mesh
+    # residency): resident input means the only per-query device traffic
+    # is the count-matrix D2H (recorded below, same delta as the scan leg)
+    from hyperspace_tpu.plan.aggregates import agg_count, agg_sum
+    from hyperspace_tpu.plan.ir import Aggregate
+
+    agg_plan = Aggregate(
+        ("q",), (agg_sum("v"), agg_count()), Filter(pred, Scan(rel))
+    )
+    agg_rewritten, agg_applied = apply_hyperspace_rules(
+        agg_plan, [entry], conf
+    )
+    assert agg_applied
+    os.environ["HYPERSPACE_TPU_HBM"] = "off"
+    a_ship, agg_ship_s, agg_ship_h2d, _ = measure(agg_rewritten)
+    os.environ["HYPERSPACE_TPU_HBM"] = "force"
+    a_res, agg_res_s, agg_res_h2d, agg_res_d2h = measure(
+        agg_rewritten, path_counter="aggregate.path.resident_mesh"
+    )
+    assert a_ship.num_rows == a_res.num_rows
+
+    def per_group(batch):
+        # every aggregate output participates in parity, not just the sum
+        return {
+            int(k): (int(s), int(c))
+            for k, s, c in zip(
+                batch.columns["q"].data,
+                batch.columns["sum_v"].data,
+                batch.columns["count"].data,
+            )
+        }
+
+    assert per_group(a_ship) == per_group(a_res)
 
     print(
         json.dumps(
@@ -137,6 +177,12 @@ def main() -> None:
                 "resident_h2d_bytes_per_query": int(res_h2d),
                 "resident_counts_d2h_bytes_per_query": int(res_d2h),
                 "resident_s": round(res_s, 4),
+                "agg_groups": int(a_res.num_rows),
+                "agg_ship_h2d_bytes_per_query": int(agg_ship_h2d),
+                "agg_ship_s": round(agg_ship_s, 4),
+                "agg_resident_h2d_bytes_per_query": int(agg_res_h2d),
+                "agg_resident_counts_d2h_bytes_per_query": int(agg_res_d2h),
+                "agg_resident_s": round(agg_res_s, 4),
             }
         )
     )
