@@ -1160,7 +1160,21 @@ def main() -> None:
     # matter how many configs future rounds add — and write the complete
     # detail (timings, variance, engine_paths, hbm, device_kernels) to a
     # sidecar the judge reads from the tree.
-    detail_path = Path(__file__).resolve().parent / "BENCH_DETAIL.json"
+    # Only a FULL real-chip record may replace the committed
+    # BENCH_DETAIL.json (resident configs present, accelerator platform,
+    # device reachable) — the README quotes that artifact, and neither a
+    # wedged-tunnel run nor a JAX_PLATFORMS=cpu / BENCH_DEVICE=0 run must
+    # overwrite it with host-or-CPU-backend numbers. Anything less
+    # records honestly to its own DEGRADED sidecar; the compact line's
+    # "detail" field names whichever file this run actually wrote.
+    env_cpu = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu"
+    full_record = (
+        "resident_device_s" in extras
+        and not extras.get("device_unreachable")
+        and not env_cpu
+    )
+    detail_name = "BENCH_DETAIL.json" if full_record else "BENCH_DETAIL_DEGRADED.json"
+    detail_path = Path(__file__).resolve().parent / detail_name
     detail_path.write_text(json.dumps(detail, indent=1) + "\n")
     compact = dict(scored)
     for k in ("resident_device_s", "resident_device_vs_host", "resident_external_s"):
