@@ -1,0 +1,84 @@
+"""Committed-artifact consistency: the performance story must trace.
+
+Every claim in README/docs quotes a committed JSON artifact (the docs/07
+discipline). These tests pin that contract mechanically: the artifacts
+parse, carry their load-bearing fields, and the README's headline
+numbers match the fields they quote — so a re-recorded artifact that
+drifts from the prose fails CI instead of waiting for a reviewer.
+"""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load(name):
+    p = REPO / name
+    if not p.exists():
+        pytest.skip(f"{name} not present")
+    return json.loads(p.read_text())
+
+
+def test_bench_detail_full_record():
+    d = _load("BENCH_DETAIL.json")
+    # the committed detail must be a FULL real-chip record — degraded
+    # runs write BENCH_DETAIL_DEGRADED.json instead (bench.py)
+    assert not d.get("device_unreachable")
+    for k in (
+        "metric",
+        "value",
+        "external_speedup_geomean",
+        "ext_speedup_resident_scan",
+        "resident_device_s",
+        "resident_host_median_s",
+        "engine_paths",
+        "mesh_ab",
+        "resident_selectivity_curve",
+    ):
+        assert k in d, k
+    # per-config external ratios each carry variance evidence
+    for cfg in ("filter", "join", "q3", "q17"):
+        assert f"{cfg}_index_median_s" in d and f"{cfg}_external_stddev_s" in d
+    # the mesh A/B's core claim: zero per-query H2D on the resident path
+    assert d["mesh_ab"]["resident_h2d_bytes_per_query"] == 0
+    assert d["mesh_ab"]["ship_h2d_bytes_per_query"] > 0
+
+
+def test_scale_artifacts_have_timeline_and_parity_fields():
+    for name in ("BENCH_SCALE.json", "BENCH_SCALE_SF100.json"):
+        d = _load(name)
+        assert d.get("repeats", 1) >= 1
+        t = d["timeline"]
+        for k in (
+            "q3_index_builds_s",
+            "q3_compaction_s",
+            "first_competitive_q3_s",
+            "q3_postopt_ratio_vs_external",
+        ):
+            assert k in t, (name, k)
+        assert d["rows"] >= 60_000_000
+
+
+def test_join_crossover_records_both_engines_and_a_decision():
+    d = _load("JOIN_CROSSOVER.json")
+    assert "decision" in d and "fused_decision" in d
+
+
+def test_readme_headline_numbers_trace_to_bench_detail():
+    d = _load("BENCH_DETAIL.json")
+    readme = (REPO / "README.md").read_text()
+    # geomean: README quotes the committed artifact to one decimal
+    geo = f"{d['external_speedup_geomean']:.1f}"
+    assert re.search(rf"\*\*{re.escape(geo)}×\*\*", readme), (
+        f"README external geomean does not quote the artifact ({geo}x)"
+    )
+    # resident absolute seconds are quoted directly (README may round)
+    v = d["resident_device_s"]
+    assert str(v) in readme or f"{v:.3f}" in readme
+    # resident external ratio, quoted to the nearest integer
+    res = f"{round(d['ext_speedup_resident_scan'])}×"
+    assert res in readme, f"README resident ratio should quote ~{res}"
