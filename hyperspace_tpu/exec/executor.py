@@ -354,7 +354,7 @@ class Executor:
                 # covers every future query's pruned subset (hbm_cache
                 # note_touch rationale)
                 mesh_cache.note_touch(
-                    [Path(p) for p in self._index_files(node)],
+                    self._index_files(node),
                     pred_cols,
                     self.mesh,
                 )
@@ -497,7 +497,7 @@ class Executor:
                     )
             elif mesh_cache.auto_enabled():
                 mesh_cache.note_touch(
-                    [Path(p) for p in self._index_files(node)],
+                    self._index_files(node),
                     pred_cols,
                     self.mesh,
                 )
@@ -920,7 +920,7 @@ def _groups_key(files, columns) -> Optional[tuple]:
     from .hbm_cache import _file_identity
 
     try:
-        idents = [_file_identity(Path(f)) for f in files]
+        idents = [_file_identity(f) for f in files]
     except OSError:
         return None
     return (tuple(sorted(idents)), tuple(columns))

@@ -165,9 +165,13 @@ class ResidentTable:
         return None
 
 
-def _file_identity(path: Path) -> tuple:
-    st = path.stat()
-    return (str(path), st.st_size, st.st_mtime_ns)
+def _file_identity(path: str | Path) -> tuple:
+    # os.stat on the string: this runs per file per query from note_touch
+    # and resident_for — pathlib construction there measured ~30% of a
+    # 4ms point lookup
+    p = str(path)
+    st = os.stat(p)
+    return (p, st.st_size, st.st_mtime_ns)
 
 
 def _encode_column(col: Column) -> Optional[Tuple[np.ndarray, str]]:
@@ -480,7 +484,7 @@ class HbmIndexCache(ResidentCacheBase):
         × ``columns``. Returns the table, or None when no column is
         device-encodable or the table exceeds the whole budget. Idempotent:
         an existing covering table is returned untouched."""
-        paths = sorted(Path(p) for p in files)
+        paths = sorted(str(p) for p in files)
         if not paths:
             return None
         try:
@@ -501,7 +505,7 @@ class HbmIndexCache(ResidentCacheBase):
 
     def note_touch(
         self,
-        files: List[Path],
+        files: List[str | Path],
         columns: List[str],
         n_rows_hint: Optional[int] = None,
     ) -> None:
@@ -518,7 +522,11 @@ class HbmIndexCache(ResidentCacheBase):
             return
         if n_rows_hint is not None and n_rows_hint < _min_auto_rows():
             return
-        paths = sorted(Path(p) for p in files)
+        # strings, not Path objects: this runs on the query thread for
+        # EVERY host-path scan (even ones whose set is memoized as
+        # too-small/failed), and pathlib construction + comparison was
+        # ~30% of a point lookup
+        paths = sorted(str(p) for p in files)
         try:
             key = tuple(_file_identity(p) for p in paths)
         except OSError:
@@ -591,7 +599,7 @@ class HbmIndexCache(ResidentCacheBase):
         t.start()
 
     def _build(
-        self, paths: List[Path], key: tuple, columns: List[str]
+        self, paths: List[str], key: tuple, columns: List[str]
     ) -> Tuple[Optional[ResidentTable], bool]:
         """(table, permanent_refusal). ``permanent_refusal`` marks
         structural conditions for this file version (nothing encodable,
@@ -816,7 +824,7 @@ class HbmIndexCache(ResidentCacheBase):
         return None
 
     def resident_for(
-        self, files: List[Path], columns: List[str]
+        self, files: List[str | Path], columns: List[str]
     ) -> Optional[ResidentTable]:
         """A registered table covering every file in ``files`` (by path +
         size + mtime identity — stale versions never match) with every
@@ -831,7 +839,7 @@ class HbmIndexCache(ResidentCacheBase):
             if not self._tables:
                 return None  # nothing resident: skip the per-file stats
         try:
-            want = {str(Path(p)): _file_identity(Path(p)) for p in files}
+            want = {str(p): _file_identity(p) for p in files}
         except OSError:
             return None
         with self._lock:

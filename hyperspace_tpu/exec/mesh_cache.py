@@ -108,7 +108,7 @@ class MeshResidentTable:
         return self.cap // self.block
 
 
-def _bucket_segments(paths: List[Path]) -> Dict[int, List[Tuple[str, int, int]]]:
+def _bucket_segments(paths: List[str]) -> Dict[int, List[Tuple[str, int, int]]]:
     """bucket -> [(path, file_row_lo, file_row_hi), ...] in path-sorted
     order, from per-bucket file names and run-file footers — the same
     bucket derivation the executor's group-by-bucket uses."""
@@ -205,7 +205,7 @@ class MeshHbmCache(ResidentCacheBase):
         """Synchronously build and register a mesh-sharded resident table.
         Idempotent; returns None when nothing encodes or the table exceeds
         the budget (same refusal semantics as the single-chip cache)."""
-        paths = sorted(Path(p) for p in files)
+        paths = sorted(str(p) for p in files)
         if not paths:
             return None
         try:
@@ -226,7 +226,7 @@ class MeshHbmCache(ResidentCacheBase):
 
     def note_touch(
         self,
-        files: List[Path],
+        files: List[str | Path],
         columns: List[str],
         mesh,
         n_rows_hint: Optional[int] = None,
@@ -239,7 +239,7 @@ class MeshHbmCache(ResidentCacheBase):
             return
         if n_rows_hint is not None and n_rows_hint < _min_auto_rows():
             return
-        paths = sorted(Path(p) for p in files)
+        paths = sorted(str(p) for p in files)
         try:
             key = tuple(_file_identity(p) for p in paths)
         except OSError:
@@ -297,7 +297,7 @@ class MeshHbmCache(ResidentCacheBase):
         t.start()
 
     def _build(
-        self, paths: List[Path], key: tuple, columns: List[str], mesh
+        self, paths: List[str], key: tuple, columns: List[str], mesh
     ) -> Tuple[Optional[MeshResidentTable], bool]:
         """(table, permanent_refusal) — hbm_cache._build semantics, with
         the concat order replaced by the bucket-per-device packing."""
@@ -515,7 +515,7 @@ class MeshHbmCache(ResidentCacheBase):
         return None
 
     def resident_for(
-        self, files: List[Path], columns: List[str], mesh
+        self, files: List[str | Path], columns: List[str], mesh
     ) -> Optional[MeshResidentTable]:
         from .hbm_cache import residency_mode
 
@@ -526,7 +526,7 @@ class MeshHbmCache(ResidentCacheBase):
             if not self._tables:
                 return None
         try:
-            want = {str(Path(p)): _file_identity(Path(p)) for p in files}
+            want = {str(p): _file_identity(p) for p in files}
         except OSError:
             return None
         with self._lock:
@@ -570,7 +570,7 @@ class MeshHbmCache(ResidentCacheBase):
     def collect_parts(
         self,
         table: MeshResidentTable,
-        files: List[Path],
+        files: List[str | Path],
         output_columns: List[str],
         predicate: Expr,
         counts: np.ndarray,

@@ -60,8 +60,10 @@ _RUN_FILE_RE = re.compile(r"^r\d{5,}-[0-9a-f]{12}\.tcb$")  # {5,}: seq >= 100000
 def is_run_file(path: str | Path) -> bool:
     """Matches exactly the names ``run_file_name`` generates — a bare
     'r' prefix would also claim spill scratch ('run-*.tcb') and any
-    future r-named file class."""
-    return bool(_RUN_FILE_RE.match(Path(path).name))
+    future r-named file class. (os.path.basename, not Path().name: this
+    runs per file per query on the scan's pruning path, and pathlib
+    re-parses the whole path just to expose the tail.)"""
+    return bool(_RUN_FILE_RE.match(os.path.basename(str(path))))
 
 
 def run_bucket_offsets(footer: Dict[str, Any]) -> Optional[np.ndarray]:
@@ -79,7 +81,7 @@ def bucket_of_file(path: str | Path) -> int:
     Spark's BucketingUtils.getBucketId used by OptimizeAction.scala:120).
     Run files (``r``-prefixed) hold ALL buckets — callers must check
     ``is_run_file`` first and use ``run_bucket_offsets`` instead."""
-    name = Path(path).name
+    name = os.path.basename(str(path))
     if not (name.startswith("b") and name.endswith(".tcb")):
         raise HyperspaceException(f"Not an index data file: {name}")
     try:

@@ -11,15 +11,15 @@ import json
 import re
 from pathlib import Path
 
-import pytest
-
 REPO = Path(__file__).resolve().parent.parent
 
 
 def _load(name):
+    # REQUIRED artifact: a missing/renamed file must FAIL, not skip — the
+    # whole point is catching README-vs-artifact drift mechanically (a
+    # skip would let a deleted artifact leave the prose unbacked)
     p = REPO / name
-    if not p.exists():
-        pytest.skip(f"{name} not present")
+    assert p.exists(), f"required committed artifact {name} is missing"
     return json.loads(p.read_text())
 
 
@@ -76,9 +76,11 @@ def test_readme_headline_numbers_trace_to_bench_detail():
     assert re.search(rf"\*\*{re.escape(geo)}×\*\*", readme), (
         f"README external geomean does not quote the artifact ({geo}x)"
     )
-    # resident absolute seconds are quoted directly (README may round)
+    # resident absolute seconds are quoted directly (README may round);
+    # word-boundary anchored so a prefix of some other number can't match
     v = d["resident_device_s"]
-    assert str(v) in readme or f"{v:.3f}" in readme
+    pat = rf"(?<![\d.])({re.escape(str(v))}|{v:.3f})(?![\d])"
+    assert re.search(pat, readme), f"README does not quote resident_device_s={v}"
     # resident external ratio, quoted to the nearest integer
     res = f"{round(d['ext_speedup_resident_scan'])}×"
     assert res in readme, f"README resident ratio should quote ~{res}"
