@@ -21,7 +21,15 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "tcb_io.cc"
+# canonical source lives at <repo>/native/tcb_io.cc; an installed wheel
+# instead carries an in-package copy (pyproject package-data). First
+# existing wins; with neither present every entry point stays on its
+# pure-Python fallback.
+_SRC_CANDIDATES = (
+    Path(__file__).resolve().parent.parent.parent / "native" / "tcb_io.cc",
+    Path(__file__).resolve().parent / "tcb_io.cc",
+)
+_SRC = next((p for p in _SRC_CANDIDATES if p.exists()), _SRC_CANDIDATES[0])
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_FAILED = False
@@ -34,7 +42,11 @@ def _build_dir() -> Path:
     d = os.environ.get("HYPERSPACE_TPU_NATIVE_DIR")
     if d:
         return Path(d)
-    if os.access(_SRC.parent, os.W_OK):
+    # repo checkout: build next to the canonical source as always.
+    # Installed wheel (in-package source): NEVER write into
+    # site-packages — artifacts there outlive `pip uninstall` — compile
+    # into the user cache instead.
+    if _SRC == _SRC_CANDIDATES[0] and os.access(_SRC.parent, os.W_OK):
         return _SRC.parent / "build"
     return Path.home() / ".cache" / "hyperspace_tpu"
 
@@ -42,9 +54,16 @@ def _build_dir() -> Path:
 def _compile() -> Optional[Path]:
     if not _SRC.exists():
         return None
+    src_bytes = _SRC.read_bytes()
+    # content-hash-keyed output: the shared user cache can serve several
+    # venvs/versions at once, and an mtime check would let one version
+    # silently load a .so compiled from another's source
+    import hashlib
+
+    tag = hashlib.sha256(src_bytes).hexdigest()[:12]
     out_dir = _build_dir()
-    out = out_dir / "libtcb_io.so"
-    if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
+    out = out_dir / f"libtcb_io.{tag}.so"
+    if out.exists():
         return out
     try:
         out_dir.mkdir(parents=True, exist_ok=True)

@@ -93,3 +93,16 @@ def test_write_file_atomic(tmp_path, lib_available):
     assert native.write_file_atomic(str(p), data)
     assert np.array_equal(np.fromfile(p, dtype=np.int64), data)
     assert not list(tmp_path.glob(".out.bin.*"))  # no tmp litter
+
+
+def test_packaged_native_source_in_sync():
+    # the wheel ships hyperspace_tpu/native/tcb_io.cc (pyproject
+    # package-data); the canonical source is native/tcb_io.cc — they must
+    # stay byte-identical or installed wheels silently run stale native
+    # code
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    canonical = (repo / "native" / "tcb_io.cc").read_bytes()
+    packaged = (repo / "hyperspace_tpu" / "native" / "tcb_io.cc").read_bytes()
+    assert canonical == packaged
