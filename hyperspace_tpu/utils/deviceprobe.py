@@ -70,8 +70,9 @@ def first_device_touch_ok(timeout_s: float | None = None) -> bool:
                 arr.block_until_ready()
                 np.asarray(arr)
                 result["ok"] = True
-            except Exception:  # noqa: BLE001 - any init failure = no device
+            except Exception as e:  # noqa: BLE001 - any init failure = no device
                 result["ok"] = False
+                result["error"] = repr(e)  # a raise is NOT a hang: surface it
 
         t = threading.Thread(
             target=touch, daemon=True, name="hyperspace-device-first-touch"
@@ -80,4 +81,14 @@ def first_device_touch_ok(timeout_s: float | None = None) -> bool:
         t.join(timeout_s)
         ok = result.get("ok", False)
         _FIRST_TOUCH["ok"] = ok
+        # timeout leaves no "error": callers can distinguish a hang from a
+        # raise (first_touch_error() below)
+        _FIRST_TOUCH["error"] = result.get("error")
         return ok
+
+
+def first_touch_error() -> "str | None":
+    """The exception repr of a FAILED (not timed-out) first touch, or
+    None — lets callers report a broken jax install as what it is instead
+    of blaming the device tunnel."""
+    return _FIRST_TOUCH.get("error")
