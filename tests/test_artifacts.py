@@ -84,3 +84,17 @@ def test_readme_headline_numbers_trace_to_bench_detail():
     # resident external ratio, quoted to the nearest integer
     res = f"{round(d['ext_speedup_resident_scan'])}×"
     assert res in readme, f"README resident ratio should quote ~{res}"
+
+
+def test_readme_host_record_numbers_trace():
+    d = _load("BENCH_HOST_R5.json")
+    readme = (REPO / "README.md").read_text()
+    assert d.get("device_unreachable") is True  # honestly-degraded record
+    geo = f"{d['external_speedup_geomean']:.1f}"
+    # anchor to the host-record paragraph: a bold quote elsewhere in the
+    # README must not satisfy this artifact's trace
+    m = re.search(r"`BENCH_HOST_R5\.json`(.{0,600})", readme, re.S)
+    assert m, "README no longer cites BENCH_HOST_R5.json"
+    assert f"**{geo}×**" in m.group(1), (
+        f"host-record paragraph should quote {geo}x"
+    )
