@@ -1,0 +1,39 @@
+"""hslint — repo-tuned static analysis for TPU-native invariants.
+
+Six rules, each encoding a bug class that actually shipped here (the
+round-5 advisor findings are the seed violations); see
+docs/09-static-analysis.md for the catalog. Entry points:
+
+    from hyperspace_tpu.analysis import run_analysis, analyze_source
+    findings = run_analysis([Path("hyperspace_tpu")])
+
+or the CLI: ``python scripts/lint.py hyperspace_tpu scripts bench.py``.
+Suppress intentional boundary violations per line with
+``# hslint: disable=HSxxx`` plus a justification comment.
+"""
+
+from __future__ import annotations
+
+from .core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    analyze_file,
+    analyze_source,
+    iter_python_files,
+    run_analysis,
+)
+from .reporter import render_json, render_text, summarize
+
+__all__ = [
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "analyze_file",
+    "analyze_source",
+    "iter_python_files",
+    "run_analysis",
+    "render_json",
+    "render_text",
+    "summarize",
+]

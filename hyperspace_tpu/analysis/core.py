@@ -1,0 +1,248 @@
+"""hslint core: module parsing, rule protocol, suppressions, findings.
+
+The analyzer is deliberately self-contained — stdlib ``ast`` only, no
+third-party dependency — so it runs anywhere the package imports,
+including CI images without the accelerator toolchain. Rules are
+*repo-tuned heuristics*, not a type system: each one encodes a bug class
+that has actually shipped here (see docs/09-static-analysis.md for the
+catalog and the known blind spots of each heuristic). Intentional
+violations at genuine host/device or IO boundaries carry a per-line
+``# hslint: disable=HSxxx`` suppression with a justification comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+# ``# hslint: disable=HS001,HS003`` suppresses those codes on that line;
+# ``# hslint: disable`` (no codes) suppresses every rule on that line.
+_SUPPRESS_RE = re.compile(
+    r"#\s*hslint:\s*disable(?:=(?P<codes>[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*))?"
+)
+
+_SKIP_DIR_NAMES = {
+    ".git",
+    "__pycache__",
+    "build",
+    ".venv",
+    "venv",
+    "node_modules",
+    ".eggs",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    code: str
+    message: str
+    path: str
+    line: int
+    col: int
+    suppressed: bool = False
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}{tag}"
+
+    def to_json_dict(self) -> Dict[str, object]:
+        return {
+            "code": self.code,
+            "message": self.message,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "suppressed": self.suppressed,
+        }
+
+
+class ModuleContext:
+    """Everything a rule needs about one parsed module."""
+
+    def __init__(self, source: str, path: str) -> None:
+        self.source = source
+        self.path = path
+        # posix form so rules can scope on "hyperspace_tpu/exec/" regardless
+        # of the OS separator or whether the caller passed an absolute path
+        self.posix = Path(path).as_posix()
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = build_aliases(self.tree)
+
+    def text_at(self, line: int) -> str:
+        return self.lines[line - 1] if 1 <= line <= len(self.lines) else ""
+
+
+class Rule:
+    """One analysis pass. Subclasses set ``code``/``name``/``description``
+    and implement ``check`` yielding ``(line, col, message)`` tuples."""
+
+    code: str = "HS000"
+    name: str = "base"
+    description: str = ""
+
+    def applies_to(self, posix_path: str) -> bool:
+        return True
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        raise NotImplementedError
+
+
+def build_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local name → dotted origin for every import in the module, so rules
+    match ``np.asarray`` and ``from time import sleep`` alike."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                else:
+                    root = a.name.split(".")[0]
+                    aliases[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.level == 0:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Resolved dotted name of a Name/Attribute chain (``np.asarray`` →
+    ``numpy.asarray``), or None when the chain is rooted in a call,
+    subscript, or other expression."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(aliases.get(cur.id, cur.id))
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The last identifier of a Name/Attribute expression (``self._lock``
+    → ``_lock``), or None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """Line → suppressed codes (None means all codes) from hslint
+    comments. A trailing marker suppresses its own line; a STANDALONE
+    comment line carrying the marker suppresses the next code line — the
+    idiom for a suppression whose justification deserves a full line:
+
+        # hslint: disable=HS004 - the decline is recorded in the row
+        except Exception:
+            ...
+
+    Further comment-only lines may sit between the marker and the code
+    line (multi-line justifications). Matching is textual (``ast`` drops
+    comments); a string literal containing the marker would also match —
+    acceptable for a lint-control channel."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    lines = source.splitlines()
+
+    def merge(line_no: int, codes: Optional[str]) -> None:
+        if codes is None:
+            out[line_no] = None
+            return
+        got = {c.strip() for c in codes.split(",") if c.strip()}
+        prev = out.get(line_no, set())
+        out[line_no] = None if prev is None else (prev or set()) | got
+
+    for i, line in enumerate(lines, start=1):
+        if "hslint" not in line:
+            continue
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        if line.lstrip().startswith("#"):
+            # standalone marker: bind to the next non-comment, non-blank
+            # line (skipping the justification's continuation comments)
+            j = i
+            while j < len(lines):
+                nxt = lines[j].strip()
+                if nxt and not nxt.startswith("#"):
+                    merge(j + 1, m.group("codes"))
+                    break
+                j += 1
+        else:
+            merge(i, m.group("codes"))
+    return out
+
+
+def analyze_source(
+    source: str, path: str, rules: Optional[Sequence[Rule]] = None
+) -> List[Finding]:
+    """All findings (suppressed ones flagged, not dropped) for one module's
+    source text. ``path`` drives per-rule scoping, so fixture tests can
+    place a snippet anywhere in the virtual tree."""
+    if rules is None:
+        from .rules import REGISTRY
+
+        rules = REGISTRY
+    ctx = ModuleContext(source, path)
+    suppressions = parse_suppressions(source)
+    findings: List[Finding] = []
+    for rule in rules:
+        if not rule.applies_to(ctx.posix):
+            continue
+        for line, col, message in rule.check(ctx):
+            codes = suppressions.get(line, "absent")
+            suppressed = codes != "absent" and (codes is None or rule.code in codes)
+            findings.append(
+                Finding(rule.code, message, path, line, col, bool(suppressed))
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def analyze_file(path: Path, rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    source = path.read_text(encoding="utf-8")
+    try:
+        return analyze_source(source, str(path), rules)
+    except SyntaxError as e:
+        return [
+            Finding(
+                "HS000",
+                f"syntax error prevents analysis: {e.msg}",
+                str(path),
+                e.lineno or 1,
+                (e.offset or 1) - 1,
+            )
+        ]
+
+
+def iter_python_files(paths: Iterable[Path]) -> Iterator[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_file():
+            if p.suffix == ".py":
+                yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not any(part in _SKIP_DIR_NAMES for part in f.parts):
+                    yield f
+
+
+def run_analysis(
+    paths: Iterable[Path], rules: Optional[Sequence[Rule]] = None
+) -> List[Finding]:
+    """Lint every ``.py`` under ``paths`` (files or directories) and return
+    the combined findings list."""
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(analyze_file(f, rules))
+    return findings

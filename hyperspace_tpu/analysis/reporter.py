@@ -1,0 +1,46 @@
+"""hslint reporters: human text and machine JSON renderings of findings."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from .core import Finding
+
+
+def summarize(findings: Sequence[Finding]) -> Dict[str, object]:
+    by_code: Dict[str, int] = {}
+    for f in findings:
+        if not f.suppressed:
+            by_code[f.code] = by_code.get(f.code, 0) + 1
+    return {
+        "total": len(findings),
+        "unsuppressed": sum(1 for f in findings if not f.suppressed),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "by_code": dict(sorted(by_code.items())),
+    }
+
+
+def render_text(findings: Sequence[Finding], show_suppressed: bool = False) -> str:
+    shown = [f for f in findings if show_suppressed or not f.suppressed]
+    lines = [f.render() for f in shown]
+    s = summarize(findings)
+    lines.append(
+        f"hslint: {s['unsuppressed']} finding(s), {s['suppressed']} suppressed"
+    )
+    if s["by_code"]:
+        lines.append(
+            "  " + ", ".join(f"{c}: {n}" for c, n in s["by_code"].items())
+        )
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps(
+        {
+            "findings": [f.to_json_dict() for f in findings],
+            "summary": summarize(findings),
+        },
+        indent=2,
+        sort_keys=True,
+    )

@@ -1,0 +1,36 @@
+"""hslint rule registry: one instance per rule, ordered by code.
+
+Adding a rule = add a module here and append an instance to REGISTRY;
+``scripts/lint.py --list-rules`` and the docs table read this list.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Rule
+from .hs001_host_sync import HostSyncRule
+from .hs002_lock_blocking import LockBlockingRule
+from .hs003_path_keys import PathKeyRule
+from .hs004_swallowed_exceptions import SwallowedExceptionRule
+from .hs005_nondeterministic_hashing import NondeterministicHashRule
+from .hs006_unbounded_cache import UnboundedCacheRule
+
+REGISTRY: List[Rule] = [
+    HostSyncRule(),
+    LockBlockingRule(),
+    PathKeyRule(),
+    SwallowedExceptionRule(),
+    NondeterministicHashRule(),
+    UnboundedCacheRule(),
+]
+
+__all__ = [
+    "REGISTRY",
+    "HostSyncRule",
+    "LockBlockingRule",
+    "PathKeyRule",
+    "SwallowedExceptionRule",
+    "NondeterministicHashRule",
+    "UnboundedCacheRule",
+]

@@ -256,7 +256,10 @@ class Executor:
     ) -> ColumnarBatch:
         if predicate is None or batch.num_rows == 0:
             return batch
-        mask = np.asarray(eval_mask(predicate, batch))
+        # host evaluation (arrays=None) returns numpy already; wrapping it
+        # in np.asarray was a no-op that would also silently DMA a device
+        # mask home if one ever leaked here (hslint HS001)
+        mask = eval_mask(predicate, batch)
         return batch.take(np.flatnonzero(mask))
 
     # -- scans ---------------------------------------------------------------
@@ -650,7 +653,8 @@ class Executor:
                         f"Run file {f} carries no bucketCounts footer."
                     )
                 for b in range(len(offs) - 1):
-                    s, e = int(offs[b]), int(offs[b + 1])
+                    # offs is a host array decoded from the JSON footer
+                    s, e = int(offs[b]), int(offs[b + 1])  # hslint: disable=HS001
                     if e > s:
                         groups.setdefault(b, []).append(
                             batch.take(np.arange(s, e))

@@ -314,6 +314,9 @@ def prepare_resident_predicate(
         try:
             predicate = bind_string_literals(predicate, shim)
         except Exception:  # noqa: BLE001 - unbindable shape: route host
+            # count the decline: a predicate shape that silently never
+            # binds keeps every query on the host path with no trace
+            metrics.incr("hbm.predicate_unbindable")
             return None
     f64_cols = {n for n in names if columns[n].enc == "f64"}
     if f64_cols:
@@ -622,6 +625,7 @@ class HbmIndexCache(ResidentCacheBase):
         try:
             readers = [layout.cached_reader(p) for p in paths]
         except Exception:  # noqa: BLE001 - vanished file = no residency
+            metrics.incr("hbm.prefetch_read_error")
             return None, False
         spans: List[Tuple[str, int, int]] = []
         start = 0
@@ -801,6 +805,7 @@ class HbmIndexCache(ResidentCacheBase):
                 + [c.data2 for c in cols.values() if c.data2 is not None]
             )
         except Exception:  # noqa: BLE001 - device loss: no residency
+            metrics.incr("hbm.device_transfer_error")
             return None, False
         if nbytes > _budget_bytes():
             metrics.incr("hbm.over_budget_refused")

@@ -178,7 +178,8 @@ def _segments_sorted(codes: np.ndarray, bounds: np.ndarray) -> bool:
     descents = np.flatnonzero(np.diff(codes) < 0)
     if not len(descents):
         return True
-    allowed = set((np.asarray(bounds[1:-1]) - 1).tolist())
+    # bounds are host segment offsets (list or host ndarray), never device
+    allowed = set((np.asarray(bounds[1:-1]) - 1).tolist())  # hslint: disable=HS001
     return all(int(d) in allowed for d in descents)
 
 
@@ -267,8 +268,9 @@ def segmented_join_ranges(
     lo = np.empty(len(l_codes), dtype=np.int64)
     counts = np.empty(len(l_codes), dtype=np.int64)
     for k in range(len(l_bounds) - 1):
-        ls, le = int(l_bounds[k]), int(l_bounds[k + 1])
-        rs, re = int(r_bounds[k]), int(r_bounds[k + 1])
+        # host numpy merge engine: bounds live on host by contract
+        ls, le = int(l_bounds[k]), int(l_bounds[k + 1])  # hslint: disable=HS001
+        rs, re = int(r_bounds[k]), int(r_bounds[k + 1])  # hslint: disable=HS001
         seg = r_codes[rs:re]
         q = l_codes[ls:le]
         left_pos = np.searchsorted(seg, q, side="left")
@@ -296,11 +298,12 @@ def _flat_segment_remap(
     span = mx - mn + 1
     if span <= 0 or n_seg * span >= (1 << 62):
         return None
+    # bounds are host segment offsets; the remap itself is host-side prep
     l_seg = np.repeat(
-        np.arange(n_seg, dtype=np.int64), np.diff(np.asarray(l_bounds))
+        np.arange(n_seg, dtype=np.int64), np.diff(np.asarray(l_bounds))  # hslint: disable=HS001
     )
     r_seg = np.repeat(
-        np.arange(n_seg, dtype=np.int64), np.diff(np.asarray(r_bounds))
+        np.arange(n_seg, dtype=np.int64), np.diff(np.asarray(r_bounds))  # hslint: disable=HS001
     )
     sp = np.int64(span)
     return l_seg * sp + (l_codes - mn), r_seg * sp + (r_codes - mn)

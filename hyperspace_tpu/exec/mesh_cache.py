@@ -316,6 +316,7 @@ class MeshHbmCache(ResidentCacheBase):
         except HyperspaceException:
             return None, True
         except Exception:  # noqa: BLE001 - vanished file = no residency
+            metrics.incr("hbm.mesh.prefetch_read_error")
             return None, False
         if not by_bucket:
             return None, True
@@ -478,6 +479,7 @@ class MeshHbmCache(ResidentCacheBase):
                 + [c.data2 for c in cols.values() if c.data2 is not None]
             )
         except Exception:  # noqa: BLE001 - device loss: no residency
+            metrics.incr("hbm.mesh.device_transfer_error")
             return None, False
         if nbytes > _budget_bytes():
             metrics.incr("hbm.mesh.over_budget_refused")

@@ -224,6 +224,9 @@ class ScanGate:
             np.asarray(back)
             return time.perf_counter() - t0
         except Exception:  # noqa: BLE001 - probing must never fail a scan
+            # a failed link probe gates every scan host-side with nothing
+            # in the scan.gate.* metrics saying why — count it
+            metrics.incr("scan.gate.probe_link_error")
             return None
 
     def _disk_key(self, n_pad: int) -> tuple:

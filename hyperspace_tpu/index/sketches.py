@@ -102,8 +102,21 @@ class SketchSpec:
         """Normalize the predicate ONCE and return ``test(data) -> bool``
         for per-file evaluation — literal conversion (and bloom position
         hashing) are loop-invariant across a file list, and at 64-file
-        sources doing them per file dominated the rule's rewrite time."""
-        raise NotImplementedError
+        sources doing them per file dominated the rule's rewrite time.
+
+        Default: wrap a LEGACY subclass's overridden ``can_match`` (the
+        previous extension point). prune_files now calls prepare_test
+        directly; without this default a can_match-only subclass raised
+        NotImplementedError, which the rule's error handling turned into
+        silently disabled skipping (round-5 advisor finding #1). The
+        override check guards against recursing into the base can_match,
+        which itself delegates here."""
+        if type(self).can_match is not SketchSpec.can_match:
+            return lambda data: self.can_match(data, dtype_str, bounds, pins)
+        raise NotImplementedError(
+            f"{type(self).__name__} must override prepare_test (preferred) "
+            "or can_match"
+        )
 
     def can_match(
         self,

@@ -41,6 +41,7 @@ from ..exceptions import HyperspaceException
 from ..storage import layout
 from ..storage.columnar import Column, ColumnarBatch, is_string
 from ..telemetry.metrics import metrics
+from ..utils.memo import bounded_memo_put
 
 SPILL_DIR_NAME = ".spill"
 
@@ -52,8 +53,10 @@ SPILL_DIR_NAME = ".spill"
 # index. Capacity stays in the key because the device/host ratio flips
 # with chunk size (host sort is O(n log n) on real rows, device D2H scales
 # with the padded capacity); capacities are already power-of-two quantized
-# so the memo stays small.
+# so the memo stays small — and bounded_memo_put makes that bound
+# explicit instead of an argument in a comment (hslint HS006).
 _ENGINE_CACHE: Dict[tuple, str] = {}
+_ENGINE_CACHE_MAX = 64
 
 
 def _engine_cache_key(chunk_capacity: int) -> tuple:
@@ -95,8 +98,19 @@ def _load_persisted_winner(key: tuple) -> Optional[str]:
     if p is None:
         return None
     try:
-        data = json.loads(p.read_text())
-    except Exception:  # noqa: BLE001 - absent/corrupt cache = no verdict
+        text = p.read_text()
+    except OSError:  # absent/unreadable cache = no verdict (common case)
+        return None
+    try:
+        data = json.loads(text)
+    except ValueError:
+        # corrupt cache silently disables cross-process probe reuse —
+        # every future build re-pays the probe; make that visible
+        metrics.incr("build.engine.probe_cache_corrupt")
+        return None
+    if not isinstance(data, dict):
+        # valid JSON that is not an object (truncated/clobbered write)
+        metrics.incr("build.engine.probe_cache_corrupt")
         return None
     v = data.get(f"{key[0]}:{key[1]}")
     if not isinstance(v, dict) or v.get("winner") not in ("device", "host"):
@@ -104,7 +118,7 @@ def _load_persisted_winner(key: tuple) -> Optional[str]:
     try:
         if time.time() - float(v["ts"]) > PROBE_CACHE_TTL_S:
             return None
-    except Exception:  # noqa: BLE001 - malformed timestamp = stale
+    except (KeyError, TypeError, ValueError):  # missing/malformed ts = stale
         return None
     return v["winner"]
 
@@ -117,14 +131,15 @@ def _persist_winner(key: tuple, choice: str) -> None:
         p.parent.mkdir(parents=True, exist_ok=True)
         try:
             data = json.loads(p.read_text())
-        except Exception:  # noqa: BLE001
+        except (OSError, ValueError):  # fresh or corrupt file: start over
             data = {}
         data[f"{key[0]}:{key[1]}"] = {"winner": choice, "ts": time.time()}
         tmp = p.with_name(p.name + f".tmp-{uuid.uuid4().hex[:8]}")
         tmp.write_text(json.dumps(data, indent=0))
         os.replace(tmp, p)  # atomic: concurrent writers last-write-win
     except Exception:  # noqa: BLE001 - caching must never fail a build
-        pass
+        # but a persistently unwritable cache silently re-probes forever
+        metrics.incr("build.engine.probe_cache_write_error")
 
 
 def sort_encoding(col: Column) -> np.ndarray:
@@ -267,7 +282,7 @@ class StreamingIndexWriter:
         if persisted is not None and (
             persisted == "host" or batch_rows >= self.chunk_capacity
         ):
-            _ENGINE_CACHE[key] = persisted
+            bounded_memo_put(_ENGINE_CACHE, key, persisted, _ENGINE_CACHE_MAX)
             metrics.incr("build.engine.winner_from_disk_cache")
             return persisted
         if batch_rows < self.chunk_capacity:
@@ -324,6 +339,9 @@ class StreamingIndexWriter:
             total += sample.num_rows * 4
             link_s = time.perf_counter() - t0
         except Exception:  # noqa: BLE001 - probing must never fail a build
+            # a failed link probe routes host with no evidence why builds
+            # stopped using the device — count it
+            metrics.incr("build.engine.probe_link_error")
             return False
         metrics.record_time("build.engine.probe_link", link_s)
         return total > 0 and link_s > host_s
@@ -337,7 +355,7 @@ class StreamingIndexWriter:
         the probe cache's 24h TTL after a one-session wedge."""
         self._probe["winner"] = 1.0 if choice == "host" else 0.0
         key = _engine_cache_key(self.chunk_capacity)
-        _ENGINE_CACHE[key] = choice
+        bounded_memo_put(_ENGINE_CACHE, key, choice, _ENGINE_CACHE_MAX)
         if not self._probe.get("unreachable"):
             _persist_winner(key, choice)
         metrics.incr(f"build.engine.auto_chose_{choice}")
@@ -498,7 +516,12 @@ class StreamingIndexWriter:
                 # verdict stays, a restarted tunnel heals next process).
                 metrics.incr("build.engine.device_unreachable")
                 self._probe["unreachable"] = True
-                _ENGINE_CACHE[_engine_cache_key(self.chunk_capacity)] = "host"
+                bounded_memo_put(
+                    _ENGINE_CACHE,
+                    _engine_cache_key(self.chunk_capacity),
+                    "host",
+                    _ENGINE_CACHE_MAX,
+                )
                 engine = "host"
             if engine in ("host", "probe-host"):
                 from ..ops.build import build_partition_host

@@ -51,6 +51,35 @@ def _build_dir() -> Path:
     return Path.home() / ".cache" / "hyperspace_tpu"
 
 
+# content-tagged builds to retain when pruning: the newest few cover the
+# versions a machine realistically runs side by side; everything older is
+# a source revision nobody loads again (ADVICE round-5 #3: the shared
+# user cache grew one .so per revision forever)
+_KEEP_SO_BUILDS = 4
+
+
+def _prune_stale_builds(out_dir: Path, keep: Path) -> None:
+    """Drop all but the newest ``_KEEP_SO_BUILDS`` content-tagged builds
+    (by mtime; ``keep`` — the .so just built/loaded — always survives).
+    Best-effort: a racing process pruning the same directory must never
+    fail the build that succeeded."""
+    try:
+        sos = sorted(
+            out_dir.glob("libtcb_io.*.so"),
+            key=lambda p: p.stat().st_mtime_ns,
+            reverse=True,
+        )
+    except OSError:
+        return
+    for stale in sos[_KEEP_SO_BUILDS:]:
+        if stale == keep:
+            continue
+        try:
+            stale.unlink()
+        except OSError:
+            pass  # racing pruner or permissions: leave it
+
+
 def _compile() -> Optional[Path]:
     if not _SRC.exists():
         return None
@@ -74,6 +103,7 @@ def _compile() -> Optional[Path]:
             check=True, capture_output=True, timeout=120,
         )
         os.replace(tmp, out)
+        _prune_stale_builds(out_dir, out)
         return out
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
         return None

@@ -33,6 +33,8 @@ def configured_platform() -> str:
 
         cfg = getattr(jax.config, "jax_platforms", None)
         return cfg.split(",")[0].strip() if cfg else jax.default_backend()
+    # hslint: disable=HS004 - "unknown" IS the answer: platform detection
+    # is advisory and callers branch on the returned string
     except Exception:  # noqa: BLE001 - advisory only
         return "unknown"
 
@@ -100,6 +102,9 @@ def _enable_persistent_compile_cache(jax) -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # hslint: disable=HS004 - capability probe at import time: an older
+    # jax without these flags only loses warm-compile caching, and there
+    # is no telemetry sink this early in process startup
     except Exception:
         pass  # older jax without these flags: cold compiles only
 

@@ -52,6 +52,9 @@ def _parquet_file(path: str):
 
     import pyarrow.parquet as pq
 
+    # str/Path callers must share one slot: the annotation does not stop a
+    # Path from arriving, and a raw-argument key halves effective capacity
+    path = str(path)
     st = os.stat(path)
     key = (path, st.st_size, st.st_mtime_ns)
     meta = _PQ_META_MEMO.get(key)
@@ -81,7 +84,11 @@ def read_parquet(
             try:
                 return pq.read_table(p, columns=columns, filters=arrow_filter)
             except Exception:  # noqa: BLE001 - pushdown is an optimization
-                pass
+                # count the fallback: a silently-declined pushdown costs a
+                # full-file decode per read with nothing else visible
+                from ..telemetry.metrics import metrics
+
+                metrics.incr("scan.arrow_pushdown_fallback")
         return _parquet_file(p).read(columns=columns)
 
     # column pushdown at the parquet reader; projection re-applied uniformly

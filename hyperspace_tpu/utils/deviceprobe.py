@@ -27,28 +27,55 @@ def device_reachable(timeout_s: int = 150) -> bool:
             timeout=timeout_s,
         )
         return p.returncode == 0 and "ok" in p.stdout
+    # hslint: disable=HS004 - the False return IS the probe verdict;
+    # callers branch on it and degrade to host paths (nothing is silent)
     except Exception:  # noqa: BLE001 - timeout or spawn failure
         return False
 
 
-# Process-wide first-touch verdict. Latched: once the watchdog times out,
-# every later caller in this process routes host immediately instead of
-# re-paying the timeout.
+# Process-wide first-touch verdict. Latched: once any caller's watchdog
+# times out, every later caller in this process routes host immediately
+# instead of re-paying the timeout. The LOCK guards only the (tiny)
+# starter election and verdict latch; callers wait on the EVENT with
+# their OWN timeout_s — holding the mutex across the 120 s join meant a
+# second thread's first touch blocked uninterruptibly for the full
+# default timeout regardless of the timeout it asked for.
 _FIRST_TOUCH_LOCK = threading.Lock()
 _FIRST_TOUCH: dict = {}
+_FIRST_TOUCH_DONE = threading.Event()
+
+
+def _latch_first_touch(
+    ok: bool, error: "str | None", token: "object | None" = None
+) -> None:
+    """Record the process verdict once (first writer wins) and wake every
+    waiter. A late-completing touch thread cannot overwrite a timeout
+    verdict that callers already acted on. ``token`` is the touch thread's
+    election token: a leaked watchdog thread from a superseded election
+    (the latch was reset, e.g. between tests) must not write into the new
+    epoch's latch — its verdict is about a touch nobody is waiting on.
+    Live callers latch unconditionally (``token=None``)."""
+    with _FIRST_TOUCH_LOCK:
+        if token is not None and _FIRST_TOUCH.get("token") is not token:
+            return
+        if "ok" not in _FIRST_TOUCH:
+            _FIRST_TOUCH["ok"] = ok
+            _FIRST_TOUCH["error"] = error
+        _FIRST_TOUCH_DONE.set()
 
 
 def first_device_touch_ok(timeout_s: float | None = None) -> bool:
     """Perform this process's first in-process device touch (one tiny
     ``device_put`` round trip — backend init rides it) under a WATCHDOG:
     a wedged tunnel blocks backend init forever with the GIL released, so
-    running it on a daemon thread with a join timeout turns an infinite
-    hang into a bounded one. Returns False on timeout or error; the
-    blocked daemon thread is leaked deliberately (it cannot be cancelled
-    and does not block process exit). Callers treat False as "route
-    host-side". Timeout default 120s (cold device runtimes take tens of
-    seconds; the first touch does not compile anything), overridable via
-    ``HYPERSPACE_TPU_FIRST_TOUCH_TIMEOUT_S``."""
+    running it on a daemon thread turns an infinite hang into a bounded
+    one. Returns False on timeout or error; the blocked daemon thread is
+    leaked deliberately (it cannot be cancelled and does not block
+    process exit). Callers treat False as "route host-side". Concurrent
+    callers each honor their OWN ``timeout_s`` (they wait on a latch
+    event, not a mutex). Timeout default 120s (cold device runtimes take
+    tens of seconds; the first touch does not compile anything),
+    overridable via ``HYPERSPACE_TPU_FIRST_TOUCH_TIMEOUT_S``."""
     if timeout_s is None:
         try:
             timeout_s = float(
@@ -56,35 +83,36 @@ def first_device_touch_ok(timeout_s: float | None = None) -> bool:
             )
         except ValueError:
             timeout_s = 120.0
+    if "ok" in _FIRST_TOUCH:
+        return _FIRST_TOUCH["ok"]
     with _FIRST_TOUCH_LOCK:
         if "ok" in _FIRST_TOUCH:
             return _FIRST_TOUCH["ok"]
-        result: dict = {}
+        if not _FIRST_TOUCH.get("started"):
+            _FIRST_TOUCH["started"] = True
+            token = _FIRST_TOUCH["token"] = object()
 
-        def touch() -> None:
-            try:
-                import jax
-                import numpy as np
+            def touch() -> None:
+                try:
+                    import jax
+                    import numpy as np
 
-                arr = jax.device_put(np.zeros(16, dtype=np.int32))
-                arr.block_until_ready()
-                np.asarray(arr)
-                result["ok"] = True
-            except Exception as e:  # noqa: BLE001 - any init failure = no device
-                result["ok"] = False
-                result["error"] = repr(e)  # a raise is NOT a hang: surface it
+                    arr = jax.device_put(np.zeros(16, dtype=np.int32))
+                    arr.block_until_ready()
+                    np.asarray(arr)
+                    _latch_first_touch(True, None, token)
+                except Exception as e:  # noqa: BLE001 - init failure = no device
+                    # a raise is NOT a hang: surface it (first_touch_error)
+                    _latch_first_touch(False, repr(e), token)
 
-        t = threading.Thread(
-            target=touch, daemon=True, name="hyperspace-device-first-touch"
-        )
-        t.start()
-        t.join(timeout_s)
-        ok = result.get("ok", False)
-        _FIRST_TOUCH["ok"] = ok
-        # timeout leaves no "error": callers can distinguish a hang from a
-        # raise (first_touch_error() below)
-        _FIRST_TOUCH["error"] = result.get("error")
-        return ok
+            threading.Thread(
+                target=touch, daemon=True, name="hyperspace-device-first-touch"
+            ).start()
+    _FIRST_TOUCH_DONE.wait(timeout_s)
+    # wait timed out with no verdict: latch the hang verdict ourselves
+    # ("error" stays None so callers can distinguish a hang from a raise)
+    _latch_first_touch(False, None)
+    return _FIRST_TOUCH["ok"]
 
 
 def first_touch_error() -> "str | None":

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""hslint CLI — run the repo-tuned static analyzer.
+
+Usage:
+    python scripts/lint.py hyperspace_tpu scripts bench.py
+    python scripts/lint.py --format json hyperspace_tpu
+    python scripts/lint.py --list-rules
+
+Exit status: 0 when no unsuppressed findings, 1 otherwise (2 on usage
+error). Suppressed findings never fail the run; ``--show-suppressed``
+prints them for auditing. This is the same entry point
+``tests/test_lint.py`` enforces in tier-1, so a clean CI run and a clean
+local run mean the same thing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+# runnable straight from a checkout without an installed package
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(_REPO_ROOT))
+
+from hyperspace_tpu.analysis import render_json, render_text, run_analysis  # noqa: E402
+from hyperspace_tpu.analysis.rules import REGISTRY  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hslint", description="repo-tuned TPU-native static analysis"
+    )
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    ap.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="include suppressed findings in text output",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in REGISTRY:
+            print(f"{rule.code} {rule.name}: {rule.description}")
+        return 0
+    if not args.paths:
+        ap.error("no paths given (try: hyperspace_tpu scripts bench.py)")
+
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(f"hslint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings = run_analysis([Path(p) for p in args.paths])
+    if args.fmt == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_suppressed=args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

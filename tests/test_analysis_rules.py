@@ -1,0 +1,397 @@
+"""Per-rule fixture tests for the hslint analyzer.
+
+Each rule gets at least one positive fixture (fires), one negative
+fixture (stays clean), and one suppressed fixture (fires but is marked
+suppressed by ``# hslint: disable=``). Paths passed to analyze_source are
+virtual — they only drive per-rule scoping.
+"""
+
+import textwrap
+
+from hyperspace_tpu.analysis import analyze_source
+from hyperspace_tpu.analysis.core import parse_suppressions
+
+
+def run(src: str, path: str = "hyperspace_tpu/exec/mod.py"):
+    return analyze_source(textwrap.dedent(src), path)
+
+
+def codes(findings, only=None):
+    return [
+        f.code
+        for f in findings
+        if not f.suppressed and (only is None or f.code == only)
+    ]
+
+
+# --- HS001: host-device sync in hot paths ----------------------------------
+
+
+def test_hs001_fires_on_readback_idioms_in_scope():
+    src = """
+    import numpy as np
+
+    def hot(arr, dev):
+        a = dev.item()
+        dev.block_until_ready()
+        b = np.asarray(dev)
+        c = int(arr[0])
+        return a, b, c
+    """
+    got = codes(run(src), "HS001")
+    assert len(got) == 4
+
+
+def test_hs001_clean_outside_scope_and_in_boundary_module():
+    src = """
+    import numpy as np
+
+    def hot(dev):
+        return dev.item(), np.asarray(dev)
+    """
+    assert codes(run(src, "hyperspace_tpu/storage/mod.py"), "HS001") == []
+    assert codes(run(src, "hyperspace_tpu/exec/scan.py"), "HS001") == []
+
+
+def test_hs001_plain_casts_not_flagged():
+    src = """
+    import numpy as np
+
+    def hot(a, b):
+        return int(np.searchsorted(a, b)), float(a_scalar)
+    """
+    assert codes(run(src), "HS001") == []
+
+
+def test_hs001_suppressed():
+    src = """
+    def hot(dev):
+        return dev.item()  # hslint: disable=HS001
+    """
+    findings = run(src)
+    assert codes(findings, "HS001") == []
+    assert [f.code for f in findings if f.suppressed] == ["HS001"]
+
+
+# --- HS002: lock held across a blocking call -------------------------------
+
+
+def test_hs002_fires_on_join_and_sleep_under_lock():
+    src = """
+    import threading
+    import time
+
+    _lock = threading.Lock()
+
+    def bad():
+        t = threading.Thread(target=x)
+        with _lock:
+            t.join(120)
+
+    def also_bad(my_mutex):
+        my_mutex.acquire()
+        time.sleep(1)
+        my_mutex.release()
+    """
+    assert codes(run(src), "HS002") == ["HS002", "HS002"]
+
+
+def test_hs002_clean_when_blocking_happens_outside_lock():
+    src = """
+    import threading
+    import time
+
+    _lock = threading.Lock()
+
+    def good():
+        t = threading.Thread(target=x)
+        with _lock:
+            state = dict(ready=True)
+        t.join(120)
+
+    def deferred_is_clean():
+        with _lock:
+            def later():
+                time.sleep(5)
+            return later
+    """
+    assert codes(run(src), "HS002") == []
+
+
+def test_hs002_suppressed():
+    src = """
+    import time
+
+    def tolerated(update_lock):
+        with update_lock:
+            time.sleep(0.01)  # hslint: disable=HS002
+    """
+    findings = run(src)
+    assert codes(findings, "HS002") == []
+    assert any(f.suppressed and f.code == "HS002" for f in findings)
+
+
+# --- HS003: un-normalized path cache keys ----------------------------------
+
+
+def test_hs003_fires_on_raw_path_in_memo_key():
+    src = """
+    _META_MEMO = {}
+
+    def lookup(path, size):
+        key = (path, size)
+        return _META_MEMO.get(key)
+    """
+    assert codes(run(src), "HS003") == ["HS003"]
+
+
+def test_hs003_clean_after_normalization():
+    src = """
+    _META_MEMO = {}
+
+    def lookup(path, size):
+        path = str(path)
+        key = (path, size)
+        return _META_MEMO.get(key)
+    """
+    assert codes(run(src), "HS003") == []
+
+
+def test_hs003_clean_when_wrapped_in_str_at_the_key_site():
+    src = """
+    _META_MEMO = {}
+
+    def lookup(path, size):
+        key = (str(path), size)
+        return _META_MEMO.get(key)
+    """
+    assert codes(run(src), "HS003") == []
+
+
+def test_hs003_suppressed():
+    src = """
+    _META_MEMO = {}
+
+    def lookup(path):
+        key = (path, 1)  # hslint: disable=HS003
+        return _META_MEMO.get(key)
+    """
+    findings = run(src)
+    assert codes(findings, "HS003") == []
+    assert any(f.suppressed and f.code == "HS003" for f in findings)
+
+
+# --- HS004: silently swallowed exceptions ----------------------------------
+
+
+def test_hs004_fires_on_silent_broad_except():
+    src = """
+    def f():
+        try:
+            g()
+        except Exception:
+            pass
+
+    def h():
+        try:
+            g()
+        except:
+            return None
+    """
+    assert codes(run(src), "HS004") == ["HS004", "HS004"]
+
+
+def test_hs004_clean_when_logged_counted_reraised_or_used():
+    src = """
+    import logging
+
+    logger = logging.getLogger(__name__)
+
+    def logged():
+        try:
+            g()
+        except Exception as e:
+            logger.warning("skipped: %s", e)
+
+    def counted():
+        try:
+            g()
+        except Exception:
+            metrics.incr("thing.failed")
+
+    def reraised():
+        try:
+            g()
+        except Exception:
+            raise
+
+    def recorded():
+        try:
+            g()
+        except Exception as e:
+            out["error"] = repr(e)
+
+    def narrow_is_fine():
+        try:
+            g()
+        except KeyError:
+            pass
+    """
+    assert codes(run(src), "HS004") == []
+
+
+def test_hs004_suppressed_by_standalone_comment_line():
+    src = """
+    def f():
+        try:
+            g()
+        # hslint: disable=HS004 - the False return is the verdict
+        except Exception:
+            return False
+    """
+    findings = run(src)
+    assert codes(findings, "HS004") == []
+    assert any(f.suppressed and f.code == "HS004" for f in findings)
+
+
+# --- HS005: non-deterministic hash inputs ----------------------------------
+
+
+def test_hs005_fires_on_set_and_dict_view_into_hash_sink():
+    src = """
+    from hyperspace_tpu.utils.hashing import md5_hex
+
+    def sig(xs, d):
+        a = md5_hex(str(set(xs)))
+        b = md5_hex(str(d.values()))
+        return a, b
+    """
+    assert codes(run(src), "HS005") == ["HS005", "HS005"]
+
+
+def test_hs005_fires_on_unsorted_json_dumps():
+    src = """
+    import hashlib
+    import json
+
+    def sig(cfg):
+        h = hashlib.md5(json.dumps(cfg).encode())
+        return h.hexdigest()
+    """
+    assert codes(run(src), "HS005") == ["HS005"]
+
+
+def test_hs005_clean_when_sorted_or_sort_keys():
+    src = """
+    import json
+
+    from hyperspace_tpu.utils.hashing import md5_hex
+
+    def sig(xs, d, cfg):
+        a = md5_hex(str(sorted(set(xs))))
+        b = md5_hex(str(sorted(d.values())))
+        c = md5_hex(json.dumps(cfg, sort_keys=True))
+        return a, b, c
+    """
+    assert codes(run(src), "HS005") == []
+
+
+def test_hs005_suppressed():
+    src = """
+    from hyperspace_tpu.utils.hashing import md5_hex
+
+    def sig(xs):
+        return md5_hex(str(set(xs)))  # hslint: disable=HS005
+    """
+    findings = run(src)
+    assert codes(findings, "HS005") == []
+    assert any(f.suppressed and f.code == "HS005" for f in findings)
+
+
+# --- HS006: unbounded module-level caches ----------------------------------
+
+
+def test_hs006_fires_on_growth_without_eviction():
+    src = """
+    _FOOTER_CACHE = {}
+
+    def put(k, v):
+        _FOOTER_CACHE[k] = v
+    """
+    assert codes(run(src), "HS006") == ["HS006"]
+
+
+def test_hs006_clean_with_bounded_put_or_eviction_branch():
+    src = """
+    from hyperspace_tpu.utils.memo import bounded_memo_put
+
+    _A_CACHE = {}
+    _B_CACHE = {}
+    _PLAIN_REGISTRY = {}
+
+    def put_a(k, v):
+        bounded_memo_put(_A_CACHE, k, v, 128)
+
+    def put_b(k, v):
+        if len(_B_CACHE) >= 32:
+            _B_CACHE.pop(next(iter(_B_CACHE)))
+        _B_CACHE[k] = v
+
+    def register(k, v):
+        _PLAIN_REGISTRY[k] = v  # not cache-named: append-only by design
+    """
+    assert codes(run(src), "HS006") == []
+
+
+def test_hs006_suppressed():
+    src = """
+    _GROWN_CACHE = {}
+
+    def put(k, v):
+        _GROWN_CACHE[k] = v  # hslint: disable=HS006
+    """
+    findings = run(src)
+    assert codes(findings, "HS006") == []
+    assert any(f.suppressed and f.code == "HS006" for f in findings)
+
+
+# --- core machinery ---------------------------------------------------------
+
+
+def test_suppressions_parse_trailing_and_standalone():
+    src = textwrap.dedent(
+        """
+        x = 1  # hslint: disable=HS001,HS002
+        # hslint: disable=HS004 - justification text
+        # continuation of the justification
+        y = 2
+        z = 3  # hslint: disable
+        """
+    )
+    sup = parse_suppressions(src)
+    assert sup[2] == {"HS001", "HS002"}
+    assert sup[5] == {"HS004"}  # bound past the continuation comment
+    assert sup[6] is None  # bare disable = all codes
+
+
+def test_syntax_error_becomes_hs000_finding(tmp_path):
+    from hyperspace_tpu.analysis import analyze_file
+
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n", encoding="utf-8")
+    findings = analyze_file(p)
+    assert [f.code for f in findings] == ["HS000"]
+    assert not findings[0].suppressed
+
+
+def test_suppressed_findings_are_reported_not_dropped():
+    from hyperspace_tpu.analysis import summarize
+
+    src = """
+    def hot(dev):
+        return dev.item()  # hslint: disable=HS001
+    """
+    findings = run(src)
+    s = summarize(findings)
+    assert s["suppressed"] == 1 and s["unsuppressed"] == 0
+    assert "(suppressed)" in findings[0].render()

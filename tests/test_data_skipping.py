@@ -38,6 +38,44 @@ def test_minmax_sketch_build_and_match():
     assert s.can_match(data, "int64", None, {5})
 
 
+def test_legacy_can_match_only_subclass_still_prepares():
+    # ADVICE round-5 #1: prune_files calls spec.prepare_test directly; a
+    # legacy subclass that only overrides can_match (the previous
+    # extension point) must get the default prepare_test wrapper instead
+    # of raising NotImplementedError into the rule's error swallowing
+    # (which silently disabled skipping).
+    from dataclasses import dataclass
+
+    from hyperspace_tpu.index.sketches import SketchSpec
+
+    calls = []
+
+    @dataclass(frozen=True)
+    class EvenOnlySketch(SketchSpec):
+        kind = "EvenOnly"
+
+        def can_match(self, data, dtype_str, bounds, pins):
+            calls.append((bounds, pins))
+            return data["parity"] == "even"
+
+    s = EvenOnlySketch("x")
+    test = s.prepare_test("int64", (2, 3), None)  # must NOT raise
+    assert test({"parity": "even"}) is True
+    assert test({"parity": "odd"}) is False
+    assert calls == [((2, 3), None), ((2, 3), None)]
+
+    # a subclass overriding NEITHER extension point fails loudly (and the
+    # base can_match -> prepare_test delegation must not recurse forever)
+    @dataclass(frozen=True)
+    class EmptySketch(SketchSpec):
+        kind = "Empty"
+
+    with pytest.raises(NotImplementedError):
+        EmptySketch("x").prepare_test("int64", None, {1})
+    with pytest.raises(NotImplementedError):
+        EmptySketch("x").can_match({}, "int64", None, {1})
+
+
 def test_bloom_sketch_no_false_negatives():
     s = BloomFilterSketch("x", fpp=0.01, expected_items=1000)
     vals = np.arange(0, 1000, dtype=np.int64)

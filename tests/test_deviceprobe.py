@@ -12,10 +12,16 @@ from hyperspace_tpu.utils import deviceprobe
 @pytest.fixture(autouse=True)
 def fresh_latch():
     saved = dict(deviceprobe._FIRST_TOUCH)
+    was_done = deviceprobe._FIRST_TOUCH_DONE.is_set()
     deviceprobe._FIRST_TOUCH.clear()
+    deviceprobe._FIRST_TOUCH_DONE.clear()
     yield
     deviceprobe._FIRST_TOUCH.clear()
     deviceprobe._FIRST_TOUCH.update(saved)
+    if was_done:
+        deviceprobe._FIRST_TOUCH_DONE.set()
+    else:
+        deviceprobe._FIRST_TOUCH_DONE.clear()
 
 
 def test_first_touch_ok_on_cpu_backend():
@@ -39,6 +45,83 @@ def test_first_touch_times_out_and_latches(monkeypatch):
     # the touch restored
     monkeypatch.undo()
     assert deviceprobe.first_device_touch_ok(timeout_s=30.0) is False
+
+
+def test_concurrent_caller_honors_own_timeout(monkeypatch):
+    # The seed violation: the first-touch mutex was held across the whole
+    # watchdog join, so a second thread's touch blocked for the FIRST
+    # caller's timeout (default 120 s) regardless of its own. With the
+    # event latch, each caller waits out only its own timeout_s.
+    import threading
+
+    import jax
+
+    def hang(*a, **k):
+        time.sleep(30)
+        raise AssertionError("unreachable")
+
+    monkeypatch.setattr(jax, "device_put", hang)
+    first_result: dict = {}
+
+    def first_caller():
+        first_result["ok"] = deviceprobe.first_device_touch_ok(timeout_s=25.0)
+
+    t = threading.Thread(target=first_caller, daemon=True)
+    t.start()
+    # let the first caller elect the touch thread and start waiting
+    deadline = time.perf_counter() + 5.0
+    while not deviceprobe._FIRST_TOUCH.get("started"):
+        assert time.perf_counter() < deadline, "touch thread never started"
+        time.sleep(0.01)
+    t0 = time.perf_counter()
+    ok = deviceprobe.first_device_touch_ok(timeout_s=0.3)
+    elapsed = time.perf_counter() - t0
+    assert ok is False
+    assert elapsed < 5, f"second caller blocked {elapsed:.1f}s on the latch"
+    # the second caller's timeout latched the process verdict and woke the
+    # first caller too — it must not sit out its full 25 s
+    t.join(10)
+    assert not t.is_alive()
+    assert first_result["ok"] is False
+
+
+def test_stale_touch_thread_cannot_poison_reset_latch(monkeypatch):
+    # A timed-out watchdog thread is leaked deliberately. When the latch
+    # is later reset (this file's fixture does exactly that between
+    # tests), the leaked thread's eventual verdict is about an election
+    # nobody is waiting on — it must not write into the fresh epoch, or
+    # it silently routes every later resident-path query to host.
+    import threading
+
+    import jax
+
+    gate = threading.Event()
+
+    def hang(*a, **k):
+        gate.wait(20)
+        raise RuntimeError("stale touch completing late")
+
+    monkeypatch.setattr(jax, "device_put", hang)
+    before = set(threading.enumerate())
+    assert deviceprobe.first_device_touch_ok(timeout_s=0.2) is False
+    leaked = [
+        t
+        for t in set(threading.enumerate()) - before
+        if t.name == "hyperspace-device-first-touch"
+    ]
+    assert leaked, "watchdog touch thread not found"
+    # simulate the latch reset the fixture performs between tests
+    deviceprobe._FIRST_TOUCH.clear()
+    deviceprobe._FIRST_TOUCH_DONE.clear()
+    gate.set()  # let the leaked thread run its failure path to completion
+    for t in leaked:
+        t.join(10)
+        assert not t.is_alive()
+    assert "ok" not in deviceprobe._FIRST_TOUCH
+    assert not deviceprobe._FIRST_TOUCH_DONE.is_set()
+    # the fresh epoch probes cleanly on the restored CPU backend
+    monkeypatch.undo()
+    assert deviceprobe.first_device_touch_ok(timeout_s=30.0) is True
 
 
 def test_first_touch_error_is_false(monkeypatch):

@@ -40,6 +40,24 @@ def write_orc(path, batch):
     paorc.write_table(pa.table(arrays), str(path))
 
 
+def test_parquet_footer_memo_one_slot_per_file(tmp_path):
+    # ADVICE round-5 #2 regression: the footer memo key must normalize the
+    # path — str at some call sites, pathlib.Path at others — or one file
+    # occupies two slots and halves the effective 128-entry capacity
+    from pathlib import Path
+
+    p = tmp_path / "one.parquet"
+    parquet_io.write_parquet(p, sample(50))
+    parquet_io._PQ_META_MEMO.clear()
+    pf_str = parquet_io._parquet_file(str(p))
+    pf_path = parquet_io._parquet_file(Path(p))
+    assert pf_str.metadata.num_rows == pf_path.metadata.num_rows == 50
+    assert len(parquet_io._PQ_META_MEMO) == 1
+    (key,) = parquet_io._PQ_META_MEMO
+    assert key[0] == str(p)  # normalized spelling, not the Path repr
+    parquet_io._PQ_META_MEMO.clear()
+
+
 def test_orc_reader_roundtrip(tmp_path):
     b = sample(200, seed=1)
     p = tmp_path / "d.orc"

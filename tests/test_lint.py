@@ -1,0 +1,82 @@
+"""Tier-1 enforcement: the tree stays hslint-clean.
+
+This is the teeth of the analyzer — every rule violation introduced
+anywhere in ``hyperspace_tpu/``, ``scripts/`` or ``bench.py`` fails this
+test unless it carries a per-line ``# hslint: disable=HSxxx`` suppression
+with a justification. Fixture-level rule behavior is covered in
+``test_analysis_rules.py``; this file only pins the zero-findings
+invariant and the CLI contract.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+LINT_TARGETS = ["hyperspace_tpu", "scripts", "bench.py"]
+
+
+def test_tree_has_zero_unsuppressed_findings():
+    from hyperspace_tpu.analysis import run_analysis
+
+    findings = run_analysis([REPO / t for t in LINT_TARGETS])
+    unsuppressed = [f for f in findings if not f.suppressed]
+    assert unsuppressed == [], "\n" + "\n".join(f.render() for f in unsuppressed)
+
+
+def test_cli_exits_zero_on_clean_tree():
+    proc = subprocess.run(
+        [sys.executable, "scripts/lint.py", *LINT_TARGETS],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_cli_json_format_and_failure_exit(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "CACHE = {}\n"
+        "def put(k, v):\n"
+        "    CACHE[k] = v\n",
+        encoding="utf-8",
+    )
+    proc = subprocess.run(
+        [sys.executable, "scripts/lint.py", "--format", "json", str(bad)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["unsuppressed"] == 1
+    assert payload["findings"][0]["code"] == "HS006"
+
+
+def test_cli_list_rules_names_all_six():
+    proc = subprocess.run(
+        [sys.executable, "scripts/lint.py", "--list-rules"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    for code in ("HS001", "HS002", "HS003", "HS004", "HS005", "HS006"):
+        assert code in proc.stdout
+
+
+def test_cli_missing_path_is_usage_error():
+    proc = subprocess.run(
+        [sys.executable, "scripts/lint.py", "no/such/dir"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 2

@@ -95,6 +95,43 @@ def test_write_file_atomic(tmp_path, lib_available):
     assert not list(tmp_path.glob(".out.bin.*"))  # no tmp litter
 
 
+def test_prune_stale_builds_keeps_newest_and_current(tmp_path):
+    # ADVICE round-5 #3: content-tagged libtcb_io.<tag>.so files
+    # accumulated in the shared user cache forever (one per source
+    # revision); after a successful build only the newest N may remain
+    import os
+
+    sos = []
+    for i in range(7):
+        p = tmp_path / f"libtcb_io.tag{i:04d}.so"
+        p.write_bytes(b"so")
+        os.utime(p, ns=(i * 10**9, i * 10**9))  # staggered mtimes
+        sos.append(p)
+    unrelated = tmp_path / "notes.txt"
+    unrelated.write_text("keep me")
+    keep = sos[6]  # the just-built newest
+    native._prune_stale_builds(tmp_path, keep)
+    remaining = sorted(p.name for p in tmp_path.glob("libtcb_io.*.so"))
+    want = sorted(p.name for p in sos[7 - native._KEEP_SO_BUILDS :])
+    assert remaining == want
+    assert keep.exists()
+    assert unrelated.exists()
+
+    # the current build survives even when its mtime makes it "oldest"
+    # (e.g. a clock-skewed shared cache) and newer files push it out of
+    # the keep window
+    os.utime(keep, ns=(0, 0))
+    for i in range(10, 10 + native._KEEP_SO_BUILDS):
+        p = tmp_path / f"libtcb_io.tag{i:04d}.so"
+        p.write_bytes(b"so")
+        os.utime(p, ns=(i * 10**9, i * 10**9))
+    native._prune_stale_builds(tmp_path, keep)
+    assert keep.exists()
+
+    # a vanished directory is a no-op, never a raise
+    native._prune_stale_builds(tmp_path / "gone", keep)
+
+
 def test_packaged_native_source_in_sync():
     # the wheel ships hyperspace_tpu/native/tcb_io.cc (pyproject
     # package-data); the canonical source is native/tcb_io.cc — they must
