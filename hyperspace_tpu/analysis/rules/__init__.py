@@ -15,6 +15,7 @@ from .hs003_path_keys import PathKeyRule
 from .hs004_swallowed_exceptions import SwallowedExceptionRule
 from .hs005_nondeterministic_hashing import NondeterministicHashRule
 from .hs006_unbounded_cache import UnboundedCacheRule
+from .hs007_unfenced_device_timing import UnfencedDeviceTimingRule
 
 REGISTRY: List[Rule] = [
     HostSyncRule(),
@@ -23,6 +24,7 @@ REGISTRY: List[Rule] = [
     SwallowedExceptionRule(),
     NondeterministicHashRule(),
     UnboundedCacheRule(),
+    UnfencedDeviceTimingRule(),
 ]
 
 __all__ = [
@@ -33,4 +35,5 @@ __all__ = [
     "SwallowedExceptionRule",
     "NondeterministicHashRule",
     "UnboundedCacheRule",
+    "UnfencedDeviceTimingRule",
 ]

@@ -1,0 +1,152 @@
+"""HS007 — device dispatch timed without a materializing fence.
+
+The round-5 fence discipline (docs/07 "Only a readback is a fence"): on
+the tunneled accelerator backend ``block_until_ready`` acknowledges
+ENQUEUE, not completion — a ``time.perf_counter()`` span around a jax
+dispatch that never reads anything back times the enqueue and reports
+~0.0s for real device work (observed: a 33-iteration kernel loop "timed"
+0.0s). Every device timing in this repo must materialize at least one
+element of its result inside the span — ``ops.fence_materialize``,
+``ops.fence_chain``, or an ``np.asarray`` readback — before the closing
+``perf_counter()`` lands. This rule machine-enforces that.
+
+Detection (intra-procedural, documented blind spots):
+  * a TIMING SPAN is ``t0 = time.perf_counter()`` followed, in the same
+    function (or at module top level), by an expression computing
+    ``time.perf_counter() - t0`` — the span is the line range between
+    the two;
+  * a DEVICE DISPATCH inside the span is any call whose resolved dotted
+    name starts with ``jax.`` (``jax.device_put``, ``jnp.*`` via import
+    aliases, ``jax.jit(...)``-produced calls are NOT resolvable — blind
+    spot: a dispatch through a locally-bound jitted function is only
+    caught when its result feeds a fence anyway);
+  * a FENCE inside the span is a call to ``fence_materialize`` /
+    ``fence_chain`` (any import spelling) or ``numpy.asarray`` — the
+    materializing readbacks. ``block_until_ready`` is deliberately NOT a
+    fence: it is the idiom this rule exists to catch.
+  * spans containing a dispatch but no fence are flagged at the dispatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..core import ModuleContext, Rule, dotted_name
+
+SCOPE = (
+    "hyperspace_tpu/exec/",
+    "hyperspace_tpu/ops/",
+    "hyperspace_tpu/serve/",
+    "hyperspace_tpu/index/",
+    "hyperspace_tpu/parallel/",
+)
+
+_FENCE_SUFFIXES = ("fence_materialize", "fence_chain")
+
+
+def _is_perf_counter(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and dotted_name(node.func, aliases) == "time.perf_counter"
+    )
+
+
+class UnfencedDeviceTimingRule(Rule):
+    code = "HS007"
+    name = "unfenced-device-timing"
+    description = (
+        "a time.perf_counter() span encloses a jax dispatch with no "
+        "materializing fence (ops.fence_materialize/fence_chain or an "
+        "np.asarray readback) before the closing perf_counter()"
+    )
+
+    def applies_to(self, posix_path: str) -> bool:
+        return any(s in posix_path for s in SCOPE)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        scopes: List[ast.AST] = [ctx.tree]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node)
+        for scope in scopes:
+            yield from self._check_scope(scope, ctx)
+
+    def _own_walk(self, scope: ast.AST):
+        """Walk a scope's body WITHOUT descending into nested function
+        definitions (each nested def is its own scope — its spans and
+        dispatches must not leak into the enclosing one)."""
+        stack = list(
+            getattr(scope, "body", [])
+            + getattr(scope, "orelse", [])
+            + getattr(scope, "finalbody", [])
+        )
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue  # nested scope: analyzed on its own
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_scope(
+        self, scope: ast.AST, ctx: ModuleContext
+    ) -> Iterator[Tuple[int, int, str]]:
+        # two passes (the walk order is not source order): first bind every
+        # ``t = perf_counter()``, then match every ``perf_counter() - t``
+        starts: Dict[str, int] = {}  # var -> lineno of t = perf_counter()
+        nodes = list(self._own_walk(scope))
+        for node in nodes:
+            if isinstance(node, ast.Assign) and _is_perf_counter(
+                node.value, ctx.aliases
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        starts[t.id] = node.lineno
+        spans: List[Tuple[int, int]] = []
+        for node in nodes:
+            if (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and isinstance(node.right, ast.Name)
+                and node.right.id in starts
+                and _is_perf_counter(node.left, ctx.aliases)
+            ):
+                spans.append((starts[node.right.id], node.lineno))
+        if not spans:
+            return
+        dispatches: List[Tuple[int, int, str]] = []
+        fences: List[int] = []
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = dotted_name(node.func, ctx.aliases) or ""
+            if resolved.startswith("jax."):
+                dispatches.append(
+                    (node.lineno, node.col_offset, resolved)
+                )
+            elif resolved == "numpy.asarray" or resolved.endswith(
+                _FENCE_SUFFIXES
+            ):
+                fences.append(node.lineno)
+        for lo, hi in spans:
+            if any(lo < f <= hi for f in fences):
+                continue
+            flagged: Optional[Tuple[int, int, str]] = None
+            for line, col, name in dispatches:
+                if lo < line <= hi and (
+                    flagged is None or line < flagged[0]
+                ):
+                    flagged = (line, col, name)
+            if flagged is not None:
+                line, col, name = flagged
+                yield (
+                    line,
+                    col,
+                    f"'{name}' dispatch inside a perf_counter span with no "
+                    "materializing fence; on the tunneled backend this times "
+                    "enqueue, not execution — fence with ops.fence_materialize"
+                    "/fence_chain (or read the result back) before closing "
+                    "the timer",
+                )

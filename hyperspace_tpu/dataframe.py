@@ -120,6 +120,7 @@ class DataFrame(EventLogging):
 
     def collect(self) -> ColumnarBatch:
         from .exec.executor import Executor
+        from .telemetry.metrics import metrics
 
         import contextlib
 
@@ -135,8 +136,13 @@ class DataFrame(EventLogging):
             tracer = jax.profiler.trace(profile_dir)
         else:
             tracer = contextlib.nullcontext()
-        with tracer:
-            return executor.execute(plan)
+        # per-query scoped registry: global counters accumulate exactly as
+        # before, and this query's own share lands on the session for
+        # explain(verbose) — concurrent queries each see only their own
+        with tracer, metrics.scoped() as query_metrics:
+            result = executor.execute(plan)
+        self.session.last_query_metrics = query_metrics.snapshot()
+        return result
 
     def to_pandas(self):
         return self.collect().to_pandas()

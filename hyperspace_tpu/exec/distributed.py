@@ -45,8 +45,10 @@ ensure_x64()
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-from jax import lax, shard_map  # noqa: E402
+from jax import lax  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: E402
+
+from ..utils.jaxcompat import shard_map  # noqa: E402
 
 _I64_PAD = np.iinfo(np.int64).max
 

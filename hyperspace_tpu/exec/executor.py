@@ -225,6 +225,7 @@ class Executor:
         parquet decode (pyarrow, GIL-released C++) overlaps the index
         side's mmap + mask. Per-side ``union.side.{index,source}`` timers
         stay observable; single-child unions skip the thread."""
+        import contextvars
         import time as _time
         from concurrent.futures import ThreadPoolExecutor
 
@@ -241,10 +242,19 @@ class Executor:
         if len(children) < 2:
             parts = [run_child(c) for c in children]
         else:
+            # per-child context copies captured HERE (the query thread):
+            # pool threads otherwise start with an empty context and the
+            # sides' timers would vanish from the query's scoped metrics
+            ctxs = [contextvars.copy_context() for _ in children]
             with ThreadPoolExecutor(
                 max_workers=len(children), thread_name_prefix="union-side"
             ) as pool:
-                parts = list(pool.map(run_child, children))
+                parts = list(
+                    pool.map(
+                        lambda pair: pair[0].run(run_child, pair[1]),
+                        zip(ctxs, children),
+                    )
+                )
         return ColumnarBatch.concat(parts)
 
     @staticmethod

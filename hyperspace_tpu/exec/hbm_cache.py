@@ -396,6 +396,150 @@ def _counts_fn(narrowed: Expr, names: tuple, n_rows128: int, use_pallas: bool):
     return fn
 
 
+# ---------------------------------------------------------------------------
+# batched (multi-predicate) counts: the serving micro-batcher's entry point
+# ---------------------------------------------------------------------------
+# One device dispatch evaluates N compatible predicates over one resident
+# table and ships home an (N, n_blocks) count matrix — N point lookups
+# share a single link round trip (the continuous-batching shape of
+# inference serving applied to index scans; arXiv:2203.01877's dispatch
+# amortization). The jitted executable is keyed on predicate STRUCTURE,
+# not literals: literal values ride as traced int32 operands, so a burst
+# of lookups with fresh keys reuses the compiled program — the per-literal
+# recompile the single-query path tolerates (its compile amortizes across
+# repeats of the SAME query) would be paid on every serving burst.
+
+
+def _expr_structure(e: Expr) -> str:
+    """Canonical structure string of a narrowed predicate with literal
+    VALUES masked out — the compile-cache key component. Two predicates
+    with equal structure differ only in literals, which are traced."""
+    from ..plan.expr import And, Cmp, Col, Lit, Not, Or
+
+    if isinstance(e, (And, Or)):
+        tag = "&" if isinstance(e, And) else "|"
+        return f"({_expr_structure(e.left)}{tag}{_expr_structure(e.right)})"
+    if isinstance(e, Not):
+        return f"~({_expr_structure(e.child)})"
+    if isinstance(e, Cmp):
+        return f"({_expr_structure(e.left)} {e.op} {_expr_structure(e.right)})"
+    if isinstance(e, Col):
+        return f"col({e.name})"
+    if isinstance(e, Lit):
+        return "?"
+    raise TypeError(f"unexpected node in narrowed predicate: {e!r}")
+
+
+def _expr_literals(e: Expr, out: list) -> None:
+    """Literal values of a narrowed predicate in the SAME traversal order
+    ``_eval_with_literals`` consumes them."""
+    from ..plan.expr import And, Cmp, Lit, Not, Or
+
+    if isinstance(e, (And, Or)):
+        _expr_literals(e.left, out)
+        _expr_literals(e.right, out)
+    elif isinstance(e, Not):
+        _expr_literals(e.child, out)
+    elif isinstance(e, Cmp):
+        if isinstance(e.left, Lit):
+            out.append(int(e.left.value))
+        if isinstance(e.right, Lit):
+            out.append(int(e.right.value))
+
+
+def _eval_with_literals(e: Expr, arrays: dict, lits, pos: list):
+    """Evaluate a narrowed predicate over flat device arrays with every
+    literal drawn from the traced ``lits`` vector (consumed in
+    ``_expr_literals`` order). Comparison semantics match eval_mask's
+    pure-int branch exactly — narrowed predicates reference only int32
+    columns, so the NULL/string handling was already compiled away by
+    bind_string_literals/narrow_expr_to_i32."""
+    from ..plan.expr import And, Cmp, Col, Lit, Not, Or, _apply_cmp
+
+    import jax.numpy as jnp
+
+    if isinstance(e, And):
+        return _eval_with_literals(e.left, arrays, lits, pos) & _eval_with_literals(
+            e.right, arrays, lits, pos
+        )
+    if isinstance(e, Or):
+        return _eval_with_literals(e.left, arrays, lits, pos) | _eval_with_literals(
+            e.right, arrays, lits, pos
+        )
+    if isinstance(e, Not):
+        return ~_eval_with_literals(e.child, arrays, lits, pos)
+    if isinstance(e, Cmp):
+
+        def side(s):
+            if isinstance(s, Col):
+                return arrays[s.name]
+            if isinstance(s, Lit):
+                v = lits[pos[0]]
+                pos[0] += 1
+                return v
+            raise TypeError(f"unexpected comparison side: {s!r}")
+
+        return _apply_cmp(jnp, e.op, side(e.left), side(e.right))
+    raise TypeError(f"not a boolean node: {e!r}")
+
+
+class BoundedFnCache:
+    """Bounded FIFO memo for jitted executables — the compile-cache
+    discipline shared by the single-chip and mesh batched entry points.
+    A losing racer's duplicate build is tolerated (last write wins);
+    jitted functions are interchangeable for equal keys."""
+
+    def __init__(self, cap: int = 64):
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._fns: dict = {}
+
+    def get(self, key):
+        with self._lock:
+            return self._fns.get(key)
+
+    def put(self, key, fn) -> None:
+        with self._lock:
+            while len(self._fns) >= self._cap:
+                self._fns.pop(next(iter(self._fns)))
+            self._fns[key] = fn
+
+
+_batch_fns = BoundedFnCache()
+
+
+def _batched_counts_fn(structures: tuple, slot_names: tuple, exprs: list,
+                       n_rows128: int):
+    """Jitted (cols dict, per-slot literal vectors) -> (N, n_blocks) int32
+    count matrix, one executable for the whole batch. ``exprs`` supplies
+    the structure at trace time only — literal values are traced operands,
+    so the cache key is (structures, slot_names, n_rows128)."""
+    key = (structures, slot_names, n_rows128)
+    fn = _batch_fns.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+
+    exprs = list(exprs)  # pin the trace-time structures
+    names_per_slot = list(slot_names)
+
+    def batched(col_arrays: dict, lit_vecs: tuple):
+        outs = []
+        for expr, names, lits in zip(exprs, names_per_slot, lit_vecs):
+            flat = {n: col_arrays[n].reshape(-1) for n in names}
+            mask = _eval_with_literals(expr, flat, lits, [0])
+            outs.append(
+                jnp.sum(mask.reshape(-1, BLOCK_ROWS).astype(jnp.int32), axis=1)
+            )
+        return jnp.stack(outs)
+
+    fn = jax.jit(batched)
+    _batch_fns.put(key, fn)
+    return fn
+
+
 class ResidentCacheBase:
     """Shared plumbing of the single-chip and mesh resident caches: table
     registry + LRU-against-budget, pending/failed population memos, and
@@ -414,6 +558,11 @@ class ResidentCacheBase:
         # refresh naturally retries.
         self._failed: set = set()
         self._lock = threading.Lock()
+        # bumped by reset(): a background populate scheduled before a
+        # reset must not register its table into the fresh registry
+        # (tests reset between cases; a slow upload from the previous
+        # case otherwise lands mid-test)
+        self._epoch = 0
 
     def auto_enabled(self) -> bool:
         """Whether first-touch population is on for this deployment —
@@ -427,8 +576,10 @@ class ResidentCacheBase:
         with self._lock:
             self._tables = [t for t in self._tables if t is not table]
 
-    def _register(self, table) -> None:
+    def _register(self, table, epoch: Optional[int] = None) -> None:
         with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                return  # cache was reset() since this build was scheduled
             # replace any table over the same file set (e.g. widened
             # column set); then evict LRU until the budget fits
             self._tables = [t for t in self._tables if t.key != table.key]
@@ -471,6 +622,7 @@ class ResidentCacheBase:
             self._tables.clear()
             self._pending.clear()
             self._failed.clear()
+            self._epoch += 1
 
 
 class HbmIndexCache(ResidentCacheBase):
@@ -544,6 +696,7 @@ class HbmIndexCache(ResidentCacheBase):
             ):
                 return
             self._pending.add(key)
+            epoch = self._epoch
 
         def bg():
             failed = False  # PERMANENT failure only (memoized per version)
@@ -574,7 +727,7 @@ class HbmIndexCache(ResidentCacheBase):
                 )
                 table, permanent = self._build(paths, key, build_cols)
                 if table is not None and set(columns) <= set(table.columns):
-                    self._register(table)
+                    self._register(table, epoch=epoch)
                 elif table is not None or permanent:
                     # partially-encodable tables are not registered from
                     # auto-population: they could never serve this
@@ -882,6 +1035,62 @@ class HbmIndexCache(ResidentCacheBase):
         n_blocks = -(-table.n_rows // BLOCK_ROWS)
         metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
         return counts[:n_blocks]
+
+    def block_counts_batch(
+        self,
+        table: ResidentTable,
+        predicates: List[Expr],
+        prepared: Optional[list] = None,
+    ) -> Optional[np.ndarray]:
+        """(N, n_blocks) per-BLOCK_ROWS match counts for N predicates over
+        one resident table in ONE device dispatch — the micro-batcher's
+        device leg (module note above block_counts' single-query twin).
+        ``prepared`` optionally carries each predicate's
+        prepare_resident_predicate result (the serving classifier already
+        ran it at submit time — rerunning the narrow pipeline per dispatch
+        would double the hot path). None when ANY predicate fails to
+        narrow to the resident encodings (the caller serves that batch
+        per-query instead; mixing one host-routed straggler into a device
+        batch would force a second dispatch anyway)."""
+        if prepared is None:
+            prepared = [
+                prepare_resident_predicate(table.columns, p)
+                for p in predicates
+            ]
+        if any(p is None for p in prepared):
+            return None
+        structures = tuple(_expr_structure(n) for n, _ in prepared)
+        slot_names = tuple(names for _, names in prepared)
+        fn = _batched_counts_fn(
+            structures,
+            slot_names,
+            [n for n, _ in prepared],
+            table.n_pad // _LANES,
+        )
+        # the union of every slot's (possibly plane-suffixed) columns,
+        # passed once — slots index into the shared dict
+        union_names = tuple(
+            dict.fromkeys(n for names in slot_names for n in names)
+        )
+        cols = dict(
+            zip(union_names, resident_arrays_for(table.columns, union_names))
+        )
+        lit_vecs = []
+        for narrowed, _ in prepared:
+            vals: list = []
+            _expr_literals(narrowed, vals)
+            lit_vecs.append(np.asarray(vals, dtype=np.int32))
+        from ..ops import kernels as K
+
+        t0 = time.perf_counter()
+        with K._x32():
+            counts = np.asarray(fn(cols, tuple(lit_vecs)))
+        metrics.record_time("serve.batch.device", time.perf_counter() - t0)
+        metrics.incr("serve.batch.dispatches")
+        metrics.incr("serve.batch.queries", len(predicates))
+        metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
+        n_blocks = -(-table.n_rows // BLOCK_ROWS)
+        return counts[:, :n_blocks]
 
     # -- observability -------------------------------------------------------
     def snapshot(self) -> dict:

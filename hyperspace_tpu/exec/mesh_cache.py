@@ -159,8 +159,9 @@ def _mesh_counts_fn(mesh, bound_repr: str, bound: Expr, names: tuple,
 
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec
+
+    from ..utils.jaxcompat import shard_map
 
     shim = ColumnarBatch(
         {name: Column("int32", np.empty(0, dtype=np.int32)) for name in names}
@@ -188,6 +189,64 @@ def _mesh_counts_fn(mesh, bound_repr: str, bound: Expr, names: tuple,
         if len(_counts_fn_cache) >= 128:
             _counts_fn_cache.pop(next(iter(_counts_fn_cache)))
         _counts_fn_cache[key] = fn
+    return fn
+
+
+def _mesh_batched_counts_fn(mesh, structures: tuple, slot_names: tuple,
+                            exprs: list, cap: int, block: int):
+    """Jitted shard_map evaluating N predicate masks per device shard and
+    reducing each to per-block counts: (cols dict, per-slot literal
+    vectors) -> (D, N, cap // block) int32, one mesh round trip for the
+    whole batch. Keyed on predicate STRUCTURE — literals are traced
+    operands (hbm_cache._batched_counts_fn rationale); the memo is
+    hbm_cache's shared BoundedFnCache (one compile-cache discipline for
+    both entry points)."""
+    from .hbm_cache import _batch_fns
+
+    key = (mesh, structures, slot_names, cap, block)
+    fn = _batch_fns.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec
+
+    from ..utils.jaxcompat import shard_map
+    from .hbm_cache import _eval_with_literals
+
+    exprs = list(exprs)
+    names_per_slot = list(slot_names)
+    axis = mesh.axis_names[0]
+    union_names = tuple(
+        dict.fromkeys(n for names in slot_names for n in names)
+    )
+
+    def shard_fn(arrays, lit_vecs):
+        flat = {n: a.reshape(-1) for n, a in arrays.items()}
+        outs = []
+        for expr, names, lits in zip(exprs, names_per_slot, lit_vecs):
+            mask = _eval_with_literals(expr, flat, lits, [0])
+            outs.append(
+                jnp.sum(
+                    mask.reshape(cap // block, block).astype(jnp.int32),
+                    axis=1,
+                )
+            )
+        return jnp.stack(outs)[None]
+
+    col_spec = {name: PartitionSpec(axis, None) for name in union_names}
+    lit_spec = tuple(PartitionSpec() for _ in exprs)  # replicated literals
+    fn = jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(col_spec, lit_spec),
+            out_specs=PartitionSpec(axis, None, None),
+            check_vma=False,
+        )
+    )
+    _batch_fns.put(key, fn)
     return fn
 
 
@@ -254,6 +313,7 @@ class MeshHbmCache(ResidentCacheBase):
             ):
                 return
             self._pending.add(key)
+            epoch = self._epoch
 
         def bg():
             failed = False
@@ -277,7 +337,7 @@ class MeshHbmCache(ResidentCacheBase):
                 )
                 table, permanent = self._build(paths, key, build_cols, mesh)
                 if table is not None and set(columns) <= set(table.columns):
-                    self._register(table)
+                    self._register(table, epoch=epoch)
                 elif table is not None or permanent:
                     failed = True
             except Exception:  # noqa: BLE001 - population must never fail a scan
@@ -567,6 +627,67 @@ class MeshHbmCache(ResidentCacheBase):
         )
         metrics.incr("scan.resident_mesh.d2h_bytes", int(counts.nbytes))
         return counts
+
+    def block_counts_batch(
+        self,
+        table: MeshResidentTable,
+        predicates: List[Expr],
+        prepared: Optional[list] = None,
+    ) -> Optional[np.ndarray]:
+        """(N, D, n_blocks) match counts for N predicates in ONE mesh
+        round trip — the mesh leg of the serving micro-batcher
+        (hbm_cache.block_counts_batch rationale: literal values ride as
+        traced operands so serving bursts reuse the compiled executable;
+        ``prepared`` optionally reuses the classifier's submit-time
+        prepare_resident_predicate results). None when any predicate
+        fails to narrow (caller serves the batch per-query)."""
+        from ..ops import kernels as K
+        from .hbm_cache import (
+            _expr_literals,
+            _expr_structure,
+            prepare_resident_predicate,
+            resident_arrays_for,
+        )
+
+        if prepared is None:
+            prepared = [
+                prepare_resident_predicate(table.columns, p)
+                for p in predicates
+            ]
+        if any(p is None for p in prepared):
+            return None
+        structures = tuple(_expr_structure(n) for n, _ in prepared)
+        slot_names = tuple(names for _, names in prepared)
+        fn = _mesh_batched_counts_fn(
+            table.mesh,
+            structures,
+            slot_names,
+            [n for n, _ in prepared],
+            table.cap,
+            table.block,
+        )
+        union_names = tuple(
+            dict.fromkeys(n for names in slot_names for n in names)
+        )
+        cols = dict(
+            zip(union_names, resident_arrays_for(table.columns, union_names))
+        )
+        lit_vecs = []
+        for narrowed, _ in prepared:
+            vals: list = []
+            _expr_literals(narrowed, vals)
+            lit_vecs.append(np.asarray(vals, dtype=np.int32))
+        lit_vecs = tuple(lit_vecs)
+        t0 = time.perf_counter()
+        with K._x32():
+            counts = np.asarray(fn(cols, lit_vecs))
+        metrics.record_time("serve.batch.mesh_device", time.perf_counter() - t0)
+        metrics.incr("serve.batch.dispatches")
+        metrics.incr("serve.batch.queries", len(predicates))
+        metrics.incr("scan.resident_mesh.d2h_bytes", int(counts.nbytes))
+        # (D, N, n_blocks) -> per-predicate (D, n_blocks) slices, stacked
+        # predicate-major so callers index counts[i] like block_counts()
+        return np.swapaxes(counts, 0, 1)
 
     # -- host-side collection ------------------------------------------------
     def collect_parts(

@@ -79,6 +79,14 @@ class Hyperspace:
         docs/05-scale-and-distribution.md "HBM residency"."""
         return self._manager.prefetch(name, columns)
 
+    def serve(self, **options):
+        """The session's QueryServer (serve.QueryServer): bounded-queue
+        admission, per-query deadlines, micro-batched resident scans and
+        plan caching over this session's indexes — the concurrent-traffic
+        surface of the north star (docs/10-serving.md). Options are
+        ServeConfig fields, applied on first creation only."""
+        return self.session.serve(**options)
+
     def explain(self, df: DataFrame, verbose: bool = False) -> str:
         from .plananalysis.plan_analyzer import explain_string
 

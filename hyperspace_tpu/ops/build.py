@@ -415,7 +415,7 @@ def _sharded_build_fn(
         n_valid = vflat.sum().astype(jnp.int32)[None]  # rank-1 for out_specs
         return out, sorted_bucket, local_counts, n_valid
 
-    from jax import shard_map
+    from ..utils.jaxcompat import shard_map
 
     names = [name for name, _ in dtypes_sig]
     in_specs = (

@@ -78,9 +78,9 @@ def _x32():
     makes Pallas index maps produce i64 scalars, which Mosaic cannot
     legalize (observed 'failed to legalize func.return (i32, i64)'). All
     kernel inputs/outputs are explicitly 32-bit, so no semantics change."""
-    import jax
+    from ..utils.jaxcompat import enable_x64
 
-    return jax.enable_x64(False)
+    return enable_x64(False)
 
 
 # ---------------------------------------------------------------------------

@@ -139,4 +139,22 @@ def explain_string(
                 f"{name:<40}{snap['timers_s'][name]:>10.4f}s{calls:>8} call(s)"
             )
         buf.write_line()
+
+        # the last query's OWN scoped share (telemetry.metrics.scoped):
+        # under concurrent serving the cumulative pool above mixes every
+        # in-flight query; this section is attributable to exactly one
+        last = getattr(session, "last_query_metrics", None)
+        if last is not None:
+            buf.write_line(_BANNER)
+            buf.write_line("Last query metrics (scoped to that query):")
+            buf.write_line(_BANNER)
+            for name in sorted(last["counters"]):
+                buf.write_line(f"{name:<40}{last['counters'][name]:>12}")
+            for name in sorted(last["timers_s"]):
+                calls = last["timer_counts"].get(name, 0)
+                buf.write_line(
+                    f"{name:<40}{last['timers_s'][name]:>10.4f}s"
+                    f"{calls:>8} call(s)"
+                )
+            buf.write_line()
     return buf.with_tag()

@@ -1,0 +1,28 @@
+"""Concurrent query serving: bounded admission, micro-batched resident
+scans, plan caching, graceful degradation.
+
+Entry points: ``session.serve()`` / ``session.submit(df)`` (the facade
+verbs), or construct a ``QueryServer`` directly. See docs/10-serving.md
+for the architecture and the batching eligibility rules.
+"""
+
+from .plan_cache import PlanCache, plan_signature
+from .server import (
+    AdmissionRejected,
+    DeadlineExceeded,
+    QueryServer,
+    QueryTicket,
+    ServeConfig,
+    ServerClosed,
+)
+
+__all__ = [
+    "AdmissionRejected",
+    "DeadlineExceeded",
+    "PlanCache",
+    "QueryServer",
+    "QueryTicket",
+    "ServeConfig",
+    "ServerClosed",
+    "plan_signature",
+]

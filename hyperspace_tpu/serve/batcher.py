@@ -1,0 +1,188 @@
+"""Micro-batcher: coalesce compatible in-flight resident scans into ONE
+device dispatch.
+
+Every resident-scan query pays the same ~65 ms link round trip for its
+count-vector D2H regardless of payload (exec/hbm_cache design note), so
+N concurrent point lookups serialized through the single-query path cost
+N round trips. Queries are COMPATIBLE when they hit the same resident
+table (same index log version — the table key carries file identities)
+with predicates that narrow to the same resident column set; a batch of
+compatible queries stacks its predicates into one jitted mask+count
+launch (``hbm_cache.block_counts_batch`` / the mesh twin) and ships home
+one (N, n_blocks) count matrix — the inference-serving
+continuous-batching shape applied to index scans. The host leg stays
+per-query and exact: each query reads only ITS candidate blocks and
+re-evaluates ITS predicate there, so batched results are bit-identical
+to serial execution.
+
+Classification happens against the OPTIMIZED plan (the server's plan
+cache makes that cheap): only the `[Project] → Filter → IndexScan` shape
+qualifies — hybrid unions, joins and aggregates take the normal executor
+path, as do resident-ineligible predicates and queries the selectivity
+zone gate routes host (a broad predicate batched onto the device would
+pay the dispatch AND read nearly every block anyway).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from ..plan.expr import Expr
+from ..plan.ir import Filter, IndexScan, LogicalPlan, Project
+from ..storage.columnar import ColumnarBatch
+from ..telemetry.metrics import metrics
+
+
+@dataclass
+class ResidentScanRequest:
+    """One classified, batchable query: everything the batched executor
+    needs, plus the compatibility key it coalesces under."""
+
+    table: object  # ResidentTable | MeshResidentTable
+    entry: object  # IndexLogEntry (schema for empty results)
+    files: List[Path]  # the QUERY's pruned file list (subset of table's)
+    predicate: Expr
+    output_columns: List[str]
+    batch_key: Tuple
+    mesh: object = None  # non-None routes the mesh cache protocol
+    # prepare_resident_predicate result from classification — carried so
+    # the dispatch leg doesn't rerun the narrow pipeline per query
+    prepared: object = None
+
+
+def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
+    """A ResidentScanRequest when ``plan`` can ride a batched resident
+    dispatch, else None (the caller executes it normally). Never raises:
+    any refusal is a routing decision, not an error."""
+    from ..exec.hbm_cache import (
+        _max_block_frac,
+        hbm_cache,
+        prepare_resident_predicate,
+        zone_block_fraction,
+    )
+    from ..exec.scan import prune_index_files
+
+    output_columns = list(plan.output_columns())
+    node = plan
+    while isinstance(node, Project):
+        node = node.child
+    if not isinstance(node, Filter) or not isinstance(node.child, IndexScan):
+        return None
+    predicate = node.condition
+    scan = node.child
+    entry = scan.entry
+    # batched results come back as the scan's required columns projected
+    # to the plan's output — a Project that REORDERS within required
+    # columns is fine, anything else was already excluded by plan shape
+    files = prune_index_files(
+        [Path(p) for p in entry.content.files()],
+        predicate,
+        entry.indexed_columns,
+        entry.schema,
+        entry.num_buckets,
+    )
+    if not files:
+        return None  # empty scans are cheap on the normal path
+    pred_cols = sorted(predicate.columns())
+    mesh = session.mesh if session.mesh is not None else None
+    if mesh is not None and mesh.devices.size > 1:
+        from ..exec.mesh_cache import mesh_cache
+
+        table = mesh_cache.resident_for(files, pred_cols, mesh)
+        if table is None:
+            return None
+        prepared = prepare_resident_predicate(table.columns, predicate)
+        if prepared is None:
+            return None
+        return ResidentScanRequest(
+            table,
+            entry,
+            files,
+            predicate,
+            output_columns,
+            (id(table), frozenset(prepared[1])),
+            mesh,
+            prepared,
+        )
+    table = hbm_cache.resident_for(files, pred_cols)
+    if table is None:
+        return None
+    # same pre-dispatch selectivity gate as the single-query scan: a
+    # predicate that cannot prune blocks reads nearly everything host-side
+    # regardless, so batching its dispatch wins nothing
+    frac = zone_block_fraction(table, predicate)
+    if frac is not None and _max_block_frac() < 1.0 and frac >= _max_block_frac():
+        return None
+    prepared = prepare_resident_predicate(table.columns, predicate)
+    if prepared is None:
+        return None
+    return ResidentScanRequest(
+        table,
+        entry,
+        files,
+        predicate,
+        output_columns,
+        (id(table), frozenset(prepared[1])),
+        None,
+        prepared,
+    )
+
+
+def execute_batch(
+    requests: List[ResidentScanRequest],
+) -> Optional[List[ColumnarBatch]]:
+    """Results for a compatible batch — ONE device dispatch, then each
+    query's exact host leg over its own candidate blocks. None when the
+    stacked dispatch declines (caller falls back to per-query execution);
+    device errors propagate so the server can latch degradation."""
+    from ..exec.hbm_cache import hbm_cache
+    from ..exec.scan import _resident_parts
+
+    table = requests[0].table
+    predicates = [r.predicate for r in requests]
+    prepared = [r.prepared for r in requests]
+    if requests[0].mesh is not None:
+        from ..exec.mesh_cache import mesh_cache
+
+        counts = mesh_cache.block_counts_batch(table, predicates, prepared)
+        if counts is None:
+            return None
+        results = []
+        for r, c in zip(requests, counts):
+            parts = mesh_cache.collect_parts(
+                table, r.files, r.output_columns, r.predicate, c
+            )
+            results.append(_concat_or_empty(parts, r))
+        metrics.incr("serve.batch.coalesced", len(requests))
+        return results
+    counts = hbm_cache.block_counts_batch(table, predicates, prepared)
+    if counts is None:
+        return None
+    results = []
+    for r, c in zip(requests, counts):
+        parts = _resident_parts(
+            table, r.files, r.output_columns, r.predicate, c
+        )
+        results.append(_concat_or_empty(parts, r))
+    metrics.incr("serve.batch.coalesced", len(requests))
+    return results
+
+
+def _concat_or_empty(parts, r: ResidentScanRequest) -> ColumnarBatch:
+    from ..exec.scan import empty_batch_for
+
+    if parts:
+        return ColumnarBatch.concat(parts)
+    empty = empty_batch_for(r.output_columns, r.entry.schema)
+    if empty is not None:
+        return empty
+    # no logged schema (cannot happen for covering indexes, which always
+    # log one): fall back to a 0-row read of the first file
+    import numpy as np
+
+    from ..storage import layout
+
+    eb = layout.read_batch(r.files[0], columns=r.output_columns)
+    return eb.take(np.array([], dtype=np.int64))

@@ -1,0 +1,106 @@
+"""Cross-query plan cache: repeat queries skip rewrite and ranking.
+
+Under serving, the optimizer batch (normalization + the three Hyperspace
+rules, including candidate enumeration and ranking against every ACTIVE
+index) runs per query even when the fleet sends the same handful of
+query shapes thousands of times. The rewrite is a pure function of
+
+  * the NORMALIZED user plan — the logical tree with every literal,
+    projection and the leaf relations' concrete file snapshot (name,
+    size, mtime per file) baked into the signature, so a source that
+    gained or lost files since the cached entry can never collide with
+    it (Hybrid Scan decisions depend on exactly that snapshot);
+  * the session's rewrite-relevant state — hyperspace on/off and the
+    full conf (hybrid-scan flags etc. live there);
+  * the index collection's LOG VERSION — (name, log id, state) of every
+    ACTIVE stable index. Any create/refresh/optimize/delete bumps an id
+    or changes the set, so cached plans from the previous index
+    generation miss naturally and age out of the LRU.
+
+The cache stores the OPTIMIZED logical plan (immutable — plan.ir nodes
+are frozen dataclasses), not results. Entries are LRU-bounded; the
+version enumeration rides the collection manager's TTL cache, so a
+lookup costs two dict probes and no directory walk in steady state.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Tuple
+
+from ..plan.ir import LogicalPlan, Scan
+from ..telemetry.metrics import metrics
+
+
+def plan_signature(plan: LogicalPlan) -> Tuple:
+    """Value-based signature of a user plan: the tree string (operators,
+    expressions, literals, projections) plus every leaf relation's file
+    identity snapshot — tree_string alone shows only file COUNTS, which
+    two different snapshots can share."""
+    leaves = []
+    for node in plan.collect(lambda n: isinstance(n, Scan)):
+        rel = node.relation
+        leaves.append(
+            (
+                rel.file_format,
+                tuple(rel.root_paths),
+                tuple(sorted(rel.options.items())),
+                tuple(
+                    (f.name, f.size, f.modified_time) for f in rel.files
+                ),
+            )
+        )
+    return (plan.tree_string(), tuple(leaves))
+
+
+class PlanCache:
+    """Bounded LRU over (plan signature, session rewrite state, index log
+    version) -> optimized plan."""
+
+    def __init__(self, max_entries: int = 256):
+        self._max = max(int(max_entries), 1)
+        self._lock = threading.Lock()
+        self._plans: "OrderedDict[tuple, LogicalPlan]" = OrderedDict()
+
+    def _version_token(self, session) -> Tuple:
+        from ..actions import states
+
+        entries = session.collection_manager.get_indexes(
+            [states.ACTIVE], prefer_stable=True
+        )
+        return (
+            session.is_hyperspace_enabled(),
+            tuple(sorted((e.name, e.id, e.state) for e in entries)),
+            tuple(sorted((k, str(v)) for k, v in session.conf.as_dict().items())),
+        )
+
+    def optimized_plan(self, df) -> LogicalPlan:
+        """The optimized plan for ``df`` — cached when this exact plan was
+        optimized under the same index-log version and session state.
+        Cache hits skip rewrite AND usage-event telemetry (the event
+        already fired when the plan was first optimized; serving metrics
+        count executions)."""
+        key = (plan_signature(df.plan), self._version_token(df.session))
+        with self._lock:
+            hit = self._plans.get(key)
+            if hit is not None:
+                self._plans.move_to_end(key)
+        if hit is not None:
+            metrics.incr("serve.plan_cache.hit")
+            return hit
+        metrics.incr("serve.plan_cache.miss")
+        plan = df.optimized_plan(log_usage=True)
+        with self._lock:
+            self._plans[key] = plan
+            while len(self._plans) > self._max:
+                self._plans.popitem(last=False)
+        return plan
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._plans), "max_entries": self._max}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._plans.clear()

@@ -1,0 +1,472 @@
+"""QueryServer: concurrent query serving over one session.
+
+The session API executes one query per ``collect()`` call on the calling
+thread; the north star serves heavy concurrent traffic. This server puts
+a BOUNDED admission queue and a worker pool between callers and the
+executor:
+
+* **admission control** — a full queue rejects immediately with the
+  current depth and a retry-after estimate instead of queueing unbounded
+  latency (load shedding at the front door, not timeout storms at the
+  back);
+* **per-query deadlines** — a query whose deadline passes while queued
+  is failed without executing (its slot goes to a query that can still
+  make it); execution itself is not preempted, so the deadline bounds
+  QUEUE time exactly and service time statistically (see stats);
+* **micro-batching** — a worker that dequeues a batchable resident scan
+  drains every compatible queued request and serves them with ONE device
+  dispatch (serve.batcher); incompatible traffic flows around the batch
+  through the other workers;
+* **plan caching** — optimized plans are cached across queries keyed by
+  normalized plan signature, invalidated by index-log version
+  (serve.plan_cache);
+* **graceful degradation** — a device failure mid-serve (or a
+  deviceprobe first-touch verdict of "wedged") latches the server onto
+  the host engine: the failed batch re-executes host-side with identical
+  results, the resident table is dropped, and every later query routes
+  host until the process is restarted. Latched beats flapping: the
+  wedged-tunnel failure mode hangs, so each retry would cost a timeout.
+
+Tickets: ``submit()`` returns a QueryTicket immediately; ``result()``
+blocks for that query only. Worker threads execute each query under a
+scoped metrics child (telemetry.metrics), so every ticket carries
+attributable counters/timers — its own for single execution, its
+batch's shared scope for coalesced execution (a per-query split of one
+stacked launch would be fiction).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..exceptions import HyperspaceException
+from ..storage.columnar import ColumnarBatch
+from ..telemetry.metrics import metrics
+from . import batcher
+from .plan_cache import PlanCache
+
+
+class AdmissionRejected(HyperspaceException):
+    """Queue full: retry after ``retry_after_s`` (an estimate from the
+    current depth and recent service times) or shed the request."""
+
+    def __init__(self, queue_depth: int, retry_after_s: float):
+        super().__init__(
+            f"admission rejected: queue full at depth {queue_depth}; "
+            f"retry after ~{retry_after_s:.3f}s"
+        )
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(HyperspaceException):
+    pass
+
+
+class ServerClosed(HyperspaceException):
+    pass
+
+
+@dataclass
+class ServeConfig:
+    max_workers: int = 4
+    max_queue: int = 64
+    # applied when submit() passes no deadline; None = no deadline
+    default_deadline_s: Optional[float] = None
+    # largest number of compatible queries one dispatch coalesces
+    batch_max: int = 64
+    plan_cache_entries: int = 256
+    # tests construct paused servers (submit a burst, then start()) to
+    # make coalescing deterministic; production keeps the default
+    autostart: bool = True
+
+
+class QueryTicket:
+    """Handle for one submitted query. ``result()`` blocks until the
+    server finishes it (or ``timeout`` passes — TimeoutError), then
+    returns the ColumnarBatch or raises what execution raised."""
+
+    def __init__(self, deadline_at: Optional[float]):
+        self._done = threading.Event()
+        self._result: Optional[ColumnarBatch] = None
+        self._error: Optional[BaseException] = None
+        self.submitted_at = time.monotonic()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.deadline_at = deadline_at
+        self.batch_size = 1  # queries sharing this one's device dispatch
+        self.metrics: Optional[dict] = None  # per-query scoped snapshot
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ColumnarBatch:
+        if not self._done.wait(timeout):
+            raise TimeoutError("query still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    @property
+    def wait_s(self) -> Optional[float]:
+        if self.started_at is None:
+            return None
+        return self.started_at - self.submitted_at
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+
+class _Request:
+    __slots__ = ("df", "plan", "resident", "ticket")
+
+    def __init__(self, df, plan, resident, ticket):
+        self.df = df
+        self.plan = plan
+        self.resident = resident  # Optional[batcher.ResidentScanRequest]
+        self.ticket = ticket
+
+
+class QueryServer:
+    def __init__(self, session, config: Optional[ServeConfig] = None):
+        self.session = session
+        self.config = config or ServeConfig()
+        self.plan_cache = PlanCache(self.config.plan_cache_entries)
+        self._cond = threading.Condition()
+        self._queue: "deque[_Request]" = deque()
+        self._workers: List[threading.Thread] = []
+        self._closed = False
+        self._host_latched = False
+        self._degraded_reason: Optional[str] = None
+        # serving stats (guarded by _cond's lock)
+        self._submitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._shed = 0
+        self._deadline_missed = 0
+        self._dispatches = 0  # device dispatches for batched queries
+        self._batched_queries = 0
+        self._batch_sizes: Dict[int, int] = {}
+        self._latencies: "deque[float]" = deque(maxlen=4096)
+        self._waits: "deque[float]" = deque(maxlen=4096)
+        self._ewma_service_s = 0.01
+        if self.config.autostart:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def start(self) -> "QueryServer":
+        """Spawn the worker pool (idempotent)."""
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("query server is closed.")
+            missing = self.config.max_workers - len(self._workers)
+            for i in range(missing):
+                t = threading.Thread(
+                    target=self._worker_loop,
+                    daemon=True,
+                    name=f"hyperspace-serve-{len(self._workers)}",
+                )
+                self._workers.append(t)
+                t.start()
+        return self
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop accepting work, fail queued queries with ServerClosed,
+        and join the workers (in-flight queries finish)."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+            workers = list(self._workers)
+        for req in pending:
+            self._finish(req.ticket, error=ServerClosed("server closed."))
+        for t in workers:
+            t.join(timeout_s)
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, df, deadline_s: Optional[float] = None) -> QueryTicket:
+        """Enqueue a DataFrame for execution. Raises AdmissionRejected
+        when the queue is full (backpressure — the caller decides whether
+        to retry, degrade, or shed), ServerClosed after close()."""
+        if df.session is not self.session:
+            raise HyperspaceException(
+                "Cannot serve a DataFrame from a different session."
+            )
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        deadline_at = (
+            time.monotonic() + deadline_s if deadline_s is not None else None
+        )
+        # plan + batchability resolved at submit time: the plan cache
+        # makes repeats ~two dict probes, and classified requests let the
+        # worker's coalescing scan stay a pure queue walk under the lock
+        ticket = QueryTicket(deadline_at)
+        try:
+            plan = self.plan_cache.optimized_plan(df)
+            resident = (
+                None
+                if self._consult_device_latch()
+                else batcher.classify(self.session, plan)
+            )
+        except Exception as e:  # noqa: BLE001 - planning failure = query failure
+            # planning failures (unknown columns, vanished files) belong
+            # to the QUERY, not the server: the ticket carries them and
+            # admission still succeeds (and counts as a submission, so
+            # stats() can never report failed > submitted)
+            metrics.incr("serve.plan_error")
+            metrics.incr("serve.submitted")
+            with self._cond:
+                self._submitted += 1
+            self._finish(ticket, error=e)
+            return ticket
+        req = _Request(df, plan, resident, ticket)
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("query server is closed.")
+            if len(self._queue) >= self.config.max_queue:
+                self._shed += 1
+                metrics.incr("serve.shed")
+                raise AdmissionRejected(
+                    len(self._queue), self._retry_after_locked()
+                )
+            self._submitted += 1
+            self._queue.append(req)
+            self._cond.notify()
+        metrics.incr("serve.submitted")
+        return ticket
+
+    def _retry_after_locked(self) -> float:
+        backlog = len(self._queue) / max(self.config.max_workers, 1)
+        return max(backlog * self._ewma_service_s, 0.001)
+
+    # -- worker --------------------------------------------------------------
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if not self._queue:  # closed and drained
+                    return
+                req = self._queue.popleft()
+                batch = [req]
+                if req.resident is not None and not self._host_latched:
+                    batch += self._drain_compatible_locked(req)
+            now = time.monotonic()
+            live: List[_Request] = []
+            for r in batch:
+                if r.ticket.deadline_at is not None and now > r.ticket.deadline_at:
+                    self._miss_deadline(r)
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            if len(live) == 1 or live[0].resident is None:
+                for r in live:
+                    self._execute_single(r)
+            else:
+                self._execute_batch(live)
+
+    def _drain_compatible_locked(self, head: _Request) -> List[_Request]:
+        """Pull every queued request sharing ``head``'s batch key (same
+        resident table identity + resident column set), preserving the
+        queue order of everything else. Called with the lock held."""
+        key = head.resident.batch_key
+        taken: List[_Request] = []
+        keep: "deque[_Request]" = deque()
+        while self._queue and len(taken) + 1 < self.config.batch_max:
+            r = self._queue.popleft()
+            if r.resident is not None and r.resident.batch_key == key:
+                taken.append(r)
+            else:
+                keep.append(r)
+        keep.extend(self._queue)
+        self._queue.clear()
+        self._queue.extend(keep)
+        return taken
+
+    # -- execution -----------------------------------------------------------
+    def _execute_single(self, req: _Request) -> None:
+        req.ticket.started_at = time.monotonic()
+        try:
+            with metrics.scoped() as qm:
+                result = self._run_plan(req)
+            req.ticket.metrics = qm.snapshot()
+            self._finish(req.ticket, result=result)
+        except Exception as e:  # noqa: BLE001 - one query's failure is its own
+            self._finish(req.ticket, error=e)
+
+    def _run_plan(self, req: _Request) -> ColumnarBatch:
+        from ..exec.executor import Executor
+
+        if self._host_latched:
+            executor = Executor(self.session.conf, device=False, mesh=None)
+        else:
+            executor = Executor(self.session.conf, mesh=self.session.mesh)
+        return executor.execute(req.plan)
+
+    def _execute_batch(self, live: List[_Request]) -> None:
+        now = time.monotonic()
+        for r in live:
+            r.ticket.started_at = now
+        residents = [r.resident for r in live]
+        try:
+            # one scope for the whole coalesced dispatch + host legs:
+            # batched tickets share their batch's metrics snapshot (a
+            # per-query split of one stacked launch would be fiction)
+            with metrics.scoped() as bm:
+                results = batcher.execute_batch(residents)
+        except Exception as e:  # noqa: BLE001 - device loss mid-serve
+            # the wedge path: drop the table so no later query retries the
+            # dead device, latch the server host-side, and serve THIS
+            # batch from the host engine — identical results, no error
+            # escapes to callers
+            self._latch_host(repr(e), residents[0])
+            results = None
+        if results is None:
+            if not self._host_latched:
+                # stacked dispatch declined (not an error): per-query path
+                metrics.incr("serve.batch.declined")
+            for r in live:
+                self._execute_single(r)
+            return
+        with self._cond:
+            self._dispatches += 1
+            self._batched_queries += len(live)
+            n = len(live)
+            self._batch_sizes[n] = self._batch_sizes.get(n, 0) + 1
+        snap = bm.snapshot()
+        for r, result in zip(live, results):
+            r.ticket.batch_size = len(live)
+            r.ticket.metrics = snap
+            self._finish(r.ticket, result=result)
+
+    def _latch_host(self, reason: str, resident) -> None:
+        from ..exec.hbm_cache import hbm_cache
+        from ..exec.mesh_cache import mesh_cache
+
+        with self._cond:
+            already = self._host_latched
+            self._host_latched = True
+            self._degraded_reason = self._degraded_reason or reason
+        if not already:
+            metrics.incr("serve.degraded")
+            cache = mesh_cache if resident.mesh is not None else hbm_cache
+            cache.drop(resident.table)
+
+    def _miss_deadline(self, req: _Request) -> None:
+        with self._cond:
+            self._deadline_missed += 1
+        metrics.incr("serve.deadline_missed")
+        self._finish(
+            req.ticket,
+            error=DeadlineExceeded(
+                "deadline expired while queued "
+                f"(waited {time.monotonic() - req.ticket.submitted_at:.3f}s)."
+            ),
+        )
+
+    def _finish(self, ticket: QueryTicket, result=None, error=None) -> None:
+        ticket.finished_at = time.monotonic()
+        ticket._result = result
+        ticket._error = error
+        if ticket.started_at is not None:
+            service = ticket.finished_at - ticket.started_at
+            with self._cond:
+                self._ewma_service_s = (
+                    0.8 * self._ewma_service_s + 0.2 * service
+                )
+                self._waits.append(ticket.wait_s or 0.0)
+        with self._cond:
+            if error is None:
+                self._completed += 1
+            else:
+                self._failed += 1
+            # latency percentiles describe SERVED queries: tickets that
+            # never started (deadline-missed, plan-error, close()-shed)
+            # would pollute p50/p99 with pure queue wait
+            if ticket.started_at is not None and ticket.latency_s is not None:
+                self._latencies.append(ticket.latency_s)
+        if error is None:
+            metrics.incr("serve.completed")
+        ticket._done.set()
+
+    # -- degradation surface -------------------------------------------------
+    def _consult_device_latch(self) -> bool:
+        """True when serving is latched host-side, consulting the
+        process-wide deviceprobe first-touch verdict: a wedged device
+        discovered by ANY component degrades serving without waiting for
+        a serve-path failure. Called per submit (latched_verdict is one
+        dict probe) and by the ``degraded`` property."""
+        if self._host_latched:
+            return True
+        from ..utils.deviceprobe import latched_verdict
+
+        if latched_verdict() is False:
+            with self._cond:
+                newly = not self._host_latched
+                self._host_latched = True
+                self._degraded_reason = (
+                    self._degraded_reason or "deviceprobe first-touch verdict"
+                )
+            if newly:
+                metrics.incr("serve.degraded")
+            return True
+        return False
+
+    @property
+    def degraded(self) -> bool:
+        """True once the server latched onto the host engine (serve-path
+        failure or deviceprobe first-touch verdict)."""
+        return self._consult_device_latch()
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> dict:
+        import statistics
+
+        with self._cond:
+            lat = sorted(self._latencies)
+            waits = list(self._waits)
+            out = {
+                "submitted": self._submitted,
+                "completed": self._completed,
+                "failed": self._failed,
+                "shed": self._shed,
+                "deadline_missed": self._deadline_missed,
+                "queue_depth": len(self._queue),
+                "workers": len(self._workers),
+                "degraded": self._host_latched,
+                "degraded_reason": self._degraded_reason,
+                "batch_dispatches": self._dispatches,
+                "batched_queries": self._batched_queries,
+                "batch_size_hist": dict(sorted(self._batch_sizes.items())),
+                "mean_batch_size": round(
+                    self._batched_queries / self._dispatches, 2
+                )
+                if self._dispatches
+                else None,
+                "plan_cache": self.plan_cache.snapshot(),
+            }
+            if lat:
+                out["latency_p50_ms"] = round(
+                    1e3 * lat[len(lat) // 2], 3
+                )
+                out["latency_p99_ms"] = round(
+                    1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3
+                )
+            if waits:
+                out["mean_wait_ms"] = round(1e3 * statistics.fmean(waits), 3)
+        return out

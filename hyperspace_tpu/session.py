@@ -11,6 +11,7 @@ rewrite-rule batch inside DataFrame.collect().
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 from .config import HyperspaceConf
@@ -36,6 +37,12 @@ class Catalog:
 
     def __init__(self, session: "HyperspaceSession"):
         self._session = session
+        # one lock over both maps: a concurrent register/drop during
+        # serving raced the plain-dict mutations (check-then-act in
+        # create_table, the two-step pop in drop) — every entry/exit goes
+        # through it, and resolution copies the entry out before building
+        # a DataFrame so no IO runs under the lock
+        self._lock = threading.RLock()
         self._views: Dict[str, object] = {}  # lower name -> LogicalPlan
         self._tables: Dict[str, tuple] = {}  # lower name -> (fmt, paths, opts)
 
@@ -51,8 +58,9 @@ class Catalog:
                 "Cannot register a view over a DataFrame from a different "
                 "session."
             )
-        self._tables.pop(name.lower(), None)
-        self._views[name.lower()] = df.plan
+        with self._lock:
+            self._tables.pop(name.lower(), None)
+            self._views[name.lower()] = df.plan
 
     def create_table(
         self,
@@ -65,20 +73,23 @@ class Catalog:
         from .exceptions import HyperspaceException
 
         key = name.lower()
-        if not replace and (key in self._tables or key in self._views):
-            raise HyperspaceException(f"Relation {name!r} already exists.")
-        self._views.pop(key, None)
-        self._tables[key] = (file_format, list(paths), dict(options))
+        with self._lock:
+            if not replace and (key in self._tables or key in self._views):
+                raise HyperspaceException(f"Relation {name!r} already exists.")
+            self._views.pop(key, None)
+            self._tables[key] = (file_format, list(paths), dict(options))
 
     def drop(self, name: str) -> bool:
         key = name.lower()
-        return (
-            self._views.pop(key, None) is not None
-            or self._tables.pop(key, None) is not None
-        )
+        with self._lock:
+            return (
+                self._views.pop(key, None) is not None
+                or self._tables.pop(key, None) is not None
+            )
 
     def list(self) -> List[str]:
-        return sorted([*self._views, *self._tables])
+        with self._lock:
+            return sorted([*self._views, *self._tables])
 
     # -- resolution ----------------------------------------------------------
     def table(self, name: str):
@@ -86,15 +97,22 @@ class Catalog:
         from .exceptions import HyperspaceException
 
         key = name.lower()
-        if key in self._views:
-            return DataFrame(self._session, self._views[key])
-        if key in self._tables:
-            fmt, paths, options = self._tables[key]
-            reader = self._session.read
-            for k, v in options.items():
-                reader = reader.option(k, v)
-            return reader._load(fmt, list(paths))
-        raise HyperspaceException(f"Unknown table or view: {name!r}.")
+        with self._lock:
+            if key in self._views:
+                plan = self._views[key]
+                entry = None
+            elif key in self._tables:
+                plan = None
+                entry = self._tables[key]
+            else:
+                raise HyperspaceException(f"Unknown table or view: {name!r}.")
+        if plan is not None:
+            return DataFrame(self._session, plan)
+        fmt, paths, options = entry
+        reader = self._session.read
+        for k, v in options.items():
+            reader = reader.option(k, v)
+        return reader._load(fmt, list(paths))
 
 
 class HyperspaceSession:
@@ -105,6 +123,38 @@ class HyperspaceSession:
         self.catalog = Catalog(self)
         self._hyperspace_enabled = False
         self._collection_manager = None  # lazy (circular import)
+        # per-query scoped metrics snapshot of the last collect() on this
+        # session (telemetry.metrics.scoped); explain(verbose) prints it
+        self.last_query_metrics: Optional[dict] = None
+        self._server = None  # lazy QueryServer (serve())
+        self._server_lock = threading.Lock()
+
+    def serve(self, **options) -> "QueryServer":
+        """The session's query server (serve.QueryServer), created on
+        first call — ``options`` are ServeConfig fields and apply only to
+        that first creation. The server accepts concurrent queries
+        through a bounded queue with admission control, coalesces
+        compatible resident scans into single device dispatches, and
+        caches optimized plans across queries (docs/10-serving.md)."""
+        with self._server_lock:
+            if self._server is None or self._server.closed:
+                from .serve import QueryServer, ServeConfig
+
+                self._server = QueryServer(self, ServeConfig(**options))
+            elif options:
+                from .exceptions import HyperspaceException
+
+                raise HyperspaceException(
+                    "serve() options apply only when the server is "
+                    "created; close() the running server first."
+                )
+            return self._server
+
+    def submit(self, df, deadline_s: Optional[float] = None):
+        """Submit a DataFrame through the session's query server —
+        shorthand for ``session.serve().submit(df, deadline_s)``; returns
+        the QueryTicket."""
+        return self.serve().submit(df, deadline_s=deadline_s)
 
     def table(self, name: str):
         """DataFrame over a registered view or table (Catalog.table)."""

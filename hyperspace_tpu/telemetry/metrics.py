@@ -15,14 +15,33 @@ Usage::
 
 Counters and timers accumulate in a process-global registry; ``snapshot()``
 returns a plain dict (surfaced by bench.py and explain(verbose)).
+
+Concurrent serving adds a second axis: with many queries in flight the
+global pool alone cannot say which query paid which cost. ``scoped()``
+opens a contextvar-bound CHILD registry — every ``incr``/``record_time``
+against the global registry also mirrors into the scope active on the
+recording thread, so each query's execution gets its own attributable
+snapshot while the global totals stay exactly as before. Scopes follow
+``contextvars`` propagation: a thread (or context copy) that entered the
+scope records into it; unrelated threads do not, so two concurrent
+queries' scopes never bleed into each other. Scopes NEST: each recording
+lands once in every enclosing scope (collect() opens its own scope, so a
+caller wrapping collect() in another still sees the query's counters).
 """
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict
+from typing import Dict, Optional
+
+# the per-query child registry active on this thread/context (None = no
+# scope; recording goes to the global registry only)
+_SCOPE: "contextvars.ContextVar[Optional[MetricsRegistry]]" = (
+    contextvars.ContextVar("hyperspace_tpu_metrics_scope", default=None)
+)
 
 
 class MetricsRegistry:
@@ -33,15 +52,33 @@ class MetricsRegistry:
         self._counters: Dict[str, int] = {}
         self._timers: Dict[str, float] = {}
         self._timer_counts: Dict[str, int] = {}
+        # enclosing scope at scoped()-entry time; mirroring walks this
+        # chain so a nested scope feeds every scope around it exactly once
+        self._parent: Optional["MetricsRegistry"] = None
 
     def incr(self, name: str, by: int = 1) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + by
+        node = _SCOPE.get()
+        while node is not None:
+            if node is not self:
+                with node._lock:
+                    node._counters[name] = node._counters.get(name, 0) + by
+            node = node._parent
 
     def record_time(self, name: str, seconds: float) -> None:
         with self._lock:
             self._timers[name] = self._timers.get(name, 0.0) + seconds
             self._timer_counts[name] = self._timer_counts.get(name, 0) + 1
+        node = _SCOPE.get()
+        while node is not None:
+            if node is not self:
+                with node._lock:
+                    node._timers[name] = node._timers.get(name, 0.0) + seconds
+                    node._timer_counts[name] = (
+                        node._timer_counts.get(name, 0) + 1
+                    )
+            node = node._parent
 
     @contextmanager
     def timer(self, name: str):
@@ -50,6 +87,22 @@ class MetricsRegistry:
             yield
         finally:
             self.record_time(name, time.perf_counter() - t0)
+
+    @contextmanager
+    def scoped(self):
+        """Bind a fresh child registry to the current context: everything
+        recorded (through ANY registry) on this thread — and on contexts
+        copied from it — until exit also lands in the child. Scopes nest
+        via a parent chain: an inner scope's recordings land once in each
+        enclosing scope too (never twice — the chain walk skips the
+        registry doing the recording)."""
+        child = MetricsRegistry()
+        child._parent = _SCOPE.get()
+        token = _SCOPE.set(child)
+        try:
+            yield child
+        finally:
+            _SCOPE.reset(token)
 
     def counter(self, name: str) -> int:
         with self._lock:

@@ -115,6 +115,13 @@ def first_device_touch_ok(timeout_s: float | None = None) -> bool:
     return _FIRST_TOUCH["ok"]
 
 
+def latched_verdict() -> "bool | None":
+    """The process's first-touch verdict IF one is already latched, else
+    None — consultable from latency-critical paths (the query server's
+    degradation check) without starting a touch or waiting on one."""
+    return _FIRST_TOUCH.get("ok")
+
+
 def first_touch_error() -> "str | None":
     """The exception repr of a FAILED (not timed-out) first touch, or
     None — lets callers report a broken jax install as what it is instead

@@ -1,0 +1,51 @@
+"""Version-portable spellings of the two jax APIs that moved homes.
+
+The engine targets current jax (``jax.shard_map``, ``jax.enable_x64``,
+shard_map's ``check_vma``), but deployment images pin older releases
+where both still live under ``jax.experimental`` and the shard_map
+replication check is spelled ``check_rep``. Every call site imports the
+one spelling from here; nothing else in the tree touches the moved
+names, so the next rename is a one-file fix.
+
+Resolution happens at call time, not import time: importing this module
+must not initialize a jax backend (the pure-host metadata paths import
+through utils/).
+"""
+
+from __future__ import annotations
+
+
+def enable_x64(enable: bool = True):
+    """Context manager scoping the x64 flag (``jax.enable_x64`` on
+    current jax, ``jax.experimental.enable_x64`` before the promotion —
+    the experimental form takes no False argument, so disabling on old
+    jax goes through ``jax.experimental.disable_x64``)."""
+    import jax
+
+    fn = getattr(jax, "enable_x64", None)
+    if fn is not None:
+        return fn(enable)
+    from jax import experimental
+
+    return experimental.enable_x64() if enable else experimental.disable_x64()
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` with the replication/varying-manual-axes check
+    kwarg translated for jax versions that spell it ``check_rep`` (or
+    ship shard_map only under ``jax.experimental``)."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn  # type: ignore
+    try:
+        return fn(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    except TypeError:
+        return fn(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )

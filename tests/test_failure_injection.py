@@ -8,6 +8,11 @@
     the log is stuck in CREATING, further actions refuse, ``cancel()``
     recovers to the last stable state AND garbage-collects the orphaned
     ``.spill`` scratch, and a rebuild then succeeds.
+(c) the query server's unhappy paths (serve/): deadline expiry while
+    queued, queue-full admission rejection, and a device that wedges
+    MID-SERVE — the failed batch must still answer correctly from the
+    host engine, the server must latch degraded, and no test may sleep
+    on a real 120 s device timeout (all injections are in-process).
 """
 
 import os
@@ -265,3 +270,178 @@ def test_sigkill_mid_spill_cancel_recovers_and_gcs_spill(tmp_path):
     key = int(np.random.default_rng(0).integers(0, 10**6, 400_000)[0])
     got = q.filter(col("k") == key).select("k", "v").collect()
     assert got.num_rows >= 1
+
+
+# ---------------------------------------------------------------------------
+# (c) query-server fault injection (serve/): deadline expiry, queue-full
+#     rejection, wedged device mid-serve. Every failure is injected
+#     in-process — no test waits on a real device timeout.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def serve_env(tmp_path, monkeypatch):
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.exec.hbm_cache import hbm_cache
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    hbm_cache.reset()
+    rng = np.random.default_rng(2)
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 5000, 40_000).astype(np.int64),
+            "v": rng.integers(0, 100, 40_000).astype(np.int64),
+        }
+    )
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"), C.INDEX_NUM_BUCKETS: 4}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("fidx", ["k"], ["v"])
+    )
+    session.enable_hyperspace()
+    assert hs.prefetch_index("fidx")
+    yield session, src, batch
+    hbm_cache.reset()
+
+
+def _serve_lookup(session, src, key):
+    from hyperspace_tpu.plan.expr import col, lit
+
+    return (
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(int(key)))
+        .select("k", "v")
+    )
+
+
+def test_serve_deadline_expiry_fails_queued_query_without_executing(serve_env):
+    from hyperspace_tpu.serve import DeadlineExceeded, QueryServer, ServeConfig
+
+    session, src, batch = serve_env
+    server = QueryServer(session, ServeConfig(max_workers=1, autostart=False))
+    # queued on a PAUSED server with a deadline that lapses before any
+    # worker exists: the query must fail with DeadlineExceeded at drain
+    # time, without ever executing
+    doomed = server.submit(
+        _serve_lookup(session, src, batch.columns["k"].data[0]),
+        deadline_s=0.01,
+    )
+    live = server.submit(_serve_lookup(session, src, batch.columns["k"].data[1]))
+    time.sleep(0.05)
+    server.start()
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=60)
+    assert doomed.started_at is None  # never executed — queue time only
+    assert live.result(timeout=60).num_rows >= 0
+    assert server.stats()["deadline_missed"] == 1
+    assert metrics.counter("serve.deadline_missed") >= 1
+    server.close()
+
+
+def test_serve_queue_full_rejection_is_backpressure_not_latency(serve_env):
+    from hyperspace_tpu.serve import AdmissionRejected, QueryServer, ServeConfig
+
+    session, src, batch = serve_env
+    server = QueryServer(
+        session, ServeConfig(max_workers=1, max_queue=2, autostart=False)
+    )
+    for i in range(2):
+        server.submit(_serve_lookup(session, src, i))
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionRejected) as exc:
+        server.submit(_serve_lookup(session, src, 2))
+    # rejection is IMMEDIATE (admission control, not a queue timeout) and
+    # carries what a load balancer needs: depth + a retry-after estimate
+    assert time.monotonic() - t0 < 1.0
+    assert exc.value.queue_depth == 2
+    assert exc.value.retry_after_s > 0
+    assert server.stats()["shed"] == 1
+    server.start()
+    server.close(timeout_s=120)
+
+
+def test_serve_wedged_device_mid_serve_degrades_and_answers_from_host(
+    serve_env, monkeypatch
+):
+    from hyperspace_tpu.exec import hbm_cache as hc
+    from hyperspace_tpu.serve import QueryServer, ServeConfig
+
+    session, src, batch = serve_env
+    keys = [int(batch.columns["k"].data[i]) for i in range(8)]
+    queries = [_serve_lookup(session, src, k) for k in keys]
+    serial = [q.collect() for q in queries]
+
+    # wedge injection: the batched device dispatch dies the way a lost
+    # tunnel dies — an exception out of the jax call, not a clean None
+    def wedged(self, table, predicates, prepared=None):
+        raise RuntimeError("DEADLINE_EXCEEDED: device tunnel wedged")
+
+    monkeypatch.setattr(hc.HbmIndexCache, "block_counts_batch", wedged)
+    metrics.reset()
+    # ONE worker so the whole burst lands in the wedged batch: a second
+    # worker would race a query down the single-query device scan, find
+    # the just-dropped table missing, and note_touch a background
+    # repopulation (correct in production — the injection wedges only the
+    # batch entry point, not the device — but it makes the "nothing
+    # resident remains" assertion below racy)
+    server = QueryServer(
+        session, ServeConfig(max_workers=1, autostart=False)
+    )
+    tickets = [server.submit(q) for q in queries]
+    server.start()
+    results = [t.result(timeout=120) for t in tickets]
+
+    # no error escaped to any caller: the failed batch re-ran host-side
+    # with identical results
+    def rows(b):
+        return sorted(
+            zip(b.columns["k"].data.tolist(), b.columns["v"].data.tolist())
+        )
+
+    for s, r in zip(serial, results):
+        assert rows(s) == rows(r)
+    stats = server.stats()
+    assert stats["degraded"] is True
+    assert "wedged" in stats["degraded_reason"]
+    assert stats["batch_dispatches"] == 0  # the device batch never landed
+    assert metrics.counter("serve.degraded") == 1
+    # the wedged table was dropped: nothing resident remains to retry
+    assert hc.hbm_cache.snapshot()["tables"] == 0
+    # later queries keep being served (host-latched), still correct
+    later = server.submit(_serve_lookup(session, src, keys[0]))
+    assert rows(later.result(timeout=120)) == rows(serial[0])
+    assert server.degraded is True
+    server.close()
+
+
+def test_serve_deviceprobe_latch_degrades_before_any_serve_failure(
+    serve_env, monkeypatch
+):
+    """A wedged device discovered by ANY component (deviceprobe's
+    first-touch latch) must route serving host WITHOUT waiting for a
+    serve-path failure — the `degraded` property consults the latch."""
+    from hyperspace_tpu.serve import QueryServer, ServeConfig
+    from hyperspace_tpu.utils import deviceprobe
+
+    session, src, batch = serve_env
+    monkeypatch.setitem(deviceprobe._FIRST_TOUCH, "ok", False)
+    server = QueryServer(session, ServeConfig(max_workers=1, autostart=False))
+    assert server.degraded is True
+    # queries still answer, host-side
+    t = server.submit(_serve_lookup(session, src, batch.columns["k"].data[0]))
+    server.start()
+    assert t.result(timeout=120).num_rows >= 0
+    assert t.batch_size == 1  # host-latched serving never batches
+    server.close()

@@ -58,7 +58,7 @@ def test_cli_json_format_and_failure_exit(tmp_path):
     assert payload["findings"][0]["code"] == "HS006"
 
 
-def test_cli_list_rules_names_all_six():
+def test_cli_list_rules_names_all_seven():
     proc = subprocess.run(
         [sys.executable, "scripts/lint.py", "--list-rules"],
         cwd=REPO,
@@ -67,7 +67,9 @@ def test_cli_list_rules_names_all_six():
         timeout=120,
     )
     assert proc.returncode == 0
-    for code in ("HS001", "HS002", "HS003", "HS004", "HS005", "HS006"):
+    for code in (
+        "HS001", "HS002", "HS003", "HS004", "HS005", "HS006", "HS007",
+    ):
         assert code in proc.stdout
 
 

@@ -1,0 +1,457 @@
+"""Query serving (hyperspace_tpu.serve): admission, micro-batching, plan
+caching, per-query metrics, and concurrent-execution parity.
+
+Every parity assertion compares against SERIAL execution of the same
+DataFrame through the session API — the serving layer must be invisible
+in results, visible only in throughput. Batching tests construct PAUSED
+servers (autostart=False): the burst sits queued before start(), so the
+first worker's drain is deterministic and "one coalesced dispatch" is an
+exact assertion, not a race.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.exec.hbm_cache import hbm_cache
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.plan.ir import IndexScan
+from hyperspace_tpu.serve import (
+    AdmissionRejected,
+    QueryServer,
+    ServeConfig,
+    ServerClosed,
+)
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def _force_residency(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    hbm_cache.reset()
+    yield
+    hbm_cache.reset()
+
+
+N_ROWS = 60_000
+
+
+def _source(n=N_ROWS, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 20_000, n).astype(np.int64),
+            "v": rng.integers(0, 1000, n).astype(np.int64),
+            "g": rng.integers(0, 40, n).astype(np.int64),
+        }
+    )
+
+
+@pytest.fixture
+def env(tmp_path):
+    batch = _source()
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"), C.INDEX_NUM_BUCKETS: 4}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("sidx", ["k"], ["v"]))
+    session.enable_hyperspace()
+    assert hs.prefetch_index("sidx")
+    return session, hs, src, batch
+
+
+def _lookup(session, src, key):
+    return (
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(int(key)))
+        .select("k", "v")
+    )
+
+
+def _sorted_rows(b):
+    return sorted(zip(b.columns["k"].data.tolist(), b.columns["v"].data.tolist()))
+
+
+# ---------------------------------------------------------------------------
+# micro-batching
+# ---------------------------------------------------------------------------
+def test_burst_coalesces_into_one_dispatch_with_parity(env):
+    session, hs, src, batch = env
+    keys = [int(batch.columns["k"].data[i]) for i in range(0, 320, 20)]
+    queries = [_lookup(session, src, k) for k in keys]
+    serial = [q.collect() for q in queries]
+
+    metrics.reset()
+    server = QueryServer(
+        session, ServeConfig(max_workers=2, autostart=False)
+    )
+    tickets = [server.submit(q) for q in queries]
+    server.start()
+    results = [t.result(timeout=120) for t in tickets]
+    for s, r in zip(serial, results):
+        assert _sorted_rows(s) == _sorted_rows(r)
+    stats = server.stats()
+    # the whole queued burst shares ONE device dispatch
+    assert stats["batch_dispatches"] == 1
+    assert stats["mean_batch_size"] == float(len(keys))
+    assert metrics.counter("serve.batch.dispatches") == 1
+    assert metrics.counter("serve.batch.queries") == len(keys)
+    assert all(t.batch_size == len(keys) for t in tickets)
+    server.close()
+
+
+def test_mixed_compatibility_batches_only_compatible(env):
+    """Range + point predicates on the resident column set coalesce; an
+    aggregate in the same burst flows through the normal path."""
+    session, hs, src, batch = env
+    from hyperspace_tpu.plan.aggregates import agg_sum
+
+    q_points = [_lookup(session, src, batch.columns["k"].data[i]) for i in range(6)]
+    q_range = (
+        session.read.parquet(str(src))
+        .filter((col("k") >= lit(100)) & (col("k") <= lit(140)))
+        .select("k", "v")
+    )
+    q_agg = (
+        session.read.parquet(str(src))
+        .group_by("g")
+        .agg(agg_sum("v", "sv"))
+    )
+    serial = [q.collect() for q in q_points + [q_range, q_agg]]
+    server = QueryServer(session, ServeConfig(max_workers=2, autostart=False))
+    tickets = [server.submit(q) for q in q_points + [q_range, q_agg]]
+    server.start()
+    results = [t.result(timeout=120) for t in tickets]
+    for s, r in zip(serial, results):
+        assert s.num_rows == r.num_rows
+        cols = list(s.columns)
+        assert sorted(s.columns[cols[-1]].data.tolist()) == sorted(
+            r.columns[cols[-1]].data.tolist()
+        )
+    stats = server.stats()
+    assert stats["completed"] == len(tickets)
+    # the aggregate never rides a batch
+    assert tickets[-1].batch_size == 1
+    server.close()
+
+
+def test_batch_results_match_block_counts_single(env):
+    """The stacked (N, n_blocks) dispatch is count-identical to N single
+    dispatches — the device leg's parity oracle."""
+    session, hs, src, batch = env
+    files = sorted(
+        __import__("pathlib").Path(
+            hs.index("sidx").index_location
+        ).glob("v__=*/*.tcb")
+    )
+    table = hbm_cache.resident_for(files, ["k"])
+    assert table is not None
+    preds = [
+        col("k") == lit(int(batch.columns["k"].data[i])) for i in range(8)
+    ] + [(col("k") >= lit(50)) & (col("k") <= lit(90))]
+    stacked = hbm_cache.block_counts_batch(table, preds)
+    assert stacked is not None and stacked.shape[0] == len(preds)
+    for i, p in enumerate(preds):
+        single = hbm_cache.block_counts(table, p)
+        assert np.array_equal(stacked[i], single)
+
+
+# ---------------------------------------------------------------------------
+# plan cache
+# ---------------------------------------------------------------------------
+def test_plan_cache_hits_repeat_queries_and_invalidates_on_index_change(env):
+    session, hs, src, batch = env
+    server = QueryServer(session, ServeConfig(max_workers=1))
+    q = lambda: _lookup(session, src, batch.columns["k"].data[7])  # noqa: E731
+    metrics.reset()
+    server.submit(q()).result(timeout=120)
+    assert metrics.counter("serve.plan_cache.miss") == 1
+    server.submit(q()).result(timeout=120)
+    assert metrics.counter("serve.plan_cache.hit") == 1
+    # the cached plan IS the rewritten plan (IndexScan baked in)
+    plan = server.plan_cache.optimized_plan(q())
+    assert plan.collect(lambda n: isinstance(n, IndexScan))
+    # index-log version bump (delete: new log id + the index leaves the
+    # ACTIVE set; source untouched, so the plan SIGNATURE is unchanged —
+    # only the version token moves) invalidates: the next lookup misses.
+    # (refresh would be a silent no-op here: unchanged source raises
+    # NoChangesException inside the action, appending no log entry.)
+    hits_before = metrics.counter("serve.plan_cache.hit")
+    hs.delete_index("sidx")
+    server.submit(_lookup(session, src, batch.columns["k"].data[7])).result(
+        timeout=120
+    )
+    assert metrics.counter("serve.plan_cache.hit") == hits_before
+    assert metrics.counter("serve.plan_cache.miss") >= 2
+    hs.restore_index("sidx")
+    server.close()
+
+
+def test_plan_signature_distinguishes_file_snapshots(env):
+    """Same paths + same file count but different file identity must not
+    collide (tree_string alone shows only counts)."""
+    session, hs, src, batch = env
+    from hyperspace_tpu.serve import plan_signature
+
+    df1 = _lookup(session, src, 5)
+    sig1 = plan_signature(df1.plan)
+    # overwrite the source file (same name, new content/mtime/size)
+    parquet_io.write_parquet(src / "part-0.parquet", _source(1000, seed=3))
+    df2 = _lookup(session, src, 5)
+    sig2 = plan_signature(df2.plan)
+    assert sig1 != sig2
+
+
+# ---------------------------------------------------------------------------
+# admission + lifecycle
+# ---------------------------------------------------------------------------
+def test_queue_full_rejects_with_depth_and_retry_after(env):
+    session, hs, src, batch = env
+    server = QueryServer(
+        session, ServeConfig(max_workers=1, max_queue=3, autostart=False)
+    )
+    qs = [_lookup(session, src, i) for i in range(5)]
+    for q in qs[:3]:
+        server.submit(q)
+    with pytest.raises(AdmissionRejected) as exc:
+        server.submit(qs[3])
+    assert exc.value.queue_depth == 3
+    assert exc.value.retry_after_s > 0
+    assert metrics.counter("serve.shed") >= 1
+    # queued work still completes once workers start
+    server.start()
+    server.close(timeout_s=120)
+
+
+def test_submit_after_close_raises_and_pending_fail_cleanly(env):
+    session, hs, src, batch = env
+    server = QueryServer(session, ServeConfig(max_workers=1, autostart=False))
+    t = server.submit(_lookup(session, src, 3))
+    server.close()
+    with pytest.raises(ServerClosed):
+        t.result(timeout=5)
+    with pytest.raises(ServerClosed):
+        server.submit(_lookup(session, src, 4))
+
+
+def test_cross_session_dataframe_refused(env, tmp_path):
+    session, hs, src, batch = env
+    other = HyperspaceSession(HyperspaceConf())
+    server = QueryServer(session, ServeConfig(autostart=False))
+    foreign = other.read.parquet(str(src))
+    with pytest.raises(HyperspaceException):
+        server.submit(foreign)
+
+
+def test_query_failures_land_on_the_ticket_not_the_server(env, monkeypatch):
+    session, hs, src, batch = env
+    server = QueryServer(session, ServeConfig(max_workers=1))
+    # execution failure: an unknown column passes planning (filter alone
+    # does not resolve names) and fails inside the executor — the error
+    # rides the ticket
+    bad = session.read.parquet(str(src)).filter(col("nope") == lit(1))
+    ticket = server.submit(bad)
+    with pytest.raises(KeyError):
+        ticket.result(timeout=30)
+    # planning failure, injected at optimize time: admission still
+    # succeeds, the error rides the ticket, serve.plan_error counts it
+    from hyperspace_tpu.dataframe import DataFrame
+
+    def boom(self, log_usage=True):
+        raise HyperspaceException("planner down")
+
+    monkeypatch.setattr(DataFrame, "optimized_plan", boom)
+    before = metrics.counter("serve.plan_error")
+    t2 = server.submit(_lookup(session, src, 1))
+    with pytest.raises(HyperspaceException):
+        t2.result(timeout=30)
+    assert metrics.counter("serve.plan_error") == before + 1
+    monkeypatch.undo()
+    # the server survives and serves the next query
+    good = server.submit(_lookup(session, src, batch.columns["k"].data[0]))
+    assert good.result(timeout=120).num_rows >= 1
+    server.close()
+
+
+def test_session_facade_verbs(env):
+    session, hs, src, batch = env
+    server = session.serve(max_workers=1)
+    assert session.serve() is server  # idempotent
+    assert hs.serve() is server
+    with pytest.raises(HyperspaceException):
+        session.serve(max_workers=3)  # options after creation refuse
+    t = session.submit(_lookup(session, src, batch.columns["k"].data[1]))
+    assert t.result(timeout=120).num_rows >= 1
+    # per-query scoped metrics ride the ticket
+    assert t.metrics is None or isinstance(t.metrics, dict)
+    server.close()
+    # a closed server is replaced on the next serve() call
+    assert session.serve() is not server
+    session.serve().close()
+
+
+# ---------------------------------------------------------------------------
+# per-query scoped metrics
+# ---------------------------------------------------------------------------
+def test_scoped_metrics_attribute_per_query(env):
+    session, hs, src, batch = env
+    q = _lookup(session, src, batch.columns["k"].data[2])
+    q.collect()
+    last = session.last_query_metrics
+    assert last is not None
+    assert last["counters"].get("scan.files_read", 0) >= 1
+    # explain(verbose) renders the scoped section
+    out = hs.explain(q, verbose=True)
+    assert "Last query metrics" in out
+
+    # two concurrent queries: each scope sees only its own files_read
+    results = {}
+
+    def run(tag, query):
+        with metrics.scoped() as qm:
+            query.collect()
+        results[tag] = qm.snapshot()["counters"].get("scan.files_read", 0)
+
+    t1 = threading.Thread(target=run, args=("a", q))
+    t2 = threading.Thread(
+        target=run, args=("b", _lookup(session, src, batch.columns["k"].data[3]))
+    )
+    t1.start(); t2.start(); t1.join(); t2.join()
+    # each scope counts its OWN scan (a point lookup prunes to one bucket
+    # file); a cross-thread bleed would double the counts
+    assert results["a"] >= 1 and results["b"] >= 1
+    assert results["a"] <= 2 and results["b"] <= 2
+
+
+# ---------------------------------------------------------------------------
+# concurrent-query stress: parity + no cache races
+# ---------------------------------------------------------------------------
+def test_concurrent_mixed_queries_parity_with_serial(env):
+    """N threads x mixed filter/join/aggregate through ONE session: every
+    result matches serial execution (races in the TCB reader LRU, the
+    join setup/bucket-groups caches, and the metadata memos would show up
+    as wrong rows or crashes here)."""
+    session, hs, src, batch = env
+    from hyperspace_tpu.plan.aggregates import agg_count, agg_sum
+
+    # a second table + index so joins exercise the bucketed SMJ caches
+    rng = np.random.default_rng(5)
+    dim = ColumnarBatch.from_pydict(
+        {
+            "dk": np.arange(0, 20_000).astype(np.int64),
+            "w": rng.integers(0, 9, 20_000).astype(np.int64),
+        }
+    )
+    dim_dir = src.parent / "dim"
+    dim_dir.mkdir()
+    parquet_io.write_parquet(dim_dir / "part-0.parquet", dim)
+    hs.create_index(
+        session.read.parquet(str(dim_dir)), IndexConfig("didx", ["dk"], ["w"])
+    )
+
+    def q_filter(i):
+        return _lookup(session, src, batch.columns["k"].data[i * 37 % N_ROWS])
+
+    def q_join(i):
+        return (
+            session.read.parquet(str(src))
+            .join(
+                session.read.parquet(str(dim_dir)),
+                col("k") == col("dk"),
+            )
+            .select("k", "v", "w")
+        )
+
+    def q_agg(i):
+        return (
+            session.read.parquet(str(src))
+            .filter(col("g") == lit(i % 40))
+            .group_by("g")
+            .agg(agg_sum("v", "sv"), agg_count())
+        )
+
+    makers = [q_filter, q_join, q_agg]
+    n_threads, per_thread = 8, 6
+    expected = {}
+    for t in range(n_threads):
+        for j in range(per_thread):
+            maker = makers[(t + j) % len(makers)]
+            key = (maker.__name__, (t * per_thread + j))
+            expected[key] = _canon(maker(t * per_thread + j).collect())
+
+    got = {}
+    errors = []
+    barrier = threading.Barrier(n_threads)
+
+    def worker(t):
+        try:
+            barrier.wait()
+            for j in range(per_thread):
+                maker = makers[(t + j) % len(makers)]
+                key = (maker.__name__, (t * per_thread + j))
+                got[key] = _canon(maker(t * per_thread + j).collect())
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert not errors, errors
+    assert got == expected
+
+
+def _canon(b):
+    cols = sorted(b.columns)
+    return sorted(zip(*(b.columns[c].data.tolist() for c in cols)))
+
+
+def test_concurrent_submissions_through_server_parity(env):
+    """The same mixed workload through server.submit from many producer
+    threads — admission, batching and the worker pool all engaged."""
+    session, hs, src, batch = env
+    from hyperspace_tpu.plan.aggregates import agg_sum
+
+    keys = [int(batch.columns["k"].data[i * 11 % N_ROWS]) for i in range(24)]
+    makers = [lambda k=k: _lookup(session, src, k) for k in keys]
+    makers.append(
+        lambda: session.read.parquet(str(src))
+        .filter(col("g") == lit(3))
+        .group_by("g")
+        .agg(agg_sum("v", "sv"))
+    )
+    expected = [_canon(m().collect()) for m in makers]
+    server = QueryServer(session, ServeConfig(max_workers=4, max_queue=256))
+    tickets = [None] * len(makers)
+
+    def producer(i):
+        tickets[i] = server.submit(makers[i]())
+
+    threads = [
+        threading.Thread(target=producer, args=(i,)) for i in range(len(makers))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    results = [_canon(t.result(timeout=300)) for t in tickets]
+    assert results == expected
+    stats = server.stats()
+    assert stats["completed"] == len(makers)
+    server.close()
