@@ -1170,6 +1170,178 @@ def main() -> None:
             else:
                 os.environ["HYPERSPACE_TPU_HBM"] = _prev_hbm10
 
+    # ---- config 11: delta-resident hybrid scan (host-union A/B) ------------
+    # The delta-residency claim (docs/11-delta-residency.md): a hybrid
+    # query whose source gained files (5% of rows appended) and lost one
+    # file executes as ONE fused base+delta device dispatch instead of
+    # paying the appended side's host parquet decode per query. A/B:
+    # host-union hybrid (residency off) vs delta-resident hybrid over the
+    # SAME indexed plan, parity-gated, with the per-query H2D counter
+    # asserted flat after population.
+    if (
+        os.environ.get("BENCH_HYBRID_RESIDENT", "1") != "0"
+        and "resident_device_s" in extras
+    ):
+        from hyperspace_tpu.exec.hbm_cache import hbm_cache as _hbm11
+        from hyperspace_tpu.plan.ir import Union as _UnionNode
+        from hyperspace_tpu.plan.rules.hybrid_scan import parse_hybrid_union
+
+        HR_ROWS = min(
+            int(os.environ.get("BENCH_HYBRID_RES_ROWS", 1 << 22)), RES_ROWS
+        )
+        hyb_batch = resident_tbl.take(np.arange(HR_ROWS))
+        N_HFILES = 8
+        _write_source(WORKDIR / "hybrid_res", hyb_batch, N_HFILES)
+        # lineage ON so the deleted file filters via the NOT-IN rewrite
+        # (and the delta's deletion bitmask on device)
+        session.conf.set(C.INDEX_LINEAGE_ENABLED, "true")
+        session.conf.set(C.INDEX_NUM_BUCKETS, "1")
+        session.conf.set(C.BUILD_CHUNK_ROWS, str(1 << 22))
+        t0 = time.perf_counter()
+        hs.create_index(
+            session.read.parquet(str(WORKDIR / "hybrid_res")),
+            IndexConfig("li_hyb_idx", ["r_k"], ["r_v"]),
+        )
+        extras["hybrid_resident_build_s"] = round(time.perf_counter() - t0, 3)
+        session.conf.set(C.INDEX_LINEAGE_ENABLED, "false")
+        session.conf.set(C.INDEX_NUM_BUCKETS, str(N_BUCKETS))
+        session.conf.set(C.BUILD_CHUNK_ROWS, str(max(N_ROWS // 8, 1 << 16)))
+        # bench shape: appends = 5% of rows, 1 deleted file
+        ap_n = HR_ROWS // 20
+        rngh = np.random.default_rng(13)
+        from hyperspace_tpu.storage.columnar import Column as _Col11
+
+        ap_batch = ColumnarBatch(
+            {
+                "r_k": _Col11.from_values(
+                    rngh.integers(0, 1 << 30, ap_n).astype(np.int64)
+                ),
+                "r_q": _Col11.from_values(
+                    rngh.integers(0, 100, ap_n).astype(np.int64)
+                ),
+                "r_m": _Col11.from_values(
+                    res_modes[rngh.integers(0, 7, ap_n)]
+                ),
+                "r_f": _Col11.from_values(
+                    np.round(rngh.uniform(0.0, 1000.0, ap_n), 6)
+                ),
+                "r_v": _Col11.from_values(
+                    rngh.integers(0, 1 << 30, ap_n).astype(np.int64)
+                ),
+            }
+        )
+        parquet_io.write_parquet(
+            WORKDIR / "hybrid_res" / "part-appended.parquet", ap_batch
+        )
+        (WORKDIR / "hybrid_res" / f"part-{N_HFILES - 1:03d}.parquet").unlink()
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, "true")
+        session.conf.set(C.INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD, "0.5")
+        hk_sorted = np.sort(hyb_batch.columns["r_k"].data)
+        h_lo = int(hk_sorted[HR_ROWS // 2])
+        h_hi = int(hk_sorted[HR_ROWS // 2 + 2000])
+        q11 = lambda: (  # noqa: E731
+            session.read.parquet(str(WORKDIR / "hybrid_res"))
+            .filter((col("r_k") >= lit(h_lo)) & (col("r_k") <= lit(h_hi)))
+            .select("r_k", "r_v")
+        )
+        session.disable_hyperspace()
+        h_off = q11().collect()
+        h_off_s = _time(
+            lambda: q11().collect(), REPEATS, extras, "hybrid_res_fullscan"
+        )
+        session.enable_hyperspace()
+        # the rewrite must actually be the hybrid union shape
+        if not q11().optimized_plan().collect(
+            lambda n: isinstance(n, _UnionNode)
+        ):
+            _fail("config11 hybrid rewrite did not produce a union")
+        _prev_hbm11 = os.environ.get("HYPERSPACE_TPU_HBM")
+        # HOST-UNION side: residency off — the per-query parquet decode
+        # of the appended side is exactly what this config meters
+        os.environ["HYPERSPACE_TPU_HBM"] = "off"
+        _hbm11.reset()
+        h_host = q11().collect()
+        h_host_s = _time(
+            lambda: q11().collect(), REPEATS, extras, "hybrid_res_host_union"
+        )
+        extras["hybrid_resident_rows"] = HR_ROWS
+        extras["hybrid_resident_appended_rows"] = ap_n
+        extras["hybrid_resident_fullscan_s"] = round(h_off_s, 4)
+        extras["hybrid_resident_host_union_s"] = round(h_host_s, 4)
+        # DELTA-RESIDENT side: prefetch base + delta (the once-per-epoch
+        # upload, timed), then the same query repeats fused
+        os.environ["HYPERSPACE_TPU_HBM"] = "auto"
+        t0 = time.perf_counter()
+        prefetched11 = hs.prefetch_index("li_hyb_idx", ["r_k"])
+        extras["hybrid_resident_prefetch_s"] = round(
+            time.perf_counter() - t0, 3
+        )
+        delta11 = None
+        if prefetched11:
+            info11 = parse_hybrid_union(
+                q11().optimized_plan().collect(
+                    lambda n: isinstance(n, _UnionNode)
+                )[0]
+            )
+            table11 = _hbm11.resident_for(
+                info11.entry.content.files(), ["r_k"]
+            )
+            if table11 is not None:
+                t0 = time.perf_counter()
+                delta11 = _hbm11.prefetch_delta(
+                    table11,
+                    info11.appended,
+                    info11.relation,
+                    list(info11.user_cols),
+                    info11.deleted_ids,
+                )
+                extras["hybrid_resident_delta_prefetch_s"] = round(
+                    time.perf_counter() - t0, 3
+                )
+        if delta11 is None:
+            extras["hybrid_resident_error"] = (
+                "base or delta prefetch refused (device/link down, or "
+                "budget override)"
+            )
+        else:
+            _indexed_run_begin()
+            h_res = q11().collect()
+            h_res_s = _time(
+                lambda: q11().collect(), REPEATS, extras, "hybrid_res_delta"
+            )
+            # per-query H2D stays at ZERO after population: the delta
+            # upload counter must not move inside the timed window
+            delta_h2d = metrics.counter("hbm.delta.h2d_bytes")
+            d2h_bytes = metrics.counter("scan.resident.d2h_bytes")
+            _indexed_run_end()
+            if engine_paths.get("scan.path.resident_hybrid", 0) <= 0:
+                _fail("config11 delta-resident hybrid path never fired")
+            if delta_h2d != 0:
+                _fail("config11 paid per-query delta H2D")
+            if (
+                h_res.num_rows != h_host.num_rows
+                or h_res.num_rows != h_off.num_rows
+            ):
+                _fail("config11 hybrid-resident row parity violated")
+            if int(h_res.columns["r_v"].data.sum()) != int(
+                h_host.columns["r_v"].data.sum()
+            ):
+                _fail("config11 hybrid-resident checksum parity violated")
+            speedups["hybrid_resident_range"] = h_off_s / h_res_s
+            extras["hybrid_resident_delta_s"] = round(h_res_s, 4)
+            extras["hybrid_resident_vs_host_union"] = round(
+                h_host_s / h_res_s, 3
+            )
+            extras["hybrid_resident_d2h_bytes_per_query"] = int(
+                d2h_bytes / max(REPEATS + 2, 1)
+            )
+            extras["hybrid_resident_hbm"] = _hbm11.snapshot()
+        if _prev_hbm11 is None:
+            os.environ.pop("HYPERSPACE_TPU_HBM", None)
+        else:
+            os.environ["HYPERSPACE_TPU_HBM"] = _prev_hbm11
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, "false")
+
     # ---- mesh-path A/B (round-4 verdict next-round #1 "done" criterion) ----
     # run on the virtual 8-device CPU mesh in a subprocess (the bench host
     # has ONE physical chip; per-query link-bytes under each architecture
@@ -1297,6 +1469,9 @@ def main() -> None:
         compact["serve_speedup_vs_serial"] = extras["serve"][
             "speedup_vs_serial"
         ]
+    for k in ("hybrid_resident_delta_s", "hybrid_resident_vs_host_union"):
+        if k in extras:
+            compact[k] = extras[k]
     compact["detail"] = detail_path.name
     line = json.dumps(compact)
     while len(line) > 1900:

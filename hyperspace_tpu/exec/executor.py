@@ -238,6 +238,15 @@ class Executor:
             metrics.record_time(f"union.side.{side}", _time.perf_counter() - t0)
             return out
 
+        # delta residency: a hybrid union whose base AND appended delta
+        # are device-resident collapses into ONE fused mask+count
+        # dispatch (exec.hbm_cache/mesh_cache) — the appended side's
+        # per-query parquet decode and the second pipeline both vanish
+        if predicate is not None:
+            fused = self._try_resident_hybrid(plan, predicate)
+            if fused is not None:
+                return fused
+
         children = list(plan.children)
         if len(children) < 2:
             parts = [run_child(c) for c in children]
@@ -256,6 +265,126 @@ class Executor:
                     )
                 )
         return ColumnarBatch.concat(parts)
+
+    def _try_resident_hybrid(
+        self, plan: Union, predicate: Expr
+    ) -> Optional[ColumnarBatch]:
+        """The delta-resident hybrid fast path: when ``plan`` is a hybrid
+        union whose base table AND appended delta are device-resident,
+        issue ONE fused mask+count dispatch over base+delta (deletion
+        bitmask applied on-device), then run the exact host legs — base
+        blocks from mmap with the lineage NOT-IN re-applied, delta blocks
+        from the host-held decoded appended batch. None routes the normal
+        per-side union (which schedules background delta population, so
+        the NEXT query lands here). Row-identical to the host union by
+        the same argument as the plain resident scan: the host re-
+        evaluates every candidate block exactly."""
+        from ..plan.rules.hybrid_scan import parse_hybrid_union
+        from ..telemetry.metrics import metrics
+        from .delta import resolve_hybrid_residency
+        from .scan import empty_batch_for
+
+        info = parse_hybrid_union(plan)
+        if info is None:
+            return None
+        entry = info.entry
+        out_cols = list(info.user_cols)
+        # eligibility (mode, coverage, pruning, table+delta lookups, the
+        # delta-aware zone gate, exact host predicate) is the ONE shared
+        # procedure with the serve micro-batcher — exec.delta
+        res = resolve_hybrid_residency(info, predicate, mesh=self.mesh)
+        if res.status == "gated":
+            # a distinct counter: the fallback union's index side runs
+            # its own zone gate and counts scan.gate.resident_selectivity
+            # there — sharing the name would double-count one query
+            metrics.incr("scan.gate.resident_hybrid_selectivity")
+            return None
+        if res.status == "no_delta":
+            if self.mesh is not None:
+                from .mesh_cache import mesh_cache
+
+                if mesh_cache.auto_enabled():
+                    mesh_cache.note_touch_delta(
+                        res.table,
+                        info.appended,
+                        info.relation,
+                        list(info.user_cols),
+                        info.deleted_ids,
+                        list(entry.indexed_columns),
+                        entry.num_buckets,
+                    )
+            else:
+                from .hbm_cache import hbm_cache
+
+                if hbm_cache.auto_enabled():
+                    hbm_cache.note_touch_delta(
+                        res.table,
+                        info.appended,
+                        info.relation,
+                        list(info.user_cols),
+                        info.deleted_ids,
+                    )
+            return None
+        if res.status != "ok":
+            return None  # the union's index side schedules note_touch
+        table, delta, files = res.table, res.delta, res.files
+        host_pred = res.host_predicate
+        if self.mesh is not None:
+            from .mesh_cache import mesh_cache
+
+            try:
+                counts = mesh_cache.hybrid_block_counts(
+                    table, delta, predicate
+                )
+            except Exception:  # noqa: BLE001 - device loss degrades
+                mesh_cache.drop(table)
+                metrics.incr("scan.resident_mesh.device_failed")
+                return None
+            if counts is None:
+                return None
+            base_counts, delta_counts = counts
+            parts = mesh_cache.collect_parts(
+                table, files, out_cols, host_pred, base_counts,
+                path_metric=None,
+            )
+            parts += mesh_cache.delta_parts(
+                delta, predicate, out_cols, delta_counts
+            )
+            metrics.incr("scan.path.resident_hybrid")
+            metrics.incr("scan.path.resident_hybrid_mesh")
+        else:
+            from .hbm_cache import hbm_cache
+            from .scan import _resident_parts
+
+            try:
+                counts = hbm_cache.hybrid_block_counts(
+                    table, delta, predicate
+                )
+            except Exception:  # noqa: BLE001 - device loss degrades
+                hbm_cache.drop(table)
+                metrics.incr("scan.resident.device_failed")
+                return None
+            if counts is None:
+                return None
+            base_counts, delta_counts = counts
+            parts = _resident_parts(
+                table, files, out_cols, host_pred, base_counts,
+                path_metric=None,
+            )
+            parts += hbm_cache.delta_parts(
+                delta, predicate, out_cols, delta_counts
+            )
+            metrics.incr("scan.path.resident_hybrid")
+        from .scan_gate import scan_gate
+
+        scan_gate.note_resident_bypass("hybrid")
+        if parts:
+            return ColumnarBatch.concat(parts)
+        empty = empty_batch_for(out_cols, entry.schema)
+        if empty is not None:
+            return empty
+        eb = layout.read_batch(files[0], columns=out_cols)
+        return eb.take(np.array([], dtype=np.int64))
 
     @staticmethod
     def _conjoin(a: Optional[Expr], b: Expr) -> Expr:
@@ -709,6 +838,15 @@ class Executor:
                 if (filtered := self._apply_predicate(v, predicate)).num_rows
             }
             tok = getattr(groups, "cache_token", None)
+            if tok is None:
+                # the pristine groups were never cached (cap 0, unstat-able
+                # files), so this FILTERED side can't derive a token and
+                # silently opts out of the cross-query join caches — count
+                # it so cache misses under filtered joins are diagnosable
+                # in explain(verbose)'s engine metrics
+                from ..telemetry.metrics import metrics
+
+                metrics.incr("join.cache.optout.filtered")
             if tok is not None:
                 # a DERIVED token: the filtered side is a pure function of
                 # (immutable files, projection, predicate) — repr of the
@@ -778,6 +916,14 @@ class Executor:
                         merged[b] = v
             if idx is None:
                 return None
+            # the merge folds DYNAMIC appended data into the groups, so
+            # the result is a plain dict that opts out of every
+            # cross-query join cache (BucketGroups docstring) — count the
+            # opt-out so repeated hybrid joins under appends show up as
+            # diagnosable cache misses, not silent slowness
+            from ..telemetry.metrics import metrics
+
+            metrics.incr("join.cache.optout.hybrid")
             return merged, idx
 
         return None
@@ -922,8 +1068,10 @@ class BucketGroups(dict):
     column list; predicate filtering extends it with the expression repr
     (deterministic, value-based — round 5). Any transform whose output
     is NOT derivable from the token alone (e.g. hybrid-scan merges with
-    dynamic appended data) must build a plain dict, which silently opts
-    out of every cross-query cache."""
+    dynamic appended data) must build a plain dict, which opts out of
+    every cross-query cache — observable via the
+    ``join.cache.optout.{hybrid,filtered}`` counters (surfaced in
+    explain(verbose)'s engine metrics), not silent."""
 
     cache_token: tuple = None
 

@@ -165,6 +165,45 @@ class ResidentTable:
         return None
 
 
+@dataclass
+class DeltaRegion:
+    """Appended-source residency for one (index version, source-snapshot
+    epoch): the appended files' predicate columns as device int32 tiles
+    (encoded against the BASE table's contracts — delta.py), their rows'
+    user columns host-side (the parquet decode paid ONCE at population,
+    so the per-query host leg reads memory, not parquet), the string OOV
+    side tables, a device deletion bitmask over the BASE rows (derived
+    from the lineage column), and per-block zone vectors for the
+    delta-aware selectivity gate."""
+
+    key: tuple  # ((name, size, mtime), ...) appended snapshot, sorted
+    base_key: tuple  # the ResidentTable.key this delta extends
+    deleted_ids: tuple  # sorted lineage ids of deleted logged files
+    n_rows: int
+    n_pad: int
+    columns: Dict[str, ResidentColumn]
+    oov: Dict[str, np.ndarray]  # per string column: sorted OOV values
+    host_batch: object  # ColumnarBatch of the appended rows (user cols)
+    del_mask: Optional[object]  # device int32 over base n_pad; 1=deleted
+    zones: Dict[str, Tuple[str, np.ndarray, np.ndarray]] = field(
+        default_factory=dict
+    )
+    nbytes: int = 0
+    last_used: float = field(default_factory=time.monotonic)
+
+
+def delta_snapshot_key(appended) -> tuple:
+    """The source-snapshot-epoch half of a delta key, from the appended
+    FileInfos the hybrid rewrite exposed (plan.rules.hybrid_scan): the
+    PLAN's snapshot defines the epoch — a file appended or replaced since
+    produces a different key and the stale delta never serves."""
+    return tuple(
+        sorted(
+            (f.name, int(f.size), int(f.modified_time)) for f in appended
+        )
+    )
+
+
 def _file_identity(path: str | Path) -> tuple:
     # os.stat on the string: this runs per file per query from note_touch
     # and resident_for — pathlib construction there measured ~30% of a
@@ -540,6 +579,135 @@ def _batched_counts_fn(structures: tuple, slot_names: tuple, exprs: list,
     return fn
 
 
+# ---------------------------------------------------------------------------
+# fused hybrid (base + delta) counts: ONE dispatch covers both sides
+# ---------------------------------------------------------------------------
+# The hybrid fast path's whole point is that base and delta ride the SAME
+# executable: the predicate mask evaluates over the base tiles (AND NOT
+# the deletion bitmask) and over the delta tiles, both reduce to
+# per-8192-row block counts, and ONE concatenated count vector comes home
+# — the appended side stops costing a second dispatch, let alone a
+# per-query parquet decode.
+
+_hybrid_fns = BoundedFnCache()
+
+
+def _hybrid_counts_fn(
+    narrowed: Expr,
+    names: tuple,
+    base_rows128: int,
+    delta_rows128: int,
+    has_mask: bool,
+):
+    """Jitted (base cols, delta cols[, del_mask]) -> int32 concat of
+    per-block match counts (base blocks then delta blocks), one
+    executable, one D2H."""
+    key = ("hy1", repr(narrowed), names, base_rows128, delta_rows128, has_mask)
+    fn = _hybrid_fns.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+
+    shim = ColumnarBatch(
+        {name: Column("int32", np.empty(0, dtype=np.int32)) for name in names}
+    )
+
+    def _side_counts(cols):
+        arrays = {n: c.reshape(-1) for n, c in zip(names, cols)}
+        return eval_mask(narrowed, shim, arrays)
+
+    if has_mask:
+
+        def counts(base_cols, delta_cols, del_mask):
+            mb = _side_counts(base_cols) & (del_mask.reshape(-1) == 0)
+            cb = jnp.sum(
+                mb.reshape(-1, BLOCK_ROWS).astype(jnp.int32), axis=1
+            )
+            md = _side_counts(delta_cols)
+            cd = jnp.sum(
+                md.reshape(-1, BLOCK_ROWS).astype(jnp.int32), axis=1
+            )
+            return jnp.concatenate([cb, cd])
+
+    else:
+
+        def counts(base_cols, delta_cols):
+            mb = _side_counts(base_cols)
+            cb = jnp.sum(
+                mb.reshape(-1, BLOCK_ROWS).astype(jnp.int32), axis=1
+            )
+            md = _side_counts(delta_cols)
+            cd = jnp.sum(
+                md.reshape(-1, BLOCK_ROWS).astype(jnp.int32), axis=1
+            )
+            return jnp.concatenate([cb, cd])
+
+    fn = jax.jit(counts)
+    _hybrid_fns.put(key, fn)
+    return fn
+
+
+def _hybrid_batched_counts_fn(
+    structures: tuple,
+    slot_names: tuple,
+    exprs: list,
+    base_rows128: int,
+    delta_rows128: int,
+    has_mask: bool,
+):
+    """Jitted (base col dict, delta col dict, per-slot literal vectors
+    [, del_mask]) -> (N, base_blocks + delta_blocks) int32 — the serving
+    micro-batcher's hybrid leg. Keyed on predicate STRUCTURE; literal
+    values (including OOV string codes) ride as traced operands so a
+    serving burst reuses the compiled program (_batched_counts_fn
+    rationale)."""
+    key = (
+        "hyN",
+        structures,
+        slot_names,
+        base_rows128,
+        delta_rows128,
+        has_mask,
+    )
+    fn = _hybrid_fns.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+
+    exprs = list(exprs)
+    names_per_slot = list(slot_names)
+
+    def batched(base_arrays: dict, delta_arrays: dict, lit_vecs: tuple,
+                del_mask=None):
+        outs = []
+        live = (
+            del_mask.reshape(-1) == 0 if del_mask is not None else None
+        )
+        for expr, names, lits in zip(exprs, names_per_slot, lit_vecs):
+            fb = {n: base_arrays[n].reshape(-1) for n in names}
+            mb = _eval_with_literals(expr, fb, lits, [0])
+            if live is not None:
+                mb = mb & live
+            cb = jnp.sum(
+                mb.reshape(-1, BLOCK_ROWS).astype(jnp.int32), axis=1
+            )
+            fd = {n: delta_arrays[n].reshape(-1) for n in names}
+            md = _eval_with_literals(expr, fd, lits, [0])
+            cd = jnp.sum(
+                md.reshape(-1, BLOCK_ROWS).astype(jnp.int32), axis=1
+            )
+            outs.append(jnp.concatenate([cb, cd]))
+        return jnp.stack(outs)
+
+    fn = jax.jit(batched)
+    _hybrid_fns.put(key, fn)
+    return fn
+
+
 class ResidentCacheBase:
     """Shared plumbing of the single-chip and mesh resident caches: table
     registry + LRU-against-budget, pending/failed population memos, and
@@ -550,6 +718,10 @@ class ResidentCacheBase:
 
     def __init__(self) -> None:
         self._tables: list = []
+        # delta regions: appended-source residency keyed by (base table
+        # key, appended-file snapshot, deleted lineage ids) — the hybrid
+        # scan's device fast path between refreshes
+        self._deltas: list = []
         self._pending: set = set()
         # (file-set key, frozenset(columns)) that can never materialize
         # (unencodable columns, too small, over budget): without this
@@ -570,29 +742,154 @@ class ResidentCacheBase:
         residency can never trigger."""
         return _auto_enabled()
 
+    def empty(self) -> bool:
+        """True when nothing is resident — the cheap pre-check callers
+        use to skip file pruning/stat work that could only ever reach a
+        guaranteed lookup miss."""
+        with self._lock:
+            return not self._tables
+
     def drop(self, table) -> None:
         """Unregister a table (device loss mid-query): later queries
-        route through the gate instead of retrying a dead device."""
+        route through the gate instead of retrying a dead device. Delta
+        regions built over the dropped base go with it — they hold
+        device arrays on the same (possibly dead) device and are useless
+        without their base."""
         with self._lock:
             self._tables = [t for t in self._tables if t is not table]
+            key = getattr(table, "key", None)
+            self._deltas = [d for d in self._deltas if d.base_key != key]
+
+    def invalidate_deltas(self, index_root: Optional[str] = None) -> None:
+        """Drop delta regions — the refresh/optimize hook: a new index
+        version changes the base file identities, so stale deltas could
+        never be served again and would only pin HBM. ``index_root``
+        scopes the drop to deltas whose BASE files live under that
+        index's directory (refreshing index A must not evict index B's
+        still-valid deltas); None drops everything (tests, operators).
+        Quick refresh deliberately does NOT call this: it changes no
+        index data files, so the (base key, appended snapshot) keys stay
+        valid and the already-uploaded delta keeps serving — the
+        promotion path (zero re-upload across a quick refresh)."""
+        prefix = None
+        if index_root is not None:
+            prefix = str(index_root).rstrip("/") + "/"
+        with self._lock:
+            if prefix is None:
+                n = len(self._deltas)
+                self._deltas.clear()
+            else:
+                keep = [
+                    d
+                    for d in self._deltas
+                    if not any(
+                        str(path).startswith(prefix)
+                        for path, _sz, _mt in d.base_key
+                    )
+                ]
+                n = len(self._deltas) - len(keep)
+                self._deltas[:] = keep
+        if n:
+            metrics.incr(f"{self._metric_prefix}.delta.invalidated", n)
+
+    def _register_delta(self, delta, epoch: Optional[int] = None) -> None:
+        with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                return  # cache was reset() since this build was scheduled
+            if not any(t.key == delta.base_key for t in self._tables):
+                # the base was evicted/dropped while this build ran: a
+                # delta is only ever reachable THROUGH a resident base
+                # (delta_for takes the table), so registering it would
+                # pin unservable device+host memory until LRU pressure
+                metrics.incr(f"{self._metric_prefix}.delta.base_gone")
+                return
+            # ONE delta per base: registering a new source-snapshot epoch
+            # supersedes every older region of the same base — under the
+            # continuous-append workload each append would otherwise
+            # strand the previous epoch's device tiles + decoded host
+            # batch until budget pressure found them. (A stale plan
+            # re-submitted over the old snapshot falls back to the host
+            # union — correct, just unaccelerated.)
+            for d in self._deltas:
+                if d.base_key == delta.base_key and (
+                    d.key != delta.key
+                    or d.deleted_ids != delta.deleted_ids
+                ):
+                    metrics.incr(f"{self._metric_prefix}.delta.superseded")
+            self._deltas = [
+                d for d in self._deltas if d.base_key != delta.base_key
+            ]
+            self._deltas.append(delta)
+            budget = _budget_bytes()
+            total = sum(t.nbytes for t in self._tables) + sum(
+                d.nbytes for d in self._deltas
+            )
+            # evict OTHER deltas first (cheapest to rebuild; a delta is
+            # useless without its base, never the other way around) —
+            # and never evict a TABLE for a delta: if the tables alone
+            # exceed the budget, the delta is refused outright so the
+            # combined footprint stays bounded
+            while total > budget and len(self._deltas) > 1:
+                victim = min(
+                    (d for d in self._deltas if d is not delta),
+                    key=lambda d: d.last_used,
+                )
+                self._deltas.remove(victim)
+                total -= victim.nbytes
+                metrics.incr(f"{self._metric_prefix}.delta.evicted")
+            if total > budget:
+                self._deltas.remove(delta)
+                metrics.incr(
+                    f"{self._metric_prefix}.delta.over_budget_refused"
+                )
+                return
+            metrics.incr(f"{self._metric_prefix}.delta.registered")
+
+    def wait_background(self, timeout_s: float = 30.0) -> None:
+        """Join in-flight background populations (tables AND deltas) —
+        benches, the multichip dryrun and tests need deterministic
+        residency after scheduling a first touch."""
+        with self._lock:
+            threads = [
+                t
+                for t in getattr(self, "_bg_threads", ())
+                if t.is_alive()
+            ]
+        for t in threads:
+            t.join(timeout_s)
 
     def _register(self, table, epoch: Optional[int] = None) -> None:
         with self._lock:
             if epoch is not None and epoch != self._epoch:
                 return  # cache was reset() since this build was scheduled
             # replace any table over the same file set (e.g. widened
-            # column set); then evict LRU until the budget fits
+            # column set); then evict LRU until the budget fits. The
+            # budget bounds tables AND deltas together (one knob, whole
+            # cache); an evicted base takes its dependent deltas with it
+            # — they hold device arrays no query could ever be served
+            # from without their base.
             self._tables = [t for t in self._tables if t.key != table.key]
             self._tables.append(table)
-            total = sum(t.nbytes for t in self._tables)
             budget = _budget_bytes()
-            while total > budget and len(self._tables) > 1:
+
+            def total() -> int:
+                return sum(t.nbytes for t in self._tables) + sum(
+                    d.nbytes for d in self._deltas
+                )
+
+            # deltas drain FIRST (cheapest to rebuild — the same priority
+            # _register_delta states); only then are LRU base tables
+            # sacrificed, each taking its dependent deltas with it
+            while total() > budget and self._deltas:
+                dvictim = min(self._deltas, key=lambda d: d.last_used)
+                self._deltas.remove(dvictim)
+                metrics.incr(f"{self._metric_prefix}.delta.evicted")
+            while total() > budget and len(self._tables) > 1:
                 victim = min(
                     (t for t in self._tables if t is not table),
                     key=lambda t: t.last_used,
                 )
                 self._tables.remove(victim)
-                total -= victim.nbytes
                 metrics.incr(f"{self._metric_prefix}.evicted")
             metrics.incr(f"{self._metric_prefix}.tables_registered")
 
@@ -620,6 +917,7 @@ class ResidentCacheBase:
     def reset(self) -> None:
         with self._lock:
             self._tables.clear()
+            self._deltas.clear()
             self._pending.clear()
             self._failed.clear()
             self._epoch += 1
@@ -1092,13 +1390,467 @@ class HbmIndexCache(ResidentCacheBase):
         n_blocks = -(-table.n_rows // BLOCK_ROWS)
         return counts[:, :n_blocks]
 
+    # -- delta residency (hybrid scan's appended side) -----------------------
+    def delta_for(
+        self, table: ResidentTable, appended, columns, deleted_ids
+    ) -> Optional[DeltaRegion]:
+        """The registered delta region extending ``table`` for exactly
+        this (appended snapshot, deleted ids) epoch with every requested
+        column resident, else None. Mode "off" disables serving here too
+        (resident_for rationale)."""
+        if residency_mode() == "off":
+            return None
+        dkey = delta_snapshot_key(appended)
+        dels = tuple(sorted(int(i) for i in deleted_ids))
+        with self._lock:
+            for d in reversed(self._deltas):
+                if (
+                    d.base_key == table.key
+                    and d.key == dkey
+                    and d.deleted_ids == dels
+                    and set(columns) <= set(d.columns)
+                ):
+                    d.last_used = time.monotonic()
+                    return d
+        return None
+
+    def prefetch_delta(
+        self,
+        table: ResidentTable,
+        appended,
+        relation,
+        host_columns,
+        deleted_ids,
+    ) -> Optional[DeltaRegion]:
+        """Synchronously build and register a delta region (benches,
+        tests, latency-critical sessions). Idempotent — but a delta built
+        against a NARROWER base (before a prefetch widened it) does not
+        satisfy the check and is rebuilt with the wider column set."""
+        want = [c for c in host_columns if c in table.columns]
+        existing = self.delta_for(table, appended, want, deleted_ids)
+        if existing is not None:
+            return existing
+        delta, _ = self._build_delta(
+            table, appended, relation, host_columns, deleted_ids
+        )
+        if delta is None:
+            return None
+        self._register_delta(delta)
+        return delta
+
+    def note_touch_delta(
+        self,
+        table: ResidentTable,
+        appended,
+        relation,
+        host_columns,
+        deleted_ids,
+    ) -> None:
+        """First-touch delta population: background upload of the
+        appended files' predicate columns (+ deletion bitmask) so REPEAT
+        hybrid queries take the fused device path. Never blocks, never
+        throws (note_touch contract). No row-count floor: the delta is
+        small by construction and its base being resident already proves
+        the table is worth serving from the device."""
+        if not _auto_enabled() or not appended:
+            return
+        dkey = delta_snapshot_key(appended)
+        dels = tuple(sorted(int(i) for i in deleted_ids))
+        want = {c for c in host_columns if c in table.columns}
+        memo = ("delta", table.key, dkey, dels)
+        with self._lock:
+            if memo in self._pending or memo in self._failed:
+                return
+            # coverage, not mere existence: a delta built against a
+            # narrower base (before a later prefetch widened it) must be
+            # rebuilt, or hybrid queries over the new columns route host
+            # forever while this memo reports "already resident"
+            if any(
+                d.base_key == table.key
+                and d.key == dkey
+                and d.deleted_ids == dels
+                and want <= set(d.columns)
+                for d in self._deltas
+            ):
+                return
+            self._pending.add(memo)
+            epoch = self._epoch
+
+        def bg():
+            failed = False
+            try:
+                delta, permanent = self._build_delta(
+                    table, appended, relation, host_columns, deleted_ids
+                )
+                if delta is not None:
+                    self._register_delta(delta, epoch=epoch)
+                    if not want <= set(delta.columns):
+                        # the build already encoded every base-covered
+                        # column it COULD — a delta still missing part of
+                        # ``want`` (e.g. appended values outside the base
+                        # encoding's range) can never cover it for this
+                        # epoch, so memoize: without this, every query
+                        # over the missing column reschedules an
+                        # identical decode+upload rebuild forever
+                        failed = True
+                elif permanent:
+                    failed = True
+            except Exception:  # noqa: BLE001 - population must never fail a scan
+                metrics.incr(f"{self._metric_prefix}.delta.populate_failed")
+            finally:
+                with self._lock:
+                    self._pending.discard(memo)
+                    if failed:
+                        if len(self._failed) >= _MAX_FAILED_MEMO:
+                            self._failed.clear()
+                        self._failed.add(memo)
+
+        t = threading.Thread(
+            target=bg, daemon=True, name="hbm-delta-populate"
+        )
+        self._track_for_exit(t)
+        t.start()
+
+    def _build_delta(
+        self,
+        table: ResidentTable,
+        appended,
+        relation,
+        host_columns,
+        deleted_ids,
+    ) -> Tuple[Optional[DeltaRegion], bool]:
+        """(delta, permanent_refusal) — _build semantics for the appended
+        side: ONE parquet decode of the appended files (the cost the
+        host union pays per query), device upload of the base-covered
+        predicate columns under the base encodings (exec.delta), and the
+        deletion bitmask derived from the base files' lineage column."""
+        from ..storage import parquet_io
+        from ..utils.deviceprobe import first_device_touch_ok
+        from .bytecache import batch_nbytes
+        from .delta import encode_delta_columns
+
+        if not first_device_touch_ok():
+            metrics.incr(f"{self._metric_prefix}.device_unreachable")
+            return None, False
+
+        t0 = time.perf_counter()
+        dels = tuple(sorted(int(i) for i in deleted_ids))
+        # doomed-build pre-check BEFORE the decode: the appended files'
+        # on-disk sizes lower-bound the decoded host batch, so with no
+        # headroom left this build could only be refused AFTER paying
+        # the full read+encode — on every query's background touch
+        with self._lock:
+            headroom0 = _budget_bytes() - sum(
+                t.nbytes for t in self._tables
+            )
+        if sum(int(f.size) for f in appended) > headroom0:
+            metrics.incr(f"{self._metric_prefix}.delta.over_budget_refused")
+            return None, False
+        try:
+            host_batch = parquet_io.read_relation(
+                relation,
+                paths=[f.name for f in appended],
+                columns=list(host_columns),
+            )
+        except Exception:  # noqa: BLE001 - vanished file = no residency
+            metrics.incr(f"{self._metric_prefix}.delta.read_error")
+            return None, False
+        n_rows = host_batch.num_rows
+        if n_rows == 0:
+            return None, True
+        n_pad = -(-n_rows // _TILE_ELEMS) * _TILE_ELEMS
+
+        # deletion bitmask source check BEFORE any upload: deletes
+        # without a readable lineage column can never serve exactly
+        if dels:
+            from .. import constants as C
+            from ..storage import layout
+
+            col_name = C.DATA_FILE_NAME_ID
+
+            for path, _start, _n in table.files:
+                footer_cols = {
+                    m["name"]
+                    for m in layout.cached_reader(path).footer["columns"]
+                }
+                if col_name not in footer_cols:
+                    metrics.incr(
+                        f"{self._metric_prefix}.delta.no_lineage_refused"
+                    )
+                    return None, True
+
+        # encode every base-covered column against the base contracts —
+        # the shared per-column loop (exec.delta, one copy for both
+        # caches)
+        flats, encs, oov, planes, zones = encode_delta_columns(
+            host_batch, table.columns, with_zones=True
+        )
+        if not flats:
+            return None, True
+        host_bytes = batch_nbytes(host_batch)
+        oov_bytes = sum(
+            sum(len(v) + 50 for v in side) for side in oov.values()
+        )
+        mask_bytes = table.n_pad * 4 if dels else 0
+        dev_bytes = planes * n_pad * 4 + mask_bytes
+        # headroom, not the whole budget: tables and deltas share the one
+        # byte bound, and registration never evicts a TABLE for a delta —
+        # so a delta that only fits by exceeding the tables' remainder
+        # would be refused there anyway, after paying the upload
+        with self._lock:
+            headroom = _budget_bytes() - sum(
+                t.nbytes for t in self._tables
+            )
+        if dev_bytes + host_bytes + oov_bytes > headroom:
+            metrics.incr(f"{self._metric_prefix}.delta.over_budget_refused")
+            return None, False
+
+        import jax
+
+        try:
+            cols: Dict[str, ResidentColumn] = {}
+            for name, flat in flats.items():
+                dtype_str, enc = encs[name]
+                if enc == "f64":
+                    hi, lo = flat
+                    fh = np.zeros(n_pad, dtype=np.int32)
+                    fl = np.zeros(n_pad, dtype=np.int32)
+                    fh[:n_rows] = hi
+                    fl[:n_rows] = lo
+                    dev_hi = jax.device_put(
+                        fh.reshape(n_pad // _LANES, _LANES)
+                    )
+                    dev_lo = jax.device_put(
+                        fl.reshape(n_pad // _LANES, _LANES)
+                    )
+                    cols[name] = ResidentColumn(
+                        dev_hi, dtype_str, "f64", fh.nbytes + fl.nbytes,
+                        None, dev_lo,
+                    )
+                else:
+                    f = np.zeros(n_pad, dtype=np.int32)
+                    f[:n_rows] = flat
+                    dev = jax.device_put(f.reshape(n_pad // _LANES, _LANES))
+                    cols[name] = ResidentColumn(
+                        dev,
+                        dtype_str,
+                        enc,
+                        f.nbytes,
+                        table.columns[name].vocab if enc == "string" else None,
+                    )
+            del_mask = None
+            if dels:
+                del_mask = jax.device_put(
+                    self._lineage_mask(table, dels).reshape(
+                        table.n_pad // _LANES, _LANES
+                    )
+                )
+            from ..ops import fence_chain
+
+            fence_chain(
+                [c.data for c in cols.values()]
+                + [c.data2 for c in cols.values() if c.data2 is not None]
+                + ([del_mask] if del_mask is not None else [])
+            )
+        except Exception:  # noqa: BLE001 - device loss: no residency
+            metrics.incr(f"{self._metric_prefix}.delta.transfer_error")
+            return None, False
+        nbytes = dev_bytes + host_bytes + oov_bytes
+        metrics.incr(f"{self._metric_prefix}.delta.h2d_bytes", dev_bytes)
+        metrics.record_time(
+            f"{self._metric_prefix}.delta.prefetch", time.perf_counter() - t0
+        )
+        return (
+            DeltaRegion(
+                delta_snapshot_key(appended),
+                table.key,
+                dels,
+                n_rows,
+                n_pad,
+                cols,
+                oov,
+                host_batch,
+                del_mask,
+                zones,
+                nbytes,
+            ),
+            False,
+        )
+
+    @staticmethod
+    def _lineage_mask(table: ResidentTable, dels: tuple) -> np.ndarray:
+        """int32 0/1 vector over the base table's padded rows: 1 where
+        the row's lineage id is in the deleted set (pad rows stay 0 and
+        are clipped by the host leg like every tail block)."""
+        from .. import constants as C
+        from ..storage import layout
+
+        flat = np.zeros(table.n_pad, dtype=np.int32)
+        dels_arr = np.asarray(dels, dtype=np.int64)
+        for path, start, n in table.files:
+            vals = (
+                layout.cached_reader(path)
+                .read([C.DATA_FILE_NAME_ID])
+                .columns[C.DATA_FILE_NAME_ID]
+                .data
+            )
+            flat[start : start + n] = np.isin(
+                np.asarray(vals, dtype=np.int64), dels_arr
+            )
+        return flat
+
+    # -- the fused hybrid query ----------------------------------------------
+    def hybrid_block_counts(
+        self, table: ResidentTable, delta: DeltaRegion, predicate: Expr
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """(base per-block counts, delta per-block counts) for one
+        predicate over base+delta in ONE device dispatch — the deletion
+        bitmask pruning deleted base rows on-device, only the stacked
+        count vector returning. None when the predicate cannot ride the
+        shared encodings (caller routes the host union)."""
+        from ..ops import kernels as K
+        from .delta import prepare_hybrid_predicate
+
+        prepared = prepare_hybrid_predicate(
+            table.columns, delta.oov, predicate
+        )
+        if prepared is None:
+            return None
+        narrowed, names = prepared
+        if any(n.split("\x00", 1)[0] not in delta.columns for n in names):
+            return None
+        fn = _hybrid_counts_fn(
+            narrowed,
+            names,
+            table.n_pad // _LANES,
+            delta.n_pad // _LANES,
+            delta.del_mask is not None,
+        )
+        bcols = resident_arrays_for(table.columns, names)
+        dcols = resident_arrays_for(delta.columns, names)
+        t0 = time.perf_counter()
+        with K._x32():
+            if delta.del_mask is not None:
+                counts = np.asarray(fn(bcols, dcols, delta.del_mask))
+            else:
+                counts = np.asarray(fn(bcols, dcols))
+        metrics.record_time(
+            "scan.resident_hybrid.device", time.perf_counter() - t0
+        )
+        metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
+        nb_pad = table.n_pad // BLOCK_ROWS
+        nb = -(-table.n_rows // BLOCK_ROWS)
+        nd = -(-delta.n_rows // BLOCK_ROWS)
+        return counts[:nb], counts[nb_pad : nb_pad + nd]
+
+    def hybrid_block_counts_batch(
+        self,
+        table: ResidentTable,
+        delta: DeltaRegion,
+        predicates: List[Expr],
+        prepared: Optional[list] = None,
+    ) -> Optional[list]:
+        """Per-predicate (base counts, delta counts) pairs for N
+        compatible hybrid queries in ONE device dispatch — the serving
+        micro-batcher's hybrid leg. None when any predicate fails to
+        narrow (caller serves the batch per-query)."""
+        from ..ops import kernels as K
+        from .delta import prepare_hybrid_predicate
+
+        if prepared is None:
+            prepared = [
+                prepare_hybrid_predicate(table.columns, delta.oov, p)
+                for p in predicates
+            ]
+        if any(p is None for p in prepared):
+            return None
+        if any(
+            n.split("\x00", 1)[0] not in delta.columns
+            for _, names in prepared
+            for n in names
+        ):
+            return None
+        structures = tuple(_expr_structure(n) for n, _ in prepared)
+        slot_names = tuple(names for _, names in prepared)
+        fn = _hybrid_batched_counts_fn(
+            structures,
+            slot_names,
+            [n for n, _ in prepared],
+            table.n_pad // _LANES,
+            delta.n_pad // _LANES,
+            delta.del_mask is not None,
+        )
+        union_names = tuple(
+            dict.fromkeys(n for names in slot_names for n in names)
+        )
+        bcols = dict(
+            zip(union_names, resident_arrays_for(table.columns, union_names))
+        )
+        dcols = dict(
+            zip(union_names, resident_arrays_for(delta.columns, union_names))
+        )
+        lit_vecs = []
+        for narrowed, _ in prepared:
+            vals: list = []
+            _expr_literals(narrowed, vals)
+            lit_vecs.append(np.asarray(vals, dtype=np.int32))
+        t0 = time.perf_counter()
+        with K._x32():
+            if delta.del_mask is not None:
+                counts = np.asarray(
+                    fn(bcols, dcols, tuple(lit_vecs), delta.del_mask)
+                )
+            else:
+                counts = np.asarray(fn(bcols, dcols, tuple(lit_vecs)))
+        metrics.record_time("serve.batch.device", time.perf_counter() - t0)
+        metrics.incr("serve.batch.dispatches")
+        metrics.incr("serve.batch.queries", len(predicates))
+        metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
+        nb_pad = table.n_pad // BLOCK_ROWS
+        nb = -(-table.n_rows // BLOCK_ROWS)
+        nd = -(-delta.n_rows // BLOCK_ROWS)
+        return [(c[:nb], c[nb_pad : nb_pad + nd]) for c in counts]
+
+    def delta_parts(
+        self,
+        delta: DeltaRegion,
+        predicate: Expr,
+        output_columns,
+        counts: np.ndarray,
+    ) -> list:
+        """The delta side's host leg: slice ONLY the 8192-row blocks the
+        device counted matches in out of the (already decoded, host-held)
+        appended batch, re-evaluate the predicate exactly there, project.
+        No parquet is touched — the decode was paid once at population."""
+        from .delta import blocks_to_runs
+
+        cand = np.flatnonzero(counts)
+        metrics.incr("scan.resident.delta_blocks_touched", int(cand.size))
+        metrics.incr("scan.resident.delta_blocks_total", int(len(counts)))
+        if cand.size == 0:
+            return []
+        parts = []
+        for lo, hi in blocks_to_runs(cand, BLOCK_ROWS, delta.n_rows):
+            sub = delta.host_batch.take(np.arange(lo, hi))
+            mask = eval_mask(predicate, sub)
+            idx = np.flatnonzero(mask)
+            if idx.size:
+                parts.append(sub.take(idx).select(list(output_columns)))
+        return parts
+
     # -- observability -------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
             return {
                 "tables": len(self._tables),
+                "deltas": len(self._deltas),
                 "resident_mb": round(
-                    sum(t.nbytes for t in self._tables) / 1e6, 1
+                    (
+                        sum(t.nbytes for t in self._tables)
+                        + sum(d.nbytes for d in self._deltas)
+                    )
+                    / 1e6,
+                    1,
                 ),
                 "budget_mb": _budget_bytes() >> 20,
                 "per_table": [
@@ -1109,6 +1861,18 @@ class HbmIndexCache(ResidentCacheBase):
                         "mb": round(t.nbytes / 1e6, 1),
                     }
                     for t in self._tables
+                ],
+                "per_delta": [
+                    {
+                        "rows": d.n_rows,
+                        "columns": sorted(d.columns),
+                        "deleted_ids": len(d.deleted_ids),
+                        "oov": {
+                            k: int(len(v)) for k, v in d.oov.items() if len(v)
+                        },
+                        "mb": round(d.nbytes / 1e6, 1),
+                    }
+                    for d in self._deltas
                 ],
             }
 

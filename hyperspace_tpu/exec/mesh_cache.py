@@ -67,8 +67,10 @@ from .hbm_cache import (
     _budget_bytes,
     _encode_column,
     _file_identity,
+    _hybrid_fns,
     _min_auto_rows,
     ResidentCacheBase,
+    delta_snapshot_key,
 )
 
 
@@ -87,6 +89,38 @@ class MeshResidentColumn:
 # one device's slice of one file: rows [file_lo, file_hi) of ``path`` live
 # at device-local rows [dev_off, dev_off + (file_hi - file_lo))
 Segment = Tuple[str, int, int, int]
+
+
+@dataclass
+class MeshDeltaRegion:
+    """Appended-source residency for one mesh-sharded base table: the
+    appended rows are hash-bucketized on the index's key columns and
+    placed on their owner device (the build's ``b % D`` rule — the same
+    placement a Repartition of the appended side would produce), so the
+    fused base+delta dispatch stays collective-free. ``dev_idx`` maps
+    each device-local row back to its row in the (host-held, decoded)
+    appended batch for the exact host leg."""
+
+    key: tuple  # appended snapshot ((name, size, mtime), ...) sorted
+    base_key: tuple  # MeshResidentTable.key this delta extends
+    deleted_ids: tuple
+    mesh: object
+    n_devices: int
+    cap: int  # padded per-device delta rows (pow2)
+    block: int  # delta count granularity (min(BLOCK_ROWS, cap))
+    dev_rows: List[int]  # real delta rows per device
+    dev_idx: List[np.ndarray]  # device-local row -> host_batch row
+    columns: Dict[str, MeshResidentColumn]
+    oov: Dict[str, np.ndarray]  # per string column: sorted OOV values
+    host_batch: ColumnarBatch  # appended rows, user columns
+    del_mask: Optional[object]  # (D, base cap) int32 device; 1 = deleted
+    n_rows: int = 0
+    nbytes: int = 0
+    last_used: float = field(default_factory=time.monotonic)
+
+    @property
+    def n_blocks(self) -> int:
+        return self.cap // self.block
 
 
 @dataclass
@@ -247,6 +281,76 @@ def _mesh_batched_counts_fn(mesh, structures: tuple, slot_names: tuple,
         )
     )
     _batch_fns.put(key, fn)
+    return fn
+
+
+def _mesh_hybrid_counts_fn(mesh, bound_repr: str, bound: Expr, names: tuple,
+                           cap_b: int, block_b: int, cap_d: int,
+                           block_d: int, has_mask: bool):
+    """Jitted shard_map evaluating the predicate over base shards (AND NOT
+    the deletion bitmask) and delta shards in ONE mesh round trip:
+    (base dict, delta dict[, mask]) -> (D, base_blocks + delta_blocks)
+    int32. Memoized in hbm_cache's shared hybrid compile cache."""
+    key = ("hy1m", mesh, bound_repr, names, cap_b, block_b, cap_d, block_d,
+           has_mask)
+    fn = _hybrid_fns.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec
+
+    from ..utils.jaxcompat import shard_map
+
+    shim = ColumnarBatch(
+        {name: Column("int32", np.empty(0, dtype=np.int32)) for name in names}
+    )
+    axis = mesh.axis_names[0]
+
+    def _counts(arrays, cap, block, live=None):
+        flat = {n: a.reshape(-1) for n, a in arrays.items()}
+        m = eval_mask(bound, shim, flat)
+        if live is not None:
+            m = m & live
+        return jnp.sum(
+            m.reshape(cap // block, block).astype(jnp.int32), axis=1
+        )
+
+    if has_mask:
+
+        def shard_fn(base_arrays, delta_arrays, mask):
+            cb = _counts(base_arrays, cap_b, block_b, mask.reshape(-1) == 0)
+            cd = _counts(delta_arrays, cap_d, block_d)
+            return jnp.concatenate([cb, cd])[None]
+
+        in_specs = (
+            {name: PartitionSpec(axis, None) for name in names},
+            {name: PartitionSpec(axis, None) for name in names},
+            PartitionSpec(axis, None),
+        )
+    else:
+
+        def shard_fn(base_arrays, delta_arrays):
+            cb = _counts(base_arrays, cap_b, block_b)
+            cd = _counts(delta_arrays, cap_d, block_d)
+            return jnp.concatenate([cb, cd])[None]
+
+        in_specs = (
+            {name: PartitionSpec(axis, None) for name in names},
+            {name: PartitionSpec(axis, None) for name in names},
+        )
+
+    fn = jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=PartitionSpec(axis, None),
+            check_vma=False,
+        )
+    )
+    _hybrid_fns.put(key, fn)
     return fn
 
 
@@ -697,13 +801,17 @@ class MeshHbmCache(ResidentCacheBase):
         output_columns: List[str],
         predicate: Expr,
         counts: np.ndarray,
+        path_metric: Optional[str] = "scan.path.resident_device_mesh",
     ) -> List[ColumnarBatch]:
         """Read ONLY the blocks the device counted matches in, re-evaluate
         the predicate exactly there, gather output columns from mmap —
         the single-chip _resident_parts protocol per device shard,
-        restricted to the query's (pruned) ``files``."""
+        restricted to the query's (pruned) ``files``. ``path_metric=None``
+        suppresses the path counter (the hybrid fused path fires
+        ``scan.path.resident_hybrid`` instead)."""
         wanted = {str(Path(f)) for f in files}
-        metrics.incr("scan.path.resident_device_mesh")
+        if path_metric is not None:
+            metrics.incr(path_metric)
         metrics.incr(
             "scan.resident_mesh.blocks_touched",
             int(np.count_nonzero(counts)),
@@ -753,13 +861,422 @@ class MeshHbmCache(ResidentCacheBase):
         keyed.sort(key=lambda kv: kv[0])
         return [b for _, b in keyed]
 
+    # -- delta residency (hybrid scan's appended side) -----------------------
+    def delta_for(
+        self, table: MeshResidentTable, appended, columns, deleted_ids
+    ) -> Optional[MeshDeltaRegion]:
+        from .hbm_cache import residency_mode
+
+        if residency_mode() == "off":
+            return None
+        dkey = delta_snapshot_key(appended)
+        dels = tuple(sorted(int(i) for i in deleted_ids))
+        with self._lock:
+            for d in reversed(self._deltas):
+                if (
+                    d.base_key == table.key
+                    and d.mesh is table.mesh
+                    and d.key == dkey
+                    and d.deleted_ids == dels
+                    and set(columns) <= set(d.columns)
+                ):
+                    d.last_used = time.monotonic()
+                    return d
+        return None
+
+    def prefetch_delta(
+        self,
+        table: MeshResidentTable,
+        appended,
+        relation,
+        host_columns,
+        deleted_ids,
+        indexed_columns,
+        num_buckets: int,
+    ) -> Optional[MeshDeltaRegion]:
+        """Synchronous mesh delta build + register (idempotent; a delta
+        built against a narrower base is rebuilt — hbm_cache note)."""
+        want = [c for c in host_columns if c in table.columns]
+        existing = self.delta_for(table, appended, want, deleted_ids)
+        if existing is not None:
+            return existing
+        delta, _ = self._build_delta(
+            table, appended, relation, host_columns, deleted_ids,
+            indexed_columns, num_buckets,
+        )
+        if delta is None:
+            return None
+        self._register_delta(delta)
+        return delta
+
+    def note_touch_delta(
+        self,
+        table: MeshResidentTable,
+        appended,
+        relation,
+        host_columns,
+        deleted_ids,
+        indexed_columns,
+        num_buckets: int,
+    ) -> None:
+        """Background mesh delta population (hbm_cache.note_touch_delta
+        contract: never blocks, never throws, no row floor)."""
+        if not _auto_enabled() or not appended:
+            return
+        dkey = delta_snapshot_key(appended)
+        dels = tuple(sorted(int(i) for i in deleted_ids))
+        want = {c for c in host_columns if c in table.columns}
+        memo = ("delta", table.key, dkey, dels)
+        with self._lock:
+            if memo in self._pending or memo in self._failed:
+                return
+            # coverage, not mere existence (hbm_cache.note_touch_delta
+            # rationale): a narrower delta must be rebuilt, not memoized
+            if any(
+                d.base_key == table.key
+                and d.mesh is table.mesh
+                and d.key == dkey
+                and d.deleted_ids == dels
+                and want <= set(d.columns)
+                for d in self._deltas
+            ):
+                return
+            self._pending.add(memo)
+            epoch = self._epoch
+
+        def bg():
+            failed = False
+            try:
+                delta, permanent = self._build_delta(
+                    table, appended, relation, host_columns, deleted_ids,
+                    indexed_columns, num_buckets,
+                )
+                if delta is not None:
+                    self._register_delta(delta, epoch=epoch)
+                    if not want <= set(delta.columns):
+                        # uncoverable want-set for this epoch: memoize or
+                        # rebuild forever (hbm_cache.note_touch_delta)
+                        failed = True
+                elif permanent:
+                    failed = True
+            except Exception:  # noqa: BLE001 - population must never fail a scan
+                metrics.incr("hbm.mesh.delta.populate_failed")
+            finally:
+                with self._lock:
+                    self._pending.discard(memo)
+                    if failed:
+                        if len(self._failed) >= _MAX_FAILED_MEMO:
+                            self._failed.clear()
+                        self._failed.add(memo)
+
+        t = threading.Thread(
+            target=bg, daemon=True, name="hbm-mesh-delta-populate"
+        )
+        self._track_for_exit(t)
+        t.start()
+
+    def _build_delta(
+        self,
+        table: MeshResidentTable,
+        appended,
+        relation,
+        host_columns,
+        deleted_ids,
+        indexed_columns,
+        num_buckets: int,
+    ) -> Tuple[Optional[MeshDeltaRegion], bool]:
+        """(delta, permanent_refusal): decode the appended files once,
+        hash-bucketize their rows to the build's ``b % D`` placement, and
+        upload per-device delta shards + the base deletion bitmask."""
+        from ..ops.hashing import bucket_ids_host, key_repr
+        from ..parallel.mesh import owner_of_bucket
+        from ..storage import parquet_io
+        from ..utils.deviceprobe import first_device_touch_ok
+        from ..utils.intmath import next_pow2
+        from .bytecache import batch_nbytes
+        from .delta import encode_delta_columns
+
+        if not first_device_touch_ok():
+            metrics.incr("hbm.mesh.device_unreachable")
+            return None, False
+
+        t0 = time.perf_counter()
+        dels = tuple(sorted(int(i) for i in deleted_ids))
+        mesh = table.mesh
+        D = table.n_devices
+        # doomed-build pre-check before the decode (hbm_cache rationale)
+        with self._lock:
+            headroom0 = _budget_bytes() - sum(
+                t.nbytes for t in self._tables
+            )
+        if sum(int(f.size) for f in appended) > headroom0:
+            metrics.incr("hbm.mesh.delta.over_budget_refused")
+            return None, False
+        try:
+            host_batch = parquet_io.read_relation(
+                relation,
+                paths=[f.name for f in appended],
+                columns=list(host_columns),
+            )
+        except Exception:  # noqa: BLE001 - vanished file = no residency
+            metrics.incr("hbm.mesh.delta.read_error")
+            return None, False
+        n_rows = host_batch.num_rows
+        if n_rows == 0:
+            return None, True
+        if any(c not in host_batch.columns for c in indexed_columns):
+            return None, True
+        if dels:
+            from .. import constants as C
+
+            col_name = C.DATA_FILE_NAME_ID
+            for segs in table.segments:
+                for path, _lo, _hi, _off in segs:
+                    footer_cols = {
+                        m["name"]
+                        for m in layout.cached_reader(path).footer["columns"]
+                    }
+                    if col_name not in footer_cols:
+                        metrics.incr("hbm.mesh.delta.no_lineage_refused")
+                        return None, True
+
+        # the build's placement rule: bucket on the index's key columns,
+        # owner = b % D; bucket-ascending order within each device
+        buckets = bucket_ids_host(
+            [key_repr(host_batch.columns[c]) for c in indexed_columns],
+            num_buckets,
+        )
+        dev_idx: List[np.ndarray] = [
+            np.empty(0, dtype=np.int64) for _ in range(D)
+        ]
+        per_dev: List[List[np.ndarray]] = [[] for _ in range(D)]
+        for b in np.unique(buckets):
+            d = owner_of_bucket(int(b), D)
+            per_dev[d].append(np.flatnonzero(buckets == b))
+        for d in range(D):
+            if per_dev[d]:
+                dev_idx[d] = np.concatenate(per_dev[d])
+        dev_rows = [int(len(ix)) for ix in dev_idx]
+        cap = next_pow2(max(max(dev_rows), 1))
+        block = min(BLOCK_ROWS, cap)
+
+        # shared per-column encode loop (exec.delta); the mesh resident
+        # path is ungated, so zone vectors are skipped
+        flats, encs, oov, planes, _zones = encode_delta_columns(
+            host_batch, table.columns, with_zones=False
+        )
+        if not flats:
+            return None, True
+        host_bytes = batch_nbytes(host_batch)
+        oov_bytes = sum(
+            sum(len(v) + 50 for v in side) for side in oov.values()
+        )
+        mask_bytes = D * table.cap * 4 if dels else 0
+        dev_bytes = planes * D * cap * 4 + mask_bytes
+        # headroom against the resident tables, not the whole budget
+        # (hbm_cache._build_delta rationale)
+        with self._lock:
+            headroom = _budget_bytes() - sum(
+                t.nbytes for t in self._tables
+            )
+        if dev_bytes + host_bytes + oov_bytes > headroom:
+            metrics.incr("hbm.mesh.delta.over_budget_refused")
+            return None, False
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(
+            mesh, PartitionSpec(mesh.axis_names[0], None)
+        )
+
+        def pack(flat: np.ndarray) -> np.ndarray:
+            packed = np.zeros((D, cap), dtype=np.int32)
+            for d in range(D):
+                if dev_rows[d]:
+                    packed[d, : dev_rows[d]] = flat[dev_idx[d]]
+            return packed
+
+        try:
+            cols: Dict[str, MeshResidentColumn] = {}
+            for name, flat in flats.items():
+                dtype_str, enc = encs[name]
+                if enc == "f64":
+                    hi, lo = flat
+                    dev_hi = jax.device_put(pack(hi), sharding)
+                    dev_lo = jax.device_put(pack(lo), sharding)
+                    cols[name] = MeshResidentColumn(
+                        dev_hi, dtype_str, "f64", 2 * D * cap * 4, None,
+                        dev_lo,
+                    )
+                else:
+                    dev = jax.device_put(pack(flat), sharding)
+                    cols[name] = MeshResidentColumn(
+                        dev,
+                        dtype_str,
+                        enc,
+                        D * cap * 4,
+                        table.columns[name].vocab if enc == "string" else None,
+                    )
+            del_mask = None
+            if dels:
+                del_mask = jax.device_put(
+                    self._lineage_mask(table, dels), sharding
+                )
+            from ..ops import fence_chain
+
+            fence_chain(
+                [c.data for c in cols.values()]
+                + [c.data2 for c in cols.values() if c.data2 is not None]
+                + ([del_mask] if del_mask is not None else [])
+            )
+        except Exception:  # noqa: BLE001 - device loss: no residency
+            metrics.incr("hbm.mesh.delta.transfer_error")
+            return None, False
+        nbytes = dev_bytes + host_bytes + oov_bytes
+        metrics.incr("hbm.mesh.delta.h2d_bytes", dev_bytes)
+        metrics.record_time(
+            "hbm.mesh.delta.prefetch", time.perf_counter() - t0
+        )
+        return (
+            MeshDeltaRegion(
+                delta_snapshot_key(appended),
+                table.key,
+                dels,
+                mesh,
+                D,
+                cap,
+                block,
+                dev_rows,
+                dev_idx,
+                cols,
+                oov,
+                host_batch,
+                del_mask,
+                n_rows,
+                nbytes,
+            ),
+            False,
+        )
+
+    @staticmethod
+    def _lineage_mask(table: MeshResidentTable, dels: tuple) -> np.ndarray:
+        """(D, cap) int32 deletion bitmask over the base shards, from the
+        base files' lineage column read at the shard segments' row
+        ranges (pad rows stay 0)."""
+        from .. import constants as C
+
+        mask = np.zeros((table.n_devices, table.cap), dtype=np.int32)
+        dels_arr = np.asarray(dels, dtype=np.int64)
+        for d in range(table.n_devices):
+            for path, flo, fhi, off in table.segments[d]:
+                vals = (
+                    layout.cached_reader(path)
+                    .read([C.DATA_FILE_NAME_ID], row_range=(flo, fhi))
+                    .columns[C.DATA_FILE_NAME_ID]
+                    .data
+                )
+                mask[d, off : off + (fhi - flo)] = np.isin(
+                    np.asarray(vals, dtype=np.int64), dels_arr
+                )
+        return mask
+
+    # -- the fused hybrid query ----------------------------------------------
+    def hybrid_block_counts(
+        self,
+        table: MeshResidentTable,
+        delta: MeshDeltaRegion,
+        predicate: Expr,
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """((D, base_blocks), (D, delta_blocks)) per-block match counts
+        for base+delta in ONE mesh round trip, deletion bitmask applied
+        on-device. None when the predicate cannot ride the shared
+        encodings (caller routes the host union)."""
+        from ..ops import kernels as K
+        from .delta import prepare_hybrid_predicate
+        from .hbm_cache import resident_arrays_for
+
+        prepared = prepare_hybrid_predicate(
+            table.columns, delta.oov, predicate
+        )
+        if prepared is None:
+            return None
+        narrowed, names = prepared
+        if any(n.split("\x00", 1)[0] not in delta.columns for n in names):
+            return None
+        fn = _mesh_hybrid_counts_fn(
+            table.mesh,
+            repr(narrowed),
+            narrowed,
+            names,
+            table.cap,
+            table.block,
+            delta.cap,
+            delta.block,
+            delta.del_mask is not None,
+        )
+        bcols = dict(
+            zip(names, resident_arrays_for(table.columns, names))
+        )
+        dcols = dict(
+            zip(names, resident_arrays_for(delta.columns, names))
+        )
+        t0 = time.perf_counter()
+        with K._x32():
+            if delta.del_mask is not None:
+                counts = np.asarray(fn(bcols, dcols, delta.del_mask))
+            else:
+                counts = np.asarray(fn(bcols, dcols))
+        metrics.record_time(
+            "scan.resident_hybrid.mesh_device", time.perf_counter() - t0
+        )
+        metrics.incr("scan.resident_mesh.d2h_bytes", int(counts.nbytes))
+        nb = table.n_blocks
+        return counts[:, :nb], counts[:, nb:]
+
+    def delta_parts(
+        self,
+        delta: MeshDeltaRegion,
+        predicate: Expr,
+        output_columns,
+        counts: np.ndarray,
+    ) -> List[ColumnarBatch]:
+        """The mesh delta's host leg: per device, slice only the counted
+        blocks' rows out of the host-held appended batch (via dev_idx),
+        re-evaluate exactly, project. No parquet per query."""
+        metrics.incr(
+            "scan.resident.delta_blocks_touched",
+            int(np.count_nonzero(counts)),
+        )
+        metrics.incr("scan.resident.delta_blocks_total", int(counts.size))
+        from .delta import blocks_to_runs
+
+        parts: List[ColumnarBatch] = []
+        for d in range(delta.n_devices):
+            cand = np.flatnonzero(counts[d])
+            if cand.size == 0:
+                continue
+            for lo, hi in blocks_to_runs(cand, delta.block, delta.dev_rows[d]):
+                sub = delta.host_batch.take(delta.dev_idx[d][lo:hi])
+                mask = eval_mask(predicate, sub)
+                idx = np.flatnonzero(np.asarray(mask))
+                if idx.size:
+                    parts.append(sub.take(idx).select(list(output_columns)))
+        return parts
+
     # -- observability -------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
             return {
                 "tables": len(self._tables),
+                "deltas": len(self._deltas),
                 "resident_mb": round(
-                    sum(t.nbytes for t in self._tables) / 1e6, 1
+                    (
+                        sum(t.nbytes for t in self._tables)
+                        + sum(d.nbytes for d in self._deltas)
+                    )
+                    / 1e6,
+                    1,
                 ),
                 "budget_mb": _budget_bytes() >> 20,
                 "per_table": [
@@ -771,6 +1288,17 @@ class MeshHbmCache(ResidentCacheBase):
                         "mb": round(t.nbytes / 1e6, 1),
                     }
                     for t in self._tables
+                ],
+                "per_delta": [
+                    {
+                        "devices": d.n_devices,
+                        "rows": d.n_rows,
+                        "cap": d.cap,
+                        "columns": sorted(d.columns),
+                        "deleted_ids": len(d.deleted_ids),
+                        "mb": round(d.nbytes / 1e6, 1),
+                    }
+                    for d in self._deltas
                 ],
             }
 

@@ -227,17 +227,21 @@ def _resident_parts(
     output_columns: List[str],
     predicate: Expr,
     counts: np.ndarray,
+    path_metric: Optional[str] = "scan.path.resident_device",
 ) -> List[ColumnarBatch]:
     """Collect the result batches of a resident scan: host reads ONLY the
     8192-row blocks the device counted matches in, re-evaluates the
     predicate exactly there, and gathers the output columns from mmap —
     no result bytes ever cross the device link. Parts come back in
-    ``files`` order, matching the host path's output order."""
+    ``files`` order, matching the host path's output order.
+    ``path_metric=None`` suppresses the path counter (the hybrid fused
+    path fires its own ``scan.path.resident_hybrid`` instead)."""
     from .hbm_cache import BLOCK_ROWS
     from ..storage.layout import cached_reader
 
     candid = np.flatnonzero(counts)
-    metrics.incr("scan.path.resident_device")
+    if path_metric is not None:
+        metrics.incr(path_metric)
     metrics.incr("scan.resident.blocks_touched", int(len(candid)))
     metrics.incr("scan.resident.blocks_total", int(len(counts)))
     if candid.size == 0:
@@ -394,6 +398,9 @@ def index_scan(
                 metrics.incr("scan.resident.device_failed")
                 counts = None
             if counts is not None:
+                from .scan_gate import scan_gate
+
+                scan_gate.note_resident_bypass("plain")
                 parts = _resident_parts(
                     table, files, output_columns, predicate, counts
                 )

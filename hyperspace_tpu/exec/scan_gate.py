@@ -28,7 +28,11 @@ RESIDENCY-AWARENESS: the gate's link arithmetic prices the per-query
 H2D upload — which HBM-resident tables (exec/hbm_cache.py) have already
 paid. The scan therefore checks residency BEFORE consulting this gate
 and routes resident file sets to the device unconditionally; the gate
-only arbitrates the non-resident (upload-per-query) path.
+only arbitrates the non-resident (upload-per-query) path. This is
+delta-aware: a hybrid scan whose base AND appended delta are resident
+(exec/hbm_cache DeltaRegion) bypasses the gate the same way — its
+appended side has no per-query upload left to price either — recorded
+via ``note_resident_bypass`` so the bypass is observable per kind.
 
 Reference parity: Spark has no such gate (the JVM executes everything);
 this is TPU-native routing policy, observable via ``scan.gate.*`` metrics
@@ -266,6 +270,16 @@ class ScanGate:
                     row[k] = st[k]
             out[str(n_pad)] = row
         return out
+
+    def note_resident_bypass(self, kind: str) -> None:
+        """Record a scan the gate never arbitrated because residency made
+        the device the winner outright (module note "RESIDENCY-
+        AWARENESS"). Delta-aware routing: ``kind`` distinguishes plain
+        resident bypasses from the hybrid base+delta fused path, so the
+        gate's metrics explain why no probe ladder ran for those scans
+        ("scan.gate.resident_bypass_hybrid" under continuous appends is
+        the delta fast path working, not a gate that went blind)."""
+        metrics.incr(f"scan.gate.resident_bypass_{kind}")
 
     def reset(self) -> None:
         with self._lock:

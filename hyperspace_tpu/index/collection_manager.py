@@ -35,6 +35,21 @@ from .path_resolver import PathResolver
 from .stats import IndexStatistics
 
 
+def _invalidate_resident_deltas(index_root) -> None:
+    """Drop THIS index's resident delta regions after an
+    index-data-rewriting action (full/incremental refresh, optimize):
+    the new version's file identities change its base keys, so its stale
+    deltas could never be served again and would only pin HBM until LRU
+    pressure found them. Scoped by the index's directory — refreshing
+    one index must not evict other indexes' still-valid deltas. Quick
+    refresh does NOT call this (see refresh() below)."""
+    from ..exec.hbm_cache import hbm_cache
+    from ..exec.mesh_cache import mesh_cache
+
+    hbm_cache.invalidate_deltas(str(index_root))
+    mesh_cache.invalidate_deltas(str(index_root))
+
+
 class IndexCollectionManager:
     def __init__(self, session):
         self.session = session
@@ -112,9 +127,18 @@ class IndexCollectionManager:
             return
         if mode == C.REFRESH_MODE_FULL:
             RefreshAction(self.session, mgr, data).run()
+            _invalidate_resident_deltas(self.path_resolver.get_index_path(name))
         elif mode == C.REFRESH_MODE_INCREMENTAL:
             RefreshIncrementalAction(self.session, mgr, data).run()
+            _invalidate_resident_deltas(self.path_resolver.get_index_path(name))
         elif mode == C.REFRESH_MODE_QUICK:
+            # deliberately NO delta invalidation: a quick refresh records
+            # the source delta in the log without touching index data
+            # files, so every (base key, appended snapshot) delta key
+            # stays valid — the resident base AND delta keep serving with
+            # zero re-upload. That continuity IS the promotion path: the
+            # already-uploaded delta columns become part of the servable
+            # state of the refreshed index instead of being re-shipped.
             RefreshQuickAction(self.session, mgr, data).run()
         else:
             raise HyperspaceException(
@@ -132,6 +156,7 @@ class IndexCollectionManager:
         OptimizeAction(
             self.session, self._existing_log_manager(name), self._data_manager(name), mode
         ).run()
+        _invalidate_resident_deltas(self.path_resolver.get_index_path(name))
 
     def cancel(self, name: str) -> None:
         CancelAction(

@@ -26,14 +26,15 @@ always goes through its own scan node. Same results, one extra plan node.
 
 from __future__ import annotations
 
-from typing import List, Optional, Set
+from dataclasses import dataclass
+from typing import List, Optional, Set, Tuple
 
 from ...config import HyperspaceConf
 from ...exceptions import HyperspaceException
 from ...index.log_entry import FileInfo, IndexLogEntry
 from ... import constants as C
 from ...sources.relation import FileRelation
-from ..expr import Not, col, is_in
+from ..expr import Col, In, Not, col, is_in
 from ..ir import (
     BucketUnion,
     Filter,
@@ -143,3 +144,91 @@ def transform_plan_to_use_hybrid_scan(
         return None
 
     return plan.transform_up(fn)
+
+
+# ---------------------------------------------------------------------------
+# Delta-residency plumbing: expose the hybrid union's appended/deleted file
+# sets to the scan layer. The rule above OWNS the union's shape, so the one
+# recognizer the executor and the serving micro-batcher share lives here —
+# pattern-matching the shape in two executors would drift the moment this
+# rule changes it.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class HybridUnionInfo:
+    """Everything the delta-resident fast path needs from a hybrid union:
+    which index the plan reads, which source files were appended since its
+    snapshot (and their relation, for the one-time delta decode), and
+    which logged files were deleted (as lineage ids for the deletion
+    bitmask / host NOT-IN re-evaluation)."""
+
+    entry: IndexLogEntry
+    scan_node: IndexScan
+    user_cols: Tuple[str, ...]  # the union's output schema (both sides)
+    appended: Tuple[FileInfo, ...]  # appended source files, name-sorted
+    relation: FileRelation  # appended-files-only relation (for reads)
+    deleted_ids: Tuple[int, ...]  # lineage ids of deleted logged files
+
+
+def parse_hybrid_union(plan: LogicalPlan) -> Optional[HybridUnionInfo]:
+    """The HybridUnionInfo of a filter-shape hybrid union built by
+    ``transform_plan_to_use_hybrid_scan`` — Union(index side, appended
+    side) with an optional lineage NOT-IN filter on the index side — or
+    None for any other plan. Never raises: an unrecognized shape is a
+    routing decision (callers execute the union per-side)."""
+    if not isinstance(plan, Union) or len(plan.children) != 2:
+        return None
+
+    def has_index_scan(node: LogicalPlan) -> bool:
+        if isinstance(node, IndexScan):
+            return True
+        return any(has_index_scan(c) for c in node.children)
+
+    idx_side = next((c for c in plan.children if has_index_scan(c)), None)
+    src_side = next(
+        (c for c in plan.children if not has_index_scan(c)), None
+    )
+    if idx_side is None or src_side is None:
+        return None
+    # index side: IndexScan | Project(user_cols, Filter(NOT-IN, IndexScan))
+    node = idx_side
+    user_cols: Optional[Tuple[str, ...]] = None
+    deleted_ids: Tuple[int, ...] = ()
+    if isinstance(node, Project):
+        user_cols = tuple(node.columns)
+        node = node.child
+    if isinstance(node, Filter):
+        cond = node.condition
+        if not (
+            isinstance(cond, Not)
+            and isinstance(cond.child, In)
+            and isinstance(cond.child.child, Col)
+            and cond.child.child.name == C.DATA_FILE_NAME_ID
+        ):
+            return None
+        deleted_ids = tuple(sorted(int(v) for v in cond.child.values))
+        node = node.child
+    if not isinstance(node, IndexScan):
+        return None
+    if user_cols is None:
+        user_cols = tuple(node.required_columns)
+    # appended side: [Project(user_cols)] Scan(appended-only relation)
+    s = src_side
+    if isinstance(s, Project):
+        s = s.child
+    if not isinstance(s, Scan) or not s.relation.files:
+        return None
+    src_cols = tuple(src_side.output_columns())
+    if tuple(c.lower() for c in src_cols) != tuple(
+        c.lower() for c in user_cols
+    ):
+        return None
+    return HybridUnionInfo(
+        node.entry,
+        node,
+        user_cols,
+        tuple(s.relation.files),
+        s.relation,
+        deleted_ids,
+    )

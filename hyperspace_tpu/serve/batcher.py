@@ -16,11 +16,17 @@ re-evaluates ITS predicate there, so batched results are bit-identical
 to serial execution.
 
 Classification happens against the OPTIMIZED plan (the server's plan
-cache makes that cheap): only the `[Project] → Filter → IndexScan` shape
-qualifies — hybrid unions, joins and aggregates take the normal executor
-path, as do resident-ineligible predicates and queries the selectivity
-zone gate routes host (a broad predicate batched onto the device would
-pay the dispatch AND read nearly every block anyway).
+cache makes that cheap): the `[Project] → Filter → IndexScan` shape
+qualifies, and so does the filter-shape HYBRID union
+(`[Project] → Filter → Union(index side, appended side)`) when both the
+base table and its appended delta are resident (exec.hbm_cache
+DeltaRegion) — those coalesce like plain scans, with the stacked hybrid
+dispatch covering base+delta+deletion-bitmask for the whole batch.
+Joins, aggregates, mesh-session hybrids (served per-query by the
+executor's own fused mesh path), resident-ineligible predicates and
+queries the selectivity zone gate routes host all take the normal
+executor path (a broad predicate batched onto the device would pay the
+dispatch AND read nearly every block anyway).
 """
 
 from __future__ import annotations
@@ -30,7 +36,7 @@ from pathlib import Path
 from typing import List, Optional, Tuple
 
 from ..plan.expr import Expr
-from ..plan.ir import Filter, IndexScan, LogicalPlan, Project
+from ..plan.ir import Filter, IndexScan, LogicalPlan, Project, Union
 from ..storage.columnar import ColumnarBatch
 from ..telemetry.metrics import metrics
 
@@ -50,6 +56,11 @@ class ResidentScanRequest:
     # prepare_resident_predicate result from classification — carried so
     # the dispatch leg doesn't rerun the narrow pipeline per query
     prepared: object = None
+    # hybrid (delta-resident) requests only: the base table's delta
+    # region, and the base host leg's exact predicate (user predicate
+    # conjoined with the lineage NOT-IN when files were deleted)
+    delta: object = None
+    host_predicate: Optional[Expr] = None
 
 
 def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
@@ -68,7 +79,13 @@ def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
     node = plan
     while isinstance(node, Project):
         node = node.child
-    if not isinstance(node, Filter) or not isinstance(node.child, IndexScan):
+    if not isinstance(node, Filter):
+        return None
+    if isinstance(node.child, Union):
+        return _classify_hybrid(
+            session, node.condition, node.child, output_columns
+        )
+    if not isinstance(node.child, IndexScan):
         return None
     predicate = node.condition
     scan = node.child
@@ -130,6 +147,55 @@ def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
     )
 
 
+def _classify_hybrid(
+    session, predicate: Expr, union: LogicalPlan, output_columns: List[str]
+) -> Optional[ResidentScanRequest]:
+    """Classify a filter-shape hybrid union for the batched hybrid
+    dispatch: base table AND delta region must be resident and the
+    predicate must ride the shared encodings. Eligibility (residency,
+    pruning, zone gate, host predicate) is exec.delta's
+    resolve_hybrid_residency — the SAME procedure the executor's fused
+    path runs, so a query never routes differently served vs collected.
+    Mesh sessions decline — their hybrid queries are served per-query by
+    the executor's fused mesh path (one shard_map dispatch each), which
+    the normal path already provides."""
+    from ..exec.delta import (
+        prepare_hybrid_predicate,
+        resolve_hybrid_residency,
+    )
+    from ..plan.rules.hybrid_scan import parse_hybrid_union
+
+    if session.mesh is not None and session.mesh.devices.size > 1:
+        return None
+    info = parse_hybrid_union(union)
+    if info is None:
+        return None
+    res = resolve_hybrid_residency(info, predicate)
+    if res.status != "ok":
+        return None
+    prepared = prepare_hybrid_predicate(
+        res.table.columns, res.delta.oov, predicate
+    )
+    if prepared is None:
+        return None
+    if any(
+        n.split("\x00", 1)[0] not in res.delta.columns for n in prepared[1]
+    ):
+        return None
+    return ResidentScanRequest(
+        res.table,
+        info.entry,
+        res.files,
+        predicate,
+        output_columns,
+        (id(res.table), id(res.delta), frozenset(prepared[1])),
+        None,
+        prepared,
+        res.delta,
+        res.host_predicate,
+    )
+
+
 def execute_batch(
     requests: List[ResidentScanRequest],
 ) -> Optional[List[ColumnarBatch]]:
@@ -143,6 +209,34 @@ def execute_batch(
     table = requests[0].table
     predicates = [r.predicate for r in requests]
     prepared = [r.prepared for r in requests]
+    if requests[0].delta is not None:
+        # hybrid batch: ONE stacked base+delta dispatch, then each
+        # query's exact host legs (base blocks from mmap with the
+        # lineage NOT-IN re-applied, delta blocks from the host-held
+        # appended batch)
+        delta = requests[0].delta
+        pairs = hbm_cache.hybrid_block_counts_batch(
+            table, delta, predicates, prepared
+        )
+        if pairs is None:
+            return None
+        results = []
+        for r, (base_c, delta_c) in zip(requests, pairs):
+            parts = _resident_parts(
+                table,
+                r.files,
+                r.output_columns,
+                r.host_predicate,
+                base_c,
+                path_metric=None,
+            )
+            parts += hbm_cache.delta_parts(
+                delta, r.predicate, r.output_columns, delta_c
+            )
+            metrics.incr("scan.path.resident_hybrid")
+            results.append(_concat_or_empty(parts, r))
+        metrics.incr("serve.batch.coalesced", len(requests))
+        return results
     if requests[0].mesh is not None:
         from ..exec.mesh_cache import mesh_cache
 

@@ -1,0 +1,485 @@
+"""Delta residency — the hybrid scan's device fast path between refreshes.
+
+A hybrid-scan query whose source gained (and possibly lost) files since
+index creation must execute its predicate as ONE fused base+delta device
+dispatch once base and delta are resident (``scan.path.resident_hybrid``),
+with row-level parity against the host union path, zero per-query H2D
+after population, correct OOV string handling (host-side side table), and
+epoch-correct invalidation (new appends, refresh/optimize).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exec.executor import Executor
+from hyperspace_tpu.exec.hbm_cache import hbm_cache
+from hyperspace_tpu.exec.mesh_cache import mesh_cache
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.plan.ir import Union
+from hyperspace_tpu.plan.rules.hybrid_scan import parse_hybrid_union
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+from tests.e2e_utils import assert_row_parity
+
+
+@pytest.fixture(autouse=True)
+def _force_residency(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    # tiny fixtures span one 8192-row block: the selectivity gate would
+    # route everything host (frac == 1.0); tests that exercise the gate
+    # re-enable it explicitly
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MAX_BLOCK_FRAC", "1.0")
+    hbm_cache.reset()
+    mesh_cache.reset()
+    yield
+    hbm_cache.reset()
+    mesh_cache.reset()
+
+
+def _source_batch(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 500, n).astype(np.int64),
+            "v": rng.integers(0, 10**6, n).astype(np.int64),
+            "s": rng.choice([b"aa", b"bb", b"cc"], n).astype(object),
+        },
+        {"k": "int64", "v": "int64", "s": "string"},
+    )
+
+
+def _appended_batch(n=300, seed=9, modes=(b"aa", b"zz")):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 500, n).astype(np.int64),
+            "v": rng.integers(0, 10**6, n).astype(np.int64),
+            "s": rng.choice(list(modes), n).astype(object),
+        },
+        {"k": "int64", "v": "int64", "s": "string"},
+    )
+
+
+@pytest.fixture
+def env(tmp_path):
+    """Session + ACTIVE covering index (lineage on, hybrid on) over a
+    3-file source, with one appended file the index has not seen."""
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.INDEX_NUM_BUCKETS: 8,
+            C.INDEX_HYBRID_SCAN_ENABLED: True,
+            C.INDEX_LINEAGE_ENABLED: True,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    src = tmp_path / "data"
+    src.mkdir()
+    batch = _source_batch()
+    per = batch.num_rows // 3
+    for i in range(3):
+        parquet_io.write_parquet(
+            src / f"part-{i}.parquet",
+            batch.take(np.arange(i * per, (i + 1) * per)),
+        )
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("didx", ["k"], ["v", "s"])
+    )
+    parquet_io.write_parquet(src / "part-append.parquet", _appended_batch())
+    session.enable_hyperspace()
+    return session, hs, src
+
+
+def _query(session, src, pred):
+    return (
+        session.read.parquet(str(src)).filter(pred).select("k", "v", "s")
+    )
+
+
+def _hybrid_info(q):
+    plan = q.optimized_plan()
+    unions = plan.collect(lambda n: isinstance(n, Union))
+    assert unions, plan.tree_string()
+    info = parse_hybrid_union(unions[0])
+    assert info is not None
+    return info
+
+
+def _prefetch_both(q, columns):
+    info = _hybrid_info(q)
+    table = hbm_cache.prefetch(info.entry.content.files(), columns)
+    assert table is not None
+    delta = hbm_cache.prefetch_delta(
+        table,
+        info.appended,
+        info.relation,
+        list(info.user_cols),
+        info.deleted_ids,
+    )
+    assert delta is not None
+    return info, table, delta
+
+
+def _off_on(session, q):
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    return off
+
+
+def test_fused_hybrid_append_only_parity_and_zero_per_query_h2d(env):
+    session, hs, src = env
+    q = _query(session, src, col("k") == lit(42))
+    off = _off_on(session, q)
+    _prefetch_both(q, ["k"])
+    h2d_after_populate = metrics.counter("hbm.delta.h2d_bytes")
+    assert h2d_after_populate > 0  # the one-time upload is metered
+    before = metrics.counter("scan.path.resident_hybrid")
+    on = q.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == before + 1
+    assert_row_parity(off, on)
+    # repeat queries pay ZERO H2D: the delta upload counter stays flat
+    for _ in range(3):
+        q.collect()
+    assert metrics.counter("hbm.delta.h2d_bytes") == h2d_after_populate
+    assert metrics.counter("scan.path.resident_hybrid") == before + 4
+    # the gate bypass is observable per kind
+    assert metrics.counter("scan.gate.resident_bypass_hybrid") >= 4
+
+
+def test_fused_hybrid_append_and_delete_filters_deleted_rows(env):
+    session, hs, src = env
+    session.conf.set(C.INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD, 0.6)
+    (src / "part-1.parquet").unlink()
+    q = _query(session, src, col("k") == lit(42))
+    off = _off_on(session, q)
+    info, table, delta = _prefetch_both(q, ["k"])
+    assert info.deleted_ids, "delete must surface lineage ids"
+    assert delta.del_mask is not None, "deletes need the deletion bitmask"
+    before = metrics.counter("scan.path.resident_hybrid")
+    on = q.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == before + 1
+    assert_row_parity(off, on)
+    # deleted rows are actually gone: only parts 0, 2 + append survive
+    batch = _source_batch()
+    per = batch.num_rows // 3
+    keep = np.concatenate([np.arange(0, per), np.arange(2 * per, 3 * per)])
+    surviving = batch.take(keep)
+    ap = _appended_batch()
+    exp = int((surviving.columns["k"].data == 42).sum()) + int(
+        (ap.columns["k"].data == 42).sum()
+    )
+    assert on.num_rows == exp
+
+
+def test_oov_string_equality_exact_and_range_declines(env):
+    session, hs, src = env
+    # "zz" exists ONLY in the appended file — it is out-of-vocab for the
+    # base global vocab and binds through the delta's side table
+    q = _query(session, src, (col("k") >= lit(0)) & (col("s") == lit("zz")))
+    off = _off_on(session, q)
+    _, _, delta = _prefetch_both(q, ["k", "s"])
+    assert len(delta.oov.get("s", ())) == 1  # the side table holds b"zz"
+    before = metrics.counter("scan.path.resident_hybrid")
+    on = q.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == before + 1
+    assert_row_parity(off, on)
+    assert on.num_rows > 0  # OOV rows actually surfaced
+    # a RANGE over the OOV-bearing column cannot ride code space: the
+    # fused path declines and the host union still answers exactly
+    q2 = _query(session, src, (col("k") >= lit(0)) & (col("s") > lit("bb")))
+    off2 = _off_on(session, q2)
+    before = metrics.counter("scan.path.resident_hybrid")
+    on2 = q2.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == before
+    assert metrics.counter("hbm.delta.oov_shape_declined") >= 1
+    assert_row_parity(off2, on2)
+
+
+def test_new_append_changes_epoch_and_repopulates(env):
+    session, hs, src = env
+    q = _query(session, src, col("k") == lit(7))
+    _prefetch_both(q, ["k"])
+    on1 = q.collect()
+    assert metrics.counter("scan.path.resident_hybrid") >= 1
+    # a SECOND append changes the source-snapshot epoch: the stale delta
+    # must never serve (its key cannot match) — the query routes the
+    # host union, schedules repopulation, and the NEXT query re-fuses
+    parquet_io.write_parquet(
+        src / "part-append2.parquet", _appended_batch(n=100, seed=11)
+    )
+    q2 = _query(session, src, col("k") == lit(7))
+    off2 = _off_on(session, q2)
+    before = metrics.counter("scan.path.resident_hybrid")
+    on2 = q2.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == before
+    assert_row_parity(off2, on2)
+    hbm_cache.wait_background(timeout_s=30.0)
+    assert hbm_cache.snapshot()["deltas"] >= 1
+    on3 = q2.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == before + 1
+    assert_row_parity(off2, on3)
+    del on1
+
+
+def test_quick_refresh_keeps_delta_full_refresh_invalidates(env):
+    session, hs, src = env
+    q = _query(session, src, col("k") == lit(42))
+    off = _off_on(session, q)
+    _prefetch_both(q, ["k"])
+    q.collect()
+    assert hbm_cache.snapshot()["deltas"] == 1
+    # QUICK refresh records the delta without touching index data: the
+    # resident base and delta keep serving with zero re-upload (the
+    # promotion path)
+    hs.refresh_index("didx", "quick")
+    assert hbm_cache.snapshot()["deltas"] == 1
+    h2d = metrics.counter("hbm.delta.h2d_bytes")
+    before = metrics.counter("scan.path.resident_hybrid")
+    on = q.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == before + 1
+    assert metrics.counter("hbm.delta.h2d_bytes") == h2d
+    assert_row_parity(off, on)
+    # FULL refresh rewrites index data: deltas invalidate by epoch
+    hs.refresh_index("didx", "full")
+    assert hbm_cache.snapshot()["deltas"] == 0
+    off2 = _off_on(session, q)
+    on2 = q.collect()
+    assert_row_parity(off2, on2)
+
+
+def test_optimize_invalidates_deltas(env):
+    session, hs, src = env
+    q = _query(session, src, col("k") == lit(42))
+    _prefetch_both(q, ["k"])
+    assert hbm_cache.snapshot()["deltas"] == 1
+    hbm_cache.invalidate_deltas()
+    assert hbm_cache.snapshot()["deltas"] == 0
+    assert metrics.counter("hbm.delta.invalidated") >= 1
+
+
+def test_selectivity_gate_routes_broad_hybrid_predicates_host(
+    env, monkeypatch
+):
+    session, hs, src = env
+    # re-arm the gate: a predicate matching every block must not pay the
+    # dispatch — the host union wins when the host reads everything anyway
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MAX_BLOCK_FRAC", "0.9")
+    q = _query(session, src, col("k") >= lit(0))
+    off = _off_on(session, q)
+    _prefetch_both(q, ["k"])
+    before = metrics.counter("scan.path.resident_hybrid")
+    gate_before = metrics.counter("scan.gate.resident_hybrid_selectivity")
+    on = q.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == before
+    assert (
+        metrics.counter("scan.gate.resident_hybrid_selectivity")
+        > gate_before
+    )
+    assert_row_parity(off, on)
+
+
+def test_first_touch_background_population_of_delta(env):
+    session, hs, src = env
+    q = _query(session, src, col("k") == lit(3))
+    # prefetch ONLY the base: the first hybrid query must schedule the
+    # delta upload in the background and serve this query host-side
+    info = _hybrid_info(q)
+    assert hbm_cache.prefetch(info.entry.content.files(), ["k"]) is not None
+    off = _off_on(session, q)
+    before = metrics.counter("scan.path.resident_hybrid")
+    on1 = q.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == before
+    assert_row_parity(off, on1)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if hbm_cache.snapshot()["deltas"]:
+            break
+        time.sleep(0.05)
+    assert hbm_cache.snapshot()["deltas"] == 1
+    on2 = q.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == before + 1
+    assert_row_parity(off, on2)
+
+
+def test_uncoverable_delta_column_memoizes_instead_of_rebuild_loop(env):
+    """An appended value outside the base encoding (int64 beyond i32)
+    makes that column permanently un-encodable for this epoch: the
+    background build must register the PARTIAL delta once and memoize
+    the uncoverable want-set — not reschedule an identical decode+upload
+    rebuild on every query — while queries over the missing column stay
+    on the host union with parity and queries over the covered columns
+    still fuse."""
+    session, hs, src = env
+    parquet_io.write_parquet(
+        src / "part-append-wide.parquet",
+        ColumnarBatch.from_pydict(
+            {
+                "k": np.array([42, 43], dtype=np.int64),
+                "v": np.array([1 << 40, 7], dtype=np.int64),  # beyond i32
+                "s": np.array([b"aa", b"bb"], dtype=object),
+            },
+            {"k": "int64", "v": "int64", "s": "string"},
+        ),
+    )
+    pred = (col("k") == lit(42)) & (col("v") >= lit(0))
+    q = _query(session, src, pred)
+    info = _hybrid_info(q)
+    assert (
+        hbm_cache.prefetch(info.entry.content.files(), ["k", "v"])
+        is not None
+    )
+    off = _off_on(session, q)
+    hyb_before = metrics.counter("scan.path.resident_hybrid")
+    on1 = q.collect()  # schedules the one background build
+    assert_row_parity(off, on1)
+    hbm_cache.wait_background(timeout_s=30.0)
+    snap = hbm_cache.snapshot()
+    assert snap["deltas"] == 1  # the partial (v-less) delta registered
+    assert "v" not in snap["per_delta"][0]["columns"]
+    h2d = metrics.counter("hbm.delta.h2d_bytes")
+    for _ in range(3):
+        on = q.collect()  # must NOT reschedule a rebuild
+        assert_row_parity(off, on)
+    hbm_cache.wait_background(timeout_s=30.0)
+    assert metrics.counter("hbm.delta.h2d_bytes") == h2d, (
+        "uncoverable delta column caused repeated rebuild uploads"
+    )
+    assert metrics.counter("scan.path.resident_hybrid") == hyb_before
+    # the PARTIAL delta still serves k-only predicates
+    qk = _query(session, src, col("k") == lit(42))
+    offk = _off_on(session, qk)
+    onk = qk.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == hyb_before + 1
+    assert_row_parity(offk, onk)
+
+
+def test_refresh_of_another_index_keeps_this_ones_delta(env, tmp_path):
+    """Invalidation is scoped by index: a full refresh of index B must
+    not drop index A's still-valid delta regions."""
+    session, hs, src = env
+    q = _query(session, src, col("k") == lit(42))
+    _prefetch_both(q, ["k"])
+    assert hbm_cache.snapshot()["deltas"] == 1
+    src2 = tmp_path / "data2"
+    src2.mkdir()
+    parquet_io.write_parquet(src2 / "part-0.parquet", _source_batch(seed=7))
+    hs.create_index(
+        session.read.parquet(str(src2)), IndexConfig("other", ["k"], ["v"])
+    )
+    parquet_io.write_parquet(
+        src2 / "part-1.parquet", _appended_batch(seed=8)
+    )
+    hs.refresh_index("other", "full")
+    assert hbm_cache.snapshot()["deltas"] == 1, (
+        "refreshing another index evicted this index's delta"
+    )
+    before = metrics.counter("scan.path.resident_hybrid")
+    q.collect()
+    assert metrics.counter("scan.path.resident_hybrid") == before + 1
+
+
+def test_delta_refused_when_budget_has_no_headroom(env, monkeypatch):
+    """The budget bounds tables AND deltas together: with no headroom
+    left after the resident tables, a delta build refuses BEFORE paying
+    the upload (and registration would refuse it too) — the combined
+    footprint never exceeds HYPERSPACE_TPU_HBM_BUDGET_MB via deltas."""
+    session, hs, src = env
+    q = _query(session, src, col("k") == lit(42))
+    info = _hybrid_info(q)
+    table = hbm_cache.prefetch(info.entry.content.files(), ["k"])
+    assert table is not None
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "0")
+    delta = hbm_cache.prefetch_delta(
+        table,
+        info.appended,
+        info.relation,
+        list(info.user_cols),
+        info.deleted_ids,
+    )
+    assert delta is None
+    assert metrics.counter("hbm.delta.over_budget_refused") >= 1
+    assert hbm_cache.snapshot()["deltas"] == 0
+
+
+def test_drop_base_table_drops_dependent_deltas(env):
+    session, hs, src = env
+    q = _query(session, src, col("k") == lit(42))
+    _, table, _ = _prefetch_both(q, ["k"])
+    assert hbm_cache.snapshot()["deltas"] == 1
+    hbm_cache.drop(table)
+    assert hbm_cache.snapshot()["deltas"] == 0
+
+
+# ---------------------------------------------------------------------------
+# mesh variant: delta shards placed by the build's b % D rule, fused
+# shard_map dispatch, zero per-query H2D
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(8)
+
+
+def test_mesh_fused_hybrid_parity_and_zero_h2d(env, mesh):
+    session, hs, src = env
+    for pred in (
+        col("k") == lit(42),
+        (col("k") >= lit(0)) & (col("s") == lit("zz")),  # OOV equality
+    ):
+        q = _query(session, src, pred)
+        off = _off_on(session, q)
+        info = _hybrid_info(q)
+        entry = info.entry
+        table = mesh_cache.prefetch(
+            entry.content.files(), sorted(pred.columns()), mesh
+        )
+        assert table is not None
+        delta = mesh_cache.prefetch_delta(
+            table,
+            info.appended,
+            info.relation,
+            list(info.user_cols),
+            info.deleted_ids,
+            list(entry.indexed_columns),
+            entry.num_buckets,
+        )
+        assert delta is not None
+        # delta shards honor the build's placement: every delta row's
+        # bucket is owned by its device
+        from hyperspace_tpu.ops.hashing import bucket_ids_host, key_repr
+        from hyperspace_tpu.parallel.mesh import owner_of_bucket
+
+        buckets = bucket_ids_host(
+            [key_repr(delta.host_batch.columns["k"])], entry.num_buckets
+        )
+        for d in range(delta.n_devices):
+            owners = {
+                owner_of_bucket(int(b), delta.n_devices)
+                for b in buckets[delta.dev_idx[d]]
+            }
+            assert owners <= {d}
+        before = metrics.counter("scan.path.resident_hybrid_mesh")
+        h2d_before = metrics.counter("dist.h2d_bytes")
+        on = Executor(session.conf, mesh=mesh, dist_min_rows=0).execute(
+            q.optimized_plan()
+        )
+        assert (
+            metrics.counter("scan.path.resident_hybrid_mesh") == before + 1
+        )
+        assert metrics.counter("dist.h2d_bytes") == h2d_before
+        assert_row_parity(off, on)
+        assert on.num_rows > 0

@@ -426,6 +426,166 @@ def test_serve_wedged_device_mid_serve_degrades_and_answers_from_host(
     server.close()
 
 
+# ---------------------------------------------------------------------------
+# (d) delta residency: device loss DURING background delta population
+#     must leave the hybrid query on the host union path with parity
+#     intact and the resident registry clean; a reset() between schedule
+#     and registration (the epoch guard) must refuse the stale region.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def hybrid_env(tmp_path, monkeypatch):
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.exec.hbm_cache import hbm_cache
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MAX_BLOCK_FRAC", "1.0")
+    hbm_cache.reset()
+    rng = np.random.default_rng(4)
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 2000, 30_000).astype(np.int64),
+            "v": rng.integers(0, 100, 30_000).astype(np.int64),
+        }
+    )
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.INDEX_NUM_BUCKETS: 4,
+            C.INDEX_HYBRID_SCAN_ENABLED: True,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("hfi", ["k"], ["v"])
+    )
+    session.enable_hyperspace()
+    assert hs.prefetch_index("hfi")
+    # the append that makes every query hybrid
+    ap = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 2000, 800).astype(np.int64),
+            "v": rng.integers(0, 100, 800).astype(np.int64),
+        }
+    )
+    parquet_io.write_parquet(src / "part-append.parquet", ap)
+    yield session, src, batch
+    hbm_cache.reset()
+
+
+def test_device_loss_during_delta_population_keeps_host_path_and_clean_registry(
+    hybrid_env, monkeypatch
+):
+    from hyperspace_tpu import ops
+    from hyperspace_tpu.exec.hbm_cache import hbm_cache
+    from hyperspace_tpu.plan.expr import col, lit
+
+    session, src, batch = hybrid_env
+    key = int(batch.columns["k"].data[3])
+    q = lambda: (  # noqa: E731
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(key))
+        .select("k", "v")
+    )
+    session.disable_hyperspace()
+    off = q().collect()
+    session.enable_hyperspace()
+
+    # wedge injection: the delta upload's materializing fence dies the
+    # way a lost tunnel dies — an exception out of the device readback
+    real_fence = ops.fence_chain
+
+    def dead_fence(arrays):
+        raise RuntimeError("DEADLINE_EXCEEDED: device tunnel wedged")
+
+    monkeypatch.setattr(ops, "fence_chain", dead_fence)
+    metrics.reset()
+    # first hybrid query: base resident, delta missing -> schedules the
+    # background population (which will die on the fence) and serves
+    # THIS query from the host union — parity must hold
+    on1 = q().collect()
+    assert sorted(on1.columns["v"].data.tolist()) == sorted(
+        off.columns["v"].data.tolist()
+    )
+    hbm_cache.wait_background(timeout_s=30.0)
+    assert metrics.counter("hbm.delta.transfer_error") >= 1
+    snap = hbm_cache.snapshot()
+    assert snap["deltas"] == 0, "half-built delta leaked into the registry"
+    assert snap["tables"] == 1, "base table must survive a delta failure"
+    assert metrics.counter("scan.path.resident_hybrid") == 0
+    # the failure is TRANSIENT (not memoized): with the device healthy
+    # again, the next touch repopulates and the query re-fuses
+    monkeypatch.setattr(ops, "fence_chain", real_fence)
+    on2 = q().collect()  # schedules a fresh population
+    assert sorted(on2.columns["v"].data.tolist()) == sorted(
+        off.columns["v"].data.tolist()
+    )
+    hbm_cache.wait_background(timeout_s=30.0)
+    assert hbm_cache.snapshot()["deltas"] == 1
+    on3 = q().collect()
+    assert metrics.counter("scan.path.resident_hybrid") == 1
+    assert sorted(on3.columns["v"].data.tolist()) == sorted(
+        off.columns["v"].data.tolist()
+    )
+
+
+def test_reset_epoch_guard_refuses_stale_delta_registration(
+    hybrid_env, monkeypatch
+):
+    """A reset() between scheduling and registration must win: the slow
+    background build's region lands against a bumped epoch and is
+    refused (the same guard the base tables use)."""
+    from hyperspace_tpu.exec.hbm_cache import hbm_cache
+    from hyperspace_tpu.plan.expr import col, lit
+    from hyperspace_tpu.plan.ir import Union
+    from hyperspace_tpu.plan.rules.hybrid_scan import parse_hybrid_union
+    from hyperspace_tpu.storage import parquet_io as pio
+
+    session, src, batch = hybrid_env
+    key = int(batch.columns["k"].data[3])
+    q = (
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(key))
+        .select("k", "v")
+    )
+    union = q.optimized_plan().collect(lambda n: isinstance(n, Union))[0]
+    info = parse_hybrid_union(union)
+    table = hbm_cache.resident_for(info.entry.content.files(), ["k"])
+    assert table is not None
+
+    gate = threading.Event()
+    release = threading.Event()
+    real_read = pio.read_relation
+
+    def slow_read(*a, **kw):
+        gate.set()
+        assert release.wait(30.0)
+        return real_read(*a, **kw)
+
+    monkeypatch.setattr(pio, "read_relation", slow_read)
+    hbm_cache.note_touch_delta(
+        table, info.appended, info.relation, list(info.user_cols), ()
+    )
+    assert gate.wait(10.0)  # the background build is inside the read
+    hbm_cache.reset()  # bumps the epoch while the build is in flight
+    release.set()
+    hbm_cache.wait_background(timeout_s=30.0)
+    assert hbm_cache.snapshot()["deltas"] == 0, (
+        "stale delta registered across a reset()"
+    )
+
+
 def test_serve_deviceprobe_latch_degrades_before_any_serve_failure(
     serve_env, monkeypatch
 ):

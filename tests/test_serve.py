@@ -202,6 +202,105 @@ def test_plan_cache_hits_repeat_queries_and_invalidates_on_index_change(env):
     server.close()
 
 
+def test_plan_cache_invalidated_by_source_append_and_delete(env):
+    """Regression (delta residency round): a cached plan must be
+    invalidated when SOURCE files are appended or deleted between
+    submits — the source-snapshot epoch participates in the signature
+    (plan_signature bakes every leaf relation's file identity snapshot),
+    not just index-log version bumps. Without this, a server would keep
+    serving the pre-append plan and silently drop appended rows."""
+    from hyperspace_tpu.plan.ir import Union as UnionNode
+
+    session, hs, src, batch = env
+    session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+    server = QueryServer(session, ServeConfig(max_workers=1))
+    key = int(batch.columns["k"].data[7])
+    metrics.reset()
+    r1 = server.submit(_lookup(session, src, key)).result(timeout=120)
+    assert metrics.counter("serve.plan_cache.miss") == 1
+    r2 = server.submit(_lookup(session, src, key)).result(timeout=120)
+    assert metrics.counter("serve.plan_cache.hit") == 1
+    assert _sorted_rows(r1) == _sorted_rows(r2)
+    # APPEND between submits: the fresh snapshot must MISS the cache and
+    # replan as a hybrid union whose results include the appended rows
+    appended = _source(2000, seed=5)
+    parquet_io.write_parquet(src / "part-append.parquet", appended)
+    hits = metrics.counter("serve.plan_cache.hit")
+    t3 = server.submit(_lookup(session, src, key))
+    r3 = t3.result(timeout=120)
+    assert metrics.counter("serve.plan_cache.hit") == hits  # no stale hit
+    assert metrics.counter("serve.plan_cache.miss") >= 2
+    plan3 = server.plan_cache.optimized_plan(_lookup(session, src, key))
+    assert plan3.collect(lambda n: isinstance(n, UnionNode))
+    extra = int((appended.columns["k"].data == key).sum())
+    assert r3.num_rows == r1.num_rows + extra
+    # REPLACE the appended file (same name, new size/mtime — the file-
+    # level delta epoch moves): yet another distinct snapshot, a miss
+    misses = metrics.counter("serve.plan_cache.miss")
+    appended2 = _source(500, seed=6)
+    parquet_io.write_parquet(src / "part-append.parquet", appended2)
+    r4 = server.submit(_lookup(session, src, key)).result(timeout=120)
+    assert metrics.counter("serve.plan_cache.miss") == misses + 1
+    extra2 = int((appended2.columns["k"].data == key).sum())
+    assert r4.num_rows == r1.num_rows + extra2
+    # DELETE the appended file: the snapshot returns to the ORIGINAL,
+    # and the ORIGINAL cached plan serves again — neither direction ever
+    # serves a stale snapshot's plan
+    hits2 = metrics.counter("serve.plan_cache.hit")
+    (src / "part-append.parquet").unlink()
+    r5 = server.submit(_lookup(session, src, key)).result(timeout=120)
+    assert metrics.counter("serve.plan_cache.hit") == hits2 + 1
+    assert _sorted_rows(r5) == _sorted_rows(r1)
+    server.close()
+
+
+def test_hybrid_burst_coalesces_into_one_fused_dispatch(env):
+    """Delta residency: a burst of compatible HYBRID lookups (appended
+    source file, base + delta resident) coalesces into ONE stacked
+    base+delta device dispatch — hybrid unions no longer fall off the
+    micro-batched fast path."""
+    from hyperspace_tpu.plan.ir import Union as UnionNode
+    from hyperspace_tpu.plan.rules.hybrid_scan import parse_hybrid_union
+
+    session, hs, src, batch = env
+    session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+    parquet_io.write_parquet(
+        src / "part-append.parquet", _source(2000, seed=5)
+    )
+    keys = [int(batch.columns["k"].data[i]) for i in range(0, 160, 20)]
+    queries = [_lookup(session, src, k) for k in keys]
+    serial = [q.collect() for q in queries]
+    # make base + delta resident (prefetch is synchronous)
+    plan = queries[0].optimized_plan()
+    union = plan.collect(lambda n: isinstance(n, UnionNode))[0]
+    info = parse_hybrid_union(union)
+    table = hbm_cache.prefetch(info.entry.content.files(), ["k"])
+    assert table is not None
+    assert (
+        hbm_cache.prefetch_delta(
+            table,
+            info.appended,
+            info.relation,
+            list(info.user_cols),
+            info.deleted_ids,
+        )
+        is not None
+    )
+    metrics.reset()
+    server = QueryServer(session, ServeConfig(max_workers=2, autostart=False))
+    tickets = [server.submit(q) for q in queries]
+    server.start()
+    results = [t.result(timeout=120) for t in tickets]
+    for s, r in zip(serial, results):
+        assert _sorted_rows(s) == _sorted_rows(r)
+    stats = server.stats()
+    assert stats["batch_dispatches"] == 1
+    assert metrics.counter("serve.batch.queries") == len(keys)
+    assert metrics.counter("scan.path.resident_hybrid") == len(keys)
+    assert all(t.batch_size == len(keys) for t in tickets)
+    server.close()
+
+
 def test_plan_signature_distinguishes_file_snapshots(env):
     """Same paths + same file count but different file identity must not
     collide (tree_string alone shows only counts)."""
