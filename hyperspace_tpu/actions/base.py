@@ -12,9 +12,17 @@ Parity: com/microsoft/hyperspace/actions/Action.scala:34-104. ``run()``:
   4. ``end()`` — write the *final*-state entry at ``base_id + 2`` and
      recreate ``latestStable`` (Action.scala:59-74).
 
-A crash between begin and end leaves the transient state in the log; all
-further modifying actions refuse in validate() until ``cancel()`` rolls the
-index back to its last stable state (SURVEY.md §5.3).
+Crash consistency (reliability/): ``_begin()`` also acquires a
+heartbeated writer lease next to the log; ``_end()`` refuses to commit
+if the lease was fenced (a newer epoch exists — the writer stalled past
+its lease and recovery or a new writer took over). A writer that FAILS
+in-process marks its lease aborted and leaves the transient entry for
+manual ``cancel()`` (the reference's contract — an operator saw the
+exception); a writer that DIES leaves its lease to expire, and
+``run()``'s pre-validate recovery consult rolls the index back to its
+last stable state automatically (recovery.py), so a crash between begin
+and end no longer wedges the index until a human intervenes
+(SURVEY.md §5.3 upgraded).
 """
 
 from __future__ import annotations
@@ -34,9 +42,16 @@ from . import states
 
 
 class Action(EventLogging):
+    # CancelAction opts out: it must operate ON the transient state
+    # (auto-recovering first would leave it nothing to cancel), and it is
+    # the break-glass that may fence a LIVE lease (force).
+    auto_recover = True
+    lease_force = False
+
     def __init__(self, log_manager: IndexLogManager):
         self.log_manager = log_manager
         self._base_id: Optional[int] = None
+        self._held_lease = None
 
     # -- to be provided by subclasses ---------------------------------------
     @property
@@ -76,8 +91,42 @@ class Action(EventLogging):
         if ev is not None and hasattr(self, "conf"):
             self.log_event(self.conf, ev)  # type: ignore[attr-defined]
 
+    # -- leasing (reliability/lease.py) --------------------------------------
+    def _lease_manager(self):
+        """LeaseManager for this index, or None when the log manager has
+        no filesystem/path surface (bare test fakes keep the pre-lease
+        protocol)."""
+        index_path = getattr(self.log_manager, "index_path", None)
+        fs = getattr(self.log_manager, "_fs", None)
+        if index_path is None or fs is None:
+            return None
+        from ..reliability.lease import LeaseManager
+
+        return LeaseManager(index_path, fs)
+
+    def _lease_duration_s(self) -> float:
+        conf = getattr(self, "conf", None)
+        if conf is not None and hasattr(conf, "lease_duration_seconds"):
+            return conf.lease_duration_seconds()
+        from ..reliability.lease import DEFAULT_LEASE_DURATION_S
+
+        return DEFAULT_LEASE_DURATION_S
+
     def run(self) -> None:
         """(Action.scala:83-104)."""
+        if self.auto_recover:
+            from ..reliability.recovery import maybe_auto_recover
+
+            if maybe_auto_recover(
+                self.log_manager,
+                data_manager=getattr(self, "data_manager", None),
+                conf=getattr(self, "conf", None),
+            ):
+                # the log changed under us: re-snapshot the base id and
+                # any cached previous entry before validating
+                self._base_id = None
+                if hasattr(self, "_previous"):
+                    self._previous = None
         try:
             self.validate()
         except NoChangesException:
@@ -90,7 +139,15 @@ class Action(EventLogging):
             self._end()
         except Exception:
             self._emit("Operation failed.")
+            # in-process failure: an operator saw this exception, so the
+            # transient entry stays for manual cancel(); the aborted
+            # tombstone tells recovery NOT to treat it as a dead writer.
+            # (A real crash never reaches this line — its lease expires.)
+            if self._held_lease is not None:
+                self._held_lease.abort()
             raise
+        if self._held_lease is not None:
+            self._held_lease.release()
         self._emit("Operation succeeded.")
 
     def _stamp(self, entry: LogEntry, id: int, state: str) -> LogEntry:
@@ -100,6 +157,13 @@ class Action(EventLogging):
         return entry
 
     def _begin(self) -> None:
+        manager = self._lease_manager()
+        if manager is not None:
+            self._held_lease = manager.acquire(
+                duration_s=self._lease_duration_s(),
+                action=type(self).__name__,
+                force=self.lease_force,
+            )
         entry = self._stamp(self.log_entry(), self.base_id + 1, self.transient_state)
         if not self.log_manager.write_log(entry.id, entry):
             raise ConcurrentModificationException(
@@ -108,6 +172,11 @@ class Action(EventLogging):
             )
 
     def _end(self) -> None:
+        if self._held_lease is not None:
+            # fencing: a writer that stalled past its lease finds a newer
+            # epoch (or its own tombstone) and must NOT commit — the index
+            # was recovered or claimed while it slept
+            self._held_lease.check_fenced()
         entry = self._stamp(self.log_entry(), self.base_id + 2, self.final_state)
         if not self.log_manager.write_log(entry.id, entry):
             raise ConcurrentModificationException(

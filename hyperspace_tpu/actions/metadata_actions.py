@@ -100,6 +100,13 @@ class CancelAction(IndexAction):
     restored entry does not reference (the committed versions' data is
     never touched)."""
 
+    # cancel IS the recovery: it operates on the transient state (a prior
+    # auto-recovery would leave nothing to cancel) and may fence a LIVE
+    # lease — the operator's break-glass against a stalled-but-beating
+    # writer (reliability/lease.py).
+    auto_recover = False
+    lease_force = True
+
     def __init__(
         self,
         log_manager: IndexLogManager,

@@ -16,6 +16,7 @@ from .hs004_swallowed_exceptions import SwallowedExceptionRule
 from .hs005_nondeterministic_hashing import NondeterministicHashRule
 from .hs006_unbounded_cache import UnboundedCacheRule
 from .hs007_unfenced_device_timing import UnfencedDeviceTimingRule
+from .hs008_raw_metadata_write import RawMetadataWriteRule
 
 REGISTRY: List[Rule] = [
     HostSyncRule(),
@@ -25,6 +26,7 @@ REGISTRY: List[Rule] = [
     NondeterministicHashRule(),
     UnboundedCacheRule(),
     UnfencedDeviceTimingRule(),
+    RawMetadataWriteRule(),
 ]
 
 __all__ = [
@@ -36,4 +38,5 @@ __all__ = [
     "NondeterministicHashRule",
     "UnboundedCacheRule",
     "UnfencedDeviceTimingRule",
+    "RawMetadataWriteRule",
 ]

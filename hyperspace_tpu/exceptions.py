@@ -19,3 +19,33 @@ class ConcurrentModificationException(HyperspaceException):
     """Raised when an action loses the optimistic-concurrency race on the
     operation log (reference: Action.scala:78-80, "Could not acquire proper
     state" on a failed write_log of the transient entry)."""
+
+
+class LeaseFencedError(ConcurrentModificationException):
+    """Raised when a writer discovers it has been fenced: its lease epoch
+    was superseded (the writer stalled past its lease and a newer writer
+    — or crash recovery — claimed the next epoch). The fenced writer's
+    ``end()`` must refuse to commit (reliability/lease.py)."""
+
+
+# -- storage error taxonomy (reliability/retry.py classifies against these) ---
+class StorageError(HyperspaceException):
+    """Base for classified storage failures on the FileSystem seam."""
+
+
+class TransientStorageError(StorageError):
+    """A failure worth retrying: flaky RPC, timeout, connection reset,
+    throttling. The RetryingFileSystem retries these with bounded
+    exponential backoff; everything else propagates immediately."""
+
+
+class PermanentStorageError(StorageError):
+    """A failure retrying cannot fix: bad request, auth, or a protocol
+    *result* misdelivered as an error. Never retried."""
+
+
+class PreconditionFailedError(PermanentStorageError):
+    """A generation-preconditioned write lost: the object changed under
+    the writer (GCS 412 outside the create_if_absent claim path). This is
+    how a fenced/stale writer's overwrite is refused instead of silently
+    clobbering newer state (storage/filesystem.py write preconditions)."""

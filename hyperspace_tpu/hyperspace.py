@@ -79,6 +79,14 @@ class Hyperspace:
         docs/05-scale-and-distribution.md "HBM residency"."""
         return self._manager.prefetch(name, columns)
 
+    def doctor(self, repair: bool = False):
+        """fsck the index system path (reliability.doctor): log-chain
+        integrity, data-file presence vs. log content, crash litter.
+        ``repair=True`` auto-rolls-back abandoned writers and vacuums
+        orphaned artifacts. Returns a DoctorReport whose ``ok`` property
+        is the zero-inconsistencies verdict (docs/12-reliability.md)."""
+        return self.session.doctor(repair=repair)
+
     def serve(self, **options):
         """The session's QueryServer (serve.QueryServer): bounded-queue
         admission, per-query deadlines, micro-batched resident scans and

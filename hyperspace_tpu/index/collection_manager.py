@@ -55,10 +55,28 @@ class IndexCollectionManager:
         self.session = session
         self.conf = session.conf
         self.path_resolver = PathResolver(self.conf)
+        # session-attach recovery: the first enumeration through this
+        # manager sweeps for abandoned writers (transient head + expired
+        # lease) and rolls them back, so a process that died mid-action
+        # heals on the next session that LOOKS at the indexes — queries
+        # and listings included, not just modifying verbs (which
+        # self-heal in Action.run)
+        self._attach_recovery_done = False
+
+    def _attach_recovery(self) -> None:
+        if self._attach_recovery_done:
+            return
+        self._attach_recovery_done = True
+        from ..reliability.recovery import recover_abandoned_indexes
+
+        recover_abandoned_indexes(self.path_resolver.system_path, self.conf)
 
     # -- per-index managers ---------------------------------------------------
     def _log_manager(self, name: str) -> IndexLogManagerImpl:
-        return IndexLogManagerImpl(self.path_resolver.get_index_path(name))
+        return IndexLogManagerImpl(
+            self.path_resolver.get_index_path(name),
+            retry_policy=self.conf.retry_policy(),
+        )
 
     def _data_manager(self, name: str) -> IndexDataManagerImpl:
         return IndexDataManagerImpl(self.path_resolver.get_index_path(name))
@@ -171,6 +189,7 @@ class IndexCollectionManager:
         index. Stable == latest when the latest state is already stable,
         so the extra latestStable read happens only for in-flight
         writers."""
+        self._attach_recovery()
         out = []
         root = self.path_resolver.system_path
         if not root.is_dir():

@@ -45,7 +45,7 @@ from typing import Dict, List, Optional
 
 from ..exceptions import HyperspaceException
 from ..storage.columnar import ColumnarBatch
-from ..telemetry.metrics import metrics
+from ..telemetry.metrics import metrics, reliability_snapshot
 from . import batcher
 from .plan_cache import PlanCache
 
@@ -83,6 +83,11 @@ class ServeConfig:
     # tests construct paused servers (submit a burst, then start()) to
     # make coalescing deterministic; production keeps the default
     autostart: bool = True
+    # how often the submit path consults crash recovery: at most one
+    # background sweep per interval rolls back abandoned writers
+    # (transient log head + expired lease) so a serving process heals
+    # indexes a dead builder left wedged. <= 0 disables.
+    recovery_sweep_interval_s: float = 60.0
 
 
 class QueryTicket:
@@ -157,6 +162,9 @@ class QueryServer:
         self._latencies: "deque[float]" = deque(maxlen=4096)
         self._waits: "deque[float]" = deque(maxlen=4096)
         self._ewma_service_s = 0.01
+        self._recovery_sweeps = 0
+        self._recovered_indexes = 0
+        self._next_recovery_sweep = 0.0  # monotonic; 0 = sweep on first submit
         if self.config.autostart:
             self.start()
 
@@ -211,6 +219,10 @@ class QueryServer:
         deadline_at = (
             time.monotonic() + deadline_s if deadline_s is not None else None
         )
+        # recovery consulted on submit: a throttled background sweep heals
+        # indexes whose writer died (the serving process is often the only
+        # long-lived process around to notice)
+        self._maybe_recovery_sweep()
         # plan + batchability resolved at submit time: the plan cache
         # makes repeats ~two dict probes, and classified requests let the
         # worker's coalescing scan stay a pure queue walk under the lock
@@ -248,6 +260,41 @@ class QueryServer:
             self._cond.notify()
         metrics.incr("serve.submitted")
         return ticket
+
+    def _maybe_recovery_sweep(self) -> None:
+        interval = self.config.recovery_sweep_interval_s
+        if interval is None or interval <= 0:
+            return
+        now = time.monotonic()
+        with self._cond:
+            if now < self._next_recovery_sweep:
+                return
+            self._next_recovery_sweep = now + interval
+        threading.Thread(
+            target=self._recovery_sweep, daemon=True, name="hyperspace-serve-recovery"
+        ).start()
+
+    def _recovery_sweep(self) -> None:
+        from ..reliability.recovery import recover_abandoned_indexes
+
+        try:
+            n = recover_abandoned_indexes(
+                self.session.conf.system_path(), self.session.conf
+            )
+        except Exception:  # noqa: BLE001
+            # counted, not raised: a failed sweep must never take down
+            # serving — the next interval retries
+            metrics.incr("serve.recovery_sweep_error")
+            return
+        metrics.incr("serve.recovery_sweep")
+        with self._cond:
+            self._recovery_sweeps += 1
+            self._recovered_indexes += n
+        if n:
+            # recovered indexes changed the log: cached plans may bind to
+            # rolled-back versions, and the TTL catalog cache may hold
+            # the transient view
+            self.session.collection_manager.clear_cache()
 
     def _retry_after_locked(self) -> float:
         backlog = len(self._queue) / max(self.config.max_workers, 1)
@@ -459,6 +506,14 @@ class QueryServer:
                 if self._dispatches
                 else None,
                 "plan_cache": self.plan_cache.snapshot(),
+                # reliability surface: what the lifecycle layer absorbed
+                # (retries) and healed (rollbacks) while this server ran
+                # — THIS server's sweeps plus the process-wide counters
+                "reliability": {
+                    "server_recovery_sweeps": self._recovery_sweeps,
+                    "recovered_indexes": self._recovered_indexes,
+                    **reliability_snapshot(),
+                },
             }
             if lat:
                 out["latency_p50_ms"] = round(

@@ -156,6 +156,16 @@ class HyperspaceSession:
         the QueryTicket."""
         return self.serve().submit(df, deadline_s=deadline_s)
 
+    def doctor(self, repair: bool = False):
+        """fsck this session's index system path: verify log-chain
+        integrity, data-file presence, and crash litter (orphaned temp
+        files, torn builds, stale leases); ``repair=True`` rolls back
+        abandoned writers and vacuums orphans. Returns a DoctorReport
+        (reliability.doctor, docs/12-reliability.md)."""
+        from .reliability.doctor import doctor
+
+        return doctor(self.conf.system_path(), repair=repair, conf=self.conf)
+
     def table(self, name: str):
         """DataFrame over a registered view or table (Catalog.table)."""
         return self.catalog.table(name)

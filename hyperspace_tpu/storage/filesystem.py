@@ -30,10 +30,18 @@ import threading
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
+from ..exceptions import PreconditionFailedError
+
 
 class FileSystem:
     """Minimal byte-blob storage interface — everything the operation log
     and the TCB layout need."""
+
+    # True on backends whose ``write`` honors ``if_generation_match`` and
+    # whose ``generation`` returns a monotonic per-object counter. Writers
+    # that fence via preconditions (the lease heartbeat) consult this and
+    # fall back to unconditioned writes elsewhere.
+    supports_generation_preconditions = False
 
     def create_if_absent(self, path: str, data: bytes) -> bool:
         """Atomically create ``path`` iff it does not exist (the OCC
@@ -45,8 +53,17 @@ class FileSystem:
         claims would both report winning."""
         raise NotImplementedError
 
-    def write(self, path: str, data: bytes) -> None:
-        """Atomic whole-object write (overwrite allowed)."""
+    def write(self, path: str, data: bytes, *, if_generation_match=None) -> None:
+        """Atomic whole-object write (overwrite allowed).
+
+        ``if_generation_match`` (backends with
+        ``supports_generation_preconditions``): the write applies only if
+        the object's current generation equals the given value — a
+        mismatch raises PreconditionFailedError, a classified PERMANENT
+        error. This is how a fenced/stale writer is refused instead of
+        silently overwriting newer state. Backends without generations
+        raise PreconditionFailedError for any non-None precondition
+        rather than pretending to honor it."""
         raise NotImplementedError
 
     def read(self, path: str, offset: int = 0, length: Optional[int] = None) -> bytes:
@@ -74,6 +91,8 @@ class PosixFileSystem(FileSystem):
     rename overwrites, so it cannot claim)."""
 
     def create_if_absent(self, path: str, data: bytes) -> bool:
+        from ..exceptions import TransientStorageError
+
         target = Path(path)
         target.parent.mkdir(parents=True, exist_ok=True)
         tmp = target.parent / f".{target.name}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
@@ -83,15 +102,37 @@ class PosixFileSystem(FileSystem):
             return True
         except FileExistsError:
             return False
+        except FileNotFoundError as e:
+            # our temp vanished between write and link: an external
+            # sweeper (crash-litter GC) mistook it for an orphan. The
+            # claim itself was never attempted — classify transient so
+            # the retry layer simply re-runs it with a fresh temp.
+            raise TransientStorageError(
+                f"claim temp for {path} swept mid-claim; retry"
+            ) from e
         finally:
             tmp.unlink(missing_ok=True)
 
-    def write(self, path: str, data: bytes) -> None:
+    def write(self, path: str, data: bytes, *, if_generation_match=None) -> None:
+        if if_generation_match is not None:
+            raise PreconditionFailedError(
+                "PosixFileSystem has no object generations; preconditioned "
+                "writes are refused rather than silently unguarded."
+            )
+        from ..exceptions import TransientStorageError
+
         target = Path(path)
         target.parent.mkdir(parents=True, exist_ok=True)
         tmp = target.parent / f".{target.name}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
-        tmp.write_bytes(data)
-        os.replace(tmp, target)
+        try:
+            tmp.write_bytes(data)
+            os.replace(tmp, target)
+        except FileNotFoundError as e:
+            # temp swept by an external GC mid-write: transient, retry
+            # re-runs with a fresh temp (see create_if_absent)
+            raise TransientStorageError(
+                f"write temp for {path} swept mid-write; retry"
+            ) from e
 
     def read(self, path: str, offset: int = 0, length: Optional[int] = None) -> bytes:
         with open(path, "rb") as f:
@@ -123,8 +164,17 @@ class FakeGcsFileSystem(FileSystem):
     * every object carries a generation number bumped on each overwrite;
     * ``create_if_absent`` is an upload with ``ifGenerationMatch=0``:
       atomic under the store's lock, exactly one concurrent creator wins —
-      the linearizable claim the log protocol needs without any rename.
+      the linearizable claim the log protocol needs without any rename;
+    * ``write`` honors ``if_generation_match=N`` the same way real GCS
+      does — a mismatch is HTTP 412, surfaced here as the classified
+      PreconditionFailedError. Before this, a fenced/stale writer's
+      ``write`` silently overwrote whatever a newer epoch had written,
+      which is exactly the lost-update the generation machinery exists
+      to prevent (and ``create_if_absent``'s own precondition already
+      prevented for creates).
     """
+
+    supports_generation_preconditions = True
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -142,10 +192,15 @@ class FakeGcsFileSystem(FileSystem):
             self._objects[k] = (bytes(data), 1)
             return True
 
-    def write(self, path: str, data: bytes) -> None:
+    def write(self, path: str, data: bytes, *, if_generation_match=None) -> None:
         k = self._key(path)
         with self._lock:
             gen = self._objects.get(k, (b"", 0))[1]
+            if if_generation_match is not None and gen != int(if_generation_match):
+                raise PreconditionFailedError(
+                    f"generation precondition failed for {path}: "
+                    f"expected {if_generation_match}, at {gen}"
+                )
             self._objects[k] = (bytes(data), gen + 1)
 
     def generation(self, path: str) -> int:

@@ -38,12 +38,22 @@ import urllib.parse
 import urllib.request
 from typing import Callable, List, Optional
 
+from ..exceptions import PreconditionFailedError
+from ..telemetry.metrics import metrics
 from .filesystem import FileSystem
 
 _RETRYABLE = {429, 500, 502, 503, 504}
 
 
 class GcsFileSystem(FileSystem):
+    supports_generation_preconditions = True
+    # every RPC already retries transient statuses/socket failures inside
+    # _request (with self-win handling on claims); the seam-level
+    # RetryingFileSystem must not wrap another retry loop around it —
+    # that would multiply attempts (~max_retries²) and compound backoff
+    # during an outage (reliability.retry.wrap_with_retries honors this)
+    has_internal_retries = True
+
     def __init__(
         self,
         bucket: str,
@@ -51,12 +61,21 @@ class GcsFileSystem(FileSystem):
         token_provider: Optional[Callable[[], str]] = None,
         timeout: float = 30.0,
         max_retries: int = 4,
+        retry_policy=None,
     ):
+        from ..reliability.retry import RetryPolicy
+
         self.bucket = bucket
         self.endpoint = endpoint.rstrip("/")
         self.token_provider = token_provider
         self.timeout = timeout
         self.max_retries = max_retries
+        # shared backoff shape with the seam-level RetryingFileSystem:
+        # bounded exponential + deterministic jitter keyed on the URL, so
+        # a herd of clients hammering one hot object de-synchronizes
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=max_retries + 1
+        )
 
     # -- plumbing ------------------------------------------------------------
     def _key(self, path: str) -> str:
@@ -114,7 +133,9 @@ class GcsFileSystem(FileSystem):
                         # a proxy) applied the upload — claims must run
                         # self-win detection on the retry's 412 too
                         retried_out.append(True)
-                    time.sleep(0.05 * (2**attempt))
+                    metrics.incr("storage.retry.attempts")
+                    metrics.incr("storage.retry.gcs_http")
+                    time.sleep(self.retry_policy.delay_for(attempt + 1, url))
                     continue
                 raise OSError(
                     f"GCS {method} {url} -> {e.code}: {body[:200]!r}"
@@ -132,7 +153,9 @@ class GcsFileSystem(FileSystem):
                     last = e
                     if retried_out is not None:
                         retried_out.append(True)
-                    time.sleep(0.05 * (2**attempt))
+                    metrics.incr("storage.retry.attempts")
+                    metrics.incr("storage.retry.gcs_conn")
+                    time.sleep(self.retry_policy.delay_for(attempt + 1, url))
                     continue
                 raise OSError(f"GCS {method} {url} unreachable: {e}") from e
         raise OSError(f"GCS {method} {url} failed after retries: {last}")
@@ -183,13 +206,33 @@ class GcsFileSystem(FileSystem):
                 return False
         return False
 
-    def write(self, path: str, data: bytes) -> None:
-        self._request(
+    def write(self, path: str, data: bytes, *, if_generation_match=None) -> None:
+        params = {}
+        if if_generation_match is not None:
+            params["ifGenerationMatch"] = int(if_generation_match)
+        retried: list = []
+        status, _ = self._request(
             "POST",
-            self._upload_url(self._key(path)),
+            self._upload_url(self._key(path), **params),
             data=bytes(data),
             headers={"Content-Type": "application/octet-stream"},
+            expect=(412,) if if_generation_match is not None else (),
+            retried_out=retried,
         )
+        if status == 412:
+            if retried:
+                # self-win detection (same as create_if_absent): a reset
+                # AFTER the server applied our preconditioned write makes
+                # the retry see 412 against its own generation bump
+                try:
+                    if self.read(path) == bytes(data):
+                        return
+                except FileNotFoundError:
+                    pass
+            raise PreconditionFailedError(
+                f"generation precondition failed for {path}: "
+                f"expected {if_generation_match}"
+            )
 
     def read(self, path: str, offset: int = 0, length: Optional[int] = None) -> bytes:
         if length == 0:

@@ -128,3 +128,26 @@ class MetricsRegistry:
 
 
 metrics = MetricsRegistry()
+
+
+def reliability_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, int]:
+    """The crash-consistency counter family in one dict — what the
+    reliability layer absorbed (storage retries), refused (fenced
+    writers), and healed (auto-rollbacks, swept crash litter). Consumed
+    by ``QueryServer.stats()["reliability"]`` and handy for dashboards;
+    the same counters mirror into per-query ``scoped()`` children, so
+    ``explain(verbose)`` shows a query's own share when its execution
+    paid a retry (docs/12-reliability.md)."""
+    r = registry if registry is not None else metrics
+    return {
+        "storage_retry_attempts": r.counter("storage.retry.attempts"),
+        "storage_retry_exhausted": r.counter("storage.retry.exhausted"),
+        "claim_self_wins": r.counter("storage.retry.claim_self_win"),
+        "auto_rollbacks": r.counter("recovery.auto_rollback"),
+        "recovery_sweeps": r.counter("recovery.sweep"),
+        "orphan_tmp_swept": r.counter("recovery.orphan_tmp_swept"),
+        "fenced_writers": r.counter("lease.fenced_writer_refused"),
+        "lease_heartbeat_errors": r.counter("lease.heartbeat_error"),
+        "doctor_issues_found": r.counter("doctor.issues_found"),
+        "doctor_issues_repaired": r.counter("doctor.issues_repaired"),
+    }

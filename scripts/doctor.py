@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""doctor CLI — fsck index directories for crash litter and log damage.
+
+Usage:
+    python scripts/doctor.py indexes/                 # scan, human output
+    python scripts/doctor.py indexes/myidx --json     # one index, JSON
+    python scripts/doctor.py indexes/ --repair        # fix what's fixable
+
+Scan mode is read-only: it reports log-chain gaps/corruption, bad
+latestStable copies, abandoned/stuck writers, missing data files, and
+orphaned artifacts (failed-build version dirs, spill scratch, crashed
+atomic_create temp files, superseded lease epochs). ``--repair`` rolls
+back abandoned writers to the last stable state, rebuilds latestStable,
+and vacuums orphans — then the same scan reports clean.
+
+Exit status: 0 when no unrepaired inconsistencies remain, 1 otherwise
+(2 on usage error). ``--json`` emits the DoctorReport as JSON on stdout
+for CI consumption.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+# runnable straight from a checkout without an installed package
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(_REPO_ROOT))
+
+from hyperspace_tpu.reliability.doctor import doctor  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="doctor",
+        description="fsck for hyperspace index directories "
+        "(log-chain integrity, data presence, crash litter)",
+    )
+    ap.add_argument(
+        "path",
+        help="an index system path (holding index dirs) or one index dir",
+    )
+    ap.add_argument(
+        "--repair",
+        action="store_true",
+        help="roll back abandoned writers, rebuild latestStable, vacuum orphans",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="emit the report as JSON"
+    )
+    args = ap.parse_args(argv)
+
+    report = doctor(args.path, repair=args.repair)
+
+    if args.json:
+        print(json.dumps(report.to_json_dict(), indent=2, sort_keys=True))
+    else:
+        print(
+            f"doctor: {report.indexes_checked} index(es) under {report.root}"
+        )
+        for issue in report.issues:
+            tag = (
+                "info"
+                if issue.informational
+                else ("repaired" if issue.repaired else "ISSUE")
+            )
+            print(
+                f"  [{tag}] {issue.index}: {issue.kind} at {issue.path} — "
+                f"{issue.detail}"
+            )
+        bad = report.inconsistencies
+        print(
+            f"doctor: {len(bad)} unrepaired inconsistencie(s)"
+            + ("" if bad else " — clean")
+        )
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

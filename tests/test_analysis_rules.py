@@ -510,3 +510,59 @@ def test_suppressed_findings_are_reported_not_dropped():
     s = summarize(findings)
     assert s["suppressed"] == 1 and s["unsuppressed"] == 0
     assert "(suppressed)" in findings[0].render()
+
+
+# --- HS008: raw fs.write of log/metadata paths ------------------------------
+def test_hs008_fires_on_raw_metadata_write():
+    src = """
+    from .. import constants as C
+
+    class Mgr:
+        def bad(self, entry):
+            self._fs.write(str(self._log_dir / "latestStable"), entry)
+
+        def also_bad(self, data):
+            self._fs.write(self._path_of(3), data)
+    """
+    assert codes(run(src), "HS008") == ["HS008", "HS008"]
+
+
+def test_hs008_precondition_or_claim_is_clean():
+    src = """
+    class Mgr:
+        def guarded(self, path, data, gen):
+            self._fs.write(
+                str(self._log_dir / "latestStable"), data,
+                if_generation_match=gen,
+            )
+
+        def claim(self, id, data):
+            return self._fs.create_if_absent(self._path_of(id), data)
+
+        def unrelated(self, path, data):
+            self._fs.write(path, data)  # no metadata marker in the path
+    """
+    assert codes(run(src), "HS008") == []
+
+
+def test_hs008_non_fs_receiver_is_clean():
+    src = """
+    class W:
+        def flush(self, buf):
+            # .write on a non-filesystem receiver (file handle, socket)
+            self.handle.write(str(self.log_dir / "latestStable"))
+            buf.write(b"HYPERSPACE_LOG")
+    """
+    assert codes(run(src), "HS008") == []
+
+
+def test_hs008_suppressed_with_justification():
+    src = """
+    class Mgr:
+        def sanctioned(self, data):
+            # hslint: disable=HS008 - latestStable is a rebuildable cache
+            self._fs.write(str(self._log_dir / "latestStable"), data)
+    """
+    findings = run(src)
+    hs8 = [f for f in findings if f.code == "HS008"]
+    assert len(hs8) == 1 and hs8[0].suppressed

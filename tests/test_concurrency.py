@@ -272,6 +272,79 @@ def test_cancel_recovers_killed_writer(env):
     assert mgr.get_latest_stable_log().state == states.ACTIVE
 
 
+def test_two_sessions_race_begin_loser_gets_cme(env):
+    """Two SESSIONS race begin() on the same index after both validated
+    against the same base state: the lease claim is the tiebreak — the
+    loser gets ConcurrentModificationException before it can touch the
+    log (reliability/lease.py)."""
+    from hyperspace_tpu.actions.metadata_actions import DeleteAction
+
+    session, hs, src, root = env
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("race2", ["k"], ["v"]))
+    idx_path = Path(session.conf.system_path()) / "race2"
+
+    # two independent sessions' worth of action state, both validated
+    # against ACTIVE before either begins (the classic lost-update shape)
+    a1 = DeleteAction(IndexLogManagerImpl(idx_path), session.conf)
+    a2 = DeleteAction(IndexLogManagerImpl(idx_path), session.conf)
+    a1.validate(); a2.validate()
+    assert a1.base_id == a2.base_id
+
+    a1._begin()  # session 1 wins the lease + the transient claim
+    try:
+        with pytest.raises(ConcurrentModificationException):
+            a2._begin()  # session 2's lease claim loses immediately
+        # the log carries exactly ONE transient entry — no torn state
+        log_dir = idx_path / C.HYPERSPACE_LOG
+        ids = sorted(int(p.name) for p in log_dir.iterdir() if p.name.isdigit())
+        assert ids == list(range(ids[-1] + 1))
+        a1._end()
+    finally:
+        if a1._held_lease is not None:
+            a1._held_lease.release()
+    mgr = IndexLogManagerImpl(idx_path)
+    assert mgr.get_latest_log().state == states.DELETED
+
+
+def test_lease_fencing_blocks_zombie_end(env):
+    """A writer that stalls past its lease is fenced: recovery (here via
+    manual cancel — the force path) claims the next epoch, and the
+    zombie's end() refuses with LeaseFencedError instead of committing
+    over the recovered log."""
+    from hyperspace_tpu.exceptions import LeaseFencedError
+    from hyperspace_tpu.actions.metadata_actions import DeleteAction
+
+    session, hs, src, root = env
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("zidx", ["k"], ["v"]))
+    idx_path = Path(session.conf.system_path()) / "zidx"
+
+    zombie = DeleteAction(IndexLogManagerImpl(idx_path), session.conf)
+    zombie.validate()
+    zombie._begin()  # transient DELETING under the zombie's lease
+    # the writer stalls: freeze its heartbeat (a hung process beats no
+    # more), so its lease stops being extended
+    zombie._held_lease._stop.set()
+    zombie._held_lease._thread.join(timeout=10.0)
+
+    # the operator recovers the stuck index; cancel force-fences the
+    # zombie's lease epoch and rolls back to ACTIVE
+    hs.cancel("zidx")
+    mgr = IndexLogManagerImpl(idx_path)
+    assert mgr.get_latest_log().state == states.ACTIVE
+
+    # the zombie wakes up and tries to commit: fenced, refused
+    with pytest.raises(LeaseFencedError):
+        zombie._end()
+    # nothing the zombie did survived — the recovered state stands
+    assert mgr.get_latest_log().state == states.ACTIVE
+    assert mgr.get_latest_stable_log().state == states.ACTIVE
+    # and the index remains fully writable by live writers
+    hs.delete_index("zidx")
+    assert mgr.get_latest_log().state == states.DELETED
+
+
 def test_queries_see_stable_snapshot_during_refresh(env):
     """While a refresh is in flight (transient REFRESHING in the log),
     queries keep using the PREVIOUS stable snapshot — the index neither

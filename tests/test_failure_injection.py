@@ -605,3 +605,151 @@ def test_serve_deviceprobe_latch_degrades_before_any_serve_failure(
     assert t.result(timeout=120).num_rows >= 0
     assert t.batch_size == 1  # host-latched serving never batches
     server.close()
+
+
+# ---------------------------------------------------------------------------
+# (e) storage-flake + crash-litter injection (reliability/): a flaky
+#     object store must not fail lifecycle actions (retry absorbs), and
+#     the temp files a crashed atomic_create leaves behind must be
+#     reported by fsck and swept by recovery.
+# ---------------------------------------------------------------------------
+
+
+def test_flaky_storage_log_rpcs_do_not_fail_lifecycle(tmp_path):
+    """Every 2nd log-protocol RPC fails transiently; create + delete +
+    restore still succeed end-to-end through the retry layer, and the
+    flakes are visible in metrics (not silently absorbed)."""
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.actions import states
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.collection_manager import IndexCollectionManager
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+    from hyperspace_tpu.reliability import FaultInjectingFileSystem, FaultRule
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io as pio
+    from hyperspace_tpu.storage.columnar import ColumnarBatch
+    from hyperspace_tpu.storage.filesystem import PosixFileSystem
+
+    rng = np.random.default_rng(1)
+    src = tmp_path / "data"
+    src.mkdir()
+    pio.write_parquet(
+        src / "p0.parquet",
+        ColumnarBatch.from_pydict(
+            {
+                "k": rng.integers(0, 20, 200).astype(np.int64),
+                "v": rng.integers(0, 100, 200).astype(np.int64),
+            }
+        ),
+    )
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.INDEX_NUM_BUCKETS: 2,
+            C.RELIABILITY_RETRY_BASE_DELAY_SECONDS: 0.001,
+            C.RELIABILITY_RETRY_MAX_DELAY_SECONDS: 0.002,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    fault = FaultInjectingFileSystem(
+        PosixFileSystem(), [FaultRule(kind="fail", op="*", every=2)]
+    )
+    orig = IndexCollectionManager._log_manager
+
+    def patched(self, name):
+        return IndexLogManagerImpl(
+            self.path_resolver.get_index_path(name),
+            fs=fault,
+            retry_policy=self.conf.retry_policy(),
+        )
+
+    IndexCollectionManager._log_manager = patched
+    metrics.reset()
+    try:
+        hs.create_index(
+            session.read.parquet(str(src)), IndexConfig("flaky", ["k"], ["v"])
+        )
+        hs.delete_index("flaky")
+        hs.restore_index("flaky")
+    finally:
+        IndexCollectionManager._log_manager = orig
+    mgr = IndexLogManagerImpl(tmp_path / "indexes" / "flaky")
+    assert mgr.get_latest_stable_log().state == states.ACTIVE
+    assert metrics.counter("storage.retry.attempts") > 0
+    assert metrics.counter("storage.retry.exhausted") == 0
+
+
+def test_orphan_tmp_files_reported_by_fsck_and_swept_by_recovery(tmp_path):
+    """Satellite: ``.name.tmp.pid.rand`` litter from a crashed
+    atomic_create (died between temp-write and link) is reported by
+    doctor() and swept when recovery rolls the abandoned writer back."""
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.actions import states as st
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.data_manager import IndexDataManagerImpl
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+    from hyperspace_tpu.reliability import LeaseManager, doctor, maybe_auto_recover
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io as pio
+    from hyperspace_tpu.storage.columnar import ColumnarBatch
+    from hyperspace_tpu.storage.filesystem import PosixFileSystem
+
+    rng = np.random.default_rng(2)
+    src = tmp_path / "data"
+    src.mkdir()
+    pio.write_parquet(
+        src / "p0.parquet",
+        ColumnarBatch.from_pydict(
+            {
+                "k": rng.integers(0, 20, 150).astype(np.int64),
+                "v": rng.integers(0, 100, 150).astype(np.int64),
+            }
+        ),
+    )
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"), C.INDEX_NUM_BUCKETS: 2}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("lit", ["k"], ["v"]))
+    idx = tmp_path / "indexes" / "lit"
+    log_dir = idx / C.HYPERSPACE_LOG
+    mgr = IndexLogManagerImpl(idx)
+
+    # simulate the dead writer: transient entry, expired lease, and the
+    # temp file its atomic_create left between temp-write and link
+    stuck = mgr.get_latest_log()
+    stuck.state = st.REFRESHING
+    assert mgr.write_log(stuck.id + 1, stuck)
+    lm = LeaseManager(idx, PosixFileSystem())
+    held = lm.acquire(duration_s=30.0)
+    held._stop.set()
+    held._thread.join(timeout=10.0)
+    rec = lm.current()
+    rec.expires_at_ms = int(time.time() * 1000) - 10_000
+    Path(lm._path_of(rec.epoch)).write_text(rec.to_json(), encoding="utf-8")
+    litter = log_dir / f".{stuck.id + 2}.tmp.424242.cafebabe"
+    litter.write_bytes(b"{ half an entry")
+    # crash litter is old by the time recovery runs; the sweep's age
+    # guard (which protects a LIVE writer's in-flight temp) must not
+    # mistake this for fresh
+    old = time.time() - 300
+    os.utime(litter, (old, old))
+
+    report = doctor(idx)
+    assert any(i.kind == "orphan-temp" for i in report.issues)
+    assert any(i.kind == "abandoned-writer" for i in report.issues)
+
+    metrics.reset()
+    assert maybe_auto_recover(
+        mgr, data_manager=IndexDataManagerImpl(idx), conf=session.conf
+    )
+    assert not litter.exists(), "recovery must sweep the atomic_create litter"
+    assert metrics.counter("recovery.orphan_tmp_swept") >= 1
+    assert mgr.get_latest_log().state == st.ACTIVE
+    assert doctor(idx).ok

@@ -194,3 +194,50 @@ def test_claim_self_win_detected_after_connection_retry(gcs, monkeypatch):
     monkeypatch.setattr(fs, "_request", real_request)
     # a genuinely lost claim (different bytes already present) stays False
     assert fs.create_if_absent("claims/7", b"other") is False
+
+
+def test_preconditioned_write_over_http(gcs):
+    """write(if_generation_match=): correct generation applies, a stale
+    one gets the classified permanent PreconditionFailedError — the
+    fenced-writer refusal the lease heartbeat relies on (ISSUE-4
+    satellite: no silent stale overwrite)."""
+    from hyperspace_tpu.exceptions import PreconditionFailedError
+
+    fs, _srv = gcs
+    assert fs.supports_generation_preconditions is True
+    fs.write("pre/obj", b"v1")
+    gen = fs.generation("pre/obj")
+    fs.write("pre/obj", b"v2", if_generation_match=gen)
+    assert fs.read("pre/obj") == b"v2"
+    with pytest.raises(PreconditionFailedError):
+        fs.write("pre/obj", b"stale", if_generation_match=gen)
+    assert fs.read("pre/obj") == b"v2"
+    # create-precondition form: generation 0 == object must not exist
+    fs.write("pre/new", b"x", if_generation_match=0)
+    with pytest.raises(PreconditionFailedError):
+        fs.write("pre/new", b"y", if_generation_match=0)
+
+
+def test_lease_protocol_over_gcs_client(gcs):
+    """The full lease cycle (acquire → heartbeat-fence → tombstone) runs
+    unchanged against the HTTP client: recovery tombstones the zombie's
+    record, and the zombie's preconditioned heartbeat observes the fence."""
+    import time as _time
+
+    from hyperspace_tpu.exceptions import LeaseFencedError
+    from hyperspace_tpu.reliability import LeaseManager
+
+    fs, _srv = gcs
+    mgr = LeaseManager("leased-idx", fs)
+    zombie = mgr.acquire(duration_s=0.2)
+    recoverer = mgr.acquire(duration_s=30.0, force=True)
+    assert recoverer.epoch == zombie.epoch + 1
+    deadline = _time.monotonic() + 10.0
+    while not zombie.fenced and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    assert zombie.fenced  # its own heartbeat saw the 412
+    with pytest.raises(LeaseFencedError):
+        zombie.check_fenced()
+    assert mgr.read(zombie.epoch).state == "fenced"
+    recoverer.release()
+    assert mgr.current().state == "released"

@@ -1,0 +1,679 @@
+"""reliability/: retry classification and backoff, the retrying
+filesystem decorator, writer leases with epoch fencing, automatic crash
+recovery, and doctor()/fsck — the unit/integration tier (the chaos
+sweep lives in test_reliability_chaos.py).
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import (
+    ConcurrentModificationException,
+    HyperspaceException,
+    LeaseFencedError,
+    PermanentStorageError,
+    PreconditionFailedError,
+    TransientStorageError,
+)
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+from hyperspace_tpu.reliability import (
+    FaultInjectingFileSystem,
+    FaultRule,
+    InjectedCrash,
+    LeaseManager,
+    RetryingFileSystem,
+    RetryPolicy,
+    call_with_retries,
+    classify_error,
+    doctor,
+    maybe_auto_recover,
+)
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.storage.filesystem import FakeGcsFileSystem, PosixFileSystem
+from hyperspace_tpu.telemetry.metrics import metrics
+
+REPO = Path(__file__).resolve().parent.parent
+
+FAST = RetryPolicy(max_attempts=4, base_delay_s=0.001, max_delay_s=0.002)
+
+
+def sample_batch(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 50, n).astype(np.int64),
+            "v": rng.integers(0, 1000, n).astype(np.int64),
+        }
+    )
+
+
+def make_env(tmp_path, lease_s=60.0, subdir="indexes"):
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / subdir),
+            C.INDEX_NUM_BUCKETS: 4,
+            C.RELIABILITY_LEASE_DURATION_SECONDS: lease_s,
+            C.RELIABILITY_RETRY_BASE_DELAY_SECONDS: 0.001,
+            C.RELIABILITY_RETRY_MAX_DELAY_SECONDS: 0.002,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    src = tmp_path / "data"
+    if not src.is_dir():
+        src.mkdir()
+        parquet_io.write_parquet(src / "part-0.parquet", sample_batch())
+    return session, hs, src
+
+
+# ---------------------------------------------------------------------------
+# classification + policy
+# ---------------------------------------------------------------------------
+def test_error_classification():
+    assert classify_error(TransientStorageError("x")) == "transient"
+    assert classify_error(TimeoutError()) == "transient"
+    assert classify_error(ConnectionResetError()) == "transient"
+    assert classify_error(OSError("EIO")) == "transient"
+    assert classify_error(PermanentStorageError("x")) == "permanent"
+    assert classify_error(PreconditionFailedError("x")) == "permanent"
+    assert classify_error(FileNotFoundError()) == "permanent"
+    assert classify_error(FileExistsError()) == "permanent"
+    assert classify_error(PermissionError()) == "permanent"
+    assert classify_error(HyperspaceException("x")) == "permanent"
+    assert classify_error(ValueError()) == "permanent"
+
+
+def test_retry_policy_deterministic_jitter_and_bounds():
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.5, jitter=0.25)
+    a = [p.delay_for(i, "op:/some/path") for i in range(1, 6)]
+    b = [p.delay_for(i, "op:/some/path") for i in range(1, 6)]
+    assert a == b  # deterministic for the same key
+    assert a != [p.delay_for(i, "op:/other/path") for i in range(1, 6)]
+    for i, d in enumerate(a, start=1):
+        base = min(0.1 * (2 ** (i - 1)), 0.5)
+        assert base * 0.75 <= d <= base * 1.25
+
+
+def test_call_with_retries_transient_then_success():
+    metrics.reset()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientStorageError("flake")
+        return "ok"
+
+    assert call_with_retries(flaky, op="t", key="k", policy=FAST) == "ok"
+    assert calls["n"] == 3
+    assert metrics.counter("storage.retry.attempts") == 2
+    assert metrics.counter("storage.retry.t") == 2
+
+
+def test_call_with_retries_permanent_is_immediate():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise PermanentStorageError("no")
+
+    with pytest.raises(PermanentStorageError):
+        call_with_retries(bad, op="t", policy=FAST)
+    assert calls["n"] == 1
+
+
+def test_call_with_retries_exhaustion_counts_and_raises():
+    metrics.reset()
+
+    def always():
+        raise TransientStorageError("down")
+
+    with pytest.raises(TransientStorageError):
+        call_with_retries(always, op="t", policy=FAST)
+    assert metrics.counter("storage.retry.exhausted") == 1
+
+
+# ---------------------------------------------------------------------------
+# the retrying filesystem
+# ---------------------------------------------------------------------------
+def test_retrying_fs_absorbs_fail_n(tmp_path):
+    inner = FaultInjectingFileSystem(
+        PosixFileSystem(),
+        [FaultRule(kind="fail", op="read", times=2)],
+    )
+    fs = RetryingFileSystem(inner, FAST)
+    target = tmp_path / "blob"
+    fs.write(str(target), b"payload")
+    assert fs.read(str(target)) == b"payload"  # two injected failures absorbed
+
+
+def test_retrying_fs_claim_self_win_detection():
+    """A claim whose first attempt applied server-side before erroring
+    must report success on retry — not 'claim lost'."""
+    inner = FakeGcsFileSystem()
+
+    class AppliesThenDies(FakeGcsFileSystem):
+        def __init__(self):
+            super().__init__()
+            self.died = False
+
+        def create_if_absent(self, path, data):
+            won = super().create_if_absent(path, data)
+            if won and not self.died:
+                self.died = True
+                raise TransientStorageError("reset after server applied PUT")
+            return won
+
+    backend = AppliesThenDies()
+    fs = RetryingFileSystem(backend, FAST)
+    metrics.reset()
+    assert fs.create_if_absent("bucket/obj", b"writer-unique-payload") is True
+    assert metrics.counter("storage.retry.claim_self_win") == 1
+    # and a genuine loss still reports False
+    assert fs.create_if_absent("bucket/obj", b"another-writer") is False
+
+
+def test_fake_gcs_write_generation_semantics():
+    """Satellite: a stale writer's preconditioned write gets a CLASSIFIED
+    permanent error, never a silent overwrite."""
+    fs = FakeGcsFileSystem()
+    fs.write("b/o", b"v1")
+    gen = fs.generation("b/o")
+    fs.write("b/o", b"v2", if_generation_match=gen)  # correct gen: applies
+    assert fs.read("b/o") == b"v2"
+    with pytest.raises(PreconditionFailedError):
+        fs.write("b/o", b"stale", if_generation_match=gen)  # gen moved on
+    assert fs.read("b/o") == b"v2"  # nothing clobbered
+    assert classify_error(PreconditionFailedError("x")) == "permanent"
+    # creating precondition: if_generation_match=0 on an absent object
+    fs.write("b/new", b"x", if_generation_match=0)
+    assert fs.read("b/new") == b"x"
+
+
+def test_posix_write_refuses_preconditions(tmp_path):
+    fs = PosixFileSystem()
+    assert fs.supports_generation_preconditions is False
+    with pytest.raises(PreconditionFailedError):
+        fs.write(str(tmp_path / "f"), b"x", if_generation_match=1)
+
+
+# ---------------------------------------------------------------------------
+# leases + fencing
+# ---------------------------------------------------------------------------
+def test_lease_acquire_conflict_and_release_cycle(tmp_path):
+    mgr = LeaseManager(tmp_path / "idx", PosixFileSystem())
+    held = mgr.acquire(duration_s=30.0, action="T")
+    assert held.epoch == 1
+    with pytest.raises(ConcurrentModificationException):
+        mgr.acquire(duration_s=30.0)  # live lease held by someone else
+    held.release()
+    held2 = mgr.acquire(duration_s=30.0)
+    assert held2.epoch == 2  # epochs only grow
+    held2.abort()
+    rec = mgr.current()
+    assert rec.state == "aborted"
+    assert not rec.is_abandoned()  # aborted is terminal, not dead-writer
+    assert mgr.acquire(duration_s=30.0).epoch == 3
+
+
+def test_lease_expiry_means_abandoned_and_heartbeat_extends(tmp_path):
+    mgr = LeaseManager(tmp_path / "idx", PosixFileSystem())
+    held = mgr.acquire(duration_s=0.3)
+    # the heartbeat (duration/3) keeps the short lease live well past
+    # its nominal duration while the holder is alive
+    time.sleep(0.6)
+    assert mgr.current().is_live()
+    # a frozen writer: heartbeat stops, lease expires, abandonment shows
+    held._stop.set()
+    held._thread.join(timeout=5.0)
+    time.sleep(0.4)
+    rec = mgr.current()
+    assert not rec.is_live()
+    assert rec.is_abandoned()
+
+
+def test_force_acquire_fences_zombie_commit(tmp_path):
+    mgr = LeaseManager(tmp_path / "idx", PosixFileSystem())
+    zombie = mgr.acquire(duration_s=30.0)
+    recoverer = mgr.acquire(duration_s=30.0, force=True)
+    assert recoverer.epoch == zombie.epoch + 1
+    with pytest.raises(LeaseFencedError):
+        zombie.check_fenced()
+    recoverer.release()
+
+
+def test_fenced_heartbeat_stops_on_generation_backend(tmp_path):
+    """On a generation backend the zombie's own heartbeat observes the
+    fence: its preconditioned write fails permanently and the heartbeat
+    thread stops instead of resurrecting the lease."""
+    fs = FakeGcsFileSystem()
+    mgr = LeaseManager("idx", fs)
+    zombie = mgr.acquire(duration_s=0.2)  # heartbeat every ~66ms
+    mgr.acquire(duration_s=30.0, force=True).release()
+    deadline = time.monotonic() + 10.0
+    while not zombie.fenced and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert zombie.fenced
+    with pytest.raises(LeaseFencedError):
+        zombie.check_fenced()
+    # the fenced tombstone survived the zombie's heartbeats
+    assert mgr.read(zombie.epoch).state == "fenced"
+
+
+# ---------------------------------------------------------------------------
+# automatic crash recovery
+# ---------------------------------------------------------------------------
+def _crash_mid_action(tmp_path, monkeypatch, lease_s, crash_rule):
+    """Create an index whose CreateAction dies at ``crash_rule`` with the
+    log routed through a fault filesystem; returns (session, hs, src,
+    index_path)."""
+    from hyperspace_tpu.index.collection_manager import IndexCollectionManager
+
+    session, hs, src = make_env(tmp_path, lease_s=lease_s)
+    fault = FaultInjectingFileSystem(PosixFileSystem(), [crash_rule])
+
+    def patched(self, name):
+        return IndexLogManagerImpl(
+            self.path_resolver.get_index_path(name), fs=fault
+        )
+
+    monkeypatch.setattr(IndexCollectionManager, "_log_manager", patched)
+    with pytest.raises(InjectedCrash):
+        hs.create_index(
+            session.read.parquet(str(src)), IndexConfig("vx", ["k"], ["v"])
+        )
+    monkeypatch.undo()
+    return session, hs, src, Path(session.conf.system_path()) / "vx"
+
+
+def test_dead_writer_auto_recovers_on_next_action(tmp_path, monkeypatch):
+    """A writer that dies between begin and end leaves a transient entry
+    + an expiring lease; the NEXT modifying action rolls it back and
+    proceeds — no manual cancel()."""
+    from hyperspace_tpu.reliability.faults import crash_at
+
+    metrics.reset()
+    # create_if_absent calls: #0 lease epoch, #1 begin entry, #2 end entry
+    _, _, src, idx = _crash_mid_action(
+        tmp_path, monkeypatch, 0.25, crash_at("create_if_absent", 2)
+    )
+    mgr = IndexLogManagerImpl(idx)
+    assert mgr.get_latest_log().state == states.CREATING  # stuck transient
+    time.sleep(0.5)  # the dead writer's lease expires (heartbeat died too)
+
+    # a FRESH session's create self-heals and succeeds end-to-end
+    session2, hs2, _ = make_env(tmp_path, lease_s=0.25)
+    hs2.create_index(
+        session2.read.parquet(str(src)), IndexConfig("vx", ["k"], ["v"])
+    )
+    assert metrics.counter("recovery.auto_rollback") >= 1
+    assert mgr.get_latest_stable_log().state == states.ACTIVE
+    # the recovery cancel + rebuild left a dense, stable log
+    ids = sorted(int(p.name) for p in (idx / C.HYPERSPACE_LOG).iterdir()
+                 if p.name.isdigit())
+    assert ids == list(range(ids[-1] + 1))
+
+
+def test_session_attach_sweep_recovers_without_any_verb(tmp_path, monkeypatch):
+    """Recovery on session attach: merely LISTING indexes through a new
+    session heals the abandoned writer."""
+    from hyperspace_tpu.reliability.faults import crash_at
+
+    _, _, src, idx = _crash_mid_action(
+        tmp_path, monkeypatch, 0.25, crash_at("create_if_absent", 2)
+    )
+    time.sleep(0.5)
+    session2, hs2, _ = make_env(tmp_path, lease_s=0.25)
+    names = [s.name for s in hs2.indexes()]
+    mgr = IndexLogManagerImpl(idx)
+    latest = mgr.get_latest_log()
+    assert latest.state in states.STABLE_STATES, (
+        f"attach sweep left {latest.state}"
+    )
+    # first create never committed -> rolled back to DOESNOTEXIST, and
+    # the listing hides it
+    assert latest.state == states.DOESNOTEXIST
+    assert "vx" not in names
+
+
+def test_in_process_failure_still_requires_manual_cancel(tmp_path):
+    """An action that FAILS (exception, process alive) aborts its lease:
+    that is operator territory — auto-recovery must NOT kick in, the
+    reference's manual cancel() contract holds."""
+    session, hs, src = make_env(tmp_path, lease_s=0.2)
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("m", ["k"], ["v"]))
+
+    from hyperspace_tpu.actions.refresh import RefreshAction
+    from hyperspace_tpu.index.data_manager import IndexDataManagerImpl
+
+    idx = Path(session.conf.system_path()) / "m"
+    parquet_io.write_parquet(src / "part-x.parquet", sample_batch(50, 7))
+
+    class Dying(RefreshAction):
+        def op(self):
+            raise RuntimeError("failed in-process")
+
+    with pytest.raises(RuntimeError):
+        Dying(session, IndexLogManagerImpl(idx), IndexDataManagerImpl(idx)).run()
+    mgr = IndexLogManagerImpl(idx)
+    assert mgr.get_latest_log().state == states.REFRESHING
+    assert LeaseManager(idx, PosixFileSystem()).current().state == "aborted"
+    time.sleep(0.4)  # aborted leases do NOT become abandoned with time
+    assert not maybe_auto_recover(mgr, conf=session.conf)
+    with pytest.raises(HyperspaceException):
+        hs.refresh_index("m", C.REFRESH_MODE_FULL)  # still refuses
+    hs.cancel("m")  # manual cancel still works (force-fences)
+    assert mgr.get_latest_log().state == states.ACTIVE
+
+
+def test_serve_submit_consults_recovery(tmp_path, monkeypatch):
+    """A serving process heals an index another (dead) process wedged:
+    the submit path's throttled sweep rolls it back in the background."""
+    from hyperspace_tpu.plan.expr import col
+    from hyperspace_tpu.reliability.faults import crash_at
+
+    session, hs, src = make_env(tmp_path, lease_s=0.25)
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("sv", ["k"], ["v"]))
+
+    # a second "process" dies mid-refresh on the same index
+    from hyperspace_tpu.index.collection_manager import IndexCollectionManager
+
+    idx = Path(session.conf.system_path()) / "sv"
+    parquet_io.write_parquet(src / "part-s.parquet", sample_batch(60, 5))
+    crasher, hs_c, _ = make_env(tmp_path, lease_s=0.25)
+    # calls: #0 lease epoch claim, #1 begin entry, #2 end entry — dying
+    # at #2 is "between begin and end" (the gate fires before the op)
+    fault = FaultInjectingFileSystem(
+        PosixFileSystem(), [crash_at("create_if_absent", 2)]
+    )
+
+    def patched(self, name):
+        return IndexLogManagerImpl(
+            self.path_resolver.get_index_path(name), fs=fault
+        )
+
+    monkeypatch.setattr(IndexCollectionManager, "_log_manager", patched)
+    with pytest.raises(InjectedCrash):
+        hs_c.refresh_index("sv", C.REFRESH_MODE_FULL)
+    monkeypatch.undo()
+    mgr = IndexLogManagerImpl(idx)
+    assert mgr.get_latest_log().state == states.REFRESHING
+    time.sleep(0.5)  # lease expires
+
+    server = session.serve(max_workers=1)
+    try:
+        t = server.submit(
+            session.read.parquet(str(src)).filter(col("k") == 3).select("k", "v")
+        )
+        t.result(timeout=120)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if mgr.get_latest_log().state == states.ACTIVE:
+                break
+            time.sleep(0.05)
+        assert mgr.get_latest_log().state == states.ACTIVE
+        # the submit-triggered sweep runs in the background (and may race
+        # the attach sweep on the planning path to the actual rollback) —
+        # poll until it lands in stats
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if server.stats()["reliability"]["server_recovery_sweeps"] >= 1:
+                break
+            time.sleep(0.05)
+        stats = server.stats()
+        assert stats["reliability"]["server_recovery_sweeps"] >= 1
+        assert stats["reliability"]["auto_rollbacks"] >= 1
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# doctor / fsck
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def healthy_index(tmp_path):
+    session, hs, src = make_env(tmp_path)
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("d", ["k"], ["v"]))
+    return session, hs, src, Path(session.conf.system_path()) / "d"
+
+
+def test_doctor_clean_tree_reports_ok(healthy_index):
+    session, _, _, idx = healthy_index
+    report = session.doctor()
+    assert report.ok
+    assert report.indexes_checked == 1
+    assert report.inconsistencies == []
+    payload = report.to_json_dict()
+    assert payload["ok"] is True and payload["indexesChecked"] == 1
+
+
+def test_doctor_reports_and_repairs_crash_litter(healthy_index):
+    session, hs, src, idx = healthy_index
+    log_dir = idx / C.HYPERSPACE_LOG
+    # (a) orphaned atomic_create temp (crash between temp-write and link)
+    (log_dir / ".2.tmp.9999.deadbeef").write_bytes(b"{}")
+    # (b) a torn build: version dir with data no log entry references
+    orphan_dir = idx / "v__=7"
+    orphan_dir.mkdir()
+    (orphan_dir / "stray.tcb").write_bytes(b"x" * 64)
+    # (c) a corrupt latestStable copy
+    (log_dir / "latestStable").write_text("{ torn", encoding="utf-8")
+
+    report = doctor(idx)
+    kinds = {i.kind for i in report.issues}
+    assert {"orphan-temp", "orphan-version-dir", "latest-stable-bad"} <= kinds
+    assert not report.ok
+
+    fixed = doctor(idx, repair=True)
+    assert all(i.repaired for i in fixed.issues if i.repairable)
+    # repaired tree scans clean
+    again = doctor(idx)
+    assert again.ok, [i.to_json_dict() for i in again.issues]
+    assert not (log_dir / ".2.tmp.9999.deadbeef").exists()
+    assert not orphan_dir.exists()
+    # latestStable was rebuilt from the chain
+    mgr = IndexLogManagerImpl(idx)
+    assert mgr.get_latest_stable_log().state == states.ACTIVE
+
+
+def test_doctor_flags_missing_data_file(healthy_index):
+    session, _, _, idx = healthy_index
+    mgr = IndexLogManagerImpl(idx)
+    victim = Path(mgr.get_latest_stable_log().content.files()[0])
+    victim.unlink()
+    report = doctor(idx)
+    assert any(i.kind == "missing-data-file" for i in report.issues)
+    assert not report.ok  # not repairable: data loss is loud, never vacuumed
+
+
+def test_doctor_repairs_abandoned_writer(tmp_path, monkeypatch):
+    from hyperspace_tpu.reliability.faults import crash_at
+
+    _, _, src, idx = _crash_mid_action(
+        tmp_path, monkeypatch, 0.25, crash_at("create_if_absent", 2)
+    )
+    time.sleep(0.5)
+    report = doctor(idx)
+    assert any(i.kind == "abandoned-writer" for i in report.issues)
+    fixed = doctor(idx, repair=True)
+    assert any(i.kind == "abandoned-writer" and i.repaired for i in fixed.issues)
+    assert doctor(idx).ok
+    assert IndexLogManagerImpl(idx).get_latest_log().state in states.STABLE_STATES
+
+
+def test_doctor_cli_json_and_exit_codes(healthy_index, tmp_path):
+    session, _, _, idx = healthy_index
+    proc = subprocess.run(
+        [sys.executable, "scripts/doctor.py", str(idx.parent), "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True and payload["indexesChecked"] == 1
+
+    (idx / C.HYPERSPACE_LOG / ".5.tmp.1.ff").write_bytes(b"{}")
+    proc = subprocess.run(
+        [sys.executable, "scripts/doctor.py", str(idx), "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert any(
+        i["kind"] == "orphan-temp" for i in json.loads(proc.stdout)["issues"]
+    )
+    proc = subprocess.run(
+        [sys.executable, "scripts/doctor.py", str(idx), "--repair"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_wrappers_delegate_generation_capability():
+    """The base-class capability attribute must not shadow delegation:
+    a wrapped generation backend keeps precondition fencing."""
+    gcs = FakeGcsFileSystem()
+    assert RetryingFileSystem(gcs).supports_generation_preconditions is True
+    assert FaultInjectingFileSystem(gcs).supports_generation_preconditions is True
+    posix = PosixFileSystem()
+    assert RetryingFileSystem(posix).supports_generation_preconditions is False
+
+
+def test_wrap_with_retries_skips_internally_retrying_backends():
+    """GcsFileSystem retries every RPC internally; stacking the seam
+    retry on top would square the attempt budget during an outage."""
+    from hyperspace_tpu.reliability.retry import wrap_with_retries
+    from hyperspace_tpu.storage.gcs import GcsFileSystem
+
+    gcs = GcsFileSystem("b", endpoint="http://127.0.0.1:1")
+    assert wrap_with_retries(gcs) is gcs
+    wrapped = wrap_with_retries(PosixFileSystem())
+    assert wrap_with_retries(wrapped) is wrapped  # idempotent
+
+
+def test_doctor_stands_down_for_live_in_flight_writer(healthy_index):
+    """A live writer's not-yet-referenced version dir and claim temp are
+    NOT orphans: doctor must neither report nor (under repair) delete
+    the in-progress build's artifacts."""
+    session, hs, src, idx = healthy_index
+    mgr = IndexLogManagerImpl(idx)
+    # simulate the in-flight writer: transient head + LIVE lease + the
+    # new version dir its end entry will reference
+    head = mgr.get_latest_log()
+    head.state = states.REFRESHING
+    assert mgr.write_log(head.id + 1, head)
+    held = LeaseManager(idx, PosixFileSystem()).acquire(duration_s=60.0)
+    building = idx / "v__=1"
+    building.mkdir()
+    (building / "in-progress.tcb").write_bytes(b"half a build")
+    (idx / C.HYPERSPACE_LOG / ".9.tmp.1.ab").write_bytes(b"claim in flight")
+    try:
+        report = doctor(idx, repair=True)
+        assert report.ok, [i.to_json_dict() for i in report.inconsistencies]
+        assert any(i.kind == "writer-in-flight" for i in report.issues)
+        assert (building / "in-progress.tcb").exists()
+        assert (idx / C.HYPERSPACE_LOG / ".9.tmp.1.ab").exists()
+    finally:
+        held.release()
+
+
+def test_tmp_sweep_age_guard_and_transient_reclaim(tmp_path):
+    """A YOUNG temp file is never swept (it may be a live writer's
+    in-flight claim), and a claim whose temp was swept anyway retries
+    transparently through the retry layer."""
+    import os
+
+    from hyperspace_tpu.reliability.recovery import sweep_orphan_tmp_files
+
+    log_dir = tmp_path / "log"
+    log_dir.mkdir()
+    young = log_dir / ".3.tmp.1.aa"
+    young.write_bytes(b"x")
+    old = log_dir / ".4.tmp.1.bb"
+    old.write_bytes(b"x")
+    os.utime(old, (time.time() - 300, time.time() - 300))
+    swept = sweep_orphan_tmp_files(log_dir)
+    assert swept == [old.name]
+    assert young.exists()
+
+    # a swept-mid-claim temp surfaces as TransientStorageError -> the
+    # retrying fs re-runs the claim with a fresh temp and it succeeds
+    class SweepingFs(PosixFileSystem):
+        def __init__(self):
+            self.raced = False
+
+        def create_if_absent(self, path, data):
+            if not self.raced:
+                self.raced = True
+                real_write = Path.write_bytes
+
+                def write_then_vanish(p, b):
+                    real_write(p, b)
+                    p.unlink()  # the sweeper got it first
+
+                Path.write_bytes, undo = write_then_vanish, real_write
+                try:
+                    return super().create_if_absent(path, data)
+                finally:
+                    Path.write_bytes = undo
+            return super().create_if_absent(path, data)
+
+    fs = RetryingFileSystem(SweepingFs(), FAST)
+    assert fs.create_if_absent(str(tmp_path / "claimed"), b"payload") is True
+    assert (tmp_path / "claimed").read_bytes() == b"payload"
+
+
+# ---------------------------------------------------------------------------
+# fault injection determinism
+# ---------------------------------------------------------------------------
+def test_fault_schedule_is_deterministic(tmp_path):
+    def run_once(root):
+        fs = FaultInjectingFileSystem(
+            PosixFileSystem(),
+            [FaultRule(kind="fail", op="write", after=1, times=1)],
+        )
+        errors = []
+        for i in range(4):
+            try:
+                fs.write(str(root / f"f{i}"), b"x")
+            except TransientStorageError:
+                errors.append(i)
+        return errors, list(fs.ops)
+
+    a = run_once(tmp_path / "a")
+    b = run_once(tmp_path / "b")
+    assert a[0] == b[0] == [1]  # fires on exactly the scheduled call
+    assert [op for op, _ in a[1]] == [op for op, _ in b[1]]
+
+
+def test_torn_write_never_fakes_a_commit(tmp_path):
+    """A torn latestStable write leaves bytes the log manager refuses
+    loudly (and doctor repairs) — never a silently-read partial entry."""
+    session, hs, src = make_env(tmp_path)
+    hs.create_index(session.read.parquet(str(src)), IndexConfig("t", ["k"], ["v"]))
+    idx = Path(session.conf.system_path()) / "t"
+    mgr = IndexLogManagerImpl(idx)
+    good = (idx / C.HYPERSPACE_LOG / "latestStable").read_bytes()
+
+    fault = FaultInjectingFileSystem(
+        PosixFileSystem(), [FaultRule(kind="torn", op="write")]
+    )
+    with pytest.raises(InjectedCrash):
+        fault.write(str(idx / C.HYPERSPACE_LOG / "latestStable"), good)
+    with pytest.raises(HyperspaceException):
+        mgr.get_latest_stable_log()
+    fixed = doctor(idx, repair=True)
+    assert any(i.kind == "latest-stable-bad" and i.repaired for i in fixed.issues)
+    assert mgr.get_latest_stable_log().state == states.ACTIVE
+    assert doctor(idx).ok

@@ -1,0 +1,281 @@
+"""Chaos sweep over the lifecycle actions (the ISSUE-4 acceptance
+harness): kill every action at every mutating storage operation — and
+tear every metadata overwrite — then assert the crash-consistency
+invariant:
+
+  1. the index AUTO-recovers to a stable log state (session attach /
+     next action), no manual cancel();
+  2. subsequent queries answer correctly (parity against a plain source
+     scan — whether the recovered index applies, rolled back, or is
+     gone entirely);
+  3. doctor() reports zero inconsistencies after repair.
+
+Fault points are enumerated by journaling a clean run of the same
+scenario (faults.RecordingFileSystem), then replaying it once per
+mutating call with a crash scheduled at exactly that call — fully
+deterministic, no randomness anywhere. Crashes are InjectedCrash
+(BaseException) and flip the filesystem dead, so no `except Exception`
+path, `finally` release, or heartbeat survives — exactly process death.
+
+A separate weather sweep injects a TRANSIENT failure on every other
+storage call (every logical op flakes once) and asserts each action
+still SUCCEEDS — the retry layer's whole-action guarantee.
+
+Scope: the operation-log protocol (the crash-consistency surface). Data
+file writes crash-test separately via the SIGKILL-mid-spill case in
+test_failure_injection.py; crashing before a read is equivalent to
+crashing before the next mutation, so only mutating calls are kill
+points.
+"""
+
+import time
+from contextlib import contextmanager
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+from hyperspace_tpu.reliability import (
+    FaultInjectingFileSystem,
+    FaultRule,
+    InjectedCrash,
+    LeaseManager,
+    doctor,
+)
+from hyperspace_tpu.reliability.faults import MUTATING_OPS, RecordingFileSystem
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.storage.filesystem import PosixFileSystem
+
+IDX = "chaos"
+N_ROWS = 200
+KEY = 7
+
+
+def small_batch(seed=0, n=N_ROWS):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 20, n).astype(np.int64),
+            "v": rng.integers(0, 1000, n).astype(np.int64),
+        }
+    )
+
+
+def fresh_env(root: Path, tag: str):
+    ws = root / tag
+    ws.mkdir()
+    src = ws / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", small_batch())
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(ws / "indexes"),
+            C.INDEX_NUM_BUCKETS: 2,
+            C.RELIABILITY_RETRY_BASE_DELAY_SECONDS: 0.001,
+            C.RELIABILITY_RETRY_MAX_DELAY_SECONDS: 0.002,
+        }
+    )
+    session = HyperspaceSession(conf)
+    return session, Hyperspace(session), src, ws / "indexes"
+
+
+@contextmanager
+def faulted_log_managers(fs):
+    """Route every collection-manager log manager through ``fs``."""
+    from hyperspace_tpu.index.collection_manager import IndexCollectionManager
+
+    orig = IndexCollectionManager._log_manager
+
+    def patched(self, name):
+        return IndexLogManagerImpl(
+            self.path_resolver.get_index_path(name),
+            fs=fs,
+            retry_policy=self.conf.retry_policy(),
+        )
+
+    IndexCollectionManager._log_manager = patched
+    try:
+        yield
+    finally:
+        IndexCollectionManager._log_manager = orig
+
+
+# the five lifecycle scenarios: (baseline steps, action under test)
+def _baseline(kind, session, hs, src):
+    if kind == "create":
+        return
+    hs.create_index(session.read.parquet(str(src)), IndexConfig(IDX, ["k"], ["v"]))
+    if kind in ("refresh", "optimize"):
+        parquet_io.write_parquet(src / "part-1.parquet", small_batch(seed=3, n=80))
+    if kind == "optimize":
+        # a second small data file so quick-optimize has something to do
+        hs.refresh_index(IDX, C.REFRESH_MODE_INCREMENTAL)
+    if kind == "vacuum":
+        hs.delete_index(IDX)
+
+
+def _action(kind, session, hs, src):
+    if kind == "create":
+        hs.create_index(
+            session.read.parquet(str(src)), IndexConfig(IDX, ["k"], ["v"])
+        )
+    elif kind == "refresh":
+        hs.refresh_index(IDX, C.REFRESH_MODE_FULL)
+    elif kind == "optimize":
+        hs.optimize_index(IDX, C.OPTIMIZE_MODE_QUICK)
+    elif kind == "delete":
+        hs.delete_index(IDX)
+    elif kind == "vacuum":
+        hs.vacuum_index(IDX)
+
+
+def _enumerate_fault_points(root, kind):
+    """Journal a clean run; return (mutating call indices, write call
+    indices) among ALL journaled calls, in call order."""
+    session, hs, src = fresh_env(root, f"enum-{kind}")[:3]
+    _baseline(kind, session, hs, src)
+    rec = RecordingFileSystem(PosixFileSystem())
+    with faulted_log_managers(rec):
+        _action(kind, session, hs, src)
+    mutating = [i for i, (op, _) in enumerate(rec.ops) if op in MUTATING_OPS]
+    writes = [i for i, (op, _) in enumerate(rec.ops) if op == "write"]
+    return mutating, writes
+
+
+def _expire_lease(index_dir: Path) -> None:
+    """Simulate wall-clock passage: rewrite the current lease record as
+    already expired (the dead writer's heartbeat is gone either way)."""
+    lm = LeaseManager(index_dir, PosixFileSystem())
+    rec = lm.current()
+    if rec is None or rec.is_terminal:
+        return
+    rec.expires_at_ms = int(time.time() * 1000) - 60_000
+    Path(lm._path_of(rec.epoch)).write_text(rec.to_json(), encoding="utf-8")
+
+
+def _expected_rows(session, src):
+    from hyperspace_tpu.plan.expr import col
+
+    session.disable_hyperspace()
+    out = (
+        session.read.parquet(str(src))
+        .filter(col("k") == KEY)
+        .select("k", "v")
+        .collect()
+    )
+    session.enable_hyperspace()
+    return sorted(out.columns["v"].data.tolist())
+
+
+def _assert_recovered(root, tag, src, indexes_dir):
+    """The invariant, checked post-crash: auto-recovery to a stable log,
+    correct queries, doctor-clean after repair."""
+    from hyperspace_tpu.plan.expr import col
+
+    idx_dir = indexes_dir / IDX
+    _expire_lease(idx_dir)
+
+    # a FRESH session (the restarted process): merely attaching (first
+    # enumeration) heals the abandoned writer — no cancel() anywhere
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(indexes_dir),
+            C.INDEX_NUM_BUCKETS: 2,
+        }
+    )
+    session2 = HyperspaceSession(conf)
+    hs2 = Hyperspace(session2)
+    hs2.indexes()  # session attach
+    mgr = IndexLogManagerImpl(idx_dir)
+    latest = mgr.get_latest_log()
+    if latest is not None:
+        assert latest.state in states.STABLE_STATES, (
+            f"{tag}: log not auto-recovered (head {latest.state})"
+        )
+
+    # queries answer correctly from whatever state recovery produced
+    session2.enable_hyperspace()
+    got = (
+        session2.read.parquet(str(src))
+        .filter(col("k") == KEY)
+        .select("k", "v")
+        .collect()
+    )
+    expected = _expected_rows(session2, src)
+    assert sorted(got.columns["v"].data.tolist()) == expected, f"{tag}: wrong rows"
+
+    # fsck: repair vacuums the crash litter, then the tree scans clean
+    doctor(indexes_dir, repair=True)
+    final = doctor(indexes_dir)
+    assert final.ok, (
+        f"{tag}: doctor still reports "
+        f"{[i.to_json_dict() for i in final.inconsistencies]}"
+    )
+
+
+def _run_crash_point(root, kind, call_index, torn: bool):
+    tag = f"{kind}@{call_index}" + ("-torn" if torn else "")
+    session, hs, src, indexes_dir = fresh_env(root, tag)
+    _baseline(kind, session, hs, src)
+    rule = FaultRule(
+        kind="torn" if torn else "crash", op="*", after=call_index
+    )
+    fault = FaultInjectingFileSystem(PosixFileSystem(), [rule])
+    with faulted_log_managers(fault):
+        with pytest.raises(InjectedCrash):
+            _action(kind, session, hs, src)
+    assert fault.dead
+    _assert_recovered(root, tag, src, indexes_dir)
+
+
+@pytest.mark.parametrize("kind", ["create", "refresh", "optimize", "delete", "vacuum"])
+def test_chaos_kill_every_mutating_op(tmp_path, kind):
+    """Crash the action at EVERY mutating log-protocol call; the index
+    must self-heal every single time."""
+    mutating, _ = _enumerate_fault_points(tmp_path, kind)
+    assert len(mutating) >= 3, f"{kind}: expected >=3 kill points, got {mutating}"
+    for call_index in mutating:
+        _run_crash_point(tmp_path, kind, call_index, torn=False)
+
+
+@pytest.mark.parametrize("kind", ["create", "refresh", "optimize", "delete", "vacuum"])
+def test_chaos_torn_metadata_overwrites(tmp_path, kind):
+    """Tear every metadata OVERWRITE (half the payload lands, then the
+    process dies): the protocol must never read the torn bytes as a
+    commit, and doctor --repair must restore a clean tree."""
+    _, writes = _enumerate_fault_points(tmp_path, kind)
+    assert writes, f"{kind}: expected at least one overwrite point"
+    for call_index in writes:
+        _run_crash_point(tmp_path, kind, call_index, torn=True)
+
+
+@pytest.mark.parametrize("kind", ["create", "refresh", "optimize", "delete", "vacuum"])
+def test_chaos_storage_weather_every_op_flakes_once(tmp_path, kind):
+    """Every storage call fails transiently on its first attempt; the
+    retry layer must carry the whole action to success — no error
+    escapes, the final state is exactly the clean run's."""
+    session, hs, src, indexes_dir = fresh_env(tmp_path, f"weather-{kind}")
+    _baseline(kind, session, hs, src)
+    fault = FaultInjectingFileSystem(
+        PosixFileSystem(), [FaultRule(kind="fail", op="*", every=2)]
+    )
+    with faulted_log_managers(fault):
+        _action(kind, session, hs, src)  # must not raise
+    final_state = {
+        "create": states.ACTIVE,
+        "refresh": states.ACTIVE,
+        "optimize": states.ACTIVE,
+        "delete": states.DELETED,
+        "vacuum": states.DOESNOTEXIST,
+    }[kind]
+    mgr = IndexLogManagerImpl(indexes_dir / IDX)
+    assert mgr.get_latest_log().state == final_state
+    assert doctor(indexes_dir).ok
