@@ -1342,6 +1342,164 @@ def main() -> None:
             os.environ["HYPERSPACE_TPU_HBM"] = _prev_hbm11
         session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, "false")
 
+    # ---- config 12: device-resident join pipeline (host A/B) ---------------
+    # The join-residency claim (docs/13-join-residency.md): the bucketed
+    # SMJ's weakest external speedups are the join shapes because only
+    # the filter path was device-resident. With both sides' join codes +
+    # payload columns resident (exec.join_residency), the materializing
+    # join's range walk runs ON device (scan.path.resident_join) and the
+    # Q17-shaped aggregate-join fuses sorted-intersection +
+    # segment-aggregate into ONE dispatch shipping ONE group table home
+    # (scan.path.resident_join_agg). A/B: host paths (residency off) vs
+    # resident over the SAME indexed plans, parity-gated, per-query H2D
+    # asserted zero after population.
+    if (
+        os.environ.get("BENCH_JOIN_RESIDENT", "1") != "0"
+        and "resident_device_s" in extras
+    ):
+        from hyperspace_tpu.exec.hbm_cache import hbm_cache as _hbm12
+
+        JR_ROWS = int(os.environ.get("BENCH_JOIN_RES_ROWS", 1 << 21))
+        JR_RIGHT = max(JR_ROWS // 4, 1)
+        rngj = np.random.default_rng(17)
+        from hyperspace_tpu.storage.columnar import Column as _Col12
+
+        jr_left = ColumnarBatch(
+            {
+                "j_k": _Col12.from_values(
+                    rngj.integers(1, JR_RIGHT + 1, JR_ROWS).astype(np.int64)
+                ),
+                "j_g": _Col12.from_values(
+                    rngj.integers(1, 200_000, JR_ROWS).astype(np.int64)
+                ),
+                "j_v": _Col12.from_values(
+                    rngj.integers(0, 1 << 20, JR_ROWS).astype(np.int64)
+                ),
+            }
+        )
+        jr_right = ColumnarBatch(
+            {
+                "o_k": _Col12.from_values(
+                    np.arange(1, JR_RIGHT + 1).astype(np.int64)
+                ),
+                "o_p": _Col12.from_values(
+                    np.round(rngj.uniform(1_000.0, 500_000.0, JR_RIGHT), 2)
+                ),
+            }
+        )
+        _write_source(WORKDIR / "jr_left", jr_left, 8)
+        _write_source(WORKDIR / "jr_right", jr_right, 4)
+        t0 = time.perf_counter()
+        hs.create_index(
+            session.read.parquet(str(WORKDIR / "jr_left")),
+            IndexConfig("jr_l_idx", ["j_k"], ["j_g", "j_v"]),
+        )
+        hs.create_index(
+            session.read.parquet(str(WORKDIR / "jr_right")),
+            IndexConfig("jr_r_idx", ["o_k"], ["o_p"]),
+        )
+        jr_detail = {
+            "rows_left": JR_ROWS,
+            "rows_right": JR_RIGHT,
+            "build_s": round(time.perf_counter() - t0, 3),
+        }
+        q12j = lambda: (  # noqa: E731
+            session.read.parquet(str(WORKDIR / "jr_left"))
+            .join(
+                session.read.parquet(str(WORKDIR / "jr_right")),
+                col("j_k") == col("o_k"),
+            )
+            .select("j_v", "o_p")
+        )
+        q12a = lambda: (  # noqa: E731
+            session.read.parquet(str(WORKDIR / "jr_left"))
+            .join(
+                session.read.parquet(str(WORKDIR / "jr_right")),
+                col("j_k") == col("o_k"),
+            )
+            .group_by("j_g")
+            .agg(
+                agg_sum("o_p", "rev"),
+                agg_avg("o_p", "avg_rev"),
+                agg_count(),
+            )
+        )
+        session.enable_hyperspace()
+        _prev_hbm12 = os.environ.get("HYPERSPACE_TPU_HBM")
+        # HOST side: residency off — the host range-fused SMJ paths (the
+        # per-query code walk) are exactly what this config meters
+        os.environ["HYPERSPACE_TPU_HBM"] = "off"
+        _hbm12.reset()
+        jh = q12j().collect()
+        jh_s = _time(lambda: q12j().collect(), REPEATS, extras, "join_res_host")
+        ah = q12a().collect()
+        ah_s = _time(
+            lambda: q12a().collect(), REPEATS, extras, "join_agg_host"
+        )
+        jr_detail["join_host_s"] = round(jh_s, 4)
+        jr_detail["agg_host_s"] = round(ah_s, 4)
+        # RESIDENT side: first touch schedules the region build; the
+        # join of the region population runs the real production path
+        os.environ["HYPERSPACE_TPU_HBM"] = "auto"
+        q12j().collect()
+        q12a().collect()  # widens the region with the group/agg payload
+        _hbm12.wait_background(300)
+        q12a().collect()  # a second touch after the plain-join build wins
+        _hbm12.wait_background(300)
+        jr_detail["hbm_joins"] = _hbm12.snapshot_joins()
+        if jr_detail["hbm_joins"]["regions"] < 1:
+            jr_detail["error"] = (
+                "join region never registered (device/link down or "
+                "budget override)"
+            )
+            extras["join_resident"] = jr_detail
+        else:
+            _indexed_run_begin()
+            jr = q12j().collect()
+            jr_s = _time(
+                lambda: q12j().collect(), REPEATS, extras, "join_res_device"
+            )
+            ar = q12a().collect()
+            ar_s = _time(
+                lambda: q12a().collect(), REPEATS, extras, "join_agg_device"
+            )
+            join_h2d = metrics.counter("hbm.join.h2d_bytes")
+            join_d2h = metrics.counter("scan.resident_join.d2h_bytes")
+            _indexed_run_end()
+            if engine_paths.get("scan.path.resident_join", 0) <= 0:
+                _fail("config12 resident join path never fired")
+            if engine_paths.get("scan.path.resident_join_agg", 0) <= 0:
+                _fail("config12 resident aggregate-join never fired")
+            if join_h2d != 0:
+                _fail("config12 paid per-query join H2D")
+            if jr.num_rows != jh.num_rows:
+                _fail("config12 resident join row parity violated")
+            if int(jr.columns["j_v"].data.sum()) != int(
+                jh.columns["j_v"].data.sum()
+            ):
+                _fail("config12 resident join checksum parity violated")
+            if ar.num_rows != ah.num_rows:
+                _fail("config12 resident agg-join group parity violated")
+            ah_rev = float(ah.columns["rev"].data.sum())
+            if abs(float(ar.columns["rev"].data.sum()) - ah_rev) > 1e-6 * abs(
+                ah_rev
+            ):
+                _fail("config12 resident agg-join checksum parity violated")
+            speedups["join_resident"] = jh_s / jr_s
+            speedups["join_resident_agg"] = ah_s / ar_s
+            jr_detail["join_device_s"] = round(jr_s, 4)
+            jr_detail["agg_device_s"] = round(ar_s, 4)
+            jr_detail["d2h_bytes_per_query"] = int(
+                join_d2h / max(2 * (REPEATS + 2), 1)
+            )
+            extras["join_resident_join_vs_host"] = round(jh_s / jr_s, 3)
+            extras["join_resident_agg_vs_host"] = round(ah_s / ar_s, 3)
+            extras["join_resident"] = jr_detail
+        if _prev_hbm12 is None:
+            os.environ.pop("HYPERSPACE_TPU_HBM", None)
+        else:
+            os.environ["HYPERSPACE_TPU_HBM"] = _prev_hbm12
+
     # ---- mesh-path A/B (round-4 verdict next-round #1 "done" criterion) ----
     # run on the virtual 8-device CPU mesh in a subprocess (the bench host
     # has ONE physical chip; per-query link-bytes under each architecture
@@ -1469,7 +1627,12 @@ def main() -> None:
         compact["serve_speedup_vs_serial"] = extras["serve"][
             "speedup_vs_serial"
         ]
-    for k in ("hybrid_resident_delta_s", "hybrid_resident_vs_host_union"):
+    for k in (
+        "hybrid_resident_delta_s",
+        "hybrid_resident_vs_host_union",
+        "join_resident_join_vs_host",
+        "join_resident_agg_vs_host",
+    ):
         if k in extras:
             compact[k] = extras[k]
     compact["detail"] = detail_path.name

@@ -25,13 +25,18 @@ from ..telemetry.metrics import metrics
 
 def _key_array(col: Column) -> np.ndarray:
     """int64 array whose equality ⟺ key equality. Strings use dictionary
-    codes (NULL = -1 is just another value); floats use their bit pattern
-    with -0.0 normalized."""
+    codes (NULL = -1 is just another value); floats ride the ONE shared
+    key normalization (ops.floatbits.float_key_codes: -0.0 normalized,
+    NaN canonicalized to a single bit pattern). Per SQL, NaN is a valid
+    GROUP key — all NaNs land in one group — so the canonical code is
+    kept as-is; the join layer, whose SQL semantics are the opposite
+    (NaN matches nothing), poisons the same codes with sentinels."""
     if is_string(col.dtype_str):
         return col.data.astype(np.int64)
     if col.data.dtype.kind == "f":
-        f = np.where(col.data == 0.0, 0.0, col.data.astype(np.float64))
-        return f.view(np.int64)
+        from ..ops.floatbits import float_key_codes
+
+        return float_key_codes(col.data)[0]
     return col.data.astype(np.int64)
 
 

@@ -672,64 +672,66 @@ class Executor:
 
     # -- joins ---------------------------------------------------------------
     def _try_join_aggregate(self, plan: "Aggregate") -> Optional[ColumnarBatch]:
-        """Fuse Aggregate([Project](Join)) over a bucketed SMJ into range
-        arithmetic: the join's match ranges (lo, counts) feed
-        aggregate_join_ranges directly — the expanded pair arrays and the
-        materialized joined batch (the bulk of Q17's indexed time) are
-        never built. Falls back (None) whenever the shapes, key columns,
-        or aggregate functions don't qualify; results are identical to
-        materialize + hash_aggregate."""
+        """Fuse Aggregate([Project](Join)) over a bucketed SMJ. The
+        first arm is the DEVICE-resident fused aggregate-join (one
+        sorted-intersection + segment-aggregate dispatch over a resident
+        join region, exec.join_residency — single-chip AND mesh); the
+        host arm fuses the join's match ranges (lo, counts) into
+        aggregate_join_ranges range arithmetic — the expanded pair
+        arrays and the materialized joined batch (the bulk of Q17's
+        indexed time) are never built on either arm. Falls back (None)
+        whenever the shapes, key columns, or aggregate functions don't
+        qualify; results are identical to materialize + hash_aggregate."""
         from .aggregate import aggregate_join_ranges
         from .joins import bucketed_join_ranges
 
-        if self.mesh is not None:
-            # the mesh path has its own distributed join + two-phase
-            # aggregate; the host fusion must not hijack it
-            return None
         node = plan.child
         if isinstance(node, Project):
             node = node.child
         if not isinstance(node, Join):
+            return None
+        # condition extraction + group-keys-on-the-left orientation: the
+        # ONE shared rule (exec.join_residency — the serve batcher's
+        # classifier runs the same one, so a query never orients
+        # differently served vs collected)
+        from .join_residency import orient_join_aggregate
+
+        oriented = orient_join_aggregate(plan)
+        if oriented is None:
+            return None
+        left_plan, right_plan, lk, rk, group_by, _aggs = oriented
+        # same metadata gates as _try_bucketed_join (on the oriented
+        # sides — the checks are side-symmetric)
+        l_meta = self._bucketed_meta(left_plan)
+        r_meta = self._bucketed_meta(right_plan)
+        if l_meta is None or r_meta is None:
+            return None
+        if l_meta.entry.num_buckets != r_meta.entry.num_buckets:
+            return None
+        if {c.lower() for c in l_meta.entry.indexed_columns} != {
+            k.lower() for k in lk
+        } or {c.lower() for c in r_meta.entry.indexed_columns} != {
+            k.lower() for k in rk
+        }:
+            return None
+        # device-resident fused aggregate-join first: ONE dispatch over
+        # the resident join region ships the finished group table home
+        # (the mesh arm runs the two-phase sharded variant). Declines
+        # fall through to the exact host arms below.
+        fused = self._try_resident_join_agg(
+            left_plan, right_plan, lk, rk, group_by, list(plan.aggs)
+        )
+        if fused is not None:
+            return fused
+        if self.mesh is not None:
+            # the mesh path has its own distributed join + two-phase
+            # aggregate; the host fusion must not hijack it
             return None
         # metadata-decidable eligibility BEFORE any bucket I/O: an
         # ineligible shape would load both sides, fail in
         # aggregate_join_ranges, then re-load everything on the fallback
         if any(a.fn not in ("count", "sum", "avg") for a in plan.aggs):
             return None
-        pairs = extract_equi_condition(node.condition)
-        if pairs is None:
-            return None
-        oriented = align_condition_sides(
-            pairs, node.left.output_columns(), node.right.output_columns()
-        )
-        if oriented is None:
-            return None
-        l_keys = [l for l, _ in oriented]
-        r_keys = [r for _, r in oriented]
-        # same metadata gates as _try_bucketed_join
-        l_meta = self._bucketed_meta(node.left)
-        r_meta = self._bucketed_meta(node.right)
-        if l_meta is None or r_meta is None:
-            return None
-        if l_meta.entry.num_buckets != r_meta.entry.num_buckets:
-            return None
-        if {c.lower() for c in l_meta.entry.indexed_columns} != {
-            k.lower() for k in l_keys
-        } or {c.lower() for c in r_meta.entry.indexed_columns} != {
-            k.lower() for k in r_keys
-        }:
-            return None
-        # the fusion needs group keys on the LEFT side; the inner join is
-        # symmetric, so swap when they live on the right
-        group_by = list(plan.group_by)
-        left_cols = {c.lower() for c in node.left.output_columns()}
-        right_cols = {c.lower() for c in node.right.output_columns()}
-        sides = (node.left, node.right, l_keys, r_keys)
-        if not all(g.lower() in left_cols for g in group_by):
-            if not all(g.lower() in right_cols for g in group_by):
-                return None  # group keys span both sides: not fusable
-            sides = (node.right, node.left, r_keys, l_keys)
-        left_plan, right_plan, lk, rk = sides
         lload = self._scan_side_by_bucket(left_plan)
         rload = self._scan_side_by_bucket(right_plan)
         if lload is None or rload is None:
@@ -752,6 +754,131 @@ class Executor:
         return aggregate_join_ranges(
             l_all, r_all, group_by, list(plan.aggs), lo, counts, r_order
         )
+
+    def _try_resident_join_agg(
+        self, left_plan, right_plan, l_keys, r_keys, group_by, aggs
+    ) -> Optional[ColumnarBatch]:
+        """The device-resident fused aggregate-join arm: eligibility is
+        exec.join_residency.resolve_join_residency — the ONE procedure
+        shared with _exec_join's materializing arm and the serve
+        micro-batcher. Device loss mid-query drops the region and
+        latches this query down to the exact host path."""
+        from ..telemetry.metrics import metrics
+        from .join_residency import resolve_join_residency
+
+        need = list(
+            dict.fromkeys(
+                list(group_by) + [a.column for a in aggs if a.column]
+            )
+        )
+        res = resolve_join_residency(
+            left_plan,
+            right_plan,
+            l_keys,
+            r_keys,
+            mesh=self.mesh,
+            payload_columns=need,
+        )
+        if res.status == "no_region":
+            self._note_join_touch(res, left_plan, right_plan, need)
+            return None
+        if res.status != "ok":
+            return None
+        if self.mesh is not None:
+            from .mesh_cache import mesh_cache as cache
+        else:
+            from .hbm_cache import hbm_cache as cache
+        try:
+            out = cache.join_agg(res.region, group_by, aggs)
+        except Exception:  # noqa: BLE001 - device loss degrades to host
+            cache.drop(res.region)
+            metrics.incr("scan.resident_join.device_failed")
+            return None
+        if out is None:
+            return None  # spec declined (dtype coverage): exact host path
+        metrics.incr(
+            "scan.path.resident_join_agg_mesh"
+            if self.mesh is not None
+            else "scan.path.resident_join_agg"
+        )
+        from .scan_gate import scan_gate
+
+        scan_gate.note_resident_bypass("join")
+        return out
+
+    def _note_join_touch(self, res, left_plan, right_plan, payload) -> None:
+        """Schedule background join-region population for the NEXT query
+        (note_touch contract: never blocks). The loader re-derives both
+        sides' bucket groups on the background thread — warm repeats hit
+        the cross-query groups cache and pay no IO."""
+        if self.mesh is not None:
+            from .mesh_cache import mesh_cache as cache
+        else:
+            from .hbm_cache import hbm_cache as cache
+        if not cache.auto_enabled():
+            return
+        l_files = res.l_node.entry.content.files()
+        r_files = res.r_node.entry.content.files()
+
+        def loader():
+            lload = self._scan_side_by_bucket(left_plan)
+            rload = self._scan_side_by_bucket(right_plan)
+            if lload is None or rload is None:
+                return None
+            lb, _ln, lp = lload
+            rb, _rn, rp = rload
+            if lp is not None:
+                lb = _project_groups(lb, list(lp.columns))
+            if rp is not None:
+                rb = _project_groups(rb, list(rp.columns))
+            return lb, rb
+
+        if self.mesh is not None:
+            cache.note_touch_join(
+                l_files, r_files, res.l_keys, res.r_keys, payload, loader,
+                self.mesh,
+            )
+        else:
+            cache.note_touch_join(
+                l_files, r_files, res.l_keys, res.r_keys, payload, loader
+            )
+
+    def _resident_join_pairs(
+        self, region, l_by_bucket, r_by_bucket, l_keys, r_keys
+    ) -> Optional[ColumnarBatch]:
+        """The materializing resident join: the match-range walk runs ON
+        device over the resident codes (one dispatch, zero H2D — only
+        the (lo, counts) vectors come home); the output gather stays
+        host-side over the (cross-query-cached) bucket groups, which is
+        where the design note says gathers belong. None degrades to the
+        host join (device loss drops the region; shape drift declines)."""
+        from ..telemetry.metrics import metrics
+        from .hbm_cache import hbm_cache
+        from .joins import _bucketed_join_setup, _expand_ranges
+
+        setup, _ck = _bucketed_join_setup(
+            l_by_bucket, r_by_bucket, list(l_keys), list(r_keys)
+        )
+        if setup is None:
+            return None
+        l_all, r_all = setup[0], setup[1]
+        if l_all.num_rows != region.n_l or r_all.num_rows != region.n_r:
+            return None  # groups drifted from the region: host path
+        try:
+            lo, counts = hbm_cache.join_ranges(region)
+        except Exception:  # noqa: BLE001 - device loss degrades to host
+            hbm_cache.drop(region)
+            metrics.incr("scan.resident_join.device_failed")
+            return None
+        l_idx, r_idx = _expand_ranges(lo, counts, region.r_order)
+        out: Dict[str, object] = {}
+        out.update(l_all.take(l_idx).columns)
+        out.update(r_all.take(r_idx).columns)
+        metrics.incr("scan.path.resident_join")
+        from .scan_gate import scan_gate
+
+        scan_gate.note_resident_bypass("join")
+        return ColumnarBatch(out)
 
     def _exec_join(self, join: Join) -> ColumnarBatch:
         pairs = extract_equi_condition(join.condition)
@@ -992,6 +1119,26 @@ class Executor:
             l_by_bucket = _project_groups(l_by_bucket, list(l_project.columns))
         if r_project is not None:
             r_by_bucket = _project_groups(r_by_bucket, list(r_project.columns))
+        if self.mesh is None:
+            # device-resident materializing join: the range walk runs on
+            # the resident codes, the gather stays host-side (the mesh
+            # arm serves aggregate-joins only — a sharded materializing
+            # join would D2H per-row positions, the link's worst shape)
+            from .join_residency import resolve_join_residency
+
+            res = resolve_join_residency(join.left, join.right, l_keys, r_keys)
+            if res.status == "ok":
+                served = self._resident_join_pairs(
+                    res.region,
+                    l_by_bucket,
+                    r_by_bucket,
+                    list(res.l_keys),
+                    list(res.r_keys),
+                )
+                if served is not None:
+                    return served
+            elif res.status == "no_region":
+                self._note_join_touch(res, join.left, join.right, ())
         total_rows = sum(b.num_rows for b in l_by_bucket.values()) + sum(
             b.num_rows for b in r_by_bucket.values()
         )

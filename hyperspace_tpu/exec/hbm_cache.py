@@ -722,6 +722,16 @@ class ResidentCacheBase:
         # key, appended-file snapshot, deleted lineage ids) — the hybrid
         # scan's device fast path between refreshes
         self._deltas: list = []
+        # join regions: (left version, right version, keys) pairs' join
+        # codes + payload columns — the bucketed SMJ's device fast path
+        # (exec.join_residency). Retention priority under budget
+        # pressure: deltas evict first, join regions second, base tables
+        # last — a region is cheap to rebuild from the groups cache but
+        # dearer than a delta's single-file decode.
+        self._joins: list = []
+        # bumped on every join-region register/evict/invalidate: the
+        # serve plan cache folds it into its version token
+        self._join_version = 0
         self._pending: set = set()
         # (file-set key, frozenset(columns)) that can never materialize
         # (unencodable columns, too small, over budget): without this
@@ -750,13 +760,16 @@ class ResidentCacheBase:
             return not self._tables
 
     def drop(self, table) -> None:
-        """Unregister a table (device loss mid-query): later queries
-        route through the gate instead of retrying a dead device. Delta
-        regions built over the dropped base go with it — they hold
-        device arrays on the same (possibly dead) device and are useless
-        without their base."""
+        """Unregister a table OR a join region (device loss mid-query):
+        later queries route through the gate instead of retrying a dead
+        device. Delta regions built over a dropped base go with it —
+        they hold device arrays on the same (possibly dead) device and
+        are useless without their base."""
         with self._lock:
             self._tables = [t for t in self._tables if t is not table]
+            if any(j is table for j in self._joins):
+                self._joins = [j for j in self._joins if j is not table]
+                self._join_version += 1
             key = getattr(table, "key", None)
             self._deltas = [d for d in self._deltas if d.base_key != key]
 
@@ -792,6 +805,108 @@ class ResidentCacheBase:
         if n:
             metrics.incr(f"{self._metric_prefix}.delta.invalidated", n)
 
+    def invalidate_joins(self, index_root: Optional[str] = None) -> None:
+        """Drop join regions — the refresh/optimize hook, scoped like
+        invalidate_deltas: a rewritten index changes its file
+        identities, so any region touching that index's directory (on
+        EITHER side of the join) could never serve again and would only
+        pin HBM. None drops everything (reset paths, operators). Quick
+        refresh deliberately does not call this: it changes no index
+        data files, so region keys stay valid and the uploaded codes
+        keep serving."""
+        prefix = None
+        if index_root is not None:
+            prefix = str(index_root).rstrip("/") + "/"
+        from .join_residency import region_roots
+
+        with self._lock:
+            if prefix is None:
+                n = len(self._joins)
+                self._joins.clear()
+            else:
+                keep = [
+                    j
+                    for j in self._joins
+                    if not any(
+                        str(p).startswith(prefix) for p in region_roots(j)
+                    )
+                ]
+                n = len(self._joins) - len(keep)
+                self._joins[:] = keep
+            if n:
+                self._join_version += 1
+        if n:
+            metrics.incr(f"{self._metric_prefix}.join.invalidated", n)
+
+    def join_region_version(self) -> int:
+        """Monotonic join-region generation counter — folded into the
+        serve plan cache's version token so cached plans never outlive a
+        region change they were classified against."""
+        with self._lock:
+            return self._join_version
+
+    def _register_join(self, region, epoch: Optional[int] = None) -> bool:
+        """Register a join region under the shared byte budget. A new
+        build over the same key supersedes (widened payload rebuilds);
+        under pressure deltas evict first, then OTHER join regions —
+        never a base table (the refusal rule _register_delta follows)."""
+        with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                return False  # cache was reset() since the build started
+            for j in self._joins:
+                if j.key == region.key:
+                    metrics.incr(f"{self._metric_prefix}.join.superseded")
+            self._joins = [j for j in self._joins if j.key != region.key]
+            self._joins.append(region)
+            budget = _budget_bytes()
+
+            def total() -> int:
+                return (
+                    sum(t.nbytes for t in self._tables)
+                    + sum(d.nbytes for d in self._deltas)
+                    + sum(j.nbytes for j in self._joins)
+                )
+
+            while total() > budget and self._deltas:
+                dvictim = min(self._deltas, key=lambda d: d.last_used)
+                self._deltas.remove(dvictim)
+                metrics.incr(f"{self._metric_prefix}.delta.evicted")
+            while total() > budget and len(self._joins) > 1:
+                jvictim = min(
+                    (j for j in self._joins if j is not region),
+                    key=lambda j: j.last_used,
+                )
+                self._joins.remove(jvictim)
+                metrics.incr(f"{self._metric_prefix}.join.evicted")
+            if total() > budget:
+                self._joins.remove(region)
+                self._join_version += 1
+                metrics.incr(
+                    f"{self._metric_prefix}.join.over_budget_refused"
+                )
+                return False
+            self._join_version += 1
+            metrics.incr(f"{self._metric_prefix}.join.registered")
+            return True
+
+    def snapshot_joins(self) -> dict:
+        with self._lock:
+            return {
+                "regions": len(self._joins),
+                "mb": round(sum(j.nbytes for j in self._joins) / 1e6, 1),
+                "version": self._join_version,
+                "per_region": [
+                    {
+                        "rows_l": j.n_l,
+                        "rows_r": j.n_r,
+                        "keys": list(j.key[2]),
+                        "payload": sorted(j.l_cols) + sorted(j.r_cols),
+                        "mb": round(j.nbytes / 1e6, 1),
+                    }
+                    for j in self._joins
+                ],
+            }
+
     def _register_delta(self, delta, epoch: Optional[int] = None) -> None:
         with self._lock:
             if epoch is not None and epoch != self._epoch:
@@ -821,8 +936,10 @@ class ResidentCacheBase:
             ]
             self._deltas.append(delta)
             budget = _budget_bytes()
-            total = sum(t.nbytes for t in self._tables) + sum(
-                d.nbytes for d in self._deltas
+            total = (
+                sum(t.nbytes for t in self._tables)
+                + sum(d.nbytes for d in self._deltas)
+                + sum(j.nbytes for j in self._joins)
             )
             # evict OTHER deltas first (cheapest to rebuild; a delta is
             # useless without its base, never the other way around) —
@@ -873,17 +990,25 @@ class ResidentCacheBase:
             budget = _budget_bytes()
 
             def total() -> int:
-                return sum(t.nbytes for t in self._tables) + sum(
-                    d.nbytes for d in self._deltas
+                return (
+                    sum(t.nbytes for t in self._tables)
+                    + sum(d.nbytes for d in self._deltas)
+                    + sum(j.nbytes for j in self._joins)
                 )
 
-            # deltas drain FIRST (cheapest to rebuild — the same priority
-            # _register_delta states); only then are LRU base tables
-            # sacrificed, each taking its dependent deltas with it
+            # deltas drain FIRST (cheapest to rebuild), join regions
+            # second (rebuildable from the host groups cache); only then
+            # are LRU base tables sacrificed, each taking its dependent
+            # deltas with it
             while total() > budget and self._deltas:
                 dvictim = min(self._deltas, key=lambda d: d.last_used)
                 self._deltas.remove(dvictim)
                 metrics.incr(f"{self._metric_prefix}.delta.evicted")
+            while total() > budget and self._joins:
+                jvictim = min(self._joins, key=lambda j: j.last_used)
+                self._joins.remove(jvictim)
+                self._join_version += 1
+                metrics.incr(f"{self._metric_prefix}.join.evicted")
             while total() > budget and len(self._tables) > 1:
                 victim = min(
                     (t for t in self._tables if t is not table),
@@ -918,9 +1043,17 @@ class ResidentCacheBase:
         with self._lock:
             self._tables.clear()
             self._deltas.clear()
+            self._joins.clear()
+            self._join_version += 1
             self._pending.clear()
             self._failed.clear()
             self._epoch += 1
+
+    def current_epoch(self) -> int:
+        """The reset() generation — consulted by the join layer's device
+        -kernel latch so an operator/test reset re-arms the kernel."""
+        with self._lock:
+            return self._epoch
 
 
 class HbmIndexCache(ResidentCacheBase):
@@ -1838,16 +1971,229 @@ class HbmIndexCache(ResidentCacheBase):
                 parts.append(sub.take(idx).select(list(output_columns)))
         return parts
 
+    # -- join regions (the device-resident bucketed SMJ) ---------------------
+    def join_for(
+        self, l_files, r_files, l_keys, r_keys, columns=()
+    ) -> Optional[object]:
+        """The registered join region for exactly this (left version,
+        right version, keys) pair with every payload column in
+        ``columns`` resident, else None. Mode "off" disables serving
+        here too (resident_for rationale)."""
+        from .join_residency import join_region_key
+
+        if residency_mode() == "off":
+            return None
+        with self._lock:
+            if not self._joins:
+                return None  # skip the per-file stats on a cold cache
+        try:
+            key = join_region_key(l_files, r_files, l_keys, r_keys)
+        except OSError:
+            return None
+        with self._lock:
+            for j in reversed(self._joins):
+                if j.key == key and all(
+                    c in j.l_cols or c in j.r_cols for c in columns
+                ):
+                    j.last_used = time.monotonic()
+                    return j
+        return None
+
+    def note_touch_join(
+        self, l_files, r_files, l_keys, r_keys, payload_columns, loader
+    ) -> None:
+        """First-touch join-region population: background build of this
+        pair's join codes (+ the payload columns an aggregate needs) so
+        REPEAT joins take the fused device path. ``loader`` is a
+        zero-arg callable returning (l_by_bucket, r_by_bucket) or None —
+        run on the background thread (the groups cache makes it cheap on
+        a warm repeat; cold it pays the IO the query just paid, once).
+        Never blocks, never throws (note_touch contract)."""
+        if not _auto_enabled():
+            return
+        from .join_residency import build_join_region, join_region_key
+
+        try:
+            key = join_region_key(l_files, r_files, l_keys, r_keys)
+        except OSError:
+            return
+        want = frozenset(payload_columns)
+        memo = ("join", key, want)
+        pending = ("join", key)
+        with self._lock:
+            if pending in self._pending or memo in self._failed:
+                return
+            if any(
+                j.key == key
+                and all(c in j.l_cols or c in j.r_cols for c in want)
+                for j in self._joins
+            ):
+                return
+            self._pending.add(pending)
+            epoch = self._epoch
+
+        def bg():
+            failed = False
+            try:
+                groups = loader()
+                if groups is None:
+                    return
+                # widen rather than replace (note_touch rationale):
+                # alternating aggregate shapes converge on one region
+                with self._lock:
+                    prior = next(
+                        (j for j in self._joins if j.key == key), None
+                    )
+                cols = list(
+                    dict.fromkeys(
+                        list(payload_columns)
+                        + (
+                            sorted(set(prior.l_cols) | set(prior.r_cols))
+                            if prior
+                            else []
+                        )
+                    )
+                )
+                region, permanent = build_join_region(
+                    self, groups[0], groups[1], key[2], key[3], key, cols
+                )
+                if region is not None:
+                    self._register_join(region, epoch=epoch)
+                    if not all(
+                        c in region.l_cols or c in region.r_cols
+                        for c in want
+                    ):
+                        # a requested payload column can never encode
+                        # for this version pair (string/oversized) —
+                        # memoize or every query reschedules an
+                        # identical rebuild forever
+                        failed = True
+                elif permanent:
+                    failed = True
+            except Exception:  # noqa: BLE001 - population must never fail a query
+                metrics.incr(f"{self._metric_prefix}.join.populate_failed")
+            finally:
+                with self._lock:
+                    self._pending.discard(pending)
+                    if failed:
+                        if len(self._failed) >= _MAX_FAILED_MEMO:
+                            self._failed.clear()
+                        self._failed.add(memo)
+
+        t = threading.Thread(
+            target=bg, daemon=True, name="hbm-join-populate"
+        )
+        self._track_for_exit(t)
+        t.start()
+
+    def prefetch_join(
+        self,
+        l_by_bucket,
+        r_by_bucket,
+        l_files,
+        r_files,
+        l_keys,
+        r_keys,
+        payload_columns=(),
+    ) -> Optional[object]:
+        """Synchronously build and register a join region (benches,
+        tests, latency-critical sessions). Idempotent; a region built
+        with a narrower payload set is rebuilt widened."""
+        from .join_residency import build_join_region, join_region_key
+
+        try:
+            key = join_region_key(l_files, r_files, l_keys, r_keys)
+        except OSError:
+            return None
+        existing = self.join_for(
+            l_files, r_files, l_keys, r_keys, payload_columns
+        )
+        if existing is not None:
+            return existing
+        region, _ = build_join_region(
+            self,
+            l_by_bucket,
+            r_by_bucket,
+            list(l_keys),
+            list(r_keys),
+            key,
+            list(payload_columns),
+        )
+        if region is None:
+            return None
+        return region if self._register_join(region) else None
+
+    def join_ranges(self, region) -> Tuple[np.ndarray, np.ndarray]:
+        """(lo, counts) match-range vectors of the resident bucketed SMJ
+        — ONE device dispatch over the resident codes, zero per-query
+        H2D; left row i matches sorted-right positions [lo[i],
+        lo[i]+counts[i]) which region.r_order maps back to rows. Device
+        errors propagate (the caller latches down to the host join)."""
+        from .join_residency import ranges_fn
+
+        fn = ranges_fn()
+        t0 = time.perf_counter()
+        lo, counts = fn(region.l_codes, region.r_codes)
+        lo = np.asarray(lo)
+        counts = np.asarray(counts)
+        metrics.record_time(
+            "scan.resident_join.device", time.perf_counter() - t0
+        )
+        metrics.incr(
+            "scan.resident_join.d2h_bytes",
+            int(lo.nbytes + counts.nbytes),
+        )
+        return lo.astype(np.int64), counts.astype(np.int64)
+
+    def join_agg(self, region, group_by, aggs):
+        """The fused aggregate-join: sorted-intersection match ranges
+        feeding segment-sum/count/min/max in ONE executable, ONE D2H of
+        the span-sized group vectors — the finished group table comes
+        home, nothing else rides the link. None when the (group_by,
+        aggs) spec cannot ride the device exactly (caller routes the
+        host fusion/materialize path); device errors propagate."""
+        from ..utils.jaxcompat import enable_x64
+        from .join_residency import (
+            finish_join_agg,
+            join_agg_fn,
+            plan_device_arrays,
+            region_agg_plan,
+        )
+
+        plan = region_agg_plan(region, list(group_by), list(aggs))
+        if plan is None:
+            metrics.incr(f"{self._metric_prefix}.join.declined.dtype")
+            return None
+        fn = join_agg_fn(plan, region.n_l, region.n_r)
+        arrays = plan_device_arrays(region, plan)
+        slots = region.l_cols[plan.group].slots
+        t0 = time.perf_counter()
+        # x64 scope: the segment sums accumulate int64/float64 — exact
+        # int arithmetic is the parity contract (module docstring)
+        with enable_x64(True):
+            raw = fn(region.l_codes, region.r_codes, slots, arrays)
+        outs = [np.asarray(o) for o in raw]
+        metrics.record_time(
+            "scan.resident_join_agg.device", time.perf_counter() - t0
+        )
+        metrics.incr(
+            "scan.resident_join.d2h_bytes",
+            sum(int(o.nbytes) for o in outs),
+        )
+        return finish_join_agg(region, plan, list(group_by), list(aggs), outs)
+
     # -- observability -------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
             return {
                 "tables": len(self._tables),
                 "deltas": len(self._deltas),
+                "joins": len(self._joins),
                 "resident_mb": round(
                     (
                         sum(t.nbytes for t in self._tables)
                         + sum(d.nbytes for d in self._deltas)
+                        + sum(j.nbytes for j in self._joins)
                     )
                     / 1e6,
                     1,

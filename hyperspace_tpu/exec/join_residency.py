@@ -1,0 +1,1049 @@
+"""Device-resident join pipeline: regions, eligibility, fused dispatches.
+
+The bench's weakest external speedups are exactly the join shapes
+(BENCH_r05: 5.5-9.0x vs 88x for point filters) because only the *filter*
+path is device-resident: the bucketed SMJ and the aggregate-over-join
+fusion run on host numpy and re-touch per-bucket data every query. TQP
+("Query Processing on Tensor Computation Runtimes") shows hash/merge
+joins and grouped aggregation map cleanly onto tensor runtimes; Theseus
+shows the win is dominated by *not moving the data*. This module carries
+both conclusions into the residency design the scan path already proved:
+
+* a **join region** keeps one (left index version, right index version,
+  join keys) pair's *join codes* resident in HBM — the composite int64
+  codes of `joins.join_codes` narrowed to the i32 transport, the right
+  side globally pre-sorted at build (hash bucketing guarantees equal
+  codes share a bucket, so one global sort replaces per-bucket merges)
+  — plus the payload/group/agg columns an indexed aggregate-join needs,
+  as raw-bit i32 planes (floats never cross the link as floats:
+  ops.floatbits rationale);
+* the fused dispatches then resolve a join ON device: one
+  ``searchsorted`` pair over resident codes produces the match ranges
+  (``scan.path.resident_join`` — only the (lo, counts) vectors come
+  home, zero per-query H2D), and for Q17-shaped aggregate-joins the
+  ranges feed segment-sum/count/min/max *in the same executable*
+  (``scan.path.resident_join_agg``) so ONE D2H ships the finished group
+  table;
+* the **mesh variant** exploits the build's ``b % D`` placement: both
+  sides' codes pack per owner device (equal keys share a bucket, so the
+  sharded join is shuffle-free) and the aggregate runs two-phase —
+  per-device partial group vectors, then ``psum``/``pmin``/``pmax``
+  into one replicated group table.
+
+Eligibility is ONE shared procedure (`resolve_join_residency`) used by
+the executor's ``_exec_join`` / ``_try_join_aggregate`` arms and the
+serve micro-batcher — mirroring ``exec.delta.resolve_hybrid_residency``
+so a query never routes differently served vs collected. Hybrid
+(bucket-union) and predicate-filtered join sides decline to host (their
+row sets are not a pure function of the immutable index files), as do
+dtype shapes the device cannot serve exactly; the host paths remain
+exact fallbacks and parity is asserted by the tests and the bench gate.
+
+Exactness contract: int aggregates are bit-exact (int64 segment sums and
+prefix differences wrap exactly like the host's); float aggregates sum
+in float64 on device, which is exact transport (bit planes) but
+order-sensitive accumulation — parity there is asserted to float64
+relative tolerance, the same gate the bench applies to host float
+checksums. Float sums under duplicate right matches decline (the prefix
+trick loses precision int64 never does — the host fusion's own rule).
+
+Nothing here reads a device array back: uploads/fences live in the
+builds below, dispatch readbacks live in the cache modules (the HS001
+boundary, like exec.delta).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..plan.ir import (
+    BucketUnion,
+    Filter,
+    IndexScan,
+    Join,
+    Project,
+    Repartition,
+    Union,
+)
+from ..storage.columnar import Column, ColumnarBatch, is_string, numpy_dtype
+from ..telemetry.metrics import metrics
+
+I32_MIN, I32_MAX = -(2**31), 2**31 - 1
+# mesh shards pad both sides to a static per-device capacity; the pads
+# must compare unequal to every real code AND to the other side's pads
+# (a left pad searching the right side must land past every real code
+# and every right pad), so two distinct top codes are reserved and the
+# build refuses code domains that reach them
+L_PAD = I32_MAX
+R_PAD = I32_MAX - 1
+_MAX_CODE = I32_MAX - 2
+
+_AGG_FNS = ("count", "sum", "avg", "min", "max")
+
+
+# ---------------------------------------------------------------------------
+# region state
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JoinPayloadColumn:
+    """One resident payload column of a join region. ``arrays`` are the
+    device i32 planes (1 for int/f32bits, 2 for f64bits — raw IEEE bit
+    planes, NOT the ordered encoding: the aggregate consumer needs
+    VALUES, and host bitcast -> device bitcast round-trips exactly)."""
+
+    arrays: tuple
+    dtype_str: str
+    enc: str  # 'int' | 'f32bits' | 'f64bits'
+    nbytes: int
+    # group-key service (dense-domain int columns only): device slot ids
+    # slot = value - mn, plus the host-side (mn, span) that rebuilds key
+    # values from kept slots — the same dense rule as aggregate._dense
+    slots: Optional[object] = None
+    mn: Optional[int] = None
+    span: Optional[int] = None
+
+
+@dataclass
+class JoinRegion:
+    """One (left index version, right index version, keys) pair's
+    resident join state on the single-chip cache."""
+
+    key: tuple  # (l_ident, r_ident, l_keys, r_keys)
+    n_l: int
+    n_r: int
+    l_codes: object  # device i32 (n_l,)
+    r_codes: object  # device i32 (n_r,), globally sorted
+    r_order: np.ndarray  # host: sorted position -> original right row
+    uniq_right: bool  # right codes unique (the FK->PK / Q17 shape)
+    l_cols: Dict[str, JoinPayloadColumn]
+    r_cols: Dict[str, JoinPayloadColumn]  # pre-permuted by r_order
+    nbytes: int = 0
+    last_used: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class MeshJoinRegion:
+    """The mesh twin: both sides' codes packed per owner device under the
+    build's ``b % D`` rule (equal keys share a bucket, so per-device
+    merges see every possible match), right side sorted *within* each
+    device, pads at the reserved top codes."""
+
+    key: tuple
+    mesh: object
+    n_devices: int
+    cap_l: int  # padded per-device left rows (pow2)
+    cap_r: int
+    dev_rows_l: list
+    dev_rows_r: list
+    l_codes: object  # device (D, cap_l) i32, NamedSharding
+    r_codes: object  # device (D, cap_r) i32, sorted per device row
+    uniq_right: bool
+    l_cols: Dict[str, JoinPayloadColumn]
+    r_cols: Dict[str, JoinPayloadColumn]
+    n_l: int = 0
+    n_r: int = 0
+    nbytes: int = 0
+    last_used: float = field(default_factory=time.monotonic)
+
+
+def join_region_key(l_files, r_files, l_keys, r_keys) -> tuple:
+    """Identity key of a join region: both sides' file identities (path +
+    size + mtime — stale versions never match, hbm_cache's one rule) plus
+    the oriented key columns. Raises OSError for vanished files (caller
+    treats as no region)."""
+    from .hbm_cache import _file_identity
+
+    return (
+        tuple(sorted(_file_identity(p) for p in l_files)),
+        tuple(sorted(_file_identity(p) for p in r_files)),
+        tuple(l_keys),
+        tuple(r_keys),
+    )
+
+
+def region_roots(region) -> list:
+    """The distinct parent-directory prefixes of a region's files — the
+    scope invalidate_joins matches refresh/optimize roots against."""
+    paths = [p for side in region.key[:2] for (p, _s, _m) in side]
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# eligibility — the ONE shared procedure (executor arms + serve batcher)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JoinResidency:
+    """Outcome of resolve_join_residency. ``declined`` statuses mirror
+    the groups-cache opt-outs (``join.cache.optout.{hybrid,filtered}``):
+    the same plan shapes that cannot carry a cross-query cache token
+    cannot be served from a region built over pristine index files."""
+
+    status: str  # "ok" | "no_region" | "declined" | "ineligible"
+    reason: str = ""  # declined: "hybrid" | "filtered"
+    region: object = None
+    l_node: object = None  # the bucketed IndexScan each side resolves to
+    r_node: object = None
+    l_keys: tuple = ()  # keys reordered to the LEFT index's column order
+    r_keys: tuple = ()
+
+
+def orient_join_aggregate(agg):
+    """(left_plan, right_plan, l_keys, r_keys, group_by, aggs) for an
+    ``Aggregate([Project](Join))`` plan, oriented so the group keys live
+    on the LEFT side (the inner join is symmetric) — the ONE orientation
+    rule shared by the executor's fused/host aggregate-join arms and the
+    serve batcher's classifier (a copy in each would drift and route the
+    same query differently served vs collected). None when the shape or
+    condition doesn't qualify."""
+    from ..plan.rules.join_rule import (
+        align_condition_sides,
+        extract_equi_condition,
+    )
+
+    node = agg.child
+    if isinstance(node, Project):
+        node = node.child
+    if not isinstance(node, Join):
+        return None
+    pairs = extract_equi_condition(node.condition)
+    if pairs is None:
+        return None
+    oriented = align_condition_sides(
+        pairs, node.left.output_columns(), node.right.output_columns()
+    )
+    if oriented is None:
+        return None
+    l_keys = [l for l, _ in oriented]
+    r_keys = [r for _, r in oriented]
+    group_by = list(agg.group_by)
+    left_cols = {c.lower() for c in node.left.output_columns()}
+    sides = (node.left, node.right, l_keys, r_keys)
+    if not all(g.lower() in left_cols for g in group_by):
+        right_cols = {c.lower() for c in node.right.output_columns()}
+        if not all(g.lower() in right_cols for g in group_by):
+            return None  # group keys span both sides: not fusable
+        sides = (node.right, node.left, r_keys, l_keys)
+    return (*sides, group_by, list(agg.aggs))
+
+
+def _side_scan(plan):
+    """The bucketed IndexScan a pristine join side resolves to, or
+    (None, why). Filters and hybrid bucket-unions make the side's rows a
+    per-query function of predicate/appended data — not servable from a
+    region keyed only by file identities."""
+    node = plan
+    while isinstance(node, Project):
+        node = node.child
+    if isinstance(node, Filter):
+        return None, "filtered"
+    if isinstance(node, (BucketUnion, Union, Repartition)):
+        return None, "hybrid"
+    if isinstance(node, IndexScan) and node.use_bucket_spec:
+        return node, ""
+    return None, "shape"
+
+
+def resolve_join_residency(
+    left_plan, right_plan, l_keys, r_keys, mesh=None, payload_columns=()
+) -> JoinResidency:
+    """Resolve whether a bucketed equi-join can take the device-resident
+    path on the cache ``mesh`` selects: residency mode, pristine-side
+    shapes (hybrid/filtered decline — counted per cache prefix), bucket
+    and key-vs-indexed-column compatibility, then the region lookup with
+    payload-column coverage. Mirrors exec.delta.resolve_hybrid_residency:
+    executor single-chip/mesh arms and the serve batcher all route
+    through HERE, so a gate tweak cannot split their behavior."""
+    from .hbm_cache import hbm_cache, residency_mode
+
+    cache = hbm_cache
+    if mesh is not None:
+        from .mesh_cache import mesh_cache as cache  # noqa: F811
+
+    if residency_mode() == "off":
+        return JoinResidency("ineligible", "mode")
+    l_node, l_why = _side_scan(left_plan)
+    r_node, r_why = _side_scan(right_plan)
+    if l_node is None or r_node is None:
+        why = l_why or r_why
+        if why in ("filtered", "hybrid"):
+            metrics.incr(f"{cache._metric_prefix}.join.declined.{why}")
+            return JoinResidency("declined", why)
+        return JoinResidency("ineligible", why or "shape")
+    if l_node.entry.num_buckets != r_node.entry.num_buckets:
+        return JoinResidency("ineligible", "buckets")
+    if {c.lower() for c in l_node.entry.indexed_columns} != {
+        k.lower() for k in l_keys
+    } or {c.lower() for c in r_node.entry.indexed_columns} != {
+        k.lower() for k in r_keys
+    }:
+        return JoinResidency("ineligible", "keys")
+    # merge order: both sides keyed in the LEFT index's column order (the
+    # executor's own rule, so region codes match the host merge exactly)
+    k2k = {a.lower(): b for a, b in zip(l_keys, r_keys)}
+    lk = list(l_node.entry.indexed_columns)
+    rk = [k2k[k.lower()] for k in lk]
+    if mesh is None:
+        region = cache.join_for(
+            l_node.entry.content.files(),
+            r_node.entry.content.files(),
+            lk,
+            rk,
+            payload_columns,
+        )
+    else:
+        region = cache.join_for(
+            l_node.entry.content.files(),
+            r_node.entry.content.files(),
+            lk,
+            rk,
+            payload_columns,
+            mesh,
+        )
+    status = "ok" if region is not None else "no_region"
+    return JoinResidency(
+        status, "", region, l_node, r_node, tuple(lk), tuple(rk)
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-side encode (build time)
+# ---------------------------------------------------------------------------
+
+
+def encode_join_payload(col: Column):
+    """(host i32 plane tuple, enc) for a device join payload column, or
+    None when the dtype cannot ride exactly: strings decline (an
+    aggregate's group/value columns would pin unbounded vocab heaps),
+    as does int64 beyond the i32 transport. Floats ride as raw IEEE bit
+    planes — value-exact on the link, reassembled by bitcast on device."""
+    if is_string(col.dtype_str):
+        return None
+    a = col.data
+    if a.dtype == np.float64:
+        bits = np.ascontiguousarray(a, dtype=np.float64).view(np.int64)
+        hi = (bits >> np.int64(32)).astype(np.int32)
+        lo = (bits & np.int64(0xFFFFFFFF)).astype(np.uint32).view(np.int32)
+        return (hi, lo), "f64bits"
+    if a.dtype == np.float32:
+        return (np.ascontiguousarray(a).view(np.int32),), "f32bits"
+    if a.dtype.kind in "iub":
+        a64 = a.astype(np.int64)
+        if len(a64) and (
+            int(a64.min()) < I32_MIN or int(a64.max()) > I32_MAX
+        ):
+            return None
+        return (a64.astype(np.int32),), "int"
+    return None
+
+
+def _encode_codes(l_codes: np.ndarray, r_codes: np.ndarray):
+    """i32-narrowed join codes, or None when the composite code domain
+    exceeds the transport (minus the reserved mesh pad codes). The
+    narrowing is a plain cast — join codes are already exact int64 and
+    both sides share one code space (joins.join_codes), so a shared
+    range check keeps cross-side comparisons value-preserving."""
+    lo_ = min(
+        int(l_codes.min()) if len(l_codes) else 0,
+        int(r_codes.min()) if len(r_codes) else 0,
+    )
+    hi_ = max(
+        int(l_codes.max()) if len(l_codes) else 0,
+        int(r_codes.max()) if len(r_codes) else 0,
+    )
+    if lo_ < I32_MIN or hi_ > _MAX_CODE:
+        return None
+    return l_codes.astype(np.int32), r_codes.astype(np.int32)
+
+
+def _payload_specs(l_all, r_all, payload_columns, n_l):
+    """Per-column host encode for the requested payload set: skips
+    columns absent from both sides or unencodable (the caller's coverage
+    check decides what that means). Returns (side, name, planes, enc,
+    group_service) tuples; group service (slots, mn, span) attaches to
+    dense-domain int LEFT columns only — the group-by side."""
+    out = []
+    for name in dict.fromkeys(payload_columns):
+        for side, batch in (("l", l_all), ("r", r_all)):
+            col = batch.columns.get(name)
+            if col is None:
+                continue
+            e = encode_join_payload(col)
+            if e is None:
+                continue
+            planes, enc = e
+            service = None
+            if side == "l" and enc == "int" and n_l:
+                a64 = col.data.astype(np.int64)
+                mn, mx = int(a64.min()), int(a64.max())
+                span = mx - mn + 1
+                # span must be O(n): the same dense-domain rule as
+                # aggregate._dense / _join_ranges_native — the device
+                # ships span-sized group vectors home
+                if 0 < span <= max(4 * n_l, 1 << 16):
+                    service = ((a64 - mn).astype(np.int32), mn, span)
+            out.append((side, name, planes, enc, service))
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# region builds
+# ---------------------------------------------------------------------------
+
+
+def build_join_region(
+    cache, l_by_bucket, r_by_bucket, l_keys, r_keys, key, payload_columns
+):
+    """(region, permanent_refusal) for the single-chip cache —
+    hbm_cache._build semantics: permanent refusals are structural for
+    this file-version pair (no common buckets, code domain beyond the
+    transport); budget/IO/device refusals are transient."""
+    from ..utils.deviceprobe import first_device_touch_ok
+    from .hbm_cache import _budget_bytes
+    from .joins import _bucketed_join_setup
+
+    pfx = cache._metric_prefix
+    if not first_device_touch_ok():
+        metrics.incr(f"{pfx}.device_unreachable")
+        return None, False
+    t0 = time.perf_counter()
+    setup, _ck = _bucketed_join_setup(
+        l_by_bucket, r_by_bucket, list(l_keys), list(r_keys)
+    )
+    if setup is None:
+        return None, True  # no common buckets: nothing to serve
+    l_all, r_all, l_codes, r_codes, _lb, _rb, _ps = setup
+    enc = _encode_codes(l_codes, r_codes)
+    if enc is None:
+        return None, True
+    l32, r32 = enc
+    r_order = np.argsort(r_codes, kind="stable")
+    r_sorted = r32[r_order]
+    uniq_right = (
+        bool((np.diff(r_sorted) > 0).all()) if len(r_sorted) > 1 else True
+    )
+    n_l, n_r = l_all.num_rows, r_all.num_rows
+    specs = _payload_specs(l_all, r_all, payload_columns, n_l)
+    dev_bytes = 4 * (n_l + n_r)
+    for _side, _name, planes, _e, service in specs:
+        dev_bytes += sum(int(p.nbytes) for p in planes)
+        if service is not None:
+            dev_bytes += 4 * n_l
+    host_bytes = int(r_order.nbytes)
+    # headroom against the resident tables (the delta build's rule):
+    # registration never evicts a TABLE for a join region, so a region
+    # that only fits by exceeding the tables' remainder would be refused
+    # there anyway, after paying the upload
+    with cache._lock:
+        headroom = _budget_bytes() - sum(t.nbytes for t in cache._tables)
+    if dev_bytes + host_bytes > headroom:
+        metrics.incr(f"{pfx}.join.over_budget_refused")
+        return None, False
+
+    import jax
+
+    try:
+        dev_l = jax.device_put(l32)
+        dev_r = jax.device_put(r_sorted)
+        fences = [dev_l, dev_r]
+        l_cols: Dict[str, JoinPayloadColumn] = {}
+        r_cols: Dict[str, JoinPayloadColumn] = {}
+        for side, name, planes, enc_s, service in specs:
+            if side == "r":
+                planes = tuple(p[r_order] for p in planes)
+            dev_planes = tuple(jax.device_put(p) for p in planes)
+            fences.extend(dev_planes)
+            nbytes_c = sum(int(p.nbytes) for p in planes)
+            pc = JoinPayloadColumn(
+                dev_planes,
+                (l_all if side == "l" else r_all).columns[name].dtype_str,
+                enc_s,
+                nbytes_c,
+            )
+            if service is not None:
+                slots, mn, span = service
+                pc.slots = jax.device_put(slots)
+                pc.mn, pc.span = mn, span
+                pc.nbytes += int(slots.nbytes)
+                fences.append(pc.slots)
+            (l_cols if side == "l" else r_cols)[name] = pc
+        from ..ops import fence_chain
+
+        fence_chain(fences)
+    except Exception:  # noqa: BLE001 - device loss: no residency
+        metrics.incr(f"{pfx}.join.transfer_error")
+        return None, False
+    metrics.incr(f"{pfx}.join.h2d_bytes", dev_bytes)
+    metrics.record_time(f"{pfx}.join.prefetch", time.perf_counter() - t0)
+    return (
+        JoinRegion(
+            key,
+            n_l,
+            n_r,
+            dev_l,
+            dev_r,
+            r_order,
+            uniq_right,
+            l_cols,
+            r_cols,
+            dev_bytes + host_bytes,
+        ),
+        False,
+    )
+
+
+def build_mesh_join_region(
+    cache, l_by_bucket, r_by_bucket, l_keys, r_keys, key, payload_columns, mesh
+):
+    """(region, permanent_refusal) for the mesh cache: each device
+    receives exactly its owned buckets' rows of BOTH sides (the build's
+    ``b % D`` rule), so per-device merges are shuffle-free and complete.
+    The right side sorts within each device; pads sit at the reserved top
+    codes so they can never match."""
+    from ..parallel.mesh import owner_of_bucket
+    from ..utils.deviceprobe import first_device_touch_ok
+    from ..utils.intmath import next_pow2
+    from .hbm_cache import _budget_bytes
+    from .joins import _bucketed_join_setup
+
+    pfx = cache._metric_prefix
+    if not first_device_touch_ok():
+        metrics.incr(f"{pfx}.device_unreachable")
+        return None, False
+    t0 = time.perf_counter()
+    setup, _ck = _bucketed_join_setup(
+        l_by_bucket, r_by_bucket, list(l_keys), list(r_keys)
+    )
+    if setup is None:
+        return None, True
+    l_all, r_all, l_codes, r_codes, l_bounds, r_bounds, _ps = setup
+    enc = _encode_codes(l_codes, r_codes)
+    if enc is None:
+        return None, True
+    l32, r32 = enc
+    n_l, n_r = l_all.num_rows, r_all.num_rows
+    # the SAME common-bucket derivation as _bucketed_join_setup, so
+    # bounds index k maps to common[k]
+    common = sorted(set(l_by_bucket) & set(r_by_bucket))
+    D = int(mesh.devices.size)
+    l_rows = [[] for _ in range(D)]
+    r_rows = [[] for _ in range(D)]
+    for k, b in enumerate(common):
+        d = owner_of_bucket(int(b), D)
+        # bounds are host segment offsets (np.cumsum over host batch row
+        # counts, _bucketed_join_setup) — never device arrays
+        l_rows[d].append(np.arange(int(l_bounds[k]), int(l_bounds[k + 1])))  # hslint: disable=HS001
+        r_rows[d].append(np.arange(int(r_bounds[k]), int(r_bounds[k + 1])))  # hslint: disable=HS001
+    l_idx = [
+        np.concatenate(rs) if rs else np.empty(0, dtype=np.int64)
+        for rs in l_rows
+    ]
+    r_idx = [
+        np.concatenate(rs) if rs else np.empty(0, dtype=np.int64)
+        for rs in r_rows
+    ]
+    dev_rows_l = [int(len(ix)) for ix in l_idx]
+    dev_rows_r = [int(len(ix)) for ix in r_idx]
+    cap_l = next_pow2(max(max(dev_rows_l), 1))
+    cap_r = next_pow2(max(max(dev_rows_r), 1))
+    # sort the right side within each device (global sortedness is
+    # meaningless across shards); payload gathers ride the same order
+    for d in range(D):
+        if dev_rows_r[d]:
+            order_d = np.argsort(r32[r_idx[d]], kind="stable")
+            r_idx[d] = r_idx[d][order_d]
+    r_sorted_global = np.sort(r32, kind="stable")
+    uniq_right = (
+        bool((np.diff(r_sorted_global) > 0).all())
+        if len(r_sorted_global) > 1
+        else True
+    )
+    specs = _payload_specs(l_all, r_all, payload_columns, n_l)
+    dev_bytes = 4 * D * (cap_l + cap_r)
+    for _side, _name, planes, _e, service in specs:
+        per = cap_l if _side == "l" else cap_r
+        dev_bytes += 4 * D * per * len(planes)
+        if service is not None:
+            dev_bytes += 4 * D * cap_l
+    with cache._lock:
+        headroom = _budget_bytes() - sum(t.nbytes for t in cache._tables)
+    if dev_bytes > headroom:
+        metrics.incr(f"{pfx}.join.over_budget_refused")
+        return None, False
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0], None))
+
+    def pack(flat: np.ndarray, idx_lists, cap: int, pad: int) -> np.ndarray:
+        packed = np.full((D, cap), pad, dtype=np.int32)
+        for d in range(D):
+            if len(idx_lists[d]):
+                packed[d, : len(idx_lists[d])] = flat[idx_lists[d]]
+        return packed
+
+    try:
+        dev_l = jax.device_put(pack(l32, l_idx, cap_l, L_PAD), sharding)
+        dev_r = jax.device_put(pack(r32, r_idx, cap_r, R_PAD), sharding)
+        fences = [dev_l, dev_r]
+        l_cols: Dict[str, JoinPayloadColumn] = {}
+        r_cols: Dict[str, JoinPayloadColumn] = {}
+        for side, name, planes, enc_s, service in specs:
+            idx = l_idx if side == "l" else r_idx
+            cap = cap_l if side == "l" else cap_r
+            dev_planes = tuple(
+                jax.device_put(pack(p, idx, cap, 0), sharding)
+                for p in planes
+            )
+            fences.extend(dev_planes)
+            pc = JoinPayloadColumn(
+                dev_planes,
+                (l_all if side == "l" else r_all).columns[name].dtype_str,
+                enc_s,
+                4 * D * cap * len(planes),
+            )
+            if service is not None:
+                slots, mn, span = service
+                pc.slots = jax.device_put(
+                    pack(slots, l_idx, cap_l, 0), sharding
+                )
+                pc.mn, pc.span = mn, span
+                pc.nbytes += 4 * D * cap_l
+                fences.append(pc.slots)
+            (l_cols if side == "l" else r_cols)[name] = pc
+        from ..ops import fence_chain
+
+        fence_chain(fences)
+    except Exception:  # noqa: BLE001 - device loss: no residency
+        metrics.incr(f"{pfx}.join.transfer_error")
+        return None, False
+    metrics.incr(f"{pfx}.join.h2d_bytes", dev_bytes)
+    metrics.record_time(f"{pfx}.join.prefetch", time.perf_counter() - t0)
+    return (
+        MeshJoinRegion(
+            key,
+            mesh,
+            D,
+            cap_l,
+            cap_r,
+            dev_rows_l,
+            dev_rows_r,
+            dev_l,
+            dev_r,
+            uniq_right,
+            l_cols,
+            r_cols,
+            n_l,
+            n_r,
+            dev_bytes,
+        ),
+        False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# aggregate planning — which (group_by, aggs) shapes the device serves
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class _ColOps:
+    name: str
+    side: str  # 'l' | 'r'
+    enc: str
+    arity: int  # device planes consumed
+    ops: tuple  # sorted subset of ('max', 'min', 'nn', 'sum')
+
+
+@dataclass(frozen=True)
+class AggPlan:
+    group: str
+    mn: int
+    span: int
+    uniq_right: bool
+    cols: tuple  # _ColOps, deterministic order
+
+    def signature(self) -> tuple:
+        """The compile-cache key component: everything the traced fn's
+        STRUCTURE depends on (names are positional at trace time)."""
+        return (
+            self.span,
+            self.uniq_right,
+            tuple((c.side, c.enc, c.arity, c.ops) for c in self.cols),
+        )
+
+
+def region_agg_plan(region, group_by, aggs) -> Optional[AggPlan]:
+    """Device aggregation plan for (group_by, aggs) over ``region``, or
+    None when the spec cannot ride the device exactly: multi-key
+    grouping, non-dense/non-int group keys, unresident columns, float
+    sums or any min/max under duplicate right matches (the prefix/range
+    tricks are only exact where the host fusion's own rules say so).
+    Declines route host — the exact fallback."""
+    if len(group_by) != 1:
+        return None
+    g = group_by[0]
+    gcol = region.l_cols.get(g)
+    if gcol is None or gcol.slots is None:
+        return None
+    wants: Dict[Tuple[str, str], set] = {}
+    for a in aggs:
+        if a.fn not in _AGG_FNS:
+            return None
+        if a.column is None:
+            continue
+        if a.column in region.l_cols:
+            side, pc = "l", region.l_cols[a.column]
+        elif a.column in region.r_cols:
+            side, pc = "r", region.r_cols[a.column]
+        else:
+            return None
+        float_col = pc.enc != "int"
+        if side == "r" and not region.uniq_right:
+            if a.fn in ("min", "max"):
+                return None
+            if float_col and a.fn in ("sum", "avg"):
+                return None
+        need = wants.setdefault((side, a.column), set())
+        if a.fn == "count":
+            if float_col:
+                need.add("nn")  # int count(col) == count(*): no NULLs
+        elif a.fn == "sum":
+            need.add("sum")
+            if float_col:
+                need.add("nn")  # SQL: all-NULL group sums to NULL
+        elif a.fn == "avg":
+            need.add("sum")
+            if float_col:
+                need.add("nn")
+        else:
+            need.add(a.fn)
+            if float_col:
+                need.add("nn")
+    cols = tuple(
+        _ColOps(
+            name,
+            side,
+            (region.l_cols if side == "l" else region.r_cols)[name].enc,
+            len((region.l_cols if side == "l" else region.r_cols)[name].arrays),
+            tuple(sorted(ops)),
+        )
+        for (side, name), ops in sorted(wants.items())
+    )
+    return AggPlan(g, gcol.mn, gcol.span, region.uniq_right, cols)
+
+
+def plan_device_arrays(region, plan: AggPlan) -> tuple:
+    """The flat device plane tuple the jitted fn consumes, in plan.cols
+    order (arity per column recorded in the plan)."""
+    flat = []
+    for c in plan.cols:
+        pc = (region.l_cols if c.side == "l" else region.r_cols)[c.name]
+        flat.extend(pc.arrays)
+    return tuple(flat)
+
+
+# ---------------------------------------------------------------------------
+# device fns
+# ---------------------------------------------------------------------------
+
+
+def _core_agg(jnp, jax, specs, span, uniq_right, l_codes, r_codes, slots, flat):
+    """The fused sorted-intersection + segment-aggregate body, shared by
+    the single-chip jit and the mesh shard_fn (which adds collectives).
+    Returns (outputs, kinds): kinds[i] in {'sum','min','max'} names the
+    collective each partial needs under a mesh."""
+    lo = jnp.searchsorted(r_codes, l_codes, side="left")
+    hi = jnp.searchsorted(r_codes, l_codes, side="right")
+    counts = (hi - lo).astype(jnp.int64)
+
+    def seg_sum(x):
+        return jax.ops.segment_sum(x, slots, num_segments=span)
+
+    outs = [seg_sum(counts)]
+    kinds = ["sum"]
+    hit = counts > 0
+    pos = jnp.where(hit, lo, 0)
+    i = 0
+    for side, enc, arity, ops in specs:
+        if enc == "f64bits":
+            word = (flat[i].astype(jnp.int64) << 32) | (
+                flat[i + 1].astype(jnp.int64) & jnp.int64(0xFFFFFFFF)
+            )
+            v = jax.lax.bitcast_convert_type(word, jnp.float64)
+            valid = ~jnp.isnan(v)
+        elif enc == "f32bits":
+            v = jax.lax.bitcast_convert_type(flat[i], jnp.float32).astype(
+                jnp.float64
+            )
+            valid = ~jnp.isnan(v)
+        else:
+            v = flat[i].astype(jnp.int64)
+            valid = None
+        i += arity
+        zero = jnp.zeros((), v.dtype)
+        if side == "l":
+            v0 = v if valid is None else jnp.where(valid, v, zero)
+            per_sum = v0 * counts
+            per_nn = (
+                counts if valid is None else jnp.where(valid, counts, 0)
+            )
+            contrib = hit if valid is None else (hit & valid)
+            vals = v
+        elif uniq_right:
+            vv = v[pos]
+            ok = hit if valid is None else (hit & valid[pos])
+            per_sum = jnp.where(ok, vv, zero)
+            per_nn = ok.astype(jnp.int64)
+            contrib = ok
+            vals = vv
+        else:
+            # duplicate right matches: prefix differences over the code
+            # runs. Value sums are int-only (the plan declines float
+            # sum/avg/min/max here — the float prefix trick loses
+            # precision int64 never does); int64 wraparound cancels
+            # exactly like the host fusion's. count(float) DOES ride:
+            # NaN (NULL) rows are excluded via an exact int64 prefix of
+            # the validity mask, matching host NULL semantics.
+            if "sum" in ops:
+                cum = jnp.concatenate(
+                    [jnp.zeros((1,), jnp.int64), jnp.cumsum(v)]
+                )
+                per_sum = cum[hi] - cum[lo]
+            else:
+                per_sum = None
+            if valid is None:
+                per_nn = counts
+            else:
+                ncum = jnp.concatenate(
+                    [
+                        jnp.zeros((1,), jnp.int64),
+                        jnp.cumsum(valid.astype(jnp.int64)),
+                    ]
+                )
+                per_nn = ncum[hi] - ncum[lo]
+            contrib = None
+            vals = None
+        for op in ops:
+            if op == "sum":
+                outs.append(seg_sum(per_sum))
+                kinds.append("sum")
+            elif op == "nn":
+                outs.append(seg_sum(per_nn))
+                kinds.append("sum")
+            elif op == "min":
+                big = (
+                    jnp.asarray(jnp.inf, vals.dtype)
+                    if vals.dtype == jnp.float64
+                    else jnp.asarray(jnp.iinfo(jnp.int64).max, vals.dtype)
+                )
+                outs.append(
+                    jax.ops.segment_min(
+                        jnp.where(contrib, vals, big),
+                        slots,
+                        num_segments=span,
+                    )
+                )
+                kinds.append("min")
+            else:  # max
+                small = (
+                    jnp.asarray(-jnp.inf, vals.dtype)
+                    if vals.dtype == jnp.float64
+                    else jnp.asarray(jnp.iinfo(jnp.int64).min, vals.dtype)
+                )
+                outs.append(
+                    jax.ops.segment_max(
+                        jnp.where(contrib, vals, small),
+                        slots,
+                        num_segments=span,
+                    )
+                )
+                kinds.append("max")
+    return outs, kinds
+
+
+def _fn_cache():
+    from .hbm_cache import BoundedFnCache
+
+    global _FNS_MEMO
+    if _FNS_MEMO is None:
+        _FNS_MEMO = BoundedFnCache(64)
+    return _FNS_MEMO
+
+
+_FNS_MEMO = None
+_RANGES_FN = None
+
+
+def ranges_fn():
+    """Jitted (l_codes, r_codes) -> (lo, counts) int32 — the match-range
+    dispatch of the materializing resident join. Shape-polymorphic (jax
+    retraces per region shape); literal-free."""
+    global _RANGES_FN
+    if _RANGES_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        def fn(l_codes, r_codes):
+            lo = jnp.searchsorted(r_codes, l_codes, side="left")
+            hi = jnp.searchsorted(r_codes, l_codes, side="right")
+            return lo.astype(jnp.int32), (hi - lo).astype(jnp.int32)
+
+        _RANGES_FN = jax.jit(fn)
+    return _RANGES_FN
+
+
+def join_agg_fn(plan: AggPlan, n_l: int, n_r: int):
+    """Jitted fused join-aggregate for the single-chip cache, memoized
+    on the plan STRUCTURE + shapes (hbm_cache compile-cache discipline)."""
+    key = ("jagg1", plan.signature(), n_l, n_r)
+    memo = _fn_cache()
+    fn = memo.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+
+    specs = [(c.side, c.enc, c.arity, c.ops) for c in plan.cols]
+    span, uniq = plan.span, plan.uniq_right
+
+    def body(l_codes, r_codes, slots, flat):
+        outs, _ = _core_agg(
+            jnp, jax, specs, span, uniq, l_codes, r_codes, slots, flat
+        )
+        return tuple(outs)
+
+    fn = jax.jit(body)
+    memo.put(key, fn)
+    return fn
+
+
+def mesh_join_agg_fn(mesh, plan: AggPlan, cap_l: int, cap_r: int):
+    """Jitted shard_map fused join-aggregate: per-device partials over
+    the full slot space, then psum/pmin/pmax into ONE replicated group
+    table — the two-phase distributed aggregate with zero shuffles."""
+    key = ("jaggM", mesh, plan.signature(), cap_l, cap_r)
+    memo = _fn_cache()
+    fn = memo.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec
+
+    from ..utils.jaxcompat import shard_map
+
+    specs = [(c.side, c.enc, c.arity, c.ops) for c in plan.cols]
+    span, uniq = plan.span, plan.uniq_right
+    axis = mesh.axis_names[0]
+    n_flat = sum(c.arity for c in plan.cols)
+
+    def shard_fn(l_codes, r_codes, slots, flat):
+        outs, kinds = _core_agg(
+            jnp,
+            jax,
+            specs,
+            span,
+            uniq,
+            l_codes.reshape(-1),
+            r_codes.reshape(-1),
+            slots.reshape(-1),
+            tuple(a.reshape(-1) for a in flat),
+        )
+        merged = []
+        for o, kind in zip(outs, kinds):
+            if kind == "sum":
+                merged.append(jax.lax.psum(o, axis))
+            elif kind == "min":
+                merged.append(jax.lax.pmin(o, axis))
+            else:
+                merged.append(jax.lax.pmax(o, axis))
+        return tuple(merged)
+
+    p_dev = PartitionSpec(axis, None)
+    in_specs = (p_dev, p_dev, p_dev, tuple(p_dev for _ in range(n_flat)))
+    n_out = 1 + sum(len(c.ops) for c in plan.cols)
+    out_specs = tuple(PartitionSpec() for _ in range(n_out))
+    fn = jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+    memo.put(key, fn)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# host finish — identical construction to aggregate._join_ranges_native
+# ---------------------------------------------------------------------------
+
+
+def finish_join_agg(region, plan: AggPlan, group_by, aggs, outs) -> ColumnarBatch:
+    """Assemble the group table from the D2H'd span-sized vectors. Groups
+    with zero joined rows do not appear (inner-join semantics); output
+    order is ascending group key, the same as the host native fusion."""
+    from ..plan.aggregates import output_dtype
+
+    rows = outs[0]
+    idx = 1
+    per_col: Dict[str, tuple] = {}
+    for c in plan.cols:
+        got = {}
+        for op in c.ops:
+            got[op] = outs[idx]
+            idx += 1
+        per_col[c.name] = (c, got)
+    keep = np.flatnonzero(rows > 0)
+    rows_kept = rows[keep].astype(np.int64)
+    g = group_by[0]
+    gmeta = region.l_cols[g]
+    out: Dict[str, Column] = {
+        g: Column(
+            gmeta.dtype_str,
+            (keep + plan.mn).astype(numpy_dtype(gmeta.dtype_str)),
+        )
+    }
+    for a in aggs:
+        if a.column is None:
+            out[a.name] = Column("int64", rows_kept)
+            continue
+        c, got = per_col[a.column]
+        float_col = c.enc != "int"
+        pc = (region.l_cols if c.side == "l" else region.r_cols)[c.name]
+        dt = output_dtype(a, pc.dtype_str)
+        nn = got.get("nn")
+        nn_k = nn[keep].astype(np.int64) if nn is not None else rows_kept
+        if a.fn == "count":
+            out[a.name] = Column("int64", nn_k)
+        elif a.fn == "avg":
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out[a.name] = Column(
+                    "float64", got["sum"][keep].astype(np.float64) / nn_k
+                )
+        elif a.fn == "sum":
+            s = got["sum"][keep].astype(numpy_dtype(dt))
+            if dt.startswith("float"):
+                # SQL NULL: sum of an all-NULL group is NULL
+                s = np.where(nn_k == 0, np.nan, s)
+            out[a.name] = Column(dt, s)
+        else:  # min / max
+            vals = got[a.fn][keep]
+            if float_col:
+                vals = np.where(nn_k == 0, np.nan, vals)
+            out[a.name] = Column(dt, vals.astype(numpy_dtype(dt)))
+    return ColumnarBatch(out)

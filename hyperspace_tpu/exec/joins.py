@@ -47,9 +47,23 @@ def _exact_codes(l_col: Column, r_col: Column) -> Tuple[np.ndarray, np.ndarray]:
         # ints up to 32 bits embed exactly in float64
         l, r = l.astype(np.float64), r.astype(np.float64)
     if l.dtype.kind == "f":
-        lf = np.where(l == 0.0, 0.0, l.astype(np.float64))
-        rf = np.where(r == 0.0, 0.0, r.astype(np.float64))
-        return lf.view(np.int64), rf.view(np.int64)
+        # ONE shared float-key normalization (ops.floatbits) — then SQL
+        # join semantics: NaN equals nothing, itself included, so each
+        # side's NaN rows are poisoned with a side-distinct sentinel no
+        # canonicalized data code can collide with
+        from ..ops.floatbits import (
+            NAN_KEY_LEFT,
+            NAN_KEY_RIGHT,
+            float_key_codes,
+        )
+
+        lf, lnan = float_key_codes(l)
+        rf, rnan = float_key_codes(r)
+        if lnan.any():
+            lf = np.where(lnan, NAN_KEY_LEFT, lf)
+        if rnan.any():
+            rf = np.where(rnan, NAN_KEY_RIGHT, rf)
+        return lf, rf
     return l.astype(np.int64), r.astype(np.int64)
 
 
@@ -89,10 +103,49 @@ def join_codes(
 # factorized codes, multi-file buckets after incremental refresh).
 # Tunable via HYPERSPACE_TPU_MIN_DEVICE_JOIN_ROWS.
 MIN_DEVICE_JOIN_ROWS = 1 << 18
-# latched after a device-kernel dispatch failure (e.g. configured-but-
+# Latched after a device-kernel dispatch failure (e.g. configured-but-
 # absent TPU): later joins skip straight to searchsorted instead of
-# re-raising per batch
-_device_kernel_dead = [False]
+# re-raising per batch. The latch is NOT a permanent process verdict
+# (its old module-global form was: one transient failure disabled the
+# kernel forever): it records the hbm_cache reset() epoch it latched
+# under, so a cache reset re-arms the kernel, and the process-wide
+# deviceprobe first-touch verdict is consulted the way the serve path
+# does — a device deviceprobe PROVED wedged skips dispatch without
+# burning a latch, and distinct failure causes are counted so
+# "why did the kernel stop firing" is answerable from metrics.
+_kernel_latch = {"dead": False, "epoch": -1}
+
+
+def _device_kernel_disabled() -> bool:
+    from ..utils.deviceprobe import latched_verdict
+
+    if latched_verdict() is False:
+        # wedged device, known process-wide: never dispatch, and leave
+        # the latch alone (the probe verdict outranks it)
+        return True
+    if not _kernel_latch["dead"]:
+        return False
+    from .hbm_cache import hbm_cache
+
+    if hbm_cache.current_epoch() != _kernel_latch["epoch"]:
+        # the cache was reset() since the failure — the operator/test
+        # asked for a fresh start, so the kernel gets another chance
+        _kernel_latch["dead"] = False
+        metrics.incr("join.path.device_kernel_rearmed")
+        return False
+    return True
+
+
+def _latch_device_kernel_dead(exc: BaseException) -> None:
+    from .hbm_cache import hbm_cache
+
+    _kernel_latch["dead"] = True
+    _kernel_latch["epoch"] = hbm_cache.current_epoch()
+    metrics.incr("join.path.device_kernel_failed")
+    # distinct causes keep the latch diagnosable: a TypeError from a
+    # kernels-API drift and an XlaRuntimeError from device loss must not
+    # collapse into one opaque count
+    metrics.incr(f"join.path.device_kernel_failed.{type(exc).__name__}")
 
 
 def _min_device_rows() -> int:
@@ -140,16 +193,16 @@ def merge_join_ranges(
             and min(len(l_codes), len(r_codes)) >= _min_device_rows()
         )
     lo = counts = None
-    if device and _k.kernels_mode() != "off" and not _device_kernel_dead[0]:
+    if device and _k.kernels_mode() != "off" and not _device_kernel_disabled():
         # kernels_mode trusts the CONFIGURED platform (no backend init);
         # if the actual backend can't run the kernel (configured-but-
-        # absent TPU), degrade to searchsorted and stop retrying
+        # absent TPU), degrade to searchsorted and stop retrying until
+        # a cache reset() re-arms the latch
         try:
             res = _k.sorted_intersect_counts(l_codes, r_sorted)
-        except Exception:  # noqa: BLE001 - device loss degrades, not fails
+        except Exception as e:  # noqa: BLE001 - device loss degrades, not fails
             res = None
-            _device_kernel_dead[0] = True
-            metrics.incr("join.path.device_kernel_failed")
+            _latch_device_kernel_dead(e)
         if res is not None:
             lo, counts = res
             metrics.incr("join.path.device_kernel")

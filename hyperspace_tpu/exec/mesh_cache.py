@@ -1264,16 +1264,200 @@ class MeshHbmCache(ResidentCacheBase):
                     parts.append(sub.take(idx).select(list(output_columns)))
         return parts
 
+    # -- join regions (the shuffle-free sharded resident SMJ) ----------------
+    def join_for(
+        self, l_files, r_files, l_keys, r_keys, columns, mesh
+    ) -> Optional[object]:
+        """hbm_cache.join_for with the mesh identity check: a region's
+        shards only serve the mesh they were placed on."""
+        from .hbm_cache import residency_mode
+        from .join_residency import join_region_key
+
+        if residency_mode() == "off":
+            return None
+        with self._lock:
+            if not self._joins:
+                return None
+        try:
+            key = join_region_key(l_files, r_files, l_keys, r_keys)
+        except OSError:
+            return None
+        with self._lock:
+            for j in reversed(self._joins):
+                if (
+                    j.key == key
+                    and j.mesh is mesh
+                    and all(
+                        c in j.l_cols or c in j.r_cols for c in columns
+                    )
+                ):
+                    j.last_used = time.monotonic()
+                    return j
+        return None
+
+    def note_touch_join(
+        self, l_files, r_files, l_keys, r_keys, payload_columns, loader, mesh
+    ) -> None:
+        """Background mesh join-region population (hbm_cache
+        note_touch_join contract: never blocks, never throws)."""
+        from .hbm_cache import _auto_enabled as _auto
+        from .join_residency import build_mesh_join_region, join_region_key
+
+        if not _auto():
+            return
+        try:
+            key = join_region_key(l_files, r_files, l_keys, r_keys)
+        except OSError:
+            return
+        want = frozenset(payload_columns)
+        memo = ("join", key, want)
+        pending = ("join", key)
+        with self._lock:
+            if pending in self._pending or memo in self._failed:
+                return
+            if any(
+                j.key == key
+                and j.mesh is mesh
+                and all(c in j.l_cols or c in j.r_cols for c in want)
+                for j in self._joins
+            ):
+                return
+            self._pending.add(pending)
+            epoch = self._epoch
+
+        def bg():
+            failed = False
+            try:
+                groups = loader()
+                if groups is None:
+                    return
+                with self._lock:
+                    prior = next(
+                        (j for j in self._joins if j.key == key), None
+                    )
+                cols = list(
+                    dict.fromkeys(
+                        list(payload_columns)
+                        + (
+                            sorted(set(prior.l_cols) | set(prior.r_cols))
+                            if prior
+                            else []
+                        )
+                    )
+                )
+                region, permanent = build_mesh_join_region(
+                    self, groups[0], groups[1], key[2], key[3], key, cols,
+                    mesh,
+                )
+                if region is not None:
+                    self._register_join(region, epoch=epoch)
+                    if not all(
+                        c in region.l_cols or c in region.r_cols
+                        for c in want
+                    ):
+                        failed = True  # uncoverable payload: memoize
+                elif permanent:
+                    failed = True
+            except Exception:  # noqa: BLE001 - population must never fail a query
+                metrics.incr("hbm.mesh.join.populate_failed")
+            finally:
+                with self._lock:
+                    self._pending.discard(pending)
+                    if failed:
+                        if len(self._failed) >= _MAX_FAILED_MEMO:
+                            self._failed.clear()
+                        self._failed.add(memo)
+
+        t = threading.Thread(
+            target=bg, daemon=True, name="hbm-mesh-join-populate"
+        )
+        self._track_for_exit(t)
+        t.start()
+
+    def prefetch_join(
+        self,
+        l_by_bucket,
+        r_by_bucket,
+        l_files,
+        r_files,
+        l_keys,
+        r_keys,
+        payload_columns,
+        mesh,
+    ) -> Optional[object]:
+        """Synchronous mesh join-region build + register (idempotent;
+        a narrower region is rebuilt widened — hbm_cache note)."""
+        from .join_residency import build_mesh_join_region, join_region_key
+
+        try:
+            key = join_region_key(l_files, r_files, l_keys, r_keys)
+        except OSError:
+            return None
+        existing = self.join_for(
+            l_files, r_files, l_keys, r_keys, payload_columns, mesh
+        )
+        if existing is not None:
+            return existing
+        region, _ = build_mesh_join_region(
+            self,
+            l_by_bucket,
+            r_by_bucket,
+            list(l_keys),
+            list(r_keys),
+            key,
+            list(payload_columns),
+            mesh,
+        )
+        if region is None:
+            return None
+        return region if self._register_join(region) else None
+
+    def join_agg(self, region, group_by, aggs):
+        """The two-phase mesh aggregate-join: per-device sorted
+        intersection + partial segment aggregates over each device's
+        owned buckets (the build's ``b % D`` placement makes the shard
+        join complete without any shuffle), psum/pmin/pmax into ONE
+        replicated group table, ONE D2H. None when the spec cannot ride
+        (caller routes host); device errors propagate."""
+        from ..utils.jaxcompat import enable_x64
+        from .join_residency import (
+            finish_join_agg,
+            mesh_join_agg_fn,
+            plan_device_arrays,
+            region_agg_plan,
+        )
+
+        plan = region_agg_plan(region, list(group_by), list(aggs))
+        if plan is None:
+            metrics.incr("hbm.mesh.join.declined.dtype")
+            return None
+        fn = mesh_join_agg_fn(region.mesh, plan, region.cap_l, region.cap_r)
+        arrays = plan_device_arrays(region, plan)
+        slots = region.l_cols[plan.group].slots
+        t0 = time.perf_counter()
+        with enable_x64(True):
+            raw = fn(region.l_codes, region.r_codes, slots, arrays)
+        outs = [np.asarray(o) for o in raw]
+        metrics.record_time(
+            "scan.resident_join_agg.mesh_device", time.perf_counter() - t0
+        )
+        metrics.incr(
+            "scan.resident_join.d2h_bytes", sum(int(o.nbytes) for o in outs)
+        )
+        return finish_join_agg(region, plan, list(group_by), list(aggs), outs)
+
     # -- observability -------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
             return {
                 "tables": len(self._tables),
                 "deltas": len(self._deltas),
+                "joins": len(self._joins),
                 "resident_mb": round(
                     (
                         sum(t.nbytes for t in self._tables)
                         + sum(d.nbytes for d in self._deltas)
+                        + sum(j.nbytes for j in self._joins)
                     )
                     / 1e6,
                     1,

@@ -274,11 +274,14 @@ class ScanGate:
     def note_resident_bypass(self, kind: str) -> None:
         """Record a scan the gate never arbitrated because residency made
         the device the winner outright (module note "RESIDENCY-
-        AWARENESS"). Delta-aware routing: ``kind`` distinguishes plain
-        resident bypasses from the hybrid base+delta fused path, so the
-        gate's metrics explain why no probe ladder ran for those scans
+        AWARENESS"). ``kind`` distinguishes the bypass families so the
+        gate's metrics explain why no probe ladder ran: plain resident
+        scans, the hybrid base+delta fused path
         ("scan.gate.resident_bypass_hybrid" under continuous appends is
-        the delta fast path working, not a gate that went blind)."""
+        the delta fast path working, not a gate that went blind), and
+        resident joins ("scan.gate.resident_bypass_join" — the join
+        region's codes are already on device, so the per-query H2D the
+        gate's link arithmetic prices is zero by construction)."""
         metrics.incr(f"scan.gate.resident_bypass_{kind}")
 
     def reset(self) -> None:

@@ -36,18 +36,22 @@ from .stats import IndexStatistics
 
 
 def _invalidate_resident_deltas(index_root) -> None:
-    """Drop THIS index's resident delta regions after an
+    """Drop THIS index's resident delta AND join regions after an
     index-data-rewriting action (full/incremental refresh, optimize):
-    the new version's file identities change its base keys, so its stale
-    deltas could never be served again and would only pin HBM until LRU
-    pressure found them. Scoped by the index's directory — refreshing
-    one index must not evict other indexes' still-valid deltas. Quick
-    refresh does NOT call this (see refresh() below)."""
+    the new version's file identities change its base/region keys, so
+    the stale regions could never be served again and would only pin
+    HBM until LRU pressure found them. Scoped by the index's directory
+    — refreshing one index must not evict other indexes' still-valid
+    regions (a join region invalidates when EITHER of its two indexes
+    lives under the refreshed root). Quick refresh does NOT call this
+    (see refresh() below)."""
     from ..exec.hbm_cache import hbm_cache
     from ..exec.mesh_cache import mesh_cache
 
     hbm_cache.invalidate_deltas(str(index_root))
     mesh_cache.invalidate_deltas(str(index_root))
+    hbm_cache.invalidate_joins(str(index_root))
+    mesh_cache.invalidate_joins(str(index_root))
 
 
 class IndexCollectionManager:

@@ -37,6 +37,31 @@ def f64_scalar_to_ordered(v: float) -> np.int64:
     return f64_to_ordered_i64(np.array([v], dtype=np.float64))[0]
 
 
+# Distinct quiet-NaN payloads, reserved as join-side NaN sentinels: after
+# float_key_codes canonicalizes every data NaN to np.nan's bit pattern,
+# no data code can collide with these — so poisoning the two sides of a
+# join with DIFFERENT sentinels makes NaN match nothing, itself included.
+NAN_KEY_LEFT = np.int64(0x7FF8000000000001)
+NAN_KEY_RIGHT = np.int64(0x7FF8000000000002)
+
+
+def float_key_codes(a: np.ndarray):
+    """(int64 bit codes, NaN mask) for a float KEY column — the ONE
+    float-key normalization shared by the join's exact codes and the
+    aggregate's group keys (it used to live in two copies that could
+    drift). -0.0 normalizes to +0.0 and every NaN canonicalizes to one
+    bit pattern, so code equality ⟺ value equality with NaN == NaN;
+    callers choose SQL semantics from there: joins poison the mask's
+    rows with per-side sentinels (NaN never matches), aggregates keep
+    the canonical code (NaN is one valid group key)."""
+    f = np.asarray(a, dtype=np.float64)
+    nan = np.isnan(f)
+    f = np.where(f == 0.0, 0.0, f)
+    if nan.any():
+        f = np.where(nan, np.nan, f)
+    return f.view(np.int64), nan
+
+
 _TOP32 = np.int32(np.uint32(0x80000000).astype(np.int32))
 
 

@@ -36,7 +36,7 @@ from pathlib import Path
 from typing import List, Optional, Tuple
 
 from ..plan.expr import Expr
-from ..plan.ir import Filter, IndexScan, LogicalPlan, Project, Union
+from ..plan.ir import Aggregate, Filter, IndexScan, LogicalPlan, Project, Union
 from ..storage.columnar import ColumnarBatch
 from ..telemetry.metrics import metrics
 
@@ -46,7 +46,7 @@ class ResidentScanRequest:
     """One classified, batchable query: everything the batched executor
     needs, plus the compatibility key it coalesces under."""
 
-    table: object  # ResidentTable | MeshResidentTable
+    table: object  # ResidentTable | MeshResidentTable | JoinRegion
     entry: object  # IndexLogEntry (schema for empty results)
     files: List[Path]  # the QUERY's pruned file list (subset of table's)
     predicate: Expr
@@ -61,6 +61,13 @@ class ResidentScanRequest:
     # conjoined with the lineage NOT-IN when files were deleted)
     delta: object = None
     host_predicate: Optional[Expr] = None
+    # resident JOIN requests only: "join_agg" coalesces aggregate-joins
+    # under the join-extended key (region identity + aggregation spec) —
+    # one fused dispatch serves the whole batch; ``table`` holds the
+    # JoinRegion so the server's latch-drop path works unchanged
+    kind: str = "scan"
+    group_by: Tuple = ()
+    aggs: Tuple = ()
 
 
 def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
@@ -79,6 +86,8 @@ def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
     node = plan
     while isinstance(node, Project):
         node = node.child
+    if isinstance(node, Aggregate):
+        return _classify_join_aggregate(session, node, output_columns)
     if not isinstance(node, Filter):
         return None
     if isinstance(node.child, Union):
@@ -196,6 +205,58 @@ def _classify_hybrid(
     )
 
 
+def _classify_join_aggregate(
+    session, agg: Aggregate, output_columns: List[str]
+) -> Optional[ResidentScanRequest]:
+    """Classify an Aggregate([Project](Join)) plan for the batched
+    resident aggregate-join: both sides must resolve to pristine
+    bucketed index scans with a registered join region covering the
+    group/agg columns, and the spec must ride the device (the SAME
+    resolve_join_residency + region_agg_plan pair the executor's fused
+    arm runs — a query never routes differently served vs collected).
+    Identical-spec queries coalesce under (region identity, spec): the
+    whole batch is served from ONE fused dispatch. Mesh sessions
+    decline — the executor's sharded fused arm serves them per-query."""
+    from ..exec.join_residency import (
+        orient_join_aggregate,
+        region_agg_plan,
+        resolve_join_residency,
+    )
+
+    if session.mesh is not None and session.mesh.devices.size > 1:
+        return None
+    oriented = orient_join_aggregate(agg)
+    if oriented is None:
+        return None
+    left_plan, right_plan, lk, rk, group_by, aggs = oriented
+    need = list(
+        dict.fromkeys(group_by + [a.column for a in aggs if a.column])
+    )
+    res = resolve_join_residency(
+        left_plan, right_plan, lk, rk, payload_columns=need
+    )
+    if res.status != "ok":
+        return None
+    if region_agg_plan(res.region, group_by, aggs) is None:
+        return None
+    spec = (tuple(group_by), tuple((a.fn, a.column, a.name) for a in aggs))
+    return ResidentScanRequest(
+        res.region,
+        None,
+        [],
+        None,
+        output_columns,
+        (id(res.region), "join_agg", spec),
+        None,
+        None,
+        None,
+        None,
+        "join_agg",
+        tuple(group_by),
+        tuple(aggs),
+    )
+
+
 def execute_batch(
     requests: List[ResidentScanRequest],
 ) -> Optional[List[ColumnarBatch]]:
@@ -205,6 +266,21 @@ def execute_batch(
     device errors propagate so the server can latch degradation."""
     from ..exec.hbm_cache import hbm_cache
     from ..exec.scan import _resident_parts
+
+    if requests[0].kind == "join_agg":
+        # the whole batch shares one (region, spec) key, so ONE fused
+        # aggregate-join dispatch serves every query in it
+        group = hbm_cache.join_agg(
+            requests[0].table,
+            list(requests[0].group_by),
+            list(requests[0].aggs),
+        )
+        if group is None:
+            return None  # spec declined since classification: per-query
+        results = [group.select(list(r.output_columns)) for r in requests]
+        metrics.incr("serve.batch.coalesced", len(requests))
+        metrics.incr("scan.path.resident_join_agg", len(requests))
+        return results
 
     table = requests[0].table
     predicates = [r.predicate for r in requests]

@@ -65,6 +65,8 @@ class PlanCache:
 
     def _version_token(self, session) -> Tuple:
         from ..actions import states
+        from ..exec.hbm_cache import hbm_cache
+        from ..exec.mesh_cache import mesh_cache
 
         entries = session.collection_manager.get_indexes(
             [states.ACTIVE], prefer_stable=True
@@ -73,6 +75,14 @@ class PlanCache:
             session.is_hyperspace_enabled(),
             tuple(sorted((e.name, e.id, e.state) for e in entries)),
             tuple(sorted((k, str(v)) for k, v in session.conf.as_dict().items())),
+            # join-region generation: batch classification runs against
+            # the optimized plan, so a cached plan must not outlive the
+            # region generation it was classified under (register /
+            # evict / invalidate / reset all bump these counters)
+            (
+                hbm_cache.join_region_version(),
+                mesh_cache.join_region_version(),
+            ),
         )
 
     def optimized_plan(self, df) -> LogicalPlan:

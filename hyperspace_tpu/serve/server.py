@@ -506,6 +506,11 @@ class QueryServer:
                 if self._dispatches
                 else None,
                 "plan_cache": self.plan_cache.snapshot(),
+                # join-region surface: what the resident join pipeline
+                # holds (regions, bytes, generation) — operators read
+                # this next to the serve counters to see whether
+                # aggregate-joins are being served fused or host-side
+                "join_regions": _join_region_stats(),
                 # reliability surface: what the lifecycle layer absorbed
                 # (retries) and healed (rollbacks) while this server ran
                 # — THIS server's sweeps plus the process-wide counters
@@ -525,3 +530,21 @@ class QueryServer:
             if waits:
                 out["mean_wait_ms"] = round(1e3 * statistics.fmean(waits), 3)
         return out
+
+
+def _join_region_stats() -> dict:
+    """Compact join-region residency snapshot for stats() — counts and
+    generation only; the per-region detail stays on the cache snapshots
+    (hbm_cache.snapshot_joins) for operators who drill down."""
+    from ..exec.hbm_cache import hbm_cache
+    from ..exec.mesh_cache import mesh_cache
+
+    out = {}
+    for name, cache in (("hbm", hbm_cache), ("mesh", mesh_cache)):
+        snap = cache.snapshot_joins()
+        out[name] = {
+            "regions": snap["regions"],
+            "mb": snap["mb"],
+            "version": snap["version"],
+        }
+    return out

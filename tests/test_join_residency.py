@@ -1,0 +1,745 @@
+"""Device-resident join pipeline (exec/join_residency.py): fused
+bucketed SMJ + segment-aggregate over HBM-resident join regions.
+
+Covers: materializing resident join and fused aggregate-join parity
+(int exact, float to f64 relative tolerance) against the host paths and
+the hyperspace-off truth; the ONE shared eligibility procedure
+declining hybrid/filtered sides exactly where the groups cache opts out
+(join.cache.optout.* counters — the PR-3 satellite's test debt); dtype
+coverage declines; device-loss latch-down to the exact host join;
+refresh/optimize invalidation scoped per index; budget refusals and the
+deltas→joins→tables eviction order; the joins.py device-kernel latch
+(deviceprobe consult + reset() re-arm + per-cause counters); NaN/-0.0
+join-key vs group-key semantics through the shared float_key_codes
+helper; and serve-path coalescing of identical aggregate-joins."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exec import executor as EX
+from hyperspace_tpu.exec import joins as J
+from hyperspace_tpu.exec.hbm_cache import HbmIndexCache, hbm_cache
+from hyperspace_tpu.exec.join_residency import (
+    region_agg_plan,
+    resolve_join_residency,
+)
+from hyperspace_tpu.exec.mesh_cache import mesh_cache
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.aggregates import (
+    agg_avg,
+    agg_count,
+    agg_max,
+    agg_min,
+    agg_sum,
+)
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.plan.ir import Join
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def _force_residency(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    hbm_cache.reset()
+    mesh_cache.reset()
+    EX.reset_groups_cache()
+    J.reset_setup_cache()
+    yield
+    hbm_cache.reset()
+    mesh_cache.reset()
+    EX.reset_groups_cache()
+    J.reset_setup_cache()
+
+
+def _setup(tmp_path, n=20_000, n_r=5_000, uniq_right=True):
+    rng = np.random.default_rng(11)
+    left = ColumnarBatch(
+        {
+            "lk": Column("int64", rng.integers(0, n_r, n)),
+            "lg": Column("int64", rng.integers(0, 40, n)),
+            "lv": Column("int64", rng.integers(0, 100, n)),
+        }
+    )
+    rk = (
+        np.arange(n_r, dtype=np.int64)
+        if uniq_right
+        else rng.integers(0, n_r // 2, n_r)
+    )
+    # ~2% NaN (SQL NULL) in the float payload: the device path's NULL
+    # machinery (validity masks, NaN-excluded count/min/max, all-NULL
+    # groups summing to NULL) must be exercised against the host — a
+    # NaN-free fixture would let NULL-semantics drift ship undetected
+    rf = np.round(rng.uniform(0.0, 1000.0, n_r), 3)
+    rf[rng.integers(0, n_r, max(n_r // 50, 1))] = np.nan
+    right = ColumnarBatch(
+        {
+            "rk": Column("int64", rk),
+            "rv": Column("int64", rng.integers(0, 100, n_r)),
+            "rf": Column("float64", rf),
+        }
+    )
+    for name, b in (("l", left), ("r", right)):
+        (tmp_path / name).mkdir()
+        parquet_io.write_parquet(tmp_path / name / "p.parquet", b)
+    session = HyperspaceSession(
+        HyperspaceConf(
+            {C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"), C.INDEX_NUM_BUCKETS: 8}
+        )
+    )
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(tmp_path / "l")),
+        IndexConfig("jl", ["lk"], ["lg", "lv"]),
+    )
+    hs.create_index(
+        session.read.parquet(str(tmp_path / "r")),
+        IndexConfig("jr", ["rk"], ["rv", "rf"]),
+    )
+    session.enable_hyperspace()
+    return session, hs
+
+
+def _join_q(session, tmp_path):
+    return (
+        session.read.parquet(str(tmp_path / "l"))
+        .join(
+            session.read.parquet(str(tmp_path / "r")),
+            col("lk") == col("rk"),
+        )
+        .select("lv", "rv")
+    )
+
+
+def _agg_q(session, tmp_path, aggs=None):
+    aggs = aggs or [
+        agg_sum("rv", "srv"),
+        agg_sum("lv", "slv"),
+        agg_avg("rf", "arf"),
+        agg_count(),
+        agg_count("rf", "crf"),
+        agg_min("lv", "mlv"),
+        agg_max("rf", "xrf"),
+    ]
+    return (
+        session.read.parquet(str(tmp_path / "l"))
+        .join(
+            session.read.parquet(str(tmp_path / "r")),
+            col("lk") == col("rk"),
+        )
+        .group_by("lg")
+        .agg(*aggs)
+    )
+
+
+def _sorted_table(batch):
+    df = batch.to_pandas()
+    return df.sort_values(batch.column_names[0]).reset_index(drop=True)
+
+
+def _assert_tables_equal(a, b):
+    assert len(a) == len(b)
+    assert list(a.columns) == list(b.columns)
+    for c in a.columns:
+        if a[c].dtype.kind == "f":
+            assert np.allclose(
+                a[c].values, b[c].values, rtol=1e-9, equal_nan=True
+            ), c
+        else:
+            assert (a[c].values == b[c].values).all(), c
+
+
+def _populate(session, tmp_path, with_agg=True, rounds=3):
+    """Run the queries until background population converges (the
+    widened rebuild needs a second touch after the codes-only build)."""
+    for _ in range(rounds):
+        _join_q(session, tmp_path).collect()
+        if with_agg:
+            _agg_q(session, tmp_path).collect()
+        hbm_cache.wait_background(60)
+        snap = hbm_cache.snapshot_joins()
+        if snap["regions"] and (
+            not with_agg or snap["per_region"][0]["payload"]
+        ):
+            return snap
+    return hbm_cache.snapshot_joins()
+
+
+# ---------------------------------------------------------------------------
+# parity + zero per-query H2D
+# ---------------------------------------------------------------------------
+
+
+def test_resident_join_parity_and_zero_h2d(tmp_path):
+    session, hs = _setup(tmp_path)
+    truth = _join_q(session, tmp_path).collect()
+    snap = _populate(session, tmp_path, with_agg=False)
+    assert snap["regions"] == 1
+    metrics.reset()
+    served = _join_q(session, tmp_path).collect()
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("scan.path.resident_join", 0) >= 1
+    assert counters.get("scan.gate.resident_bypass_join", 0) >= 1
+    # the region uploaded BEFORE this window: the repeat query pays zero
+    # H2D, and only the (lo, counts) vectors came home
+    assert counters.get("hbm.join.h2d_bytes", 0) == 0
+    assert counters.get("scan.resident_join.d2h_bytes", 0) > 0
+    assert served.num_rows == truth.num_rows
+    for c in ("lv", "rv"):
+        assert int(served.columns[c].data.sum()) == int(
+            truth.columns[c].data.sum()
+        )
+    # row-identical to the hyperspace-off truth as well
+    session.disable_hyperspace()
+    off = _join_q(session, tmp_path).collect()
+    assert off.num_rows == served.num_rows
+
+
+def test_resident_join_agg_parity_full_spec(tmp_path):
+    """sum/avg/count/count(col)/min/max over int AND float columns, left
+    AND right sides, against the host path and the hyperspace-off truth
+    (ints exact, floats to f64 relative tolerance)."""
+    session, hs = _setup(tmp_path)
+    host = _sorted_table(_agg_q(session, tmp_path).collect())
+    snap = _populate(session, tmp_path)
+    assert snap["regions"] == 1 and snap["per_region"][0]["payload"]
+    metrics.reset()
+    served = _agg_q(session, tmp_path).collect()
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("scan.path.resident_join_agg", 0) >= 1
+    assert counters.get("hbm.join.h2d_bytes", 0) == 0
+    _assert_tables_equal(host, _sorted_table(served))
+    session.disable_hyperspace()
+    truth = _sorted_table(_agg_q(session, tmp_path).collect())
+    _assert_tables_equal(truth, _sorted_table(served))
+
+
+def test_resident_join_agg_min_max_only(tmp_path):
+    """min/max-only specs have NO host range fusion (it declines them) —
+    the device path must still match materialize + hash_aggregate."""
+    session, hs = _setup(tmp_path)
+    aggs = [agg_min("rv", "mrv"), agg_max("lv", "xlv"), agg_min("rf", "mrf")]
+    host = _sorted_table(_agg_q(session, tmp_path, aggs).collect())
+    _populate(session, tmp_path)
+    # payload for THIS spec may still be missing: touch + wait once more
+    _agg_q(session, tmp_path, aggs).collect()
+    hbm_cache.wait_background(60)
+    metrics.reset()
+    served = _agg_q(session, tmp_path, aggs).collect()
+    assert (
+        metrics.snapshot()["counters"].get("scan.path.resident_join_agg", 0)
+        >= 1
+    )
+    _assert_tables_equal(host, _sorted_table(served))
+
+
+def test_duplicate_right_matches_int_exact_float_declines(tmp_path):
+    """Non-unique right keys: int sums ride the device (int64 prefix
+    differences, exact); float sums/min/max decline to host with the
+    dtype counter — the host fusion's own rule, mirrored."""
+    session, hs = _setup(tmp_path, uniq_right=False)
+    # count(float) rides too: NaN (NULL) rows are excluded via the
+    # validity-prefix — the device must match host NULL semantics even
+    # under duplicate right matches (review finding: per_nn = counts
+    # silently counted NULLs)
+    int_aggs = [agg_sum("rv", "srv"), agg_count(), agg_count("rf", "crf")]
+    host = _sorted_table(_agg_q(session, tmp_path, int_aggs).collect())
+    _populate(session, tmp_path)
+    _agg_q(session, tmp_path, int_aggs).collect()
+    hbm_cache.wait_background(60)
+    metrics.reset()
+    served = _agg_q(session, tmp_path, int_aggs).collect()
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("scan.path.resident_join_agg", 0) >= 1
+    _assert_tables_equal(host, _sorted_table(served))
+    # float aggregate under duplicate matches: device declines, host
+    # serves — parity still holds end to end
+    f_aggs = [agg_sum("rf", "srf")]
+    metrics.reset()
+    fl = _agg_q(session, tmp_path, f_aggs).collect()
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("scan.path.resident_join_agg", 0) == 0
+    assert counters.get("hbm.join.declined.dtype", 0) >= 1
+    session.disable_hyperspace()
+    truth = _sorted_table(_agg_q(session, tmp_path, f_aggs).collect())
+    _assert_tables_equal(truth, _sorted_table(fl))
+
+
+# ---------------------------------------------------------------------------
+# eligibility — declines mirror the groups-cache opt-outs (PR-3 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _join_node(df):
+    joins = df.optimized_plan().collect(lambda n: isinstance(n, Join))
+    assert joins
+    return joins[0]
+
+
+def test_filtered_join_declines_and_optout_counter_fires(
+    tmp_path, monkeypatch
+):
+    # cache cap 0: filtered sides cannot derive a token (the pristine
+    # groups were never cached) and must count their opt-out
+    monkeypatch.setenv("HYPERSPACE_TPU_JOIN_CACHE_MB", "0")
+    session, hs = _setup(tmp_path)
+    q = (
+        session.read.parquet(str(tmp_path / "l"))
+        .filter(col("lv") > lit(50))
+        .join(
+            session.read.parquet(str(tmp_path / "r")),
+            col("lk") == col("rk"),
+        )
+        .select("lv", "rv")
+    )
+    metrics.reset()
+    q.collect()
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("join.cache.optout.filtered", 0) >= 1
+    # the resident-join eligibility procedure declines the SAME case
+    node = _join_node(q)
+    res = resolve_join_residency(node.left, node.right, ["lk"], ["rk"])
+    assert res.status == "declined" and res.reason == "filtered"
+    assert (
+        metrics.snapshot()["counters"].get("hbm.join.declined.filtered", 0)
+        >= 1
+    )
+
+
+def test_hybrid_join_declines_and_optout_counter_fires(tmp_path):
+    session, hs = _setup(tmp_path)
+    # append a file the index has not seen; hybrid scan folds it in
+    rng = np.random.default_rng(3)
+    ap = ColumnarBatch(
+        {
+            "lk": Column("int64", rng.integers(0, 5000, 200)),
+            "lg": Column("int64", rng.integers(0, 40, 200)),
+            "lv": Column("int64", rng.integers(0, 100, 200)),
+        }
+    )
+    parquet_io.write_parquet(tmp_path / "l" / "appended.parquet", ap)
+    session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, "true")
+    q = _join_q(session, tmp_path)
+    metrics.reset()
+    q.collect()
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("join.cache.optout.hybrid", 0) >= 1
+    node = _join_node(q)
+    res = resolve_join_residency(node.left, node.right, ["lk"], ["rk"])
+    assert res.status == "declined" and res.reason == "hybrid"
+    assert (
+        metrics.snapshot()["counters"].get("hbm.join.declined.hybrid", 0) >= 1
+    )
+    # and no region was ever populated for the hybrid shape
+    hbm_cache.wait_background(30)
+    assert hbm_cache.snapshot_joins()["regions"] == 0
+
+
+def test_mode_off_is_ineligible(tmp_path, monkeypatch):
+    session, hs = _setup(tmp_path)
+    q = _join_q(session, tmp_path)
+    node = _join_node(q)
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "off")
+    res = resolve_join_residency(node.left, node.right, ["lk"], ["rk"])
+    assert res.status == "ineligible" and res.reason == "mode"
+
+
+# ---------------------------------------------------------------------------
+# fault injection: device loss latches down to the exact host join
+# ---------------------------------------------------------------------------
+
+
+def test_device_loss_mid_join_latches_to_host(tmp_path, monkeypatch):
+    session, hs = _setup(tmp_path)
+    truth = _join_q(session, tmp_path).collect()
+    _populate(session, tmp_path, with_agg=False)
+    assert hbm_cache.snapshot_joins()["regions"] == 1
+
+    def boom(self, region):
+        raise RuntimeError("injected device loss")
+
+    monkeypatch.setattr(HbmIndexCache, "join_ranges", boom)
+    metrics.reset()
+    served = _join_q(session, tmp_path).collect()  # exact host fallback
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("scan.resident_join.device_failed", 0) == 1
+    assert counters.get("scan.path.resident_join", 0) == 0
+    assert served.num_rows == truth.num_rows
+    assert int(served.columns["rv"].data.sum()) == int(
+        truth.columns["rv"].data.sum()
+    )
+    # the region was dropped: no later query retries the dead device
+    assert hbm_cache.snapshot_joins()["regions"] == 0
+
+
+def test_device_loss_mid_join_agg_latches_to_host(tmp_path, monkeypatch):
+    session, hs = _setup(tmp_path)
+    host = _sorted_table(_agg_q(session, tmp_path).collect())
+    _populate(session, tmp_path)
+
+    def boom(self, region, group_by, aggs):
+        raise RuntimeError("injected device loss")
+
+    monkeypatch.setattr(HbmIndexCache, "join_agg", boom)
+    v0 = hbm_cache.join_region_version()
+    metrics.reset()
+    served = _agg_q(session, tmp_path).collect()
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("scan.resident_join.device_failed", 0) >= 1
+    assert counters.get("scan.path.resident_join_agg", 0) == 0
+    _assert_tables_equal(host, _sorted_table(served))
+    # the failed region was DROPPED (generation bumped); the host
+    # fallback's own touch may legitimately repopulate a fresh one in
+    # the background — transient failures heal, like delta residency
+    assert hbm_cache.join_region_version() > v0
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: invalidation scoped per index, reset, budget
+# ---------------------------------------------------------------------------
+
+
+def test_refresh_invalidates_regions_scoped_to_index(tmp_path):
+    session, hs = _setup(tmp_path)
+    _populate(session, tmp_path, with_agg=False)
+    assert hbm_cache.snapshot_joins()["regions"] == 1
+    # append data so the full refresh rewrites the LEFT index's files
+    rng = np.random.default_rng(5)
+    ap = ColumnarBatch(
+        {
+            "lk": Column("int64", rng.integers(0, 5000, 100)),
+            "lg": Column("int64", rng.integers(0, 40, 100)),
+            "lv": Column("int64", rng.integers(0, 100, 100)),
+        }
+    )
+    parquet_io.write_parquet(tmp_path / "l" / "appended2.parquet", ap)
+    metrics.reset()
+    hs.refresh_index("jl", "full")
+    assert hbm_cache.snapshot_joins()["regions"] == 0
+    assert (
+        metrics.snapshot()["counters"].get("hbm.join.invalidated", 0) == 1
+    )
+
+
+def test_refresh_of_unrelated_index_keeps_regions(tmp_path):
+    session, hs = _setup(tmp_path)
+    _populate(session, tmp_path, with_agg=False)
+    assert hbm_cache.snapshot_joins()["regions"] == 1
+    # a third, unrelated index: refreshing it must not drop the region
+    (tmp_path / "u").mkdir()
+    parquet_io.write_parquet(
+        tmp_path / "u" / "p.parquet",
+        ColumnarBatch({"uk": Column("int64", np.arange(100))}),
+    )
+    hs.create_index(
+        session.read.parquet(str(tmp_path / "u")),
+        IndexConfig("ju", ["uk"], []),
+    )
+    hs.refresh_index("ju", "full")
+    assert hbm_cache.snapshot_joins()["regions"] == 1
+    # reset() clears everything and bumps the region generation
+    v0 = hbm_cache.join_region_version()
+    hbm_cache.reset()
+    assert hbm_cache.snapshot_joins()["regions"] == 0
+    assert hbm_cache.join_region_version() > v0
+
+
+def test_over_budget_region_is_refused(tmp_path, monkeypatch):
+    session, hs = _setup(tmp_path)
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "0")
+    metrics.reset()
+    _join_q(session, tmp_path).collect()
+    hbm_cache.wait_background(60)
+    assert hbm_cache.snapshot_joins()["regions"] == 0
+    assert (
+        metrics.snapshot()["counters"].get("hbm.join.over_budget_refused", 0)
+        >= 1
+    )
+
+
+def test_eviction_order_deltas_then_joins_then_tables(monkeypatch):
+    """Unit check of the retention priority: registering a table under
+    pressure drains deltas first, then join regions, then LRU tables."""
+    from hyperspace_tpu.exec.hbm_cache import ResidentTable
+
+    cache = HbmIndexCache()
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "1")  # 1 MiB
+
+    def table(key, nbytes):
+        return ResidentTable((key,), [], 1, 1, {}, nbytes)
+
+    class _Stub:
+        def __init__(self, key, nbytes):
+            self.key = key
+            self.base_key = ("gone",)
+            self.deleted_ids = ()
+            self.nbytes = nbytes
+            self.last_used = 0.0
+
+    old = table("t_old", 300_000)
+    cache._register(old)
+    cache._deltas.append(_Stub("d1", 300_000))
+    cache._joins.append(_Stub("j1", 300_000))
+    # 900 KB resident; a 300 KB table pushes past 1 MiB: the delta goes
+    # first, nothing else needed
+    cache._register(table("t_new", 300_000))
+    assert not cache._deltas and len(cache._joins) == 1
+    assert len(cache._tables) == 2
+    # next pressure wave: the join region is the second victim
+    cache._register(table("t_new2", 300_000))
+    assert not cache._joins and len(cache._tables) == 3
+    # only then tables fall, LRU first
+    cache._register(table("t_new3", 300_000))
+    assert [t.key for t in cache._tables][0] != ("t_old",)
+
+
+# ---------------------------------------------------------------------------
+# joins.py device-kernel latch (satellite): deviceprobe consult, reset()
+# re-arm, per-cause counters
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_latch_consults_probe_rearms_on_reset(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_KERNELS", "interpret")
+    from hyperspace_tpu.ops import kernels as K
+    from hyperspace_tpu.utils import deviceprobe
+
+    calls = {"n": 0}
+
+    def failing(l_codes, r_sorted):
+        calls["n"] += 1
+        raise RuntimeError("injected kernel failure")
+
+    monkeypatch.setattr(K, "sorted_intersect_counts", failing)
+    monkeypatch.setattr(
+        J, "_kernel_latch", {"dead": False, "epoch": -1}
+    )
+    rng = np.random.default_rng(0)
+    l_codes = np.sort(rng.integers(0, 1000, 4096)).astype(np.int64)
+    r_codes = np.sort(rng.integers(0, 1000, 4096)).astype(np.int64)
+    metrics.reset()
+    lo, counts, r_order = J.merge_join_ranges(l_codes, r_codes, device=True)
+    counters = metrics.snapshot()["counters"]
+    assert calls["n"] == 1
+    assert counters.get("join.path.device_kernel_failed", 0) == 1
+    # distinct failure causes are counted (not one opaque total)
+    assert counters.get("join.path.device_kernel_failed.RuntimeError", 0) == 1
+    assert counters.get("join.path.host_searchsorted", 0) == 1
+    # latched: the next join does NOT retry the kernel
+    J.merge_join_ranges(l_codes, r_codes, device=True)
+    assert calls["n"] == 1
+    # a cache reset() re-arms the latch — the kernel gets another chance
+    hbm_cache.reset()
+    J.merge_join_ranges(l_codes, r_codes, device=True)
+    assert calls["n"] == 2
+    # a latched-negative deviceprobe verdict disables dispatch outright
+    # (the serve path's consult), even with the kernel latch re-armed
+    hbm_cache.reset()
+    monkeypatch.setitem(deviceprobe._FIRST_TOUCH, "ok", False)
+    J.merge_join_ranges(l_codes, r_codes, device=True)
+    assert calls["n"] == 2
+    # exactness was never at risk: the fallback produced real ranges
+    assert len(lo) == len(l_codes) and int(counts.sum()) > 0
+    assert r_order is not None
+
+
+# ---------------------------------------------------------------------------
+# NaN / -0.0 key semantics through the shared helper (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_nan_never_matches_in_joins_but_groups_in_aggregates():
+    from hyperspace_tpu.exec.aggregate import hash_aggregate
+    from hyperspace_tpu.exec.joins import inner_join
+
+    # two distinct NaN payloads + a -0.0/+0.0 pair on each side
+    payload_nans = np.array(
+        [0x7FF8000000000000, 0x7FF800000000ABCD], dtype=np.uint64
+    ).view(np.float64)
+    lvals = np.array(
+        [1.5, payload_nans[0], -0.0, 2.5, payload_nans[1]], dtype=np.float64
+    )
+    rvals = np.array(
+        [payload_nans[1], 0.0, 1.5, payload_nans[0]], dtype=np.float64
+    )
+    left = ColumnarBatch(
+        {
+            "k": Column("float64", lvals),
+            "lid": Column("int64", np.arange(5, dtype=np.int64)),
+        }
+    )
+    right = ColumnarBatch(
+        {
+            "rk": Column("float64", rvals),
+            "rid": Column("int64", np.arange(4, dtype=np.int64)),
+        }
+    )
+    out = inner_join(left, right, ["k"], ["rk"])
+    got = sorted(
+        zip(out.columns["lid"].data.tolist(), out.columns["rid"].data.tolist())
+    )
+    # SQL: NaN equals nothing (any payload); -0.0 == +0.0; 1.5 matches
+    assert got == [(0, 2), (2, 1)]
+
+    # aggregates: every NaN payload is ONE group, -0.0/+0.0 one group
+    agg = hash_aggregate(
+        ColumnarBatch(
+            {
+                "k": Column("float64", np.concatenate([lvals, rvals])),
+                "v": Column("int64", np.ones(9, dtype=np.int64)),
+            }
+        ),
+        ["k"],
+        [agg_count()],
+    )
+    keys = agg.columns["k"].data
+    cnt = dict(
+        zip(
+            [("nan" if np.isnan(k) else float(k)) for k in keys],
+            agg.columns["count"].data.tolist(),
+        )
+    )
+    assert len(keys) == 4  # {nan, 0.0, 1.5, 2.5}
+    assert cnt["nan"] == 4 and cnt[0.0] == 2 and cnt[1.5] == 2
+
+
+def test_nan_keys_multikey_join_never_match():
+    from hyperspace_tpu.exec.joins import join_codes
+
+    nan = np.float64("nan")
+    left = ColumnarBatch(
+        {
+            "a": Column("int64", np.array([1, 1, 2], dtype=np.int64)),
+            "b": Column("float64", np.array([nan, 2.0, -0.0])),
+        }
+    )
+    right = ColumnarBatch(
+        {
+            "a2": Column("int64", np.array([1, 1, 2], dtype=np.int64)),
+            "b2": Column("float64", np.array([nan, 2.0, 0.0])),
+        }
+    )
+    lc, rc = join_codes(left, right, ["a", "b"], ["a2", "b2"])
+    # (1, 2.0) and (2, ±0.0) match; (1, NaN) must not
+    assert lc[1] == rc[1] and lc[2] == rc[2]
+    assert lc[0] != rc[0]
+
+
+# ---------------------------------------------------------------------------
+# serving: identical aggregate-joins coalesce under the join-extended key
+# ---------------------------------------------------------------------------
+
+
+def test_serve_coalesces_identical_aggregate_joins(tmp_path):
+    from hyperspace_tpu.serve import QueryServer, ServeConfig
+
+    session, hs = _setup(tmp_path)
+    aggs = [agg_sum("rv", "srv"), agg_count()]
+    host = _sorted_table(_agg_q(session, tmp_path, aggs).collect())
+    _populate(session, tmp_path)
+    _agg_q(session, tmp_path, aggs).collect()
+    hbm_cache.wait_background(60)
+    server = QueryServer(
+        session, ServeConfig(max_workers=2, batch_max=8, autostart=False)
+    )
+    dfs = [_agg_q(session, tmp_path, aggs) for _ in range(6)]
+    tickets = [server.submit(df) for df in dfs]
+    server.start()
+    results = [t.result(timeout=120) for t in tickets]
+    stats = server.stats()
+    server.close()
+    assert stats["batch_dispatches"] >= 1
+    assert stats["batched_queries"] >= 2
+    assert stats["join_regions"]["hbm"]["regions"] >= 1
+    for r in results:
+        _assert_tables_equal(host, _sorted_table(r))
+
+
+def test_region_agg_plan_declines_unservable_specs(tmp_path):
+    session, hs = _setup(tmp_path)
+    _populate(session, tmp_path)
+    q = _agg_q(session, tmp_path)
+    node = _join_node(q)
+    res = resolve_join_residency(
+        node.left, node.right, ["lk"], ["rk"],
+        payload_columns=["lg", "rv", "rf", "lv"],
+    )
+    assert res.status == "ok"
+    region = res.region
+    # multi-key grouping declines
+    assert region_agg_plan(region, ["lg", "lv"], [agg_count()]) is None
+    # unresident group column declines
+    assert region_agg_plan(region, ["nope"], [agg_count()]) is None
+    # servable spec plans (sanity)
+    assert (
+        region_agg_plan(region, ["lg"], [agg_sum("rv", "s"), agg_count()])
+        is not None
+    )
+
+
+# ---------------------------------------------------------------------------
+# mesh variant: shuffle-free sharded join, two-phase psum/pmin/pmax
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(8)
+
+
+def test_mesh_fused_join_agg_parity_and_zero_h2d(tmp_path, mesh):
+    from hyperspace_tpu.config import HyperspaceConf as _Conf
+    from hyperspace_tpu.exec.executor import Executor
+    from hyperspace_tpu.plan.aggregates import agg_avg as _avg
+    from hyperspace_tpu.plan.ir import Aggregate, IndexScan, Scan
+    from hyperspace_tpu.plan.rules import apply_hyperspace_rules
+    from tests.e2e_utils import build_index, write_source
+
+    conf = _Conf()
+    rng = np.random.default_rng(7)
+    li = ColumnarBatch.from_pydict(
+        {
+            "l_k": rng.integers(0, 150, 12_000).astype(np.int64),
+            "l_g": rng.integers(0, 25, 12_000).astype(np.int64),
+        },
+        {"l_k": "int64", "l_g": "int64"},
+    )
+    orders = ColumnarBatch.from_pydict(
+        {
+            "o_k": np.arange(150).astype(np.int64),
+            "o_t": np.round(rng.uniform(0, 9000.0, 150), 2),
+        },
+        {"o_k": "int64", "o_t": "float64"},
+    )
+    l_rel = write_source(tmp_path / "li", li, n_files=3)
+    o_rel = write_source(tmp_path / "or", orders, n_files=2)
+    l_entry = build_index("li_idx", l_rel, ["l_k"], ["l_g"], tmp_path / "idx")
+    o_entry = build_index("o_idx", o_rel, ["o_k"], ["o_t"], tmp_path / "idx")
+    plan = Aggregate(
+        ("l_g",),
+        (agg_sum("o_t", "rev"), _avg("o_t", "avg_rev"), agg_count()),
+        Join(Scan(l_rel), Scan(o_rel), col("l_k") == col("o_k")),
+    )
+    rewritten, applied = apply_hyperspace_rules(
+        plan, [l_entry, o_entry], conf
+    )
+    assert applied and rewritten.collect(lambda n: isinstance(n, IndexScan))
+    single = Executor(conf).execute(rewritten)
+    ex = Executor(conf, mesh=mesh, dist_min_rows=0)
+    ex.execute(rewritten)  # schedules the mesh region build
+    mesh_cache.wait_background(120)
+    assert mesh_cache.snapshot_joins()["regions"] == 1
+    metrics.reset()
+    served = ex.execute(rewritten)
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("scan.path.resident_join_agg_mesh", 0) == 1
+    assert counters.get("hbm.mesh.join.h2d_bytes", 0) == 0  # zero per-query H2D
+    _assert_tables_equal(_sorted_table(single), _sorted_table(served))
