@@ -1500,6 +1500,137 @@ def main() -> None:
         else:
             os.environ["HYPERSPACE_TPU_HBM"] = _prev_hbm12
 
+    # ---- config 13: pipelined build A/B (serial vs pipelined) --------------
+    # The build-pipeline claim (docs/14-build-pipeline.md): the streamed
+    # build's stages — ingest decode, dispatch, spill compute, spill
+    # write, finalize merge — overlap across the parallel.pool worker
+    # layer instead of serializing on one core. A/B: the SAME source,
+    # chunking, and PINNED engine (auto would probe each side under its
+    # own width-keyed cache slot and could elect different engines — the
+    # ratio would then measure an engine switch, not pipelining), once
+    # with pipeline=off (every stage inline, zero threads) and once with
+    # the pipeline on. Parity-gated on the produced index (per-bucket
+    # counts + contents) AND on query results through each index. Host
+    # engine by default: that is where the SF100 build serialized;
+    # BENCH_BUILD_PIPE_ENGINE=device A/Bs the device path instead.
+    if os.environ.get("BENCH_BUILD_PIPELINE", "1") != "0":
+        import pyarrow.dataset as pads
+
+        from hyperspace_tpu.storage import layout as _layout13
+        from hyperspace_tpu.telemetry.metrics import build_pipeline_snapshot
+
+        bp_src = WORKDIR / "lineitem"
+        bp_chunk = int(
+            os.environ.get("BENCH_BUILD_PIPE_CHUNK", max(N_ROWS // 16, 1 << 15))
+        )
+        bp_engine = os.environ.get("BENCH_BUILD_PIPE_ENGINE", "host")
+        bp_detail = {
+            "rows": N_ROWS,
+            "chunk_rows": bp_chunk,
+            "pinned_engine": bp_engine,
+        }
+        bp_sessions = {}
+
+        def _bp_build(mode: str):
+            conf_b = HyperspaceConf(
+                {
+                    C.INDEX_SYSTEM_PATH: str(WORKDIR / f"bp_idx_{mode}"),
+                    C.INDEX_NUM_BUCKETS: N_BUCKETS,
+                    C.BUILD_MODE: C.BUILD_MODE_STREAMING,
+                    C.BUILD_CHUNK_ROWS: bp_chunk,
+                    C.BUILD_PIPELINE: mode,
+                    C.BUILD_ENGINE: bp_engine,
+                }
+            )
+            s = HyperspaceSession(conf_b)
+            bp_sessions[mode] = s
+            metrics.reset()
+            t0 = time.perf_counter()
+            Hyperspace(s).create_index(
+                s.read.parquet(str(bp_src)),
+                IndexConfig(
+                    "bp_idx", ["l_orderkey"], ["l_partkey", "l_shipmode"]
+                ),
+            )
+            wall = time.perf_counter() - t0
+            snap = metrics.snapshot()
+            steady_rows = snap["counters"].get("build.stream.steady_rows", 0)
+            steady_s = snap["timers_s"].get("build.stream.steady", 0.0)
+            return {
+                "build_s": round(wall, 3),
+                "rows_per_s": round(N_ROWS / wall),
+                "steady_rows_per_s": (
+                    round(steady_rows / steady_s) if steady_s > 0 else None
+                ),
+                "stages": build_pipeline_snapshot(),
+                # which engine each side elected (widths probe separately)
+                "engine": {
+                    k.split(".")[-1]: v
+                    for k, v in snap["counters"].items()
+                    if k.startswith("build.engine.")
+                },
+            }
+
+        def _bp_bucket_contents(mode: str):
+            vdir = (
+                WORKDIR / f"bp_idx_{mode}" / "bp_idx" / "v__=0"
+            )
+            out = {}
+            for f in sorted(vdir.glob("*.tcb")):
+                b = _layout13.bucket_of_file(f)
+                fb = _layout13.read_batch(f)
+                out[b] = (
+                    fb.num_rows,
+                    fb.columns["l_orderkey"].data.tolist(),
+                    int(fb.columns["l_partkey"].data.sum()),
+                )
+            return out
+
+        bp_detail["serial"] = _bp_build("off")
+        bp_detail["pipelined"] = _bp_build("on")
+        if _bp_bucket_contents("off") != _bp_bucket_contents("on"):
+            _fail("config13 serial/pipelined index content parity violated")
+        bp_key = int(
+            pads.dataset(str(bp_src), format="parquet")
+            .head(1)
+            .column("l_orderkey")[0]
+            .as_py()
+        )
+        bp_rows = {}
+        for mode, s in bp_sessions.items():
+            s.enable_hyperspace()
+            got = (
+                s.read.parquet(str(bp_src))
+                .filter(col("l_orderkey") == bp_key)
+                .select("l_orderkey", "l_partkey", "l_shipmode")
+                .to_pandas()
+                .sort_values(["l_partkey", "l_shipmode"])
+                .reset_index(drop=True)
+            )
+            bp_rows[mode] = got
+        if not bp_rows["off"].equals(bp_rows["on"]):
+            _fail("config13 serial/pipelined query parity violated")
+        st = bp_detail["pipelined"]["stages"]
+        bp_detail["overlap_spill_sum_exceeds_wall"] = bool(
+            st.get("spill_compute_busy_s", 0.0) + st.get("spill_write_busy_s", 0.0)
+            > st.get("wall_s", 0.0) > 0
+        )
+        sp_serial = bp_detail["serial"]["steady_rows_per_s"]
+        sp_pipe = bp_detail["pipelined"]["steady_rows_per_s"]
+        if sp_serial and sp_pipe:
+            bp_detail["steady_speedup_x"] = round(sp_pipe / sp_serial, 2)
+            speedups["build_pipeline"] = sp_pipe / sp_serial
+        bp_detail["wall_speedup_x"] = round(
+            bp_detail["serial"]["build_s"] / bp_detail["pipelined"]["build_s"], 2
+        )
+        extras["build_pipeline"] = bp_detail
+        extras["build_pipeline_speedup_x"] = bp_detail.get(
+            "steady_speedup_x", bp_detail["wall_speedup_x"]
+        )
+        extras["build_pipeline_rows_per_s"] = bp_detail["pipelined"]["rows_per_s"]
+        for mode in ("off", "on"):
+            shutil.rmtree(WORKDIR / f"bp_idx_{mode}", ignore_errors=True)
+
     # ---- mesh-path A/B (round-4 verdict next-round #1 "done" criterion) ----
     # run on the virtual 8-device CPU mesh in a subprocess (the bench host
     # has ONE physical chip; per-query link-bytes under each architecture
@@ -1632,6 +1763,8 @@ def main() -> None:
         "hybrid_resident_vs_host_union",
         "join_resident_join_vs_host",
         "join_resident_agg_vs_host",
+        "build_pipeline_speedup_x",
+        "build_pipeline_rows_per_s",
     ):
         if k in extras:
             compact[k] = extras[k]

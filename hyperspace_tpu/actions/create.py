@@ -138,6 +138,59 @@ class CreateActionBase:
                     Column("int64", np.full(chunk.num_rows, fid, dtype=np.int64)),
                 )
 
+    def prepare_index_chunk_tasks(
+        self,
+        relation: FileRelation,
+        indexed: List[str],
+        included: List[str],
+        lineage: bool,
+        tracker: FileIdTracker,
+        chunk_rows: int,
+    ):
+        """Parallel-ingest twin of prepare_index_chunks: a list of
+        zero-arg decode tasks (each returning a list of chunks) the
+        pipelined build fans across ingest workers IN ORDER — same rows,
+        same order, same built bytes as the serial generator. Returns
+        None for shapes the task split cannot express (partitioned
+        relations materialize hive columns through a sequential reader;
+        only parquet has the row-group random access the split needs) —
+        the caller falls back to serial ingest."""
+        if relation.partition_spec is not None:
+            return None
+        if relation.read_format != "parquet":
+            return None
+        cols = list(indexed) + list(included)
+        pairs = (
+            self.session.sources.lineage_pairs(relation, tracker)
+            if lineage
+            else [(f.name, None) for f in relation.files]
+        )
+        tasks = []
+        for path, fid in pairs:
+            for t in parquet_io.file_chunk_tasks(
+                "parquet", path, columns=cols, chunk_rows=chunk_rows
+            ):
+                if fid is None:
+                    tasks.append(t)
+                else:
+
+                    def with_lineage(t=t, fid=fid):
+                        return [
+                            chunk.with_column(
+                                C.DATA_FILE_NAME_ID,
+                                Column(
+                                    "int64",
+                                    np.full(
+                                        chunk.num_rows, fid, dtype=np.int64
+                                    ),
+                                ),
+                            )
+                            for chunk in t()
+                        ]
+
+                    tasks.append(with_lineage)
+        return tasks
+
     def _streaming_build(self, relation: FileRelation) -> bool:
         """Build-mode policy: 'streaming' forces the out-of-core path,
         'inmemory' forces the materialized path, 'auto' streams when the
@@ -164,14 +217,23 @@ class CreateActionBase:
     ) -> List[Path]:
         indexed, included = self.resolved_columns(relation, config)
         extra_meta = {"indexName": config.index_name}
+        pipeline = self.conf.build_pipeline()
         if self._streaming_build(relation):
             from ..index.stream_builder import write_index_data_streaming
 
             chunk_rows = self.conf.build_chunk_rows()
-            return write_index_data_streaming(
-                self.prepare_index_chunks(
+            chunk_tasks = self.prepare_index_chunk_tasks(
+                relation, indexed, included, lineage, tracker, chunk_rows
+            )
+            chunks = (
+                None
+                if chunk_tasks is not None
+                else self.prepare_index_chunks(
                     relation, indexed, included, lineage, tracker, chunk_rows
-                ),
+                )
+            )
+            return write_index_data_streaming(
+                chunks,
                 indexed,
                 num_buckets,
                 version_dir,
@@ -180,6 +242,8 @@ class CreateActionBase:
                 mesh=self.session.mesh,
                 engine=self.conf.build_engine(),
                 finalize_mode=self.conf.build_finalize_mode(),
+                chunk_tasks=chunk_tasks,
+                pipeline=pipeline,
             )
         batch = self.prepare_index_batch(relation, indexed, included, lineage, tracker)
         return write_index_data(
@@ -190,6 +254,7 @@ class CreateActionBase:
             mesh=self.session.mesh,
             engine=self.conf.build_engine(),
             extra_meta=extra_meta,
+            host_workers=pipeline.host_width(),
         )
 
     # -- metadata (CreateActionBase.scala:50-95) -----------------------------

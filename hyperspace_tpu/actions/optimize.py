@@ -125,11 +125,23 @@ class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
         ]
         from ..telemetry.metrics import metrics
 
-        for b in sorted(set(to_optimize) | run_buckets):
+        # every part that already carries the right footer sort order is a
+        # sorted RUN: the bucket then rebuilds via the stable k-way
+        # searchsorted merge (stream_builder.merge_sorted_runs) instead of
+        # a concat + full lexsort — the same asymptotic win the build's
+        # finalize took, applied to the deferred compaction (at SF100 the
+        # compaction was ~300s of concat+re-sort of already-sorted parts).
+        # Parts without the footer claim (legacy files) keep the re-sort.
+        def compact_bucket(b: int):
             with metrics.timer("optimize.bucket_read"):
-                parts = [
-                    layout.read_batch(f.name) for f in to_optimize.get(b, [])
-                ]
+                parts = []
+                parts_sorted = True
+                for f in to_optimize.get(b, []):
+                    parts.append(layout.read_batch(f.name))
+                    footer = layout.cached_reader(f.name).footer
+                    parts_sorted = parts_sorted and (
+                        footer.get("sortedBy") == list(indexed)
+                    )
                 for reader, offs in zip(run_readers, run_offsets):
                     if b < len(offs) - 1 and offs[b + 1] > offs[b]:
                         parts.append(
@@ -137,28 +149,52 @@ class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
                                 row_range=(int(offs[b]), int(offs[b + 1]))
                             )
                         )
+                        parts_sorted = parts_sorted and (
+                            reader.footer.get("sortedBy") == list(indexed)
+                        )
                 if not parts:  # bucket emptied (e.g. lineage delete)
-                    continue
-                merged = (
-                    parts[0] if len(parts) == 1 else ColumnarBatch.concat(parts)
-                )
-            # restore per-bucket sort order on the indexed columns via the
-            # shared order-preserving encodings (stream_builder.sort_encoding):
-            # strings sort by unified dictionary codes, floats by their
-            # ordered-int encodings — key_repr would sort strings by FNV
-            # hash and float32 by raw bit pattern (negatives reversed)
-            from ..index.stream_builder import sort_encoding
+                    return None
+            from ..index.stream_builder import merge_sorted_runs, sort_encoding
 
             with metrics.timer("optimize.bucket_sort"):
-                reprs = [sort_encoding(merged.columns[c]) for c in indexed]
-                order = np.lexsort(list(reversed(reprs)))
-                merged = merged.take(order)
+                if parts_sorted:
+                    merged = merge_sorted_runs(parts, list(indexed))
+                else:
+                    # restore per-bucket sort order on the indexed columns
+                    # via the shared order-preserving encodings
+                    # (stream_builder.sort_encoding): strings sort by
+                    # unified dictionary codes, floats by their ordered-int
+                    # encodings — key_repr would sort strings by FNV hash
+                    # and float32 by raw bit pattern (negatives reversed)
+                    merged = (
+                        parts[0]
+                        if len(parts) == 1
+                        else ColumnarBatch.concat(parts)
+                    )
+                    reprs = [sort_encoding(merged.columns[c]) for c in indexed]
+                    order = np.lexsort(list(reversed(reprs)))
+                    merged = merged.take(order)
             with metrics.timer("optimize.bucket_write"):
                 p = version_dir / layout.bucket_file_name(b)
                 layout.write_batch(
                     p, merged, sorted_by=list(indexed), bucket=b
                 )
-            new_paths.append(str(p))
+            return str(p)
+
+        # buckets are independent (disjoint inputs, distinct output
+        # files): compact them across the build pipeline's merge pool
+        from ..parallel.pool import run_parallel
+
+        pipe = self.conf.build_pipeline()
+        results = run_parallel(
+            [
+                lambda b=b: compact_bucket(b)
+                for b in sorted(set(to_optimize) | run_buckets)
+            ],
+            pipe.merge_workers if pipe.enabled else 1,
+            name="optimize-compact",
+        )
+        new_paths.extend(p for p in results if p is not None)
 
         tracker = FileIdTracker()
         new_content = Content.from_leaf_files(new_paths, tracker)

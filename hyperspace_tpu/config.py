@@ -198,6 +198,44 @@ class HyperspaceConf:
             )
         return v
 
+    def build_pipeline(self):
+        """The BuildPipelineConfig from the ``hyperspace.index.build.*``
+        pipeline knobs (docs/14-build-pipeline.md): worker counts accept
+        an int or "auto" (the machine-derived default);
+        ``pipeline=off`` returns the zero-thread serial config."""
+        from .index.stream_builder import BuildPipelineConfig
+
+        mode = str(self.get(C.BUILD_PIPELINE, C.BUILD_PIPELINE_DEFAULT)).lower()
+        if mode not in C.BUILD_PIPELINE_MODES:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"Unknown {C.BUILD_PIPELINE}={mode!r}; expected one of "
+                f"{C.BUILD_PIPELINE_MODES}."
+            )
+        if mode == C.BUILD_PIPELINE_OFF:
+            return BuildPipelineConfig.serial()
+        auto = BuildPipelineConfig.default()
+
+        def _workers(key: str, fallback: int) -> int:
+            v = self.get(key, C.BUILD_WORKERS_AUTO)
+            if str(v).strip().lower() == C.BUILD_WORKERS_AUTO:
+                return fallback
+            return max(1, int(v))
+
+        return BuildPipelineConfig(
+            enabled=True,
+            ingest_workers=_workers(C.BUILD_INGEST_WORKERS, auto.ingest_workers),
+            spill_compute_workers=_workers(
+                C.BUILD_SPILL_COMPUTE_WORKERS, auto.spill_compute_workers
+            ),
+            spill_write_workers=_workers(
+                C.BUILD_SPILL_WRITE_WORKERS, auto.spill_write_workers
+            ),
+            merge_workers=_workers(C.BUILD_MERGE_WORKERS, auto.merge_workers),
+            queue_depth=max(1, int(self.get(C.BUILD_QUEUE_DEPTH, auto.queue_depth))),
+        )
+
     def distributed_min_rows(self) -> int:
         return int(
             self.get(

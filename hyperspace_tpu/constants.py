@@ -66,6 +66,23 @@ BUILD_ENGINE_DEVICE = "device"
 BUILD_ENGINE_HOST = "host"
 BUILD_ENGINES = (BUILD_ENGINE_AUTO, BUILD_ENGINE_DEVICE, BUILD_ENGINE_HOST)
 BUILD_ENGINE_DEFAULT = BUILD_ENGINE_AUTO
+# Pipelined build (docs/14-build-pipeline.md): worker counts and queue
+# depths of the staged ingest→dispatch→spill-compute→spill-write→merge
+# pipeline. pipeline=off runs every stage inline on the caller thread
+# (zero background threads — the deterministic A/B baseline of bench
+# config 13 and the debugging escape hatch). Worker counts accept an int
+# or "auto" (derived from the host core count).
+BUILD_PIPELINE = "hyperspace.index.build.pipeline"
+BUILD_PIPELINE_ON = "on"
+BUILD_PIPELINE_OFF = "off"
+BUILD_PIPELINE_MODES = (BUILD_PIPELINE_ON, BUILD_PIPELINE_OFF)
+BUILD_PIPELINE_DEFAULT = BUILD_PIPELINE_ON
+BUILD_INGEST_WORKERS = "hyperspace.index.build.ingestWorkers"
+BUILD_SPILL_COMPUTE_WORKERS = "hyperspace.index.build.spillComputeWorkers"
+BUILD_SPILL_WRITE_WORKERS = "hyperspace.index.build.spillWriteWorkers"
+BUILD_MERGE_WORKERS = "hyperspace.index.build.mergeWorkers"
+BUILD_QUEUE_DEPTH = "hyperspace.index.build.queueDepth"
+BUILD_WORKERS_AUTO = "auto"
 
 # Lineage (reference: IndexConstants.scala:74-76)
 INDEX_LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"

@@ -236,7 +236,7 @@ class ScanGate:
     def _disk_key(self, n_pad: int) -> tuple:
         from ..index.stream_builder import _engine_cache_key
 
-        platform, _ = _engine_cache_key(0)
+        platform = _engine_cache_key(0)[0]  # (platform, capacity, width)
         return (f"scan.{platform}", n_pad)
 
     def _load_disk(self, n_pad: int) -> Optional[str]:

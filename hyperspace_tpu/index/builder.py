@@ -51,12 +51,17 @@ def write_index_data(
     mesh=None,
     extra_meta: Optional[dict] = None,
     engine: str = "auto",
+    host_workers: int = 1,
 ) -> List[Path]:
     """Partition+sort ``batch`` and write one TCB file per non-empty bucket
     into ``out_dir``. Returns written paths. ``mesh`` selects the sharded
     (ICI all_to_all) path; None routes between the single-device kernel
     and its host twin (``engine``: device | host | auto — see
-    _route_inmemory_engine)."""
+    _route_inmemory_engine). ``host_workers`` > 1 runs the host twin's
+    one big stable sort across that many threads
+    (ops.build.build_partition_host_parallel — identical output): the
+    in-memory build has a single sort, so intra-sort parallelism is the
+    only way the worker pool can help it."""
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     written: List[Path] = []
@@ -87,11 +92,11 @@ def write_index_data(
                 write_bucket(int(bucket_ids[s]), dev_batch.take(np.arange(s, e)))
     else:
         if _route_inmemory_engine(engine, batch.num_rows) == "host":
-            from ..ops.build import build_partition_host
+            from ..ops.build import build_partition_host_parallel
 
             metrics.incr("build.engine.host")
-            sorted_batch, counts = build_partition_host(
-                batch, indexed_cols, num_buckets
+            sorted_batch, counts = build_partition_host_parallel(
+                batch, indexed_cols, num_buckets, host_workers
             )
         else:
             from ..ops.build import build_partition_single

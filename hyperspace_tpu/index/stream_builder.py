@@ -14,13 +14,31 @@ the explicit TPU-native pipeline with the same bounded-memory property:
 * **spill**: each sorted chunk lands in one spill TCB whose footer carries
   ``bucketCounts`` — rows are already grouped by bucket, so a bucket's rows
   in a run are one contiguous row-range (byte-range per column, mmap-read);
-* **merge**: per bucket, the sorted runs from all spills are concatenated
-  and merged on host (runs stay sorted under dictionary unification because
-  codes are order-preserving), then written as the final bucket file.
+* **merge**: per bucket, the sorted runs from all spills merge on host via
+  a stable k-way searchsorted merge (runs stay sorted under dictionary
+  unification because codes are order-preserving), then the final bucket
+  file is written.
 
-Peak host memory is O(chunk + largest bucket), independent of dataset size.
-HBM holds one padded chunk. That is the "HBM residency management …
-bucket-at-a-time scheduling" hard part of SURVEY.md §7.
+Peak host memory is O(in-flight chunks + largest bucket), independent of
+dataset size. HBM holds the in-flight padded chunks. That is the "HBM
+residency management … bucket-at-a-time scheduling" hard part of
+SURVEY.md §7.
+
+As of the pipelined build (docs/14-build-pipeline.md) every stage runs on
+the ``parallel.pool`` worker layer with bounded queues:
+
+  ingest decode (N workers, ordered) → dispatch (main thread; device H2D +
+  kernel, or the host-sort closure) → spill compute (N workers: blocking
+  D2H fetch + decode, or the host partition+sort) → spill write (M
+  workers: file IO) → finalize (per-bucket k-way merges across the pool).
+
+Chunk ORDER is preserved end to end (ordered ingest, sequence-numbered
+runs, run-ordered stable merges), so the built index is byte-identical to
+a serial build — ``BuildPipelineConfig.serial()`` (conf
+``hyperspace.index.build.pipeline=off``) runs the same code inline with
+zero threads, which is the A/B baseline of bench config 13. A failure in
+any stage latches a shared ``FirstError``; every stage drains, teardown
+joins every worker, and the FIRST error re-raises on the main thread.
 """
 
 from __future__ import annotations
@@ -32,18 +50,78 @@ import shutil
 import threading
 import time
 import uuid
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, Iterable, Iterator, List, Optional
 
 import numpy as np
 
 from ..exceptions import HyperspaceException
+from ..parallel.pool import FirstError, WorkerPool, ordered_map, run_parallel
 from ..storage import layout
 from ..storage.columnar import Column, ColumnarBatch, is_string
 from ..telemetry.metrics import metrics
 from ..utils.memo import bounded_memo_put
 
 SPILL_DIR_NAME = ".spill"
+
+# Device-engine in-flight chunk cap (HBM high-water), independent of the
+# spill-compute pool width — see StreamingIndexWriter.__init__.
+DEVICE_INFLIGHT_CHUNKS = 3
+
+
+@dataclass(frozen=True)
+class BuildPipelineConfig:
+    """Worker counts and queue depths of the pipelined build — the
+    ``hyperspace.index.build.*`` knobs (docs/14-build-pipeline.md).
+
+    ``enabled=False`` is the SERIAL mode: every stage runs inline on the
+    caller thread with zero background threads — the deterministic A/B
+    baseline (bench config 13) and the debugging escape hatch. In-flight
+    chunk memory under the pipelined mode is bounded by
+    ``ingest_workers + spill_compute_workers + spill_write_workers +
+    2·queue_depth`` chunks; on the device engine the dispatched-but-
+    unfetched chunks (HBM high-water) are bounded by
+    ``spill_compute_workers + queue_depth``."""
+
+    enabled: bool = True
+    ingest_workers: int = 1
+    spill_compute_workers: int = 1
+    spill_write_workers: int = 1
+    merge_workers: int = 1
+    queue_depth: int = 2
+
+    @staticmethod
+    def default() -> "BuildPipelineConfig":
+        ncpu = os.cpu_count() or 1
+        return BuildPipelineConfig(
+            enabled=True,
+            ingest_workers=max(1, min(4, ncpu)),
+            spill_compute_workers=max(1, ncpu),
+            spill_write_workers=max(1, min(2, ncpu)),
+            merge_workers=max(1, ncpu),
+            queue_depth=2,
+        )
+
+    @staticmethod
+    def serial() -> "BuildPipelineConfig":
+        return BuildPipelineConfig(
+            enabled=False,
+            ingest_workers=1,
+            spill_compute_workers=1,
+            spill_write_workers=1,
+            merge_workers=1,
+            queue_depth=1,
+        )
+
+    def host_width(self) -> int:
+        """Effective host-sort parallelism: how many spill-compute
+        workers can really run host partition+sorts side by side. Folded
+        into the engine-probe cache key so a 1-core verdict never binds
+        a 16-core run (and vice versa)."""
+        if not self.enabled:
+            return 1
+        return max(1, min(self.spill_compute_workers, os.cpu_count() or 1))
 
 # Per-process memo of the auto engine probe's winner ("device" | "host"),
 # keyed by (JAX backend platform, padded chunk capacity). The probe
@@ -59,16 +137,26 @@ _ENGINE_CACHE: Dict[tuple, str] = {}
 _ENGINE_CACHE_MAX = 64
 
 
-def _engine_cache_key(chunk_capacity: int) -> tuple:
-    """(platform, capacity) memo key. The platform MUST be derived without
-    initializing the jax backend: cold backend init on a tunneled chip
-    costs seconds, and paying it just to look up a verdict that says
-    "host" would charge every pure-host build the device tax the memo
-    exists to avoid. The configured platform string (env / jax.config) is
-    a faithful proxy — it is what decides which backend WOULD initialize."""
+def _engine_cache_key(chunk_capacity: int, host_width: Optional[int] = None) -> tuple:
+    """(platform, capacity, host width) memo key. The platform MUST be
+    derived without initializing the jax backend: cold backend init on a
+    tunneled chip costs seconds, and paying it just to look up a verdict
+    that says "host" would charge every pure-host build the device tax
+    the memo exists to avoid. The configured platform string (env /
+    jax.config) is a faithful proxy — it is what decides which backend
+    WOULD initialize.
+
+    ``host_width`` is the build's effective host-sort parallelism
+    (BuildPipelineConfig.host_width): the host engine's throughput
+    scales with the spill-compute pool while the device engine's does
+    not, so a verdict measured at width 1 must not bind a width-16 run —
+    the widths get separate slots (and separate persisted entries).
+    ``None`` means "the default pipeline's width on this machine"."""
     from ..ops import configured_platform
 
-    return (configured_platform(), chunk_capacity)
+    if host_width is None:
+        host_width = BuildPipelineConfig.default().host_width()
+    return (configured_platform(), chunk_capacity, int(host_width))
 
 
 def _probe_cache_path() -> Optional[Path]:
@@ -112,7 +200,7 @@ def _load_persisted_winner(key: tuple) -> Optional[str]:
         # valid JSON that is not an object (truncated/clobbered write)
         metrics.incr("build.engine.probe_cache_corrupt")
         return None
-    v = data.get(f"{key[0]}:{key[1]}")
+    v = data.get(":".join(str(p) for p in key))
     if not isinstance(v, dict) or v.get("winner") not in ("device", "host"):
         return None
     try:
@@ -133,7 +221,7 @@ def _persist_winner(key: tuple, choice: str) -> None:
             data = json.loads(p.read_text())
         except (OSError, ValueError):  # fresh or corrupt file: start over
             data = {}
-        data[f"{key[0]}:{key[1]}"] = {"winner": choice, "ts": time.time()}
+        data[":".join(str(p) for p in key)] = {"winner": choice, "ts": time.time()}
         tmp = p.with_name(p.name + f".tmp-{uuid.uuid4().hex[:8]}")
         tmp.write_text(json.dumps(data, indent=0))
         os.replace(tmp, p)  # atomic: concurrent writers last-write-win
@@ -166,8 +254,13 @@ def merge_sorted_runs(runs: List[ColumnarBatch], key_names: List[str]) -> Column
     """Merge per-run key-sorted batches into one key-sorted batch.
     ``ColumnarBatch.concat`` re-encodes string columns onto a shared sorted
     vocab (order-preserving, so each run remains sorted); the merge itself
-    is a stable lexsort over the key encodings — O(n log n) on a bucket's
-    rows, which the spill layout bounds to total/num_buckets."""
+    EXPLOITS that sortedness: a stable pairwise searchsorted tournament
+    (ops.build.merge_sorted_orders) — vectorized binary-search merges
+    instead of the concat+full-lexsort this function used to pay, which
+    re-sorted already-sorted runs from scratch on every bucket of every
+    finalize. Ties keep run order, exactly like the stable lexsort did.
+    Key shapes the int64 composite cannot express (63-bit overflow) fall
+    back to the lexsort."""
     if len(runs) == 1:
         return runs[0]
     merged = ColumnarBatch.concat(runs)
@@ -175,18 +268,25 @@ def merge_sorted_runs(runs: List[ColumnarBatch], key_names: List[str]) -> Column
         return merged
     keys = [sort_encoding(merged.columns[k]) for k in key_names]
     if len(keys) == 1:
-        # one key: a stable argsort (radix for ints) is always valid and
-        # needs no packing passes
-        order = np.argsort(keys[0], kind="stable")
+        comp = keys[0]  # one key: its encoding is directly comparable
     else:
         from ..ops.build import _pack_sort_keys
 
         comp = _pack_sort_keys(keys, None, 0)
-        if comp is not None:
-            # packed keys: one stable argsort beats the multi-key lexsort
-            order = np.argsort(comp, kind="stable")
-        else:
-            order = np.lexsort(list(reversed(keys)))  # last key is primary
+    if comp is None:
+        order = np.lexsort(list(reversed(keys)))  # last key is primary
+    else:
+        from ..ops.build import merge_sorted_orders
+
+        slices = []
+        lo = 0
+        for r in runs:
+            hi = lo + r.num_rows
+            slices.append(
+                (comp[lo:hi], np.arange(lo, hi, dtype=np.int64))
+            )
+            lo = hi
+        order = merge_sorted_orders(slices)
     return merged.take(order)
 
 
@@ -209,6 +309,7 @@ class StreamingIndexWriter:
         mesh=None,
         engine: str = "auto",
         finalize_mode: str = "merge",
+        pipeline: Optional[BuildPipelineConfig] = None,
     ):
         if chunk_capacity < 1:
             raise HyperspaceException("chunk_capacity must be positive.")
@@ -227,6 +328,7 @@ class StreamingIndexWriter:
         self.chunk_capacity = next_pow2(chunk_capacity)
         self.extra_meta = extra_meta
         self.mesh = mesh
+        self.pipeline = pipeline if pipeline is not None else BuildPipelineConfig.default()
         # chunk engine: device | host | auto (host probe on chunk 0, link
         # check, device compile on chunk 1, device probe on chunk 2, then
         # the measured winner — see _route_engine; constants.BUILD_ENGINE
@@ -241,15 +343,28 @@ class StreamingIndexWriter:
         self._rows = 0
         self._chunk_times: List[float] = []
         self._finalized = False
-        # pipeline stage 3: a spill thread performs the blocking D2H fetch
-        # + decode + run write while the main thread dispatches the next
-        # chunk's H2D + kernel (stage 2) and the prefetch thread decodes
-        # source input (stage 1). Queue depth 1 bounds in-flight chunk
-        # results at three (worker fetching N, N+1 queued, N+2 dispatched
-        # before its enqueue blocks) — the HBM high-water mark.
-        self._spill_q: Optional[queue.Queue] = None
-        self._spill_thread: Optional[threading.Thread] = None
-        self._spill_failure: List[BaseException] = []
+        # spill stages (docs/14-build-pipeline.md): the compute pool runs
+        # the blocking D2H fetch + decode (device engine) or the host
+        # partition+sort; each finished chunk hands its run to the write
+        # pool (file IO). Both stages overlap each other AND the main
+        # thread's dispatch; bounded queues make backpressure the memory
+        # bound. Runs carry the chunk's SEQUENCE NUMBER so completion
+        # order never changes the on-disk run order (merge stability).
+        self._err = FirstError()
+        self._compute_pool: Optional[WorkerPool] = None
+        self._write_pool: Optional[WorkerPool] = None
+        self._spill_lock = threading.Lock()
+        self._spill_by_seq: Dict[int, tuple] = {}
+        self._chunk_seq = 0
+        # the DEVICE engine's own in-flight bound: dispatched-but-
+        # unfetched chunks pin padded key buffers + sort temps in HBM,
+        # and extra spill-compute workers buy nothing there (D2H is
+        # serialized on the one link) — without this, the HBM high-water
+        # would scale with the host's core count. 3 preserves the
+        # pre-pipeline bound (fetching N, queued N+1, dispatched N+2).
+        self._device_slots = threading.BoundedSemaphore(
+            DEVICE_INFLIGHT_CHUNKS
+        )
         self._t_first_add: Optional[float] = None
         self._t_pipeline_done: Optional[float] = None
 
@@ -270,7 +385,7 @@ class StreamingIndexWriter:
         in-memory size policy and publish nothing."""
         if self._engine in ("device", "host"):
             return self._engine
-        key = _engine_cache_key(self.chunk_capacity)
+        key = self._cache_key()
         cached = _ENGINE_CACHE.get(key)
         if cached is not None:
             return cached
@@ -297,6 +412,17 @@ class StreamingIndexWriter:
         if ci == 2:
             return "probe-device"
         return self._decide_winner()
+
+    def _cache_key(self) -> tuple:
+        return _engine_cache_key(self.chunk_capacity, self.pipeline.host_width())
+
+    def _host_scale(self) -> float:
+        """How much faster than the single-threaded probe measurement the
+        host engine effectively runs under this pipeline: spill-compute
+        workers sort chunks side by side (up to the core count), while
+        the device engine still serializes on the one device — the
+        election must compare like with like."""
+        return float(self.pipeline.host_width())
 
     def _link_rules_out_device(self, sample: ColumnarBatch) -> bool:
         """True when a timed, compile-free device round trip of the
@@ -344,7 +470,10 @@ class StreamingIndexWriter:
             metrics.incr("build.engine.probe_link_error")
             return False
         metrics.record_time("build.engine.probe_link", link_s)
-        return total > 0 and link_s > host_s
+        # compare against the host engine's EFFECTIVE per-chunk cost under
+        # this pipeline (pool-parallel host sorts), not the raw one-core
+        # probe time — the stricter bar the device must actually clear
+        return total > 0 and link_s > host_s / self._host_scale()
 
     def _publish_winner(self, choice: str, by_link: bool = False) -> None:
         """The ONE place the probe verdict is recorded: probe state, the
@@ -354,7 +483,7 @@ class StreamingIndexWriter:
         persisting it would rule the device engine out machine-wide for
         the probe cache's 24h TTL after a one-session wedge."""
         self._probe["winner"] = 1.0 if choice == "host" else 0.0
-        key = _engine_cache_key(self.chunk_capacity)
+        key = self._cache_key()
         bounded_memo_put(_ENGINE_CACHE, key, choice, _ENGINE_CACHE_MAX)
         if not self._probe.get("unreachable"):
             _persist_winner(key, choice)
@@ -369,19 +498,38 @@ class StreamingIndexWriter:
         if "winner" not in self._probe:
             dev = self._probe.get("device_s")
             host = self._probe.get("host_s")
+            host_eff = None if host is None else host / self._host_scale()
             self._publish_winner(
-                "host" if host is not None and (dev is None or host < dev)
+                "host"
+                if host_eff is not None and (dev is None or host_eff < dev)
                 else "device"
             )
         return "host" if self._probe["winner"] else "device"
 
-    def _spill_run(self, sorted_batch: ColumnarBatch, counts: np.ndarray) -> None:
-        """Persist one bucket-grouped, key-sorted run. The index-level
-        extra_meta rides every spill footer so runs-mode finalize can
-        promote the file as-is — under merge mode the extra is simply
-        unread (spills are consumed via row ranges)."""
+    def _acquire_device_slot(self) -> None:
+        """Failure-aware bounded wait for a device in-flight slot: after
+        a pipeline failure the draining pools never release slots, so a
+        plain acquire could park the main thread — poll with the latch."""
+        while not self._device_slots.acquire(timeout=0.05):
+            self._err.check()
+
+    def _next_seq(self) -> int:
+        seq = self._chunk_seq  # main thread only: add_chunk/finalize
+        self._chunk_seq += 1
+        return seq
+
+    def _spill_run_at(
+        self, seq: int, sorted_batch: ColumnarBatch, counts: np.ndarray
+    ) -> None:
+        """Persist one bucket-grouped, key-sorted run under its chunk
+        SEQUENCE number: write workers may finish out of order, but the
+        on-disk run order (hence merge-stability tie order) is pinned to
+        ingest order. The index-level extra_meta rides every spill footer
+        so runs-mode finalize can promote the file as-is — under merge
+        mode the extra is simply unread (spills are consumed via row
+        ranges)."""
         self._spill_dir.mkdir(parents=True, exist_ok=True)
-        p = self._spill_dir / f"run-{len(self._spills):05d}-{uuid.uuid4().hex[:8]}.tcb"
+        p = self._spill_dir / f"run-{seq:05d}-{uuid.uuid4().hex[:8]}.tcb"
         layout.write_batch(
             p,
             sorted_batch,
@@ -391,66 +539,101 @@ class StreamingIndexWriter:
                 "bucketCounts": [int(c) for c in counts],
             },
         )
-        self._spills.append(p)
-        self._spill_counts.append(np.asarray(counts, dtype=np.int64))
+        with self._spill_lock:
+            self._spill_by_seq[seq] = (p, np.asarray(counts, dtype=np.int64))
 
     # -- spill pipeline -------------------------------------------------------
-    def _spill_worker(self) -> None:
-        while True:
-            item = self._spill_q.get()
-            if item is None:
-                return
-            if self._spill_failure:
-                continue  # drain after failure; error raised on main thread
-            try:
-                # phase split for the throughput story: compute = blocking
-                # D2H fetch + decode (device engine) or the host sort (host
-                # engine); write = spill-file IO. Both overlap the main
-                # thread's dispatch, so their SUM can exceed wall-clock —
-                # they identify the pipeline's bottleneck stage, not a
-                # wall-clock decomposition.
-                t0 = time.perf_counter()
-                batch, counts = item()  # blocking D2H + decode
-                t1 = time.perf_counter()
-                self._spill_run(batch, counts)
-                metrics.record_time("build.stream.spill_compute", t1 - t0)
-                metrics.record_time(
-                    "build.stream.spill_write", time.perf_counter() - t1
-                )
-            except BaseException as e:  # noqa: BLE001 - re-raised on main
-                self._spill_failure.append(e)
+    def _ensure_pools(self) -> None:
+        if self._compute_pool is not None:
+            return
+        pipe = self.pipeline
+        self._compute_pool = WorkerPool(
+            pipe.spill_compute_workers,
+            "spill-compute",
+            queue_depth=pipe.queue_depth,
+            failure=self._err,
+        )
+        self._write_pool = WorkerPool(
+            pipe.spill_write_workers,
+            "spill-write",
+            queue_depth=pipe.queue_depth,
+            failure=self._err,
+        )
+        metrics.gauge(
+            "build.stream.workers.spill_compute", pipe.spill_compute_workers
+        )
+        metrics.gauge("build.stream.workers.spill_write", pipe.spill_write_workers)
 
     def _enqueue_spill(self, finish) -> None:
-        if self._spill_thread is None:
-            self._spill_q = queue.Queue(maxsize=1)
-            self._spill_thread = threading.Thread(
-                target=self._spill_worker, daemon=True, name="spill-writer"
+        """Route one dispatched chunk through the spill stages. Phase
+        split for the throughput story: compute = blocking D2H fetch +
+        decode (device engine) or the host partition+sort (host engine);
+        write = spill-file IO. The stage timers SUM worker busy time, so
+        under the pipeline their sum exceeding wall-clock is the overlap
+        working as designed — they identify the bottleneck stage, not a
+        wall-clock decomposition."""
+        seq = self._next_seq()
+        if not self.pipeline.enabled:
+            t0 = time.perf_counter()
+            batch, counts = finish()
+            t1 = time.perf_counter()
+            self._spill_run_at(seq, batch, counts)
+            metrics.record_time("build.stream.spill_compute", t1 - t0)
+            metrics.record_time(
+                "build.stream.spill_write", time.perf_counter() - t1
             )
-            self._spill_thread.start()
-        self._spill_q.put(finish)
-        self._check_spill_failure()
+            return
+        self._ensure_pools()
+
+        def compute_task(seq=seq, finish=finish) -> None:
+            t0 = time.perf_counter()
+            batch, counts = finish()  # blocking D2H + decode, or host sort
+            metrics.record_time(
+                "build.stream.spill_compute", time.perf_counter() - t0
+            )
+
+            def write_task(seq=seq, batch=batch, counts=counts) -> None:
+                t0 = time.perf_counter()
+                self._spill_run_at(seq, batch, counts)
+                metrics.record_time(
+                    "build.stream.spill_write", time.perf_counter() - t0
+                )
+
+            # bounded submit: a full write queue backpressures compute
+            # workers, which backpressures the main dispatch loop — the
+            # chunk memory bound. A False return means the pipeline
+            # already failed; the latched error surfaces on main.
+            self._write_pool.submit(write_task)
+
+        self._compute_pool.submit(compute_task)
+        self._err.check()
 
     def _drain_spills(self) -> None:
-        if self._spill_thread is not None:
-            self._spill_q.put(None)
-            self._spill_thread.join()
-            self._spill_thread = None
-        self._check_spill_failure()
-
-    def _check_spill_failure(self) -> None:
-        if self._spill_failure:
-            raise self._spill_failure[0]
+        if self._compute_pool is not None:
+            self._compute_pool.close()  # flushes its write_pool submits
+        if self._write_pool is not None:
+            self._write_pool.close()
+        self._compute_pool = None
+        self._write_pool = None
+        self._err.check()
+        # materialize the ordered run list for finalize
+        with self._spill_lock:
+            items = sorted(self._spill_by_seq.items())
+        self._spills = [p for _, (p, _c) in items]
+        self._spill_counts = [c for _, (_p, c) in items]
 
     def abort(self) -> None:
-        """Best-effort teardown after a failed build: stop the spill
-        thread (it would otherwise park on q.get() for the process
-        lifetime) and remove spill files. Safe to call repeatedly or
-        after finalize()."""
-        if self._spill_thread is not None:
-            self._spill_q.put(None)  # worker always drains; brief block ok
-            self._spill_thread.join()
-            self._spill_thread = None
-        self._spill_failure.clear()
+        """Best-effort teardown after a failed build: drain and join
+        every pool worker (no parked threads, whatever stage died) and
+        remove spill files. Safe to call repeatedly or after
+        finalize()."""
+        if self._compute_pool is not None:
+            self._compute_pool.abort()
+        if self._write_pool is not None:
+            self._write_pool.abort()
+        self._compute_pool = None
+        self._write_pool = None
+        self._err = FirstError()  # a reused writer must not re-raise
         shutil.rmtree(self._spill_dir, ignore_errors=True)
         self._finalized = True
 
@@ -500,11 +683,14 @@ class StreamingIndexWriter:
                 batch, self.indexed_cols, self.num_buckets, self.mesh
             )
             self._chunk_times.append(time.perf_counter() - t0)
+            metrics.record_time(
+                "build.stream.dispatch", self._chunk_times[-1]
+            )
             for dev_batch, bucket_ids in per_device:
                 if dev_batch.num_rows == 0:
                     continue
                 counts = np.bincount(bucket_ids, minlength=self.num_buckets)
-                self._spill_run(dev_batch, counts)
+                self._spill_run_at(self._next_seq(), dev_batch, counts)
         else:
             engine = self._route_engine(batch.num_rows)
             if engine in ("device", "probe-device") and not first_device_touch_ok():
@@ -517,10 +703,7 @@ class StreamingIndexWriter:
                 metrics.incr("build.engine.device_unreachable")
                 self._probe["unreachable"] = True
                 bounded_memo_put(
-                    _ENGINE_CACHE,
-                    _engine_cache_key(self.chunk_capacity),
-                    "host",
-                    _ENGINE_CACHE_MAX,
+                    _ENGINE_CACHE, self._cache_key(), "host", _ENGINE_CACHE_MAX
                 )
                 engine = "host"
             if engine in ("host", "probe-host"):
@@ -550,17 +733,25 @@ class StreamingIndexWriter:
             else:
                 from ..ops.build import build_partition_single
 
-                # dispatch H2D + kernel (async); the spill thread performs
-                # the blocking fetch + decode + write, overlapping the next
-                # chunk
+                # dispatch H2D + kernel (async); a spill-compute worker
+                # performs the blocking fetch + decode, overlapping the
+                # next chunk. The slot acquire blocks dispatch when
+                # DEVICE_INFLIGHT_CHUNKS results are already in flight.
                 metrics.incr("build.engine.device")
-                finish = build_partition_single(
+                self._acquire_device_slot()
+                inner = build_partition_single(
                     batch,
                     self.indexed_cols,
                     self.num_buckets,
                     pad_to=self.chunk_capacity,
                     defer=True,
                 )
+
+                def finish(inner=inner):
+                    try:
+                        return inner()
+                    finally:
+                        self._device_slots.release()
                 if engine == "probe-device":
                     # synchronous D2H here on the main thread so the probe
                     # time covers the full device round trip
@@ -572,6 +763,7 @@ class StreamingIndexWriter:
                     )
                     finish = lambda r=result: r  # noqa: E731
             self._chunk_times.append(time.perf_counter() - t0)
+            metrics.record_time("build.stream.dispatch", self._chunk_times[-1])
             self._enqueue_spill(finish)
         self._rows += batch.num_rows
         metrics.incr("build.stream.chunks")
@@ -604,6 +796,14 @@ class StreamingIndexWriter:
             self._decide_winner()
         if self._t_first_add is not None:
             self._t_pipeline_done = time.perf_counter()
+            # the denominator of every stage's occupancy: busy-time sums
+            # (spill_compute/spill_write/ingest_decode) divided by this
+            # wall give per-stage utilization, and a busy SUM above it is
+            # the overlap evidence (telemetry.build_pipeline_snapshot)
+            metrics.record_time(
+                "build.stream.pipeline_wall",
+                self._t_pipeline_done - self._t_first_add,
+            )
         self._finalized = True
         t0 = time.perf_counter()
         written: List[Path] = []
@@ -636,17 +836,17 @@ class StreamingIndexWriter:
         if self._spills:
             # per-spill cumulative row offsets of each bucket segment; one
             # reader per spill (footer parsed + vocab decoded once, not per
-            # (bucket, run) pair)
+            # (bucket, run) pair); readers are shared by the merge workers
+            # (mmap range reads are thread-safe; the vocab decode memo is
+            # lock-guarded in TcbReader)
             offsets = [
                 np.concatenate([[0], np.cumsum(c)]) for c in self._spill_counts
             ]
             readers = [layout.TcbReader(p) for p in self._spills]
             totals = np.sum(self._spill_counts, axis=0)
             self.out_dir.mkdir(parents=True, exist_ok=True)
-            read_s = merge_s = write_s = 0.0
-            for b in range(self.num_buckets):
-                if totals[b] == 0:
-                    continue
+
+            def merge_bucket(b: int):
                 t_r = time.perf_counter()
                 runs = []
                 for reader, off in zip(readers, offsets):
@@ -664,10 +864,27 @@ class StreamingIndexWriter:
                     bucket=b,
                     extra=self.extra_meta,
                 )
+                return p, t_m - t_r, t_w - t_m, time.perf_counter() - t_w
+
+            # per-bucket merges fan out across the pool: buckets are
+            # independent (disjoint row ranges in, distinct files out).
+            # Host memory is O(merge_workers × bucket), the pipelined
+            # sibling of the serial path's O(largest bucket).
+            buckets = [b for b in range(self.num_buckets) if totals[b] > 0]
+            workers = (
+                self.pipeline.merge_workers if self.pipeline.enabled else 1
+            )
+            results = run_parallel(
+                [lambda b=b: merge_bucket(b) for b in buckets],
+                workers,
+                name="bucket-merge",
+            )
+            read_s = merge_s = write_s = 0.0
+            for p, r_s, m_s, w_s in results:
                 written.append(p)
-                read_s += t_m - t_r
-                merge_s += t_w - t_m
-                write_s += time.perf_counter() - t_w
+                read_s += r_s
+                merge_s += m_s
+                write_s += w_s
             metrics.record_time("build.stream.merge_read", read_s)
             metrics.record_time("build.stream.merge_sort", merge_s)
             metrics.record_time("build.stream.merge_write", write_s)
@@ -778,7 +995,7 @@ def prefetch_chunks(
 
 
 def write_index_data_streaming(
-    chunks: Iterable[ColumnarBatch],
+    chunks: Optional[Iterable[ColumnarBatch]],
     indexed_cols: List[str],
     num_buckets: int,
     out_dir: str | Path,
@@ -787,11 +1004,28 @@ def write_index_data_streaming(
     mesh=None,
     engine: str = "auto",
     finalize_mode: str = "merge",
+    chunk_tasks: Optional[Iterable] = None,
+    pipeline: Optional[BuildPipelineConfig] = None,
 ) -> List[Path]:
-    """Drive a StreamingIndexWriter over an iterator of chunks, with
-    ingest prefetched one chunk ahead of device compute. A failure
-    anywhere tears the pipeline down (no parked spill thread, no orphan
-    spill files) before re-raising."""
+    """Drive a StreamingIndexWriter over source chunks. A failure
+    anywhere tears the pipeline down (no parked workers, no orphan spill
+    files) before re-raising the FIRST error on this thread.
+
+    Ingest comes in two shapes:
+
+    * ``chunks`` — a sequential iterator; under the pipelined mode it is
+      prefetched one chunk ahead (the decode overlaps compute but stays
+      single-threaded — the iterator protocol is inherently serial);
+    * ``chunk_tasks`` — an iterable of zero-arg callables, each decoding
+      ONE source slice into a list of batches (parquet_io.
+      file_chunk_tasks). These fan out across ``pipeline.ingest_workers``
+      with results consumed in task order, so decode parallelism never
+      changes ingest order (hence never changes the built index bytes).
+
+    ``build.stream.ingest_wait`` records main-thread time blocked on
+    ingest — near-zero means decode fully overlaps compute;
+    ``build.stream.ingest_decode`` records ingest-worker busy time."""
+    pipe = pipeline if pipeline is not None else BuildPipelineConfig.default()
     writer = StreamingIndexWriter(
         indexed_cols,
         num_buckets,
@@ -801,23 +1035,67 @@ def write_index_data_streaming(
         mesh=mesh,
         engine=engine,
         finalize_mode=finalize_mode,
+        pipeline=pipe,
     )
+    if chunks is None and chunk_tasks is None:
+        raise HyperspaceException(
+            "write_index_data_streaming needs chunks or chunk_tasks."
+        )
+    ingest_parallel = (
+        chunk_tasks is not None and pipe.enabled and pipe.ingest_workers > 1
+    )
+    it = None
     try:
-        # time spent blocked on the prefetch queue = source decode is the
-        # bottleneck (the producer can't keep the device/sort stage fed);
-        # near-zero means ingest fully overlaps compute
-        it = iter(prefetch_chunks(chunks))
+        if ingest_parallel:
+
+            def decode(task):
+                t0 = time.perf_counter()
+                out = task()
+                metrics.record_time(
+                    "build.stream.ingest_decode", time.perf_counter() - t0
+                )
+                return out
+
+            metrics.gauge("build.stream.workers.ingest", pipe.ingest_workers)
+            it = ordered_map(
+                decode,
+                chunk_tasks,
+                pipe.ingest_workers,
+                window=pipe.ingest_workers + pipe.queue_depth,
+                name="ingest",
+                failure=writer._err,
+            )
+        elif chunk_tasks is not None and chunks is None:
+            # serial fallback: run the decode tasks inline, in order
+            chunks = (c for task in chunk_tasks for c in task())
+        if it is None:
+            it = (
+                iter(prefetch_chunks(chunks))
+                if pipe.enabled
+                else iter(chunks)
+            )
+            batched = False
+        else:
+            batched = True
+        # time spent blocked on ingest = source decode is the bottleneck
+        # (the producers can't keep the device/sort stage fed)
         wait_s = 0.0
         while True:
             t0 = time.perf_counter()
             try:
-                chunk = next(it)
+                item = next(it)
             except StopIteration:
                 break
             wait_s += time.perf_counter() - t0
-            writer.add_chunk(chunk)
+            if batched:
+                for chunk in item:
+                    writer.add_chunk(chunk)
+            else:
+                writer.add_chunk(item)
         metrics.record_time("build.stream.ingest_wait", wait_s)
         return writer.finalize()
     except BaseException:
+        if it is not None and hasattr(it, "close"):
+            it.close()  # join ingest workers before spill teardown
         writer.abort()
         raise

@@ -161,6 +161,43 @@ def _sort_by_bucket_and_keys(
 _single_kernel_cache: dict = {}
 
 
+def _packed_minmax(arr: np.ndarray) -> Optional[Tuple[int, int]]:
+    """(min, max) of a padded transport buffer as Python ints, or None
+    for shapes the packed kernel declines: float32 travels raw (its
+    device sort operand is a bit transform — bounding it on host would
+    cost the very O(n) transform the pack exists to avoid) and uint64
+    values beyond int64 (the int64 composite bias would wrap)."""
+    if arr.dtype == np.float32 or arr.dtype == np.float64:
+        return None
+    if arr.size == 0:
+        return None
+    mn, mx = int(arr.min()), int(arr.max())
+    if mx > (1 << 63) - 1 or mn < -(1 << 63):
+        return None
+    return mn, mx
+
+
+def _pack_plan(
+    bounds: List[Tuple[int, int]], bucket_bits: int
+) -> Optional[List[Tuple[int, int]]]:
+    """[(min, bits)] per key for the (bucket, keys...) radix pack, or
+    None when ``bucket_bits`` plus the key widths don't fit 63 bits.
+    THE one copy of the bit-budget rule — _pack_sort_keys (host) and
+    build_partition_single (device) both size their composites here;
+    they differ only in the bucket ceiling they pass (the device kernel
+    must also fit the ``num_buckets`` invalid-row marker). Spans compute
+    in Python ints — narrow-dtype-safe."""
+    total_bits = bucket_bits
+    plan: List[Tuple[int, int]] = []
+    for mn, mx in bounds:
+        kb = max(mx - mn, 1).bit_length()
+        total_bits += kb
+        if total_bits > 63:
+            return None
+        plan.append((mn, kb))
+    return plan
+
+
 def _single_perm_kernel(dtypes_key: tuple, key_names: tuple, num_buckets: int):
     """Permutation-returning sort kernel: uploads ONLY key columns and
     ships home a 4-byte-per-row permutation + bucket counts. The sorted
@@ -187,6 +224,47 @@ def _single_perm_kernel(dtypes_key: tuple, key_names: tuple, num_buckets: int):
         _out, _sb, counts, perm = _sort_by_bucket_and_keys(
             arrays, bucket, keys, num_buckets
         )
+        return perm, counts
+
+    if len(_single_kernel_cache) >= 64:
+        _single_kernel_cache.pop(next(iter(_single_kernel_cache)))
+    _single_kernel_cache[cache_key] = kernel
+    return kernel
+
+
+def _single_perm_kernel_packed(
+    dtypes_key: tuple, key_names: tuple, num_buckets: int
+):
+    """Radix-partition twin of _single_perm_kernel: bit-packs
+    (bucket, key1-min1, key2-min2, …) into ONE int64 sort operand —
+    the device analog of build_partition_host's composite fast path —
+    so lax.sort compares a single key instead of a 1+len(keys)-operand
+    lexicographic comparator. Mins and shift widths enter as DEVICE
+    OPERANDS (they vary chunk to chunk), so one compiled executable
+    serves every chunk; whether the widths fit 63 bits is the host-side
+    routing decision in build_partition_single. Order and stability are
+    bit-identical to the unpacked kernel: the pack is order-preserving
+    and iota remains the tie-break payload of a stable sort."""
+    cache_key = ("perm-packed", dtypes_key, key_names, num_buckets)
+    fn = _single_kernel_cache.get(cache_key)
+    if fn is not None:
+        return fn
+    dtypes = dict(dtypes_key)
+    keys = list(key_names)
+
+    @jax.jit
+    def kernel(arrays, vh, n_valid, mins, shifts):
+        bucket = device_bucket_ids(arrays, dtypes, keys, vh, num_buckets)
+        m = bucket.shape[0]
+        iota = lax.iota(jnp.int32, m)
+        bucket = jnp.where(iota < n_valid, bucket, num_buckets)
+        packed = bucket.astype(jnp.int64)
+        for i, k in enumerate(keys):
+            enc = _ordered_sort_operand(arrays[k]).astype(jnp.int64)
+            packed = jnp.left_shift(packed, shifts[i].astype(jnp.int64))
+            packed = jnp.bitwise_or(packed, enc - mins[i])
+        _packed_sorted, perm = lax.sort([packed, iota], num_keys=1)
+        counts = jnp.bincount(bucket, length=num_buckets)
         return perm, counts
 
     if len(_single_kernel_cache) >= 64:
@@ -234,12 +312,11 @@ def build_partition_single(
     if n_pad < n:
         raise HyperspaceException(f"pad_to={n_pad} smaller than batch rows {n}.")
     # keys ONLY cross the link (see _single_perm_kernel)
-    arrays = {
-        k: jnp.asarray(
-            np.pad(encode_for_device(batch.columns[k]), (0, n_pad - n))
-        )
+    host_bufs = {
+        k: np.pad(encode_for_device(batch.columns[k]), (0, n_pad - n))
         for k in key_names
     }
+    arrays = {k: jnp.asarray(b) for k, b in host_bufs.items()}
     vh = {
         k: jnp.asarray(vocab_hashes(batch.columns[k]))
         for k in key_names
@@ -247,8 +324,33 @@ def build_partition_single(
     }
     n_dev = jnp.asarray(n, dtype=jnp.int32)
     key_dtypes = tuple(sorted((k, dtypes[k]) for k in key_names))
-    kernel = _single_perm_kernel(key_dtypes, tuple(key_names), num_buckets)
-    perm_dev, counts_dev = kernel(arrays, vh, n_dev)
+    # radix-pack routing: when every key's padded transport buffer bounds
+    # to a 63-bit (bucket, keys…) composite, the single-operand packed
+    # sort runs instead of the multi-operand comparator sort — same
+    # permutation, fewer sort operands. The min/max host pass is one
+    # bandwidth-bound sweep over buffers the pad already materialized.
+    bounds = [_packed_minmax(host_bufs[k]) for k in key_names]
+    plan = (
+        _pack_plan(bounds, max(int(num_buckets), 1).bit_length())
+        if all(b is not None for b in bounds)
+        else None
+    )
+    if plan is not None:
+        mins_dev = jnp.asarray(
+            np.array([mn for mn, _ in plan], dtype=np.int64)
+        )
+        shifts_dev = jnp.asarray(
+            np.array([kb for _, kb in plan], dtype=np.int32)
+        )
+        kernel = _single_perm_kernel_packed(
+            key_dtypes, tuple(key_names), num_buckets
+        )
+        metrics.incr("build.engine.device_radix")
+        perm_dev, counts_dev = kernel(arrays, vh, n_dev, mins_dev, shifts_dev)
+    else:
+        kernel = _single_perm_kernel(key_dtypes, tuple(key_names), num_buckets)
+        metrics.incr("build.engine.device_sortfull")
+        perm_dev, counts_dev = kernel(arrays, vh, n_dev)
 
     def finish() -> Tuple[ColumnarBatch, np.ndarray]:
         counts = np.asarray(counts_dev)[:num_buckets]
@@ -276,32 +378,31 @@ def _pack_sort_keys(
     """Bit-pack (bucket?, enc1-min1, enc2-min2, …) into one int64 whose
     ascending order equals the lexicographic order of the inputs, or None
     when the widths don't fit 63 bits (caller falls back to lexsort).
-    Spans are computed in Python ints (narrow-dtype-safe); stability of
-    the single argsort preserves tie order exactly like lexsort."""
+    The budget rule lives in _pack_plan (shared with the device radix
+    kernel); stability of the single argsort preserves tie order exactly
+    like lexsort."""
     if not encs or not len(encs[0]):
         return None
-    total_bits = (
-        max(int(num_buckets - 1), 1).bit_length() if bucket is not None else 0
-    )
-    parts = []
+    bounds = []
     i64_max, i64_min = (1 << 63) - 1, -(1 << 63)
     for e in encs:
         mn = int(e.min())
         mx = int(e.max())
         if mx > i64_max or mn < i64_min:
             return None  # uint64 beyond int64: the bias cast would raise
-        span = mx - mn
-        kb = max(span, 1).bit_length()
-        total_bits += kb
-        if total_bits > 63:
-            return None
-        parts.append((e, mn, kb))
+        bounds.append((mn, mx))
+    bucket_bits = (
+        max(int(num_buckets - 1), 1).bit_length() if bucket is not None else 0
+    )
+    plan = _pack_plan(bounds, bucket_bits)
+    if plan is None:
+        return None
     comp = (
         bucket.astype(np.int64)
         if bucket is not None
         else np.zeros(len(encs[0]), dtype=np.int64)
     )
-    for e, mn, kb in parts:
+    for e, (mn, kb) in zip(encs, plan):
         comp = (comp << np.int64(kb)) | (e.astype(np.int64) - np.int64(mn))
     return comp
 
@@ -342,15 +443,115 @@ def build_partition_host(
         order = np.lexsort(tuple(reversed(encs)) + (bucket,))
     counts = np.bincount(bucket, minlength=num_buckets).astype(np.int64)
     out = batch.take(order)
+    _canonicalize_f64(out)
+    return out, counts
+
+
+def _canonicalize_f64(out: ColumnarBatch) -> None:
+    """-0.0 → +0.0 on float64 columns, matching the device transport
+    encoding (ops.floatbits): every engine must produce identical bytes."""
     for name, col in out.columns.items():
         if col.dtype_str == "float64":
-            # the float64 transport encoding canonicalizes -0.0 to +0.0
-            # (ops.floatbits; only f64 crosses the device encoded — f32
-            # travels raw and keeps its sign bit on both engines); the
-            # twin must produce identical bytes
             out.columns[name] = Column(
                 col.dtype_str, np.where(col.data == 0.0, 0.0, col.data)
             )
+
+
+def merge_sorted_orders(
+    runs: List[Tuple[np.ndarray, np.ndarray]],
+) -> np.ndarray:
+    """Merge per-run (sorted_keys, row_indices) pairs into one global
+    row-index order, STABLY: ties keep run order (run i's rows before
+    run j's for i < j), exactly like a stable argsort over the
+    concatenation. Pairwise searchsorted tournament — every pass is a
+    handful of vectorized O(m log m) binary-search merges instead of the
+    full O(n log n) re-sort the old concat+lexsort paid; this is the
+    shared engine of merge_sorted_runs (finalize) and the multi-core
+    host partition."""
+    runs = [r for r in runs if len(r[1])]
+    if not runs:
+        return np.empty(0, dtype=np.int64)
+    while len(runs) > 1:
+        nxt: List[Tuple[np.ndarray, np.ndarray]] = []
+        # adjacent pairs only: merging (0,1),(2,3)… preserves the global
+        # run order that makes the merge stable
+        for i in range(0, len(runs) - 1, 2):
+            (ak, ai), (bk, bi) = runs[i], runs[i + 1]
+            la, lb = len(ak), len(bk)
+            # merged position of a[x] = x + |b strictly before a[x]|;
+            # of b[y] = y + |a at-or-before b[y]| (ties: a first)
+            pos_a = np.arange(la, dtype=np.int64) + np.searchsorted(
+                bk, ak, side="left"
+            )
+            pos_b = np.arange(lb, dtype=np.int64) + np.searchsorted(
+                ak, bk, side="right"
+            )
+            mk = np.empty(la + lb, dtype=ak.dtype)
+            mi = np.empty(la + lb, dtype=np.int64)
+            mk[pos_a] = ak
+            mk[pos_b] = bk
+            mi[pos_a] = ai
+            mi[pos_b] = bi
+            nxt.append((mk, mi))
+        if len(runs) % 2:
+            nxt.append(runs[-1])
+        runs = nxt
+    return np.asarray(runs[0][1], dtype=np.int64)
+
+
+# Below this many rows the slice/merge machinery costs more than the one
+# stable argsort it replaces; the serial twin handles small chunks.
+HOST_PARALLEL_MIN_ROWS = 1 << 16
+
+
+def build_partition_host_parallel(
+    batch: ColumnarBatch,
+    key_names: List[str],
+    num_buckets: int,
+    workers: int,
+) -> Tuple[ColumnarBatch, np.ndarray]:
+    """Multi-core twin of build_partition_host: identical output, the
+    O(n log n) stable sort split across ``workers`` host threads.
+
+    Rows split into contiguous slices; each worker stable-argsorts its
+    slice of the packed (bucket, keys…) composite (numpy's sort releases
+    the GIL, so threads scale on real cores); slices then merge via the
+    stable searchsorted tournament. Contiguous slices + left-run-wins
+    ties reproduce the serial stable argsort bit-for-bit. Shapes the
+    composite cannot pack (63-bit overflow, float32 keys' raw transport)
+    fall back to the serial twin — parity over parallelism."""
+    n = batch.num_rows
+    if workers <= 1 or n < HOST_PARALLEL_MIN_ROWS:
+        return build_partition_host(batch, key_names, num_buckets)
+    from ..index.stream_builder import sort_encoding
+    from ..parallel.pool import run_parallel
+
+    bucket = bucket_ids_host(
+        [key_repr(batch.columns[k]) for k in key_names], num_buckets
+    )
+    encs = [sort_encoding(batch.columns[k]) for k in key_names]
+    comp = _pack_sort_keys(encs, bucket, num_buckets)
+    if comp is None:
+        return build_partition_host(batch, key_names, num_buckets)
+    workers = min(int(workers), max(n // HOST_PARALLEL_MIN_ROWS, 1))
+    step = -(-n // workers)
+    spans = [(s, min(s + step, n)) for s in range(0, n, step)]
+
+    def slice_sort(span: Tuple[int, int]):
+        s, e = span
+        order = np.argsort(comp[s:e], kind="stable").astype(np.int64) + s
+        return comp[order], order
+
+    sorted_slices = run_parallel(
+        [lambda sp=sp: slice_sort(sp) for sp in spans],
+        workers,
+        name="host-partition",
+    )
+    order = merge_sorted_orders(sorted_slices)
+    counts = np.bincount(bucket, minlength=num_buckets).astype(np.int64)
+    out = batch.take(order)
+    _canonicalize_f64(out)
+    metrics.incr("build.engine.host_parallel")
     return out, counts
 
 

@@ -1,0 +1,10 @@
+"""Host- and device-parallelism utilities.
+
+``mesh`` builds the device mesh (bucket parallelism over chips);
+``pool`` is the HOST worker-pool layer the pipelined index build runs on
+(bounded queues, ordered parallel map, cross-stage failure propagation).
+"""
+
+from .pool import FirstError, WorkerPool, ordered_map, run_parallel
+
+__all__ = ["FirstError", "WorkerPool", "ordered_map", "run_parallel"]

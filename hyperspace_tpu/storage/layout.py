@@ -236,14 +236,22 @@ class TcbReader:
         else:
             self._raw = np.fromfile(self.path, dtype=np.uint8)
         self._vocabs: Dict[str, np.ndarray] = {}
+        # one reader is shared by the build's parallel bucket merges and
+        # by concurrent query threads: range reads over the mmap are
+        # naturally safe, the vocab decode memo needs the lock
+        self._vocab_lock = Lock()
 
     @property
     def num_rows(self) -> int:
         return self.footer["numRows"]
 
     def _vocab(self, name: str) -> np.ndarray:
-        v = self._vocabs.get(name)
+        with self._vocab_lock:
+            v = self._vocabs.get(name)
         if v is None:
+            # decode outside the lock (hslint HS002: the encode loop over
+            # a big vocab is real work); a racing double-decode is benign
+            # — identical arrays, last write wins
             v = np.array(
                 [
                     x.encode("utf-8", "surrogateescape")
@@ -251,7 +259,8 @@ class TcbReader:
                 ],
                 dtype=object,
             )
-            self._vocabs[name] = v
+            with self._vocab_lock:
+                self._vocabs[name] = v
         return v
 
     def read(

@@ -66,6 +66,20 @@ class MetricsRegistry:
                     node._counters[name] = node._counters.get(name, 0) + by
             node = node._parent
 
+    def gauge(self, name: str, value: int) -> None:
+        """SET a counter to a level (worker counts, pool widths): unlike
+        incr, repeated recordings of the same configuration don't
+        accumulate across builds in one process — the snapshot reports
+        the level, not a running total."""
+        with self._lock:
+            self._counters[name] = int(value)
+        node = _SCOPE.get()
+        while node is not None:
+            if node is not self:
+                with node._lock:
+                    node._counters[name] = int(value)
+            node = node._parent
+
     def record_time(self, name: str, seconds: float) -> None:
         with self._lock:
             self._timers[name] = self._timers.get(name, 0.0) + seconds
@@ -128,6 +142,43 @@ class MetricsRegistry:
 
 
 metrics = MetricsRegistry()
+
+
+def build_pipeline_snapshot(
+    registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, object]:
+    """Per-stage occupancy of the pipelined index build in one dict
+    (docs/14-build-pipeline.md; consumed by bench config 13 and
+    scripts/bench_scale.py). ``*_busy_s`` sums WORKER time per stage, so
+    with the pipeline on, busy sums legitimately exceed ``wall_s`` —
+    that excess IS the overlap (serial mode: they add up to ≤ wall).
+    ``*_occupancy`` divides by wall: the stage nearest its worker count
+    is the bottleneck; a stage near zero has headroom (or did no work).
+    """
+    r = registry if registry is not None else metrics
+    wall = r.time_of("build.stream.pipeline_wall")
+    stages = {
+        "ingest_decode": r.time_of("build.stream.ingest_decode"),
+        "dispatch": r.time_of("build.stream.dispatch"),
+        "spill_compute": r.time_of("build.stream.spill_compute"),
+        "spill_write": r.time_of("build.stream.spill_write"),
+    }
+    out: Dict[str, object] = {"wall_s": round(wall, 4)}
+    for name, busy in stages.items():
+        out[f"{name}_busy_s"] = round(busy, 4)
+        if wall > 0:
+            out[f"{name}_occupancy"] = round(busy / wall, 3)
+    out["ingest_wait_s"] = round(r.time_of("build.stream.ingest_wait"), 4)
+    out["workers"] = {
+        k.rsplit(".", 1)[-1]: r.counter(k)
+        for k in (
+            "build.stream.workers.ingest",
+            "build.stream.workers.spill_compute",
+            "build.stream.workers.spill_write",
+        )
+        if r.counter(k)
+    }
+    return out
 
 
 def reliability_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, int]:

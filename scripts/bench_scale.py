@@ -215,7 +215,7 @@ def main() -> None:
     from hyperspace_tpu.plan.aggregates import agg_avg, agg_count, agg_sum
     from hyperspace_tpu.plan.expr import col
     from hyperspace_tpu.session import HyperspaceSession
-    from hyperspace_tpu.telemetry.metrics import metrics
+    from hyperspace_tpu.telemetry.metrics import build_pipeline_snapshot, metrics
 
     # this artifact measures the runs-layout + host engine paths; HBM
     # auto-population would upload hundreds of MB on daemon threads
@@ -237,6 +237,10 @@ def main() -> None:
             C.BUILD_MODE: C.BUILD_MODE_STREAMING,
             C.BUILD_CHUNK_ROWS: 1 << 22,  # 4M-row chunks -> 15 chunks at 60M
             C.BUILD_FINALIZE_MODE: finalize_mode,
+            # SCALE_PIPELINE=off reproduces the pre-pipeline serial build
+            C.BUILD_PIPELINE: os.environ.get(
+                "SCALE_PIPELINE", C.BUILD_PIPELINE_DEFAULT
+            ),
         }
     )
     session = HyperspaceSession(conf)
@@ -270,6 +274,18 @@ def main() -> None:
         "phase_merge_read_s": round(timers.get("build.stream.merge_read", 0.0), 2),
         "phase_merge_sort_s": round(timers.get("build.stream.merge_sort", 0.0), 2),
         "phase_merge_write_s": round(timers.get("build.stream.merge_write", 0.0), 2),
+        # pipelined-build decomposition (docs/14-build-pipeline.md): the
+        # phase_* spill/ingest timers above SUM worker busy time, so with
+        # the pipeline on their sum exceeding phase_pipeline_wall_s is
+        # the overlap working; occupancy ratios name the bottleneck stage
+        "phase_ingest_decode_s": round(
+            timers.get("build.stream.ingest_decode", 0.0), 2
+        ),
+        "phase_dispatch_s": round(timers.get("build.stream.dispatch", 0.0), 2),
+        "phase_pipeline_wall_s": round(
+            timers.get("build.stream.pipeline_wall", 0.0), 2
+        ),
+        "build_pipeline": build_pipeline_snapshot(),
     }
     build["build_finalize_mode"] = finalize_mode
     build["build_run_files"] = counters.get("build.stream.run_files", 0)

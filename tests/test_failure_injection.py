@@ -196,7 +196,7 @@ from hyperspace_tpu.index import stream_builder
 
 # suicide mid-spill: the third spilled run SIGKILLs the process — no
 # teardown, no atexit, exactly a crashed builder
-real = stream_builder.StreamingIndexWriter._spill_run
+real = stream_builder.StreamingIndexWriter._spill_run_at
 count = {"n": 0}
 def killer(self, *a, **k):
     count["n"] += 1
@@ -204,7 +204,7 @@ def killer(self, *a, **k):
         print("KILLING", flush=True)
         os.kill(os.getpid(), 9)
     return real(self, *a, **k)
-stream_builder.StreamingIndexWriter._spill_run = killer
+stream_builder.StreamingIndexWriter._spill_run_at = killer
 
 conf = HyperspaceConf({C.INDEX_SYSTEM_PATH: f"{ws}/indexes",
                        C.INDEX_NUM_BUCKETS: 8,

@@ -472,8 +472,8 @@ def test_writer_splits_oversized_batch(tmp_path):
 
 
 def test_streaming_failure_tears_down_pipeline(tmp_path, monkeypatch):
-    """A spill failure mid-build must stop the spill thread (no parked
-    daemon) and clean the spill dir, then re-raise."""
+    """A spill failure mid-build must stop every pool worker (no parked
+    daemons) and clean the spill dir, then re-raise."""
     import threading
     import time
 
@@ -489,13 +489,16 @@ def test_streaming_failure_tears_down_pipeline(tmp_path, monkeypatch):
         sb.write_index_data_streaming(
             chunks_of(b, 512), ["orderkey"], 4, tmp_path / "o", chunk_capacity=512
         )
+    pool_prefixes = ("spill-compute", "spill-write", "ingest", "bucket-merge")
     deadline = time.time() + 5
     while time.time() < deadline and any(
-        t.name == "spill-writer" and t.is_alive() for t in threading.enumerate()
+        t.name.startswith(pool_prefixes) and t.is_alive()
+        for t in threading.enumerate()
     ):
         time.sleep(0.05)
     assert not any(
-        t.name == "spill-writer" and t.is_alive() for t in threading.enumerate()
+        t.name.startswith(pool_prefixes) and t.is_alive()
+        for t in threading.enumerate()
     )
     assert not (tmp_path / "o" / ".spill").exists()
 
