@@ -1,13 +1,18 @@
 """hslint — repo-tuned static analysis for TPU-native invariants.
 
-Six rules, each encoding a bug class that actually shipped here (the
-round-5 advisor findings are the seed violations); see
+Two phases. Per-file rules (HS001-HS008) each encode a bug class that
+actually shipped here (the round-5 advisor findings are the seed
+violations). Project rules (HS009-HS013) run on a whole-program model —
+symbol table, resolved intra-package call graph, lock inventory
+(analysis/project.py) — and machine-check the cross-module concurrency
+invariants (lock ordering, guarded fields, blocking reachability,
+residency fence/epoch discipline, config-key registry); see
 docs/09-static-analysis.md for the catalog. Entry points:
 
     from hyperspace_tpu.analysis import run_analysis, analyze_source
-    findings = run_analysis([Path("hyperspace_tpu")])
+    findings = run_analysis([Path("hyperspace_tpu")])  # both phases
 
-or the CLI: ``python scripts/lint.py hyperspace_tpu scripts bench.py``.
+or the CLI: ``python scripts/lint.py`` (defaults to the tier-1 targets).
 Suppress intentional boundary violations per line with
 ``# hslint: disable=HSxxx`` plus a justification comment.
 """
@@ -17,10 +22,13 @@ from __future__ import annotations
 from .core import (
     Finding,
     ModuleContext,
+    ProjectRule,
     Rule,
     analyze_file,
+    analyze_project_sources,
     analyze_source,
     iter_python_files,
+    iter_suppression_markers,
     run_analysis,
 )
 from .reporter import render_json, render_text, summarize
@@ -28,10 +36,13 @@ from .reporter import render_json, render_text, summarize
 __all__ = [
     "Finding",
     "ModuleContext",
+    "ProjectRule",
     "Rule",
     "analyze_file",
+    "analyze_project_sources",
     "analyze_source",
     "iter_python_files",
+    "iter_suppression_markers",
     "run_analysis",
     "render_json",
     "render_text",

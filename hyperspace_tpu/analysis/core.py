@@ -18,8 +18,8 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
-# ``# hslint: disable=HS001,HS003`` suppresses those codes on that line;
-# ``# hslint: disable`` (no codes) suppresses every rule on that line.
+# a comment ``hslint: disable=HS001,HS003`` suppresses those codes on its
+# line; with no ``=codes`` every rule is suppressed on that line.
 _SUPPRESS_RE = re.compile(
     r"#\s*hslint:\s*disable(?:=(?P<codes>[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*))?"
 )
@@ -93,6 +93,24 @@ class Rule:
         raise NotImplementedError
 
 
+class ProjectRule(Rule):
+    """Phase-2 analysis pass over the whole-program model
+    (analysis/project.py) instead of one module's AST. Subclasses
+    implement ``check_project`` yielding ``(path, line, col, message)``
+    tuples — path included because a cross-module property anchors its
+    finding wherever the witness site lives. Project rules run only when
+    the analysis builds a project model (``run_analysis(project=True)``,
+    the default); ``analyze_source`` skips them."""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Tuple[int, int, str]]:
+        return iter(())  # per-file phase: nothing — the model phase reports
+
+    def check_project(
+        self, project
+    ) -> Iterator[Tuple[str, int, int, str]]:
+        raise NotImplementedError
+
+
 def build_aliases(tree: ast.AST) -> Dict[str, str]:
     """Local name → dotted origin for every import in the module, so rules
     match ``np.asarray`` and ``from time import sleep`` alike."""
@@ -148,26 +166,50 @@ def parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
             ...
 
     Further comment-only lines may sit between the marker and the code
-    line (multi-line justifications). Matching is textual (``ast`` drops
-    comments); a string literal containing the marker would also match —
-    acceptable for a lint-control channel."""
+    line (multi-line justifications). Markers are matched in COMMENT
+    tokens only (``tokenize``-classified): a docstring or help text that
+    merely mentions the marker is neither a suppression nor a
+    ``--check-suppressions`` audit subject. On files tokenize cannot
+    process the classification falls back to any-line textual matching
+    (lint-control channel: fail open)."""
     out: Dict[int, Optional[Set[str]]] = {}
-    lines = source.splitlines()
-
-    def merge(line_no: int, codes: Optional[str]) -> None:
+    for _marker_line, bound_line, codes in iter_suppression_markers(source):
         if codes is None:
-            out[line_no] = None
-            return
-        got = {c.strip() for c in codes.split(",") if c.strip()}
-        prev = out.get(line_no, set())
-        out[line_no] = None if prev is None else (prev or set()) | got
+            out[bound_line] = None
+            continue
+        prev = out.get(bound_line, set())
+        out[bound_line] = None if prev is None else (prev or set()) | codes
+    return out
 
+
+def iter_suppression_markers(
+    source: str,
+) -> List[Tuple[int, int, Optional[Set[str]]]]:
+    """Every suppression marker in a module as ``(marker line, bound
+    line, codes)`` — codes None for a bare ``disable``. The bound line is
+    where findings are matched (a trailing marker binds to its own line,
+    a standalone comment marker to the next code line);
+    ``--check-suppressions`` reports stale markers at the MARKER line,
+    which is where the delete happens."""
+    out: List[Tuple[int, int, Optional[Set[str]]]] = []
+    if "hslint" not in source:
+        return out
+    lines = source.splitlines()
+    comment_lines = _comment_lines(source)
     for i, line in enumerate(lines, start=1):
         if "hslint" not in line:
+            continue
+        if comment_lines is not None and i not in comment_lines:
             continue
         m = _SUPPRESS_RE.search(line)
         if not m:
             continue
+        raw = m.group("codes")
+        codes = (
+            None
+            if raw is None
+            else {c.strip() for c in raw.split(",") if c.strip()}
+        )
         if line.lstrip().startswith("#"):
             # standalone marker: bind to the next non-comment, non-blank
             # line (skipping the justification's continuation comments)
@@ -175,11 +217,28 @@ def parse_suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
             while j < len(lines):
                 nxt = lines[j].strip()
                 if nxt and not nxt.startswith("#"):
-                    merge(j + 1, m.group("codes"))
+                    out.append((i, j + 1, codes))
                     break
                 j += 1
         else:
-            merge(i, m.group("codes"))
+            out.append((i, i, codes))
+    return out
+
+
+def _comment_lines(source: str) -> Optional[Set[int]]:
+    """Line numbers carrying a ``#`` comment token, or None when
+    tokenize cannot process the source (caller falls back to textual
+    matching on every line)."""
+    import io
+    import tokenize
+
+    out: Set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out.add(tok.start[0])
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
     return out
 
 
@@ -238,11 +297,131 @@ def iter_python_files(paths: Iterable[Path]) -> Iterator[Path]:
 
 
 def run_analysis(
-    paths: Iterable[Path], rules: Optional[Sequence[Rule]] = None
+    paths: Iterable[Path],
+    rules: Optional[Sequence[Rule]] = None,
+    project: bool = True,
+    timings: Optional[Dict[str, float]] = None,
+    model_sink: Optional[list] = None,
 ) -> List[Finding]:
     """Lint every ``.py`` under ``paths`` (files or directories) and return
-    the combined findings list."""
+    the combined findings list.
+
+    Two phases: per-file rules run on each module's AST as before; with
+    ``project=True`` (the default) a whole-program model is then built
+    over ALL the parsed modules and the cross-module rules (HS009+) run
+    on it. ``timings`` — when a dict is passed, it is filled with
+    per-rule wall seconds plus ``"project-model"`` for the model build
+    (the ``--timings`` CLI surface). ``model_sink`` — when a list is
+    passed and the project phase runs, the built ProjectModel is
+    appended to it (the ``--call-graph-dump`` surface: the model is
+    expensive enough that the CLI must not build it twice)."""
+    import time as _time
+
+    if rules is None:
+        from .rules import REGISTRY
+
+        rules = REGISTRY
+    file_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+
+    def note(code: str, dt: float) -> None:
+        if timings is not None:
+            timings[code] = timings.get(code, 0.0) + dt
+
     findings: List[Finding] = []
-    for f in iter_python_files(paths):
-        findings.extend(analyze_file(f, rules))
+    entries: List[Tuple[ModuleContext, str, bool]] = []
+    suppressions_by_path: Dict[str, Dict[int, Optional[Set[str]]]] = {}
+    for root in paths:
+        root = Path(root)
+        base = root.parent.as_posix()
+        for f in iter_python_files([root]):
+            source = f.read_text(encoding="utf-8")
+            try:
+                ctx = ModuleContext(source, str(f))
+            except SyntaxError as e:
+                findings.append(
+                    Finding(
+                        "HS000",
+                        f"syntax error prevents analysis: {e.msg}",
+                        str(f),
+                        e.lineno or 1,
+                        (e.offset or 1) - 1,
+                    )
+                )
+                continue
+            suppressions = parse_suppressions(source)
+            suppressions_by_path[ctx.path] = suppressions
+            if project and project_rules:
+                from .project import path_to_module
+
+                name, is_pkg = path_to_module(f.as_posix(), base)
+                entries.append((ctx, name, is_pkg))
+            for rule in file_rules:
+                if not rule.applies_to(ctx.posix):
+                    continue
+                t0 = _time.perf_counter()
+                for line, col, message in rule.check(ctx):
+                    codes = suppressions.get(line, "absent")
+                    suppressed = codes != "absent" and (
+                        codes is None or rule.code in codes
+                    )
+                    findings.append(
+                        Finding(
+                            rule.code, message, ctx.path, line, col,
+                            bool(suppressed),
+                        )
+                    )
+                note(rule.code, _time.perf_counter() - t0)
+    if project and project_rules and entries:
+        from .project import build_project
+
+        t0 = _time.perf_counter()
+        model = build_project(entries)
+        note("project-model", _time.perf_counter() - t0)
+        if model_sink is not None:
+            model_sink.append(model)
+        for rule in project_rules:
+            t0 = _time.perf_counter()
+            for path, line, col, message in rule.check_project(model):
+                codes = suppressions_by_path.get(path, {}).get(line, "absent")
+                suppressed = codes != "absent" and (
+                    codes is None or rule.code in codes
+                )
+                findings.append(
+                    Finding(rule.code, message, path, line, col, bool(suppressed))
+                )
+            note(rule.code, _time.perf_counter() - t0)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def analyze_project_sources(
+    sources: Dict[str, str], rules: Optional[Sequence[Rule]] = None
+) -> List[Finding]:
+    """Project-rule findings over a virtual ``{posix path: source}`` tree
+    — the fixture entry point: tests hand a synthetic multi-module
+    package and get cross-module findings with suppressions applied, no
+    filesystem involved."""
+    from .project import build_project_from_sources
+
+    if rules is None:
+        from .rules import REGISTRY
+
+        rules = REGISTRY
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+    model = build_project_from_sources(sources)
+    sups = {
+        path: parse_suppressions(src) for path, src in sources.items()
+    }
+    findings: List[Finding] = []
+    for rule in project_rules:
+        for path, line, col, message in rule.check_project(model):
+            codes = sups.get(path, {}).get(line, "absent")
+            suppressed = codes != "absent" and (
+                codes is None or rule.code in codes
+            )
+            findings.append(
+                Finding(rule.code, message, path, line, col, bool(suppressed))
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings

@@ -2,6 +2,9 @@
 
 Adding a rule = add a module here and append an instance to REGISTRY;
 ``scripts/lint.py --list-rules`` and the docs table read this list.
+HS001-HS008 are per-file passes; HS009+ are project rules (subclasses of
+``ProjectRule``) running on the whole-program model of
+``analysis/project.py``.
 """
 
 from __future__ import annotations
@@ -17,6 +20,11 @@ from .hs005_nondeterministic_hashing import NondeterministicHashRule
 from .hs006_unbounded_cache import UnboundedCacheRule
 from .hs007_unfenced_device_timing import UnfencedDeviceTimingRule
 from .hs008_raw_metadata_write import RawMetadataWriteRule
+from .hs009_lock_order import LockOrderRule
+from .hs010_guarded_fields import GuardedFieldRule
+from .hs011_interproc_blocking import InterprocBlockingRule
+from .hs012_residency_fence import ResidencyFenceRule
+from .hs013_config_keys import ConfigKeyRule
 
 REGISTRY: List[Rule] = [
     HostSyncRule(),
@@ -27,6 +35,11 @@ REGISTRY: List[Rule] = [
     UnboundedCacheRule(),
     UnfencedDeviceTimingRule(),
     RawMetadataWriteRule(),
+    LockOrderRule(),
+    GuardedFieldRule(),
+    InterprocBlockingRule(),
+    ResidencyFenceRule(),
+    ConfigKeyRule(),
 ]
 
 __all__ = [
@@ -39,4 +52,9 @@ __all__ = [
     "UnboundedCacheRule",
     "UnfencedDeviceTimingRule",
     "RawMetadataWriteRule",
+    "LockOrderRule",
+    "GuardedFieldRule",
+    "InterprocBlockingRule",
+    "ResidencyFenceRule",
+    "ConfigKeyRule",
 ]

@@ -58,6 +58,39 @@ def _lock_name(expr: ast.AST) -> Optional[str]:
     return None
 
 
+def blocking_reason(
+    call: ast.Call, aliases: Dict[str, str], thread_vars: Set[str]
+) -> Optional[str]:
+    """Why ``call`` is a DIRECT blocking call, or None. The shared
+    detector: HS002 applies it lexically inside one function's lock
+    regions; the project model (analysis/project.py) applies it to every
+    function so HS011 can follow blocking reachability through the call
+    graph."""
+    d = dotted_name(call.func, aliases)
+    if d:
+        if d == "time.sleep" or d == "open":
+            return f"'{d}'"
+        if d.startswith(_BLOCKING_PREFIXES):
+            return f"'{d}'"
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        recv = call.func.value
+        recv_name = terminal_name(recv)
+        if attr in _FILE_IO_ATTRS:
+            return f"'.{attr}()'"
+        if attr == "join":
+            if (recv_name and recv_name in thread_vars) or (
+                recv_name and _THREADISH_RE.search(recv_name)
+            ):
+                return f"'{recv_name}.join()'"
+        if attr == "wait":
+            if (recv_name and recv_name in thread_vars) or (
+                recv_name and _WAITISH_RE.search(recv_name)
+            ):
+                return f"'{recv_name}.wait()'"
+    return None
+
+
 class LockBlockingRule(Rule):
     code = "HS002"
     name = "lock-held-across-blocking-call"
@@ -201,26 +234,4 @@ class LockBlockingRule(Rule):
     def _blocking(
         self, call: ast.Call, ctx: ModuleContext, thread_vars: Set[str]
     ) -> Optional[str]:
-        d = dotted_name(call.func, ctx.aliases)
-        if d:
-            if d == "time.sleep" or d == "open":
-                return f"'{d}'"
-            if d.startswith(_BLOCKING_PREFIXES):
-                return f"'{d}'"
-        if isinstance(call.func, ast.Attribute):
-            attr = call.func.attr
-            recv = call.func.value
-            recv_name = terminal_name(recv)
-            if attr in _FILE_IO_ATTRS:
-                return f"'.{attr}()'"
-            if attr == "join":
-                if (recv_name and recv_name in thread_vars) or (
-                    recv_name and _THREADISH_RE.search(recv_name)
-                ):
-                    return f"'{recv_name}.join()'"
-            if attr == "wait":
-                if (recv_name and recv_name in thread_vars) or (
-                    recv_name and _WAITISH_RE.search(recv_name)
-                ):
-                    return f"'{recv_name}.wait()'"
-        return None
+        return blocking_reason(call, ctx.aliases, thread_vars)

@@ -156,15 +156,19 @@ class ScanGate:
         """Block until background link probes (for one size class, or all
         when ``n_rows`` is None) have published — tests and benches need
         deterministic state."""
-        if n_rows is not None:
-            t = self._state.get(next_pow2(n_rows), {}).get("_probe_thread")
-            threads = [t] if t is not None else []
-        else:
-            threads = [
-                st["_probe_thread"]
-                for st in list(self._state.values())
-                if "_probe_thread" in st
-            ]
+        # snapshot under the lock: a concurrent decide() inserting a new
+        # size class while this iterates would raise "dictionary changed
+        # size during iteration" (HS010's lock-free-read finding)
+        with self._lock:
+            if n_rows is not None:
+                t = self._state.get(next_pow2(n_rows), {}).get("_probe_thread")
+                threads = [t] if t is not None else []
+            else:
+                threads = [
+                    st["_probe_thread"]
+                    for st in self._state.values()
+                    if "_probe_thread" in st
+                ]
         for t in threads:
             t.join(timeout)
 
@@ -206,9 +210,12 @@ class ScanGate:
                 winner_new = True
             else:
                 winner_new = False
+            # the return value is captured under the lock too: the
+            # post-release re-read raced record_device_failure's pin
+            winner = st["winner"]
         if winner_new:
             self._persist(n_pad)
-        return self._state[n_pad]["winner"]
+        return winner
 
     def _time_link(self, arrays: dict, n_rows: int) -> Optional[float]:
         try:

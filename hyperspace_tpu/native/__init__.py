@@ -119,6 +119,9 @@ def _load() -> Optional[ctypes.CDLL]:
         if os.environ.get("HYPERSPACE_TPU_NATIVE", "auto").lower() == "off":
             _LIB_FAILED = True
             return None
+        # hslint: disable=HS011 - once-per-process build latch: holding
+        # _LOCK across the g++ compile IS the dedup; racers need the .so
+        # before proceeding and there is no caller-timeout contract here
         so = _compile()
         if so is None:
             _LIB_FAILED = True

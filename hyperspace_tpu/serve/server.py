@@ -148,7 +148,11 @@ class QueryServer:
         self._queue: "deque[_Request]" = deque()
         self._workers: List[threading.Thread] = []
         self._closed = False
-        self._host_latched = False
+        # host latch-down is an Event, not a lock-guarded bool: workers
+        # consult it on every query's hot path, and an Event read is
+        # race-free without taking _cond (the HS010 finding: the bool
+        # was written under _cond but read lock-free in three places)
+        self._host_latch = threading.Event()
         self._degraded_reason: Optional[str] = None
         # serving stats (guarded by _cond's lock)
         self._submitted = 0
@@ -310,7 +314,7 @@ class QueryServer:
                     return
                 req = self._queue.popleft()
                 batch = [req]
-                if req.resident is not None and not self._host_latched:
+                if req.resident is not None and not self._host_latch.is_set():
                     batch += self._drain_compatible_locked(req)
             now = time.monotonic()
             live: List[_Request] = []
@@ -359,7 +363,7 @@ class QueryServer:
     def _run_plan(self, req: _Request) -> ColumnarBatch:
         from ..exec.executor import Executor
 
-        if self._host_latched:
+        if self._host_latch.is_set():
             executor = Executor(self.session.conf, device=False, mesh=None)
         else:
             executor = Executor(self.session.conf, mesh=self.session.mesh)
@@ -384,7 +388,7 @@ class QueryServer:
             self._latch_host(repr(e), residents[0])
             results = None
         if results is None:
-            if not self._host_latched:
+            if not self._host_latch.is_set():
                 # stacked dispatch declined (not an error): per-query path
                 metrics.incr("serve.batch.declined")
             for r in live:
@@ -406,8 +410,8 @@ class QueryServer:
         from ..exec.mesh_cache import mesh_cache
 
         with self._cond:
-            already = self._host_latched
-            self._host_latched = True
+            already = self._host_latch.is_set()
+            self._host_latch.set()
             self._degraded_reason = self._degraded_reason or reason
         if not already:
             metrics.incr("serve.degraded")
@@ -458,14 +462,14 @@ class QueryServer:
         discovered by ANY component degrades serving without waiting for
         a serve-path failure. Called per submit (latched_verdict is one
         dict probe) and by the ``degraded`` property."""
-        if self._host_latched:
+        if self._host_latch.is_set():
             return True
         from ..utils.deviceprobe import latched_verdict
 
         if latched_verdict() is False:
             with self._cond:
-                newly = not self._host_latched
-                self._host_latched = True
+                newly = not self._host_latch.is_set()
+                self._host_latch.set()
                 self._degraded_reason = (
                     self._degraded_reason or "deviceprobe first-touch verdict"
                 )
@@ -495,7 +499,7 @@ class QueryServer:
                 "deadline_missed": self._deadline_missed,
                 "queue_depth": len(self._queue),
                 "workers": len(self._workers),
-                "degraded": self._host_latched,
+                "degraded": self._host_latch.is_set(),
                 "degraded_reason": self._degraded_reason,
                 "batch_dispatches": self._dispatches,
                 "batched_queries": self._batched_queries,

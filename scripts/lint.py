@@ -2,21 +2,32 @@
 """hslint CLI — run the repo-tuned static analyzer.
 
 Usage:
+    python scripts/lint.py                      # tier-1 targets, both phases
     python scripts/lint.py hyperspace_tpu scripts bench.py
     python scripts/lint.py --format json hyperspace_tpu
+    python scripts/lint.py --no-project somefile.py   # per-file rules only
+    python scripts/lint.py --changed HEAD~1     # full model, report changed
+    python scripts/lint.py --check-suppressions # stale-suppression audit
+    python scripts/lint.py --call-graph-dump cg.json --timings
     python scripts/lint.py --list-rules
 
-Exit status: 0 when no unsuppressed findings, 1 otherwise (2 on usage
-error). Suppressed findings never fail the run; ``--show-suppressed``
-prints them for auditing. This is the same entry point
-``tests/test_lint.py`` enforces in tier-1, so a clean CI run and a clean
-local run mean the same thing.
+The whole-program phase (HS009+) is ON by default: it builds one project
+model over every given path, so even ``--changed`` pre-commit runs see
+cross-module effects of a local edit. Exit status: 0 when no unsuppressed
+findings (in the reported set), 1 otherwise (2 on usage error).
+Suppressed findings never fail the run; ``--show-suppressed`` prints them
+for auditing. This is the same entry point ``tests/test_lint.py``
+enforces in tier-1, so a clean CI run and a clean local run mean the
+same thing.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
+import time
 from pathlib import Path
 
 # runnable straight from a checkout without an installed package
@@ -24,15 +35,31 @@ _REPO_ROOT = Path(__file__).resolve().parent.parent
 if str(_REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(_REPO_ROOT))
 
-from hyperspace_tpu.analysis import render_json, render_text, run_analysis  # noqa: E402
+from hyperspace_tpu.analysis import (  # noqa: E402
+    iter_python_files,
+    iter_suppression_markers,
+    render_json,
+    render_text,
+    run_analysis,
+)
 from hyperspace_tpu.analysis.rules import REGISTRY  # noqa: E402
+
+# the tier-1 surface: what a bare ``python scripts/lint.py`` lints and
+# what tests/test_lint.py holds at zero unsuppressed findings
+DEFAULT_TARGETS = ("hyperspace_tpu", "scripts", "bench.py")
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="hslint", description="repo-tuned TPU-native static analysis"
     )
-    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to lint (default: "
+        + " ".join(DEFAULT_TARGETS)
+        + " from the repo root)",
+    )
     ap.add_argument(
         "--format", choices=("text", "json"), default="text", dest="fmt"
     )
@@ -40,6 +67,45 @@ def main(argv=None) -> int:
         "--show-suppressed",
         action="store_true",
         help="include suppressed findings in text output",
+    )
+    ap.add_argument(
+        "--project",
+        dest="project",
+        action="store_true",
+        default=True,
+        help="run the whole-program phase (HS009+) — the default",
+    )
+    ap.add_argument(
+        "--no-project",
+        dest="project",
+        action="store_false",
+        help="skip the whole-program phase; per-file rules only",
+    )
+    ap.add_argument(
+        "--timings",
+        action="store_true",
+        help="print per-rule wall seconds (project model build included)",
+    )
+    ap.add_argument(
+        "--call-graph-dump",
+        metavar="PATH",
+        help="write the project model (resolved call graph, lock "
+        "inventory, per-function lock events) as JSON — the debug "
+        "artifact for surprising HS009-HS012 verdicts",
+    )
+    ap.add_argument(
+        "--changed",
+        metavar="GIT_REF",
+        help="build the FULL project model but report findings only in "
+        "files changed since GIT_REF (plus untracked files) — the fast "
+        "pre-commit mode",
+    )
+    ap.add_argument(
+        "--check-suppressions",
+        action="store_true",
+        help="audit mode: report every '# hslint: disable' marker whose "
+        "rule no longer fires on its line (stale suppressions get "
+        "deleted, not inherited); exits 1 when any are stale",
     )
     ap.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog and exit"
@@ -50,20 +116,141 @@ def main(argv=None) -> int:
         for rule in REGISTRY:
             print(f"{rule.code} {rule.name}: {rule.description}")
         return 0
-    if not args.paths:
-        ap.error("no paths given (try: hyperspace_tpu scripts bench.py)")
+    paths = args.paths or [str(_REPO_ROOT / t) for t in DEFAULT_TARGETS]
 
-    missing = [p for p in args.paths if not Path(p).exists()]
+    missing = [p for p in paths if not Path(p).exists()]
     if missing:
         print(f"hslint: no such path: {', '.join(missing)}", file=sys.stderr)
         return 2
 
-    findings = run_analysis([Path(p) for p in args.paths])
+    if not args.project and args.check_suppressions:
+        # the audit must see every rule a marker can name — auditing
+        # with project rules off would report live HS009+ suppressions
+        # as stale and tell the user to delete them
+        ap.error("--check-suppressions requires the project phase "
+                 "(drop --no-project)")
+    if not args.project and args.call_graph_dump:
+        ap.error("--call-graph-dump is a project-phase artifact "
+                 "(drop --no-project)")
+
+    changed = None
+    if args.changed is not None:
+        # resolved BEFORE the (multi-second) analysis so a typo'd ref
+        # fails fast
+        changed = _changed_files(args.changed)
+        if changed is None:
+            print(
+                f"hslint: cannot resolve --changed {args.changed!r} "
+                "(not a git checkout, or unknown ref)",
+                file=sys.stderr,
+            )
+            return 2
+
+    timings: dict = {}
+    models: list = []
+    t0 = time.perf_counter()
+    findings = run_analysis(
+        [Path(p) for p in paths],
+        project=args.project,
+        timings=timings if args.timings else None,
+        model_sink=models if args.call_graph_dump else None,
+    )
+    wall = time.perf_counter() - t0
+
+    if args.call_graph_dump and models:
+        Path(args.call_graph_dump).write_text(
+            json.dumps(models[0].dump(), indent=2, sort_keys=True),
+            encoding="utf-8",
+        )
+        print(f"hslint: call graph written to {args.call_graph_dump}")
+
+    if args.check_suppressions:
+        return _check_suppressions(paths, findings)
+
+    if changed is not None:
+        findings = [
+            f for f in findings if Path(f.path).resolve() in changed
+        ]
+
     if args.fmt == "json":
         print(render_json(findings))
     else:
         print(render_text(findings, show_suppressed=args.show_suppressed))
+    if args.timings:
+        for code, dt in sorted(timings.items()):
+            print(f"  {code}: {dt * 1e3:.1f} ms", file=sys.stderr)
+        print(f"  total: {wall:.2f} s", file=sys.stderr)
     return 1 if any(not f.suppressed for f in findings) else 0
+
+
+def _changed_files(ref: str) -> "set | None":
+    """Absolute paths changed since ``ref`` plus untracked files, or None
+    when git cannot answer (the caller turns that into a usage error
+    rather than silently linting nothing)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=_REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=_REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    out = set()
+    for line in diff.stdout.splitlines() + untracked.stdout.splitlines():
+        line = line.strip()
+        if line:
+            out.add((_REPO_ROOT / line).resolve())
+    return out
+
+
+def _check_suppressions(paths, findings) -> int:
+    """Report markers whose codes never fire on their bound line. A bare
+    ``disable`` is stale when NO finding lands on its line; a coded
+    marker is stale per code."""
+    by_site: dict = {}
+    for f in findings:
+        by_site.setdefault((str(Path(f.path)), f.line), set()).add(f.code)
+    stale = 0
+    checked = 0
+    for root in paths:
+        for fpath in iter_python_files([Path(root)]):
+            source = fpath.read_text(encoding="utf-8")
+            for marker_line, bound_line, codes in iter_suppression_markers(
+                source
+            ):
+                fired = by_site.get((str(fpath), bound_line), set())
+                if codes is None:
+                    checked += 1
+                    if not fired:
+                        stale += 1
+                        print(
+                            f"{fpath}:{marker_line}: stale suppression — "
+                            "no rule fires on the suppressed line"
+                        )
+                    continue
+                for code in sorted(codes):
+                    checked += 1
+                    if code not in fired:
+                        stale += 1
+                        print(
+                            f"{fpath}:{marker_line}: stale suppression — "
+                            f"{code} no longer fires on the suppressed line"
+                        )
+    print(
+        f"hslint: {checked} suppression(s) audited, {stale} stale"
+    )
+    return 1 if stale else 0
 
 
 if __name__ == "__main__":

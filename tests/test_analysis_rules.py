@@ -566,3 +566,800 @@ def test_hs008_suppressed_with_justification():
     findings = run(src)
     hs8 = [f for f in findings if f.code == "HS008"]
     assert len(hs8) == 1 and hs8[0].suppressed
+
+
+# === project rules (HS009-HS013): fixtures over virtual multi-module trees ==
+
+
+from hyperspace_tpu.analysis import analyze_project_sources
+
+
+def run_project(sources: dict):
+    return analyze_project_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()}
+    )
+
+
+# --- HS009: lock-order inversion --------------------------------------------
+
+
+_HS009_A = """
+    import threading
+
+    from . import b
+
+    _A_LOCK = threading.Lock()
+
+    def locked_a():
+        with _A_LOCK:
+            pass
+
+    def do_a():
+        with _A_LOCK:
+            b.locked_b()
+    """
+
+
+def test_hs009_fires_on_two_module_cycle():
+    sources = {
+        "pkg/a.py": _HS009_A,
+        "pkg/b.py": """
+        import threading
+
+        from . import a
+
+        _B_LOCK = threading.Lock()
+
+        def locked_b():
+            with _B_LOCK:
+                pass
+
+        def do_b():
+            with _B_LOCK:
+                a.locked_a()
+        """,
+    }
+    findings = run_project(sources)
+    got = codes(findings, "HS009")
+    assert got == ["HS009", "HS009"]  # one finding per edge of the cycle
+    paths = {f.path for f in findings if f.code == "HS009"}
+    assert paths == {"pkg/a.py", "pkg/b.py"}
+    msg = [f for f in findings if f.path == "pkg/a.py"][0].message
+    assert "pkg.b:_B_LOCK" in msg and "pkg.a:_A_LOCK" in msg
+
+
+def test_hs009_clean_after_refactor_releases_before_call():
+    sources = {
+        "pkg/a.py": _HS009_A,
+        "pkg/b.py": """
+        import threading
+
+        from . import a
+
+        _B_LOCK = threading.Lock()
+
+        def locked_b():
+            with _B_LOCK:
+                pass
+
+        def do_b():
+            with _B_LOCK:
+                state = compute()
+            a.locked_a()
+        """,
+    }
+    assert codes(run_project(sources), "HS009") == []
+
+
+def test_hs009_lexical_nesting_and_self_edge():
+    # nested acquisition inside ONE function still builds edges; a
+    # consistent order is clean, and same-identity nesting is not a cycle
+    sources = {
+        "pkg/m.py": """
+        import threading
+
+        _L1 = threading.Lock()
+        _L2 = threading.Lock()
+
+        def ordered_one():
+            with _L1:
+                with _L2:
+                    pass
+
+        def ordered_two():
+            with _L1:
+                with _L2:
+                    pass
+        """
+    }
+    assert codes(run_project(sources), "HS009") == []
+    sources["pkg/m.py"] += """
+        def inverted():
+            with _L2:
+                with _L1:
+                    pass
+        """
+    # per-witness reporting: both forward sites + the inverted site
+    assert codes(run_project(sources), "HS009") == ["HS009"] * 3
+
+
+def test_hs009_suppressed():
+    sources = {
+        "pkg/m.py": """
+        import threading
+
+        _L1 = threading.Lock()
+        _L2 = threading.Lock()
+
+        def one():
+            with _L1:
+                # hslint: disable=HS009 - instance-disjoint by construction
+                with _L2:
+                    pass
+
+        def two():
+            with _L2:
+                # hslint: disable=HS009 - instance-disjoint by construction
+                with _L1:
+                    pass
+        """
+    }
+    findings = run_project(sources)
+    assert codes(findings, "HS009") == []
+    assert sum(1 for f in findings if f.suppressed and f.code == "HS009") == 2
+
+
+# --- HS010: inconsistently-guarded field ------------------------------------
+
+
+def test_hs010_fires_on_lock_free_read_of_guarded_field():
+    sources = {
+        "pkg/m.py": """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._completed = 0
+
+            def finish(self):
+                with self._lock:
+                    self._completed += 1
+
+            def fail(self):
+                with self._lock:
+                    self._completed += 1
+
+            def stats(self):
+                return {"completed": self._completed}
+        """
+    }
+    findings = run_project(sources)
+    got = [f for f in findings if f.code == "HS010" and not f.suppressed]
+    assert len(got) == 1
+    assert "_completed" in got[0].message and "read lock-free" in got[0].message
+
+
+def test_hs010_clean_when_every_access_guarded_or_init():
+    sources = {
+        "pkg/m.py": """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._completed = 0
+
+            def finish(self):
+                with self._lock:
+                    self._completed += 1
+
+            def fail(self):
+                with self._lock:
+                    self._completed += 1
+
+            def stats(self):
+                with self._lock:
+                    return {"completed": self._completed}
+
+            def _drain_locked(self):
+                return self._completed
+        """
+    }
+    assert codes(run_project(sources), "HS010") == []
+
+
+def test_hs010_call_graph_guarded_helper_is_clean():
+    # _bump writes lock-free lexically, but its every resolved call site
+    # holds the guard — the "via the call graph" half of the rule
+    sources = {
+        "pkg/m.py": """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1
+
+            def b(self):
+                with self._lock:
+                    self._n += 2
+
+            def c(self):
+                with self._lock:
+                    self._bump()
+
+            def _bump(self):
+                self._n += 1
+        """
+    }
+    assert codes(run_project(sources), "HS010") == []
+
+
+def test_hs010_sync_attrs_and_single_write_not_flagged():
+    sources = {
+        "pkg/m.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._done = threading.Event()
+                self._once = 0
+
+            def finish(self):
+                with self._lock:
+                    self._once = 1
+
+            def check(self):
+                return self._done.is_set(), self._once
+        """
+    }
+    # _done is self-synchronizing; _once has only ONE guarded write site
+    # (no established convention)
+    assert codes(run_project(sources), "HS010") == []
+
+
+def test_hs010_suppressed():
+    sources = {
+        "pkg/m.py": """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._completed = 0
+
+            def finish(self):
+                with self._lock:
+                    self._completed += 1
+
+            def fail(self):
+                with self._lock:
+                    self._completed += 1
+
+            def stats(self):
+                return self._completed  # hslint: disable=HS010
+        """
+    }
+    findings = run_project(sources)
+    assert codes(findings, "HS010") == []
+    assert any(f.suppressed and f.code == "HS010" for f in findings)
+
+
+# --- HS011: interprocedural blocking-under-lock -----------------------------
+
+
+def test_hs011_fires_on_transitive_blocking_under_lock():
+    sources = {
+        "pkg/work.py": """
+        import threading
+
+        from . import helper
+
+        _LOCK = threading.Lock()
+
+        def tick():
+            with _LOCK:
+                helper.flush()
+        """,
+        "pkg/helper.py": """
+        import time
+
+        def flush():
+            time.sleep(1)
+        """,
+    }
+    findings = run_project(sources)
+    got = [f for f in findings if f.code == "HS011" and not f.suppressed]
+    assert len(got) == 1
+    assert got[0].path == "pkg/work.py"
+    assert "time.sleep" in got[0].message
+
+
+def test_hs011_two_hop_chain_names_the_via():
+    sources = {
+        "pkg/work.py": """
+        import threading
+
+        from . import mid
+
+        _LOCK = threading.Lock()
+
+        def tick():
+            with _LOCK:
+                mid.step()
+        """,
+        "pkg/mid.py": """
+        from . import helper
+
+        def step():
+            helper.flush()
+        """,
+        "pkg/helper.py": """
+        import time
+
+        def flush():
+            time.sleep(1)
+        """,
+    }
+    got = [
+        f
+        for f in run_project(sources)
+        if f.code == "HS011" and f.path == "pkg/work.py"
+    ]
+    assert len(got) == 1
+    assert "via" in got[0].message
+
+
+def test_hs011_clean_outside_lock_or_unresolved():
+    sources = {
+        "pkg/work.py": """
+        import threading
+
+        from . import helper
+
+        _LOCK = threading.Lock()
+
+        def tick():
+            with _LOCK:
+                state = dict(ready=True)
+            helper.flush()
+
+        def cb(fn):
+            with _LOCK:
+                fn()
+        """,
+        "pkg/helper.py": """
+        import time
+
+        def flush():
+            time.sleep(1)
+        """,
+    }
+    assert codes(run_project(sources), "HS011") == []
+
+
+def test_hs011_queue_and_device_dispatch_are_endpoints():
+    sources = {
+        "pkg/work.py": """
+        import threading
+
+        from . import helper
+
+        _LOCK = threading.Lock()
+
+        def tick():
+            with _LOCK:
+                helper.enqueue(1)
+        """,
+        "pkg/helper.py": """
+        import queue
+
+        _q = queue.Queue(maxsize=2)
+
+        def enqueue(x):
+            _q.put(x)
+        """,
+    }
+    got = codes(run_project(sources), "HS011")
+    assert got == ["HS011"]
+
+
+def test_hs011_suppressed():
+    sources = {
+        "pkg/work.py": """
+        import threading
+
+        from . import helper
+
+        _LOCK = threading.Lock()
+
+        def tick():
+            with _LOCK:
+                helper.flush()  # hslint: disable=HS011
+        """,
+        "pkg/helper.py": """
+        import time
+
+        def flush():
+            time.sleep(1)
+        """,
+    }
+    findings = run_project(sources)
+    assert codes(findings, "HS011") == []
+    assert any(f.suppressed and f.code == "HS011" for f in findings)
+
+
+# --- HS012: unfenced residency mutation -------------------------------------
+
+
+_HS012_GOOD = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._tables = []
+            self._epoch = 0
+
+        def reset(self):
+            with self._lock:
+                self._tables.clear()
+                self._epoch += 1
+
+        def register(self, t, epoch):
+            with self._lock:
+                if epoch != self._epoch:
+                    return
+                self._tables.append(t)
+    """
+
+
+def test_hs012_fires_on_unlocked_mutation_and_missing_epoch_guard():
+    sources = {
+        "pkg/cache.py": _HS012_GOOD
+        + textwrap.dedent(
+            """
+            def register_unlocked(self, t, epoch):
+                if epoch != self._epoch:
+                    return
+                self._tables.append(t)
+
+            def register_unguarded(self, t):
+                with self._lock:
+                    self._tables.append(t)
+            """
+        ).replace("\n", "\n        ")
+    }
+    findings = run_project(sources)
+    got = [f for f in findings if f.code == "HS012" and not f.suppressed]
+    assert len(got) == 2
+    msgs = " | ".join(f.message for f in got)
+    assert "outside" in msgs and "epoch guard" in msgs
+
+
+def test_hs012_clean_with_lock_and_epoch_guard():
+    assert codes(run_project({"pkg/cache.py": _HS012_GOOD}), "HS012") == []
+
+
+def test_hs012_fence_substitutes_for_epoch_guard():
+    sources = {
+        "pkg/cache.py": _HS012_GOOD
+        + textwrap.dedent(
+            """
+            def register_fenced(self, t):
+                from .ops import fence_chain
+
+                fence_chain([t])
+                with self._lock:
+                    self._tables.append(t)
+            """
+        ).replace("\n", "\n        ")
+    }
+    assert codes(run_project(sources), "HS012") == []
+
+
+def test_hs012_non_residency_class_is_out_of_scope():
+    sources = {
+        "pkg/other.py": """
+        import threading
+
+        class PlainRegistry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._tables = []
+
+            def add(self, t):
+                self._tables.append(t)
+        """
+    }
+    # owns a lock and a _tables, but never writes an _epoch: not a
+    # residency cache (HS010 may have its own opinion; HS012 stays out)
+    assert codes(run_project(sources), "HS012") == []
+
+
+def test_hs012_suppressed():
+    sources = {
+        "pkg/cache.py": _HS012_GOOD
+        + textwrap.dedent(
+            """
+            def register_unguarded(self, t):
+                with self._lock:
+                    self._tables.append(t)  # hslint: disable=HS012
+            """
+        ).replace("\n", "\n        ")
+    }
+    findings = run_project(sources)
+    assert codes(findings, "HS012") == []
+    assert any(f.suppressed and f.code == "HS012" for f in findings)
+
+
+# --- HS013: undeclared config key -------------------------------------------
+
+
+def test_hs013_fires_on_typod_key():
+    sources = {
+        "hyperspace_tpu/constants.py": """
+        BUILD_WORKERS = "hyperspace.index.build.ingestWorkers"
+        """,
+        "hyperspace_tpu/use.py": """
+        def workers(conf):
+            return conf.get("hyperspace.index.build.ingestWorker", 4)
+        """,
+    }
+    got = [f for f in run_project(sources) if f.code == "HS013"]
+    assert len(got) == 1
+    assert "ingestWorker" in got[0].message
+    assert got[0].path == "hyperspace_tpu/use.py"
+
+
+def test_hs013_clean_on_declared_keys_and_non_key_strings():
+    sources = {
+        "hyperspace_tpu/constants.py": """
+        BUILD_WORKERS = "hyperspace.index.build.ingestWorkers"
+        """,
+        "hyperspace_tpu/use.py": '''
+        def workers(conf):
+            """Reads hyperspace.index.build.* knobs (prose: not a key)."""
+            pat = "hyperspace.index.build.*"
+            return conf.get("hyperspace.index.build.ingestWorkers", 4)
+        ''',
+    }
+    assert codes(run_project(sources), "HS013") == []
+
+
+def test_hs013_silent_without_a_registry_module():
+    sources = {
+        "pkg/use.py": """
+        def workers(conf):
+            return conf.get("hyperspace.index.build.ingestWorker", 4)
+        """
+    }
+    assert codes(run_project(sources), "HS013") == []
+
+
+def test_hs013_suppressed():
+    sources = {
+        "hyperspace_tpu/constants.py": """
+        KEY = "hyperspace.index.numBuckets"
+        """,
+        "hyperspace_tpu/use.py": """
+        def legacy(conf):
+            return conf.get("hyperspace.legacy.knob")  # hslint: disable=HS013
+        """,
+    }
+    findings = run_project(sources)
+    assert codes(findings, "HS013") == []
+    assert any(f.suppressed and f.code == "HS013" for f in findings)
+
+
+# --- the project model: call-graph resolution over a synthetic package ------
+
+
+def test_call_graph_resolution_over_synthetic_package():
+    from hyperspace_tpu.analysis.project import build_project_from_sources
+
+    model = build_project_from_sources(
+        {
+            "pkg/base.py": textwrap.dedent(
+                """
+                class Base:
+                    def shared(self):
+                        return 1
+                """
+            ),
+            "pkg/core.py": textwrap.dedent(
+                """
+                from .base import Base
+
+                class Engine(Base):
+                    def run(self):
+                        return self.helper() + self.shared()
+
+                    def helper(self):
+                        return 2
+
+                engine = Engine()
+
+                def module_fn():
+                    return engine.run()
+                """
+            ),
+            "pkg/user.py": textwrap.dedent(
+                """
+                from . import core
+                from .core import engine, module_fn, Engine
+
+                def via_module():
+                    return core.module_fn()
+
+                def via_imported_name():
+                    return module_fn()
+
+                def via_singleton():
+                    return engine.run()
+
+                def via_ctor_and_local():
+                    e = Engine()
+                    return e.helper()
+
+                class Sub(Engine):
+                    def go(self):
+                        return super().run()
+                """
+            ),
+        }
+    )
+
+    def callees(qual):
+        return {s.callee for s in model.functions[qual].calls if s.callee}
+
+    # self-method + inherited-method resolution through the MRO
+    assert callees("pkg.core:Engine.run") == {
+        "pkg.core:Engine.helper",
+        "pkg.base:Base.shared",
+    }
+    # module-level singleton method call
+    assert "pkg.core:Engine.run" in callees("pkg.core:module_fn")
+    # cross-module: dotted module fn, imported name, imported singleton
+    assert "pkg.core:module_fn" in callees("pkg.user:via_module")
+    assert "pkg.core:module_fn" in callees("pkg.user:via_imported_name")
+    assert "pkg.core:Engine.run" in callees("pkg.user:via_singleton")
+    # locally constructed instance typing
+    assert "pkg.core:Engine.helper" in callees("pkg.user:via_ctor_and_local")
+    # super() resolves past the defining class
+    assert "pkg.core:Engine.run" in callees("pkg.user:Sub.go")
+    # singleton typing recorded on the defining module
+    assert model.modules["pkg.core"].singletons == {"engine": "pkg.core:Engine"}
+
+
+def test_lock_inventory_identity_is_the_defining_owner():
+    from hyperspace_tpu.analysis.project import build_project_from_sources
+
+    model = build_project_from_sources(
+        {
+            "pkg/base.py": textwrap.dedent(
+                """
+                import threading
+
+                class Cache:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                """
+            ),
+            "pkg/sub.py": textwrap.dedent(
+                """
+                from .base import Cache
+
+                class MeshCache(Cache):
+                    def touch(self):
+                        with self._lock:
+                            return 1
+                """
+            ),
+        }
+    )
+    sub = model.classes["pkg.sub:MeshCache"]
+    # the subclass's self._lock maps to the DEFINING owner's identity
+    assert model.lock_id_in_mro(sub, "_lock") == "pkg.base:Cache._lock"
+    touch = model.functions["pkg.sub:MeshCache.touch"]
+    assert [a.lock for a in touch.acquires] == ["pkg.base:Cache._lock"]
+
+
+# --- review regressions: closure recursion, per-witness HS009, HS010 cycles -
+
+
+def test_blocking_closure_handles_self_recursion():
+    # a self-recursive function with a direct blocking endpoint must not
+    # crash the closure fixpoint (set mutated while iterated)
+    sources = {
+        "pkg/m.py": """
+        import threading
+        import time
+
+        _LOCK = threading.Lock()
+
+        def retry(n):
+            time.sleep(0.1)
+            if n:
+                retry(n - 1)
+
+        def tick():
+            with _LOCK:
+                retry(3)
+        """
+    }
+    got = codes(run_project(sources), "HS011")
+    assert got == ["HS011"]
+
+
+def test_hs009_every_witness_site_gets_its_own_finding():
+    # two distinct A-under-B sites: suppressing one must not hide the
+    # other, so each witness is a separate finding
+    sources = {
+        "pkg/m.py": """
+        import threading
+
+        _L1 = threading.Lock()
+        _L2 = threading.Lock()
+
+        def fwd():
+            with _L1:
+                with _L2:
+                    pass
+
+        def inv_one():
+            with _L2:
+                with _L1:
+                    pass
+
+        def inv_two():
+            with _L2:
+                with _L1:
+                    pass
+        """
+    }
+    findings = [f for f in run_project(sources) if f.code == "HS009"]
+    # 1 forward witness + 2 inversion witnesses
+    assert len(findings) == 3
+    assert len({(f.path, f.line) for f in findings}) == 3
+
+
+def test_hs010_mutually_recursive_lock_free_readers_are_flagged():
+    # a() and b() only call each other: a self-supporting cycle must NOT
+    # count as called-with-lock-held (least fixpoint, not greatest)
+    sources = {
+        "pkg/m.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def w1(self):
+                with self._lock:
+                    self._count += 1
+
+            def w2(self):
+                with self._lock:
+                    self._count += 2
+
+            def a(self, n):
+                if n:
+                    self.b(n - 1)
+                return self._count
+
+            def b(self, n):
+                if n:
+                    self.a(n - 1)
+                return self._count
+        """
+    }
+    got = [f for f in run_project(sources) if f.code == "HS010"]
+    assert len(got) == 2  # both cycle members' lock-free reads surface

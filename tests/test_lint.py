@@ -58,7 +58,7 @@ def test_cli_json_format_and_failure_exit(tmp_path):
     assert payload["findings"][0]["code"] == "HS006"
 
 
-def test_cli_list_rules_names_all_seven():
+def test_cli_list_rules_names_all_thirteen():
     proc = subprocess.run(
         [sys.executable, "scripts/lint.py", "--list-rules"],
         cwd=REPO,
@@ -69,6 +69,7 @@ def test_cli_list_rules_names_all_seven():
     assert proc.returncode == 0
     for code in (
         "HS001", "HS002", "HS003", "HS004", "HS005", "HS006", "HS007",
+        "HS008", "HS009", "HS010", "HS011", "HS012", "HS013",
     ):
         assert code in proc.stdout
 
@@ -82,3 +83,175 @@ def test_cli_missing_path_is_usage_error():
         timeout=120,
     )
     assert proc.returncode == 2
+
+
+# --- whole-program phase: CLI contract and wall-time budget -----------------
+
+
+def test_full_tree_wall_time_budget():
+    """Both phases over the whole tree stay under the pre-commit budget
+    (<10 s on the dev container) — the property that keeps --changed
+    runs viable, since they pay the FULL model build. Best-of-two: one
+    measurement on a loaded CI box measures the neighbors, not the
+    analyzer."""
+    import time
+
+    from hyperspace_tpu.analysis import run_analysis
+
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        run_analysis([REPO / t for t in LINT_TARGETS])
+        best = min(best, time.perf_counter() - t0)
+        if best < 10.0:
+            break
+    assert best < 10.0, f"full-tree analysis took {best:.1f}s (budget 10s)"
+
+
+def test_project_phase_finds_cross_module_cycle(tmp_path):
+    """End-to-end through the CLI: a two-module A->B / B->A lock cycle
+    fires HS009 with --project (the default) and is invisible with
+    --no-project."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "import threading\n"
+        "from . import b\n"
+        "_A_LOCK = threading.Lock()\n"
+        "def locked_a():\n"
+        "    with _A_LOCK:\n"
+        "        pass\n"
+        "def do_a():\n"
+        "    with _A_LOCK:\n"
+        "        b.locked_b()\n",
+        encoding="utf-8",
+    )
+    (pkg / "b.py").write_text(
+        "import threading\n"
+        "from . import a\n"
+        "_B_LOCK = threading.Lock()\n"
+        "def locked_b():\n"
+        "    with _B_LOCK:\n"
+        "        pass\n"
+        "def do_b():\n"
+        "    with _B_LOCK:\n"
+        "        a.locked_a()\n",
+        encoding="utf-8",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts/lint.py"), "--format", "json",
+         str(pkg)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["by_code"] == {"HS009": 2}
+    proc2 = subprocess.run(
+        [sys.executable, str(REPO / "scripts/lint.py"), "--no-project",
+         str(pkg)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc2.returncode == 0
+
+
+def test_cli_default_paths_and_timings():
+    proc = subprocess.run(
+        [sys.executable, "scripts/lint.py", "--timings"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+    # per-rule timings (stderr): every project rule accounted for
+    for code in ("HS009", "HS010", "HS011", "HS012", "HS013", "project-model"):
+        assert code in proc.stderr
+
+
+def test_cli_call_graph_dump(tmp_path):
+    out = tmp_path / "cg.json"
+    proc = subprocess.run(
+        [sys.executable, "scripts/lint.py", "--call-graph-dump", str(out),
+         "hyperspace_tpu/serve"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(out.read_text(encoding="utf-8"))
+    assert set(payload) == {"functions", "locks", "modules"}
+    assert any(q.startswith("serve.server:QueryServer.") for q in payload["functions"])
+
+
+def test_cli_check_suppressions_clean_tree_and_stale_detection(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "scripts/lint.py", "--check-suppressions"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert ", 0 stale" in proc.stdout
+    stale = tmp_path / "stale.py"
+    stale.write_text(
+        "def f(x):\n"
+        "    return x  # hslint: disable=HS001\n",
+        encoding="utf-8",
+    )
+    proc2 = subprocess.run(
+        [sys.executable, str(REPO / "scripts/lint.py"),
+         "--check-suppressions", str(stale)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc2.returncode == 1
+    assert "HS001 no longer fires" in proc2.stdout
+
+
+def test_cli_changed_mode_filters_to_changed_files():
+    # HEAD as the ref: a clean worktree (or one where only non-.py files
+    # changed) reports nothing; the full model still builds — the mode's
+    # contract is filtering, not skipping analysis
+    proc = subprocess.run(
+        [sys.executable, "scripts/lint.py", "--changed", "HEAD"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    bad_ref = subprocess.run(
+        [sys.executable, "scripts/lint.py", "--changed",
+         "no-such-ref-anywhere"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert bad_ref.returncode == 2
+
+
+def test_cli_audit_and_dump_reject_no_project(tmp_path):
+    # auditing with project rules off would report live HS009+
+    # suppressions as stale; both combos are usage errors
+    for flag in (["--check-suppressions"], ["--call-graph-dump",
+                                            str(tmp_path / "cg.json")]):
+        proc = subprocess.run(
+            [sys.executable, "scripts/lint.py", "--no-project", *flag],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 2, (flag, proc.stdout, proc.stderr)
