@@ -236,6 +236,45 @@ class HyperspaceConf:
             queue_depth=max(1, int(self.get(C.BUILD_QUEUE_DEPTH, auto.queue_depth))),
         )
 
+    def residency_compression(self) -> str:
+        v = str(
+            self.get(C.RESIDENCY_COMPRESSION, C.RESIDENCY_COMPRESSION_DEFAULT)
+        ).lower()
+        if v not in C.RESIDENCY_COMPRESSION_MODES:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"Unknown {C.RESIDENCY_COMPRESSION}={v!r}; expected one of "
+                f"{C.RESIDENCY_COMPRESSION_MODES}."
+            )
+        return v
+
+    def residency_streaming(self) -> str:
+        v = str(
+            self.get(C.RESIDENCY_STREAMING, C.RESIDENCY_STREAMING_DEFAULT)
+        ).lower()
+        if v not in C.RESIDENCY_STREAMING_MODES:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"Unknown {C.RESIDENCY_STREAMING}={v!r}; expected one of "
+                f"{C.RESIDENCY_STREAMING_MODES}."
+            )
+        return v
+
+    def residency_window_rows(self) -> int:
+        return int(
+            self.get(
+                C.RESIDENCY_STREAMING_WINDOW_ROWS,
+                C.RESIDENCY_STREAMING_WINDOW_ROWS_DEFAULT,
+            )
+        )
+
+    def residency_for_delta(self) -> bool:
+        return self._to_bool(
+            self.get(C.RESIDENCY_FOR_DELTA, C.RESIDENCY_FOR_DELTA_DEFAULT)
+        )
+
     def distributed_min_rows(self) -> int:
         return int(
             self.get(

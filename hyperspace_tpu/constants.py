@@ -179,6 +179,42 @@ RELIABILITY_RETRY_BASE_DELAY_SECONDS_DEFAULT = 0.05
 RELIABILITY_RETRY_MAX_DELAY_SECONDS = "hyperspace.reliability.retry.maxDelaySeconds"
 RELIABILITY_RETRY_MAX_DELAY_SECONDS_DEFAULT = 2.0
 
+# --- residency tier ladder ---------------------------------------------------
+# Oversubscribed residency (docs/15-streaming-residency.md; no reference
+# analog — Spark leans on the OS page cache). The exec caches are
+# process-global, so these session knobs set process defaults via
+# HyperspaceSession (the residency.knobs module); the matching
+# HYPERSPACE_TPU_RESIDENCY_* env vars override both (hbm_cache style).
+# Compression: "auto" bit-packs code planes when the raw table exceeds
+# the HBM budget; "force" always packs packable columns (tests, and
+# deployments that prefer capacity over decode cost); "off" never packs.
+RESIDENCY_COMPRESSION = "hyperspace.residency.compression"
+RESIDENCY_COMPRESSION_AUTO = "auto"
+RESIDENCY_COMPRESSION_FORCE = "force"
+RESIDENCY_COMPRESSION_OFF = "off"
+RESIDENCY_COMPRESSION_MODES = (
+    RESIDENCY_COMPRESSION_AUTO,
+    RESIDENCY_COMPRESSION_FORCE,
+    RESIDENCY_COMPRESSION_OFF,
+)
+RESIDENCY_COMPRESSION_DEFAULT = RESIDENCY_COMPRESSION_AUTO
+# Streaming block-window tier: "auto" stages oversubscribed tables
+# through the double-buffered HBM slab pair; "off" refuses them (host
+# path) — the pre-PR-8 behavior.
+RESIDENCY_STREAMING = "hyperspace.residency.streaming"
+RESIDENCY_STREAMING_AUTO = "auto"
+RESIDENCY_STREAMING_OFF = "off"
+RESIDENCY_STREAMING_MODES = (RESIDENCY_STREAMING_AUTO, RESIDENCY_STREAMING_OFF)
+RESIDENCY_STREAMING_DEFAULT = RESIDENCY_STREAMING_AUTO
+# Rows per streamed window (padded up to the mask tile). Two windows'
+# device bytes are charged against the HBM budget — the fixed slab pair.
+RESIDENCY_STREAMING_WINDOW_ROWS = "hyperspace.residency.streaming.windowRows"
+RESIDENCY_STREAMING_WINDOW_ROWS_DEFAULT = 1 << 20
+# Frame-of-reference delta packing of the join regions' pre-sorted right
+# codes ("on"/"off").
+RESIDENCY_FOR_DELTA = "hyperspace.residency.forDelta"
+RESIDENCY_FOR_DELTA_DEFAULT = "on"
+
 # --- telemetry ---------------------------------------------------------------
 # (reference: telemetry/Constants.scala:20)
 EVENT_LOGGER_CLASS = "hyperspace.eventLoggerClass"

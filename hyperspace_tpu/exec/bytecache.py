@@ -28,15 +28,25 @@ def env_mb(name: str, default_mb: int) -> int:
     return env_int(name, default_mb) << 20
 
 
+def vocab_heap_bytes(vocab) -> int:
+    """Host-heap estimate of one string dictionary (bytes objects +
+    ~50 B python overhead per entry) — THE one copy of the heuristic
+    every residency-budget account reads (hbm/mesh tables, deltas' OOV
+    side tables, streaming columns); None counts as zero so call sites
+    don't re-spell the guard."""
+    if vocab is None:
+        return 0
+    return sum(len(v) + 50 for v in vocab)
+
+
 def batch_nbytes(batch) -> int:
     """Memory footprint of a ColumnarBatch INCLUDING string dictionaries
     — code arrays alone undercount string-heavy data by the whole vocab
-    heap (bytes objects + ~50B python overhead per entry)."""
+    heap (vocab_heap_bytes)."""
     n = 0
     for c in batch.columns.values():
         n += c.data.nbytes
-        if c.vocab is not None:
-            n += sum(len(v) + 50 for v in c.vocab)
+        n += vocab_heap_bytes(c.vocab)
     return n
 
 

@@ -402,6 +402,12 @@ def resolve_hybrid_residency(
     )
     if table is None:
         return HybridResidency("no_table", files)
+    if getattr(table, "tier", "resident") != "resident":
+        # compressed/streaming bases decline the fused hybrid path (the
+        # dispatch reads raw base planes); the host union stays exact —
+        # returning "ineligible" (not "no_delta") keeps the executor
+        # from scheduling a delta population that could never register
+        return HybridResidency("ineligible", files)
     delta = cache.delta_for(
         table, info.appended, pred_cols, info.deleted_ids
     )

@@ -134,6 +134,10 @@ class ResidentColumn:
     # index into (host-side — literals bind against it, it never uploads)
     vocab: Optional[np.ndarray] = None
     data2: Optional[object] = None  # f64 low plane (ops.floatbits)
+    # compressed tier only (ops.bitpack.PackSpec): ``data`` holds packed
+    # int32 WORDS and the counts executables fuse the decode — budget
+    # accounting charges the packed bytes (docs/15-streaming-residency.md)
+    pack: Optional[object] = None
 
 
 @dataclass
@@ -157,6 +161,11 @@ class ResidentTable:
         default_factory=dict
     )
     last_used: float = field(default_factory=time.monotonic)
+    # residency tier ladder (docs/15-streaming-residency.md): "resident"
+    # (raw planes) or "compressed" (bit-packed planes); the streaming
+    # tier registers its own table type (residency.streaming)
+    tier: str = "resident"
+    raw_nbytes: int = 0  # what the planes would cost raw (observability)
 
     def file_span(self, path: str) -> Optional[Tuple[int, int]]:
         for p, start, n in self.files:
@@ -387,17 +396,59 @@ def resident_arrays_for(
     return out
 
 
+def resident_specs_for(
+    columns: Dict[str, "ResidentColumn"], names: Tuple[str, ...]
+) -> tuple:
+    """Per-name PackSpec (or None for raw planes), aligned with
+    resident_arrays_for's order — the static decode half of a compressed
+    table's operands (f64 planes always ride raw; only single-plane
+    columns pack)."""
+    out = []
+    for n in names:
+        if "\x00" in n:
+            out.append(None)
+        else:
+            out.append(getattr(columns[n], "pack", None))
+    return tuple(out)
+
+
+def _flatten_operands(names, cols, specs):
+    """Traced flattening of the counts executables' operands: raw planes
+    reshape, packed planes decode in place (ops.bitpack) — decompression
+    never leaves the executable, so it never round-trips to host."""
+    from ..ops.bitpack import unpack_plain_jnp
+
+    out = {}
+    for n, c, s in zip(names, cols, specs):
+        out[n] = c.reshape(-1) if s is None else unpack_plain_jnp(c, s)
+    return out
+
+
 _counts_fn_cache: dict = {}
 _counts_fn_lock = threading.Lock()
 
 
-def _counts_fn(narrowed: Expr, names: tuple, n_rows128: int, use_pallas: bool):
+def _counts_fn(
+    narrowed: Expr,
+    names: tuple,
+    n_rows128: int,
+    use_pallas: bool,
+    specs: Optional[tuple] = None,
+):
     """Jitted (device cols) -> int32 per-block match counts; the mask is
     the Pallas kernel when available, XLA elementwise otherwise, and the
-    block reduction fuses behind it in the same executable."""
+    block reduction fuses behind it in the same executable. ``specs``
+    (per-name PackSpec/None) routes compressed planes through the fused
+    in-executable decode — the Pallas kernel never sees packed words, so
+    callers pass use_pallas=False alongside any non-None spec."""
     from ..ops import kernels as K
 
-    key = (repr(narrowed), names, n_rows128, use_pallas, K.kernels_mode())
+    if specs is None:
+        specs = tuple(None for _ in names)
+    key = (
+        repr(narrowed), names, n_rows128, use_pallas, specs,
+        K.kernels_mode(),
+    )
     with _counts_fn_lock:
         fn = _counts_fn_cache.get(key)
         if fn is not None:
@@ -421,7 +472,7 @@ def _counts_fn(narrowed: Expr, names: tuple, n_rows128: int, use_pallas: bool):
         )
 
         def counts(cols):
-            arrays = {n: c.reshape(-1) for n, c in zip(names, cols)}
+            arrays = _flatten_operands(names, cols, specs)
             m = eval_mask(narrowed, shim, arrays)
             return jnp.sum(
                 m.reshape(-1, BLOCK_ROWS).astype(jnp.int32), axis=1
@@ -548,12 +599,14 @@ _batch_fns = BoundedFnCache()
 
 
 def _batched_counts_fn(structures: tuple, slot_names: tuple, exprs: list,
-                       n_rows128: int):
+                       n_rows128: int, spec_map: Optional[tuple] = None):
     """Jitted (cols dict, per-slot literal vectors) -> (N, n_blocks) int32
     count matrix, one executable for the whole batch. ``exprs`` supplies
     the structure at trace time only — literal values are traced operands,
-    so the cache key is (structures, slot_names, n_rows128)."""
-    key = (structures, slot_names, n_rows128)
+    so the cache key is (structures, slot_names, n_rows128, spec_map).
+    ``spec_map`` (tuple of (name, PackSpec/None) pairs) routes compressed
+    planes through the fused in-executable decode, once per union name."""
+    key = (structures, slot_names, n_rows128, spec_map)
     fn = _batch_fns.get(key)
     if fn is not None:
         return fn
@@ -563,11 +616,18 @@ def _batched_counts_fn(structures: tuple, slot_names: tuple, exprs: list,
 
     exprs = list(exprs)  # pin the trace-time structures
     names_per_slot = list(slot_names)
+    specs_by_name = dict(spec_map or ())
 
     def batched(col_arrays: dict, lit_vecs: tuple):
+        union = tuple(col_arrays)
+        flat_all = _flatten_operands(
+            union,
+            [col_arrays[n] for n in union],
+            tuple(specs_by_name.get(n) for n in union),
+        )
         outs = []
         for expr, names, lits in zip(exprs, names_per_slot, lit_vecs):
-            flat = {n: col_arrays[n].reshape(-1) for n in names}
+            flat = {n: flat_all[n] for n in names}
             mask = _eval_with_literals(expr, flat, lits, [0])
             outs.append(
                 jnp.sum(mask.reshape(-1, BLOCK_ROWS).astype(jnp.int32), axis=1)
@@ -1055,6 +1115,40 @@ class ResidentCacheBase:
         with self._lock:
             return self._epoch
 
+    def snapshot_residency(self) -> dict:
+        """The tier-ladder surface (docs/15-streaming-residency.md):
+        which tier each table landed on, what compression bought
+        (budget-charged vs raw bytes), and the streaming tables' window
+        state — consumed by server.stats()["residency"] next to the
+        process-wide counter family (telemetry.residency_snapshot)."""
+        with self._lock:
+            per = []
+            for t in self._tables:
+                tier = getattr(t, "tier", "resident")
+                row = {
+                    "tier": tier,
+                    "rows": t.n_rows,
+                    "columns": sorted(t.columns),
+                    "mb": round(t.nbytes / 1e6, 1),
+                }
+                raw = getattr(t, "raw_nbytes", 0)
+                if raw:
+                    row["raw_mb"] = round(raw / 1e6, 1)
+                if tier == "streaming":
+                    row["windows"] = t.n_windows
+                    row["window_rows"] = t.window_rows
+                    row["window_gen"] = t.window_gen
+                    row["host_mb"] = round(t.host_bytes / 1e6, 1)
+                per.append(row)
+            tiers: Dict[str, int] = {}
+            for row in per:
+                tiers[row["tier"]] = tiers.get(row["tier"], 0) + 1
+            return {
+                "tables": per,
+                "by_tier": tiers,
+                "budget_mb": _budget_bytes() >> 20,
+            }
+
 
 class HbmIndexCache(ResidentCacheBase):
     """Device-side column cache over immutable TCB index files, LRU-bounded
@@ -1220,13 +1314,17 @@ class HbmIndexCache(ResidentCacheBase):
         if n_rows == 0:
             return None, True
         n_pad = -(-n_rows // _TILE_ELEMS) * _TILE_ELEMS
-        # budget pre-check BEFORE any read or upload: every resident
+        # budget pre-check BEFORE any read or upload: every raw resident
         # column costs exactly n_pad * 4 bytes on device (string columns
-        # upload CODES only — the global vocab stays host-side), so an
-        # over-budget table is knowable upfront — refusing after the H2D
-        # would waste the full multi-GB transfer on a thin link. float64
-        # columns cost TWO int32 planes (ops.floatbits two-plane ordered
-        # encoding).
+        # upload CODES only — the global vocab stays host-side; float64
+        # columns cost TWO int32 planes). A raw-over-budget table is only
+        # refused HERE when the tier ladder below it is switched off —
+        # with compression or streaming enabled, oversubscription is what
+        # the ladder exists for (docs/15-streaming-residency.md), and the
+        # read cost runs on the background populate thread.
+        from ..residency import knobs as _rknobs
+        from .bytecache import vocab_heap_bytes
+
         dtype_of = {
             m["name"]: m["dtype"] for m in readers[0].footer["columns"]
         }
@@ -1246,19 +1344,24 @@ class HbmIndexCache(ResidentCacheBase):
                         None,
                     )
                     if m is not None:
-                        vocab_est += sum(len(v) + 50 for v in m.get("vocab", ()))
+                        vocab_est += vocab_heap_bytes(m.get("vocab", ()))
         planes = sum(
             2 if dtype_of[c] == "float64" else 1 for c in encodable
         )
-        if planes * n_pad * 4 + vocab_est > _budget_bytes():
+        ladder_open = (
+            _rknobs.compression_mode() != "off"
+            or _rknobs.streaming_enabled()
+        )
+        if planes * n_pad * 4 + vocab_est > _budget_bytes() and not ladder_open:
             metrics.incr("hbm.over_budget_refused")
             return None, False
 
-        import jax
-
-        cols: Dict[str, ResidentColumn] = {}
+        # --- encode phase: host planes only, no uploads yet ----------------
+        # name -> (dtype_str, enc, vocab, {plane_key: int np flat of
+        # n_rows values}); plane_key '' for single-plane columns,
+        # 'hi'/'lo' for the f64 ordered pair
+        host_planes: Dict[str, tuple] = {}
         zones: Dict[str, Tuple[str, np.ndarray, np.ndarray]] = {}
-        nbytes = 0
         for name in encodable:
             enc = None
             vocab = None
@@ -1311,34 +1414,28 @@ class HbmIndexCache(ResidentCacheBase):
                     lo_parts.append(e[1])
                 if not ok:
                     continue
-                flat_hi = np.zeros(n_pad, dtype=np.int32)
-                flat_lo = np.zeros(n_pad, dtype=np.int32)
-                flat_hi[:n_rows] = (
+                flat_hi = (
                     np.concatenate(hi_parts)
                     if len(hi_parts) > 1
                     else hi_parts[0]
                 )
-                flat_lo[:n_rows] = (
+                flat_lo = (
                     np.concatenate(lo_parts)
                     if len(lo_parts) > 1
                     else lo_parts[0]
                 )
-                dev_hi = jax.device_put(flat_hi.reshape(n_pad // _LANES, _LANES))
-                dev_lo = jax.device_put(flat_lo.reshape(n_pad // _LANES, _LANES))
-                col_bytes = flat_hi.nbytes + flat_lo.nbytes
-                cols[name] = ResidentColumn(
-                    dev_hi, "float64", "f64", col_bytes, None, dev_lo
-                )
                 # zone vectors in ordered-i64 space (monotone with the
                 # float order, so bound compares are exact-conservative)
-                ordered = (flat_hi[:n_rows].astype(np.int64) << 32) | (
+                ordered = (flat_hi.astype(np.int64) << 32) | (
                     np.bitwise_xor(
-                        flat_lo[:n_rows].view(np.uint32), np.uint32(0x80000000)
+                        flat_lo.view(np.uint32), np.uint32(0x80000000)
                     ).astype(np.int64)
                 )
                 zlo, zhi = _block_zones(ordered)
                 zones[name] = ("f64ord", zlo, zhi)
-                nbytes += col_bytes
+                host_planes[name] = (
+                    "float64", "f64", None, {"hi": flat_hi, "lo": flat_lo}
+                )
                 continue
             else:
                 parts = []
@@ -1357,26 +1454,119 @@ class HbmIndexCache(ResidentCacheBase):
                     parts.append(a)
                 if not ok or enc is None:
                     continue
-            flat = np.zeros(n_pad, dtype=np.int32)
-            flat[:n_rows] = np.concatenate(parts) if len(parts) > 1 else parts[0]
-            dev = jax.device_put(flat.reshape(n_pad // _LANES, _LANES))
-            # accounted bytes include the HOST-side vocab heap: the LRU
-            # and budget then bound the table's total footprint, not just
-            # its device half
-            col_bytes = flat.nbytes + (
-                sum(len(v) + 50 for v in vocab) if vocab is not None else 0
-            )
-            cols[name] = ResidentColumn(
-                dev, dtype_of[name], enc, col_bytes, vocab
-            )
+            flat = np.concatenate(parts) if len(parts) > 1 else parts[0]
             if enc == "int":
                 # int narrowing is value-preserving, so the i32 flat IS
                 # the original value space for zone compares
-                zlo, zhi = _block_zones(flat[:n_rows])
+                zlo, zhi = _block_zones(flat)
                 zones[name] = ("value", zlo, zhi)
-            nbytes += col_bytes
-        if not cols:
+            host_planes[name] = (dtype_of[name], enc, vocab, {"": flat})
+        if not host_planes:
             return None, True  # nothing encoded (e.g. NaN float32 data)
+
+        # --- tier plan: the ONE ladder procedure (residency.tiers) ----------
+        from ..ops import bitpack
+        from ..residency import plan_tier
+
+        pack_specs = {}
+        raw_plane_bytes = 0
+        unpacked_bytes = 0
+        side_bytes = 0
+        for name, (_dts, enc, vocab, planes_d) in host_planes.items():
+            if vocab is not None:
+                side_bytes += vocab_heap_bytes(vocab)
+            raw_plane_bytes += len(planes_d) * n_pad * 4
+            spec = None
+            if len(planes_d) == 1:
+                flat = planes_d[""]
+                if flat.size:
+                    spec = bitpack.pack_spec(
+                        int(flat.min()), int(flat.max()), n_pad
+                    )
+            if spec is not None:
+                pack_specs[name] = spec
+            else:
+                unpacked_bytes += len(planes_d) * n_pad * 4
+        plan = plan_tier(
+            raw_plane_bytes,
+            _budget_bytes(),
+            pack_specs,
+            unpacked_bytes,
+            side_bytes,
+            streaming_ok=True,
+        )
+        if plan.tier == "host":
+            metrics.incr("hbm.over_budget_refused")
+            return None, False
+        if plan.tier == "streaming":
+            from ..residency.streaming import build_streaming_table
+
+            table = build_streaming_table(
+                key,
+                spans,
+                n_rows,
+                host_planes,
+                zones,
+                plan.specs,
+                _rknobs.streaming_window_rows(),
+            )
+            if table.nbytes > _budget_bytes():
+                # even the slab pair cannot fit: genuinely no device tier
+                metrics.incr("hbm.over_budget_refused")
+                return None, False
+            metrics.incr("residency.tier.streaming_built")
+            metrics.record_time("hbm.prefetch", time.perf_counter() - t0)
+            return table, False
+
+        # --- materialize: resident (raw planes) or compressed (packed) -----
+        import jax
+
+        cols: Dict[str, ResidentColumn] = {}
+        nbytes = 0
+        for name, (dts, enc, vocab, planes_d) in host_planes.items():
+            vocab_heap = vocab_heap_bytes(vocab)
+            if enc == "f64":
+                flat_hi = np.zeros(n_pad, dtype=np.int32)
+                flat_lo = np.zeros(n_pad, dtype=np.int32)
+                flat_hi[:n_rows] = planes_d["hi"]
+                flat_lo[:n_rows] = planes_d["lo"]
+                dev_hi = jax.device_put(
+                    flat_hi.reshape(n_pad // _LANES, _LANES)
+                )
+                dev_lo = jax.device_put(
+                    flat_lo.reshape(n_pad // _LANES, _LANES)
+                )
+                col_bytes = flat_hi.nbytes + flat_lo.nbytes
+                cols[name] = ResidentColumn(
+                    dev_hi, dts, "f64", col_bytes, None, dev_lo
+                )
+                nbytes += col_bytes
+                continue
+            spec = plan.specs.get(name)
+            if spec is not None:
+                # compressed plane: pad rows encode the frame reference
+                # (in-range garbage clipped by the host leg, like the
+                # zero pads of the raw planes)
+                padded = np.full(n_pad, spec.ref0, dtype=np.int64)
+                padded[:n_rows] = planes_d[""]
+                words = bitpack.pack_plain(padded, spec)
+                dev = jax.device_put(
+                    words.reshape(len(words) // _LANES, _LANES)
+                )
+                col_bytes = words.nbytes + vocab_heap
+                cols[name] = ResidentColumn(
+                    dev, dts, enc, col_bytes, vocab, None, spec
+                )
+            else:
+                flat = np.zeros(n_pad, dtype=np.int32)
+                flat[:n_rows] = planes_d[""]
+                dev = jax.device_put(flat.reshape(n_pad // _LANES, _LANES))
+                # accounted bytes include the HOST-side vocab heap: the
+                # LRU and budget then bound the table's total footprint,
+                # not just its device half
+                col_bytes = flat.nbytes + vocab_heap
+                cols[name] = ResidentColumn(dev, dts, enc, col_bytes, vocab)
+            nbytes += col_bytes
         try:
             # materializing chain fence: on the tunneled backend
             # block_until_ready acks enqueue, which would close the
@@ -1394,9 +1584,25 @@ class HbmIndexCache(ResidentCacheBase):
         if nbytes > _budget_bytes():
             metrics.incr("hbm.over_budget_refused")
             return None, False
+        if plan.tier == "compressed":
+            metrics.incr("residency.tier.compressed_built")
+            metrics.incr("residency.compressed.packed_bytes", nbytes)
+            metrics.incr(
+                "residency.compressed.raw_bytes", raw_plane_bytes + side_bytes
+            )
         metrics.record_time("hbm.prefetch", time.perf_counter() - t0)
         return (
-            ResidentTable(key, spans, n_rows, n_pad, cols, nbytes, zones),
+            ResidentTable(
+                key,
+                spans,
+                n_rows,
+                n_pad,
+                cols,
+                nbytes,
+                zones,
+                tier=plan.tier,
+                raw_nbytes=raw_plane_bytes + side_bytes,
+            ),
             False,
         )
 
@@ -1444,9 +1650,15 @@ class HbmIndexCache(ResidentCacheBase):
         """Per-BLOCK_ROWS match counts for ``predicate`` over the resident
         table — ONE device round trip, count-vector-sized D2H. None when
         the predicate does not narrow to the resident encodings (caller
-        routes host)."""
+        routes host). Tier-transparent: compressed tables fuse the
+        bitpack decode into the same executable; streaming tables run the
+        double-buffered window loop (residency.streaming)."""
         from ..ops import kernels as K
 
+        if getattr(table, "tier", "resident") == "streaming":
+            from ..residency.streaming import stream_block_counts
+
+            return stream_block_counts(table, predicate)
         # bind (string vocab) -> expand (f64 two-plane) -> narrow (i32):
         # the shared resident pipeline; None = predicate can't ride the
         # resident encodings, caller routes host
@@ -1454,8 +1666,13 @@ class HbmIndexCache(ResidentCacheBase):
         if prepared is None:
             return None
         narrowed, names = prepared
-        use_pallas = K.kernels_mode() != "off"
-        fn = _counts_fn(narrowed, names, table.n_pad // _LANES, use_pallas)
+        specs = resident_specs_for(table.columns, names)
+        # the Pallas mask kernel reads raw planes only — packed words
+        # route through the XLA branch's fused decode
+        use_pallas = K.kernels_mode() != "off" and not any(specs)
+        fn = _counts_fn(
+            narrowed, names, table.n_pad // _LANES, use_pallas, specs
+        )
         cols = resident_arrays_for(table.columns, names)
         t0 = time.perf_counter()
         with K._x32():
@@ -1482,7 +1699,12 @@ class HbmIndexCache(ResidentCacheBase):
         would double the hot path). None when ANY predicate fails to
         narrow to the resident encodings (the caller serves that batch
         per-query instead; mixing one host-routed straggler into a device
-        batch would force a second dispatch anyway)."""
+        batch would force a second dispatch anyway). Tier-transparent
+        like block_counts: streaming tables window the whole batch."""
+        if getattr(table, "tier", "resident") == "streaming":
+            from ..residency.streaming import stream_block_counts_batch
+
+            return stream_block_counts_batch(table, predicates, prepared)
         if prepared is None:
             prepared = [
                 prepare_resident_predicate(table.columns, p)
@@ -1492,16 +1714,19 @@ class HbmIndexCache(ResidentCacheBase):
             return None
         structures = tuple(_expr_structure(n) for n, _ in prepared)
         slot_names = tuple(names for _, names in prepared)
+        # the union of every slot's (possibly plane-suffixed) columns,
+        # passed once — slots index into the shared dict
+        union_names = tuple(
+            dict.fromkeys(n for names in slot_names for n in names)
+        )
         fn = _batched_counts_fn(
             structures,
             slot_names,
             [n for n, _ in prepared],
             table.n_pad // _LANES,
-        )
-        # the union of every slot's (possibly plane-suffixed) columns,
-        # passed once — slots index into the shared dict
-        union_names = tuple(
-            dict.fromkeys(n for names in slot_names for n in names)
+            tuple(
+                zip(union_names, resident_specs_for(table.columns, union_names))
+            ),
         )
         cols = dict(
             zip(union_names, resident_arrays_for(table.columns, union_names))
@@ -1659,9 +1884,16 @@ class HbmIndexCache(ResidentCacheBase):
         deletion bitmask derived from the base files' lineage column."""
         from ..storage import parquet_io
         from ..utils.deviceprobe import first_device_touch_ok
-        from .bytecache import batch_nbytes
+        from .bytecache import batch_nbytes, vocab_heap_bytes
         from .delta import encode_delta_columns
 
+        if getattr(table, "tier", "resident") != "resident":
+            # the fused hybrid dispatch reads the base's RAW planes; a
+            # compressed/streaming base cannot anchor a delta region for
+            # this epoch (structural for the version — memoized), and
+            # resolve_hybrid_residency already routes such queries host
+            metrics.incr(f"{self._metric_prefix}.delta.declined.tier")
+            return None, True
         if not first_device_touch_ok():
             metrics.incr(f"{self._metric_prefix}.device_unreachable")
             return None, False
@@ -1721,9 +1953,7 @@ class HbmIndexCache(ResidentCacheBase):
         if not flats:
             return None, True
         host_bytes = batch_nbytes(host_batch)
-        oov_bytes = sum(
-            sum(len(v) + 50 for v in side) for side in oov.values()
-        )
+        oov_bytes = sum(vocab_heap_bytes(side) for side in oov.values())
         mask_bytes = table.n_pad * 4 if dels else 0
         dev_bytes = planes * n_pad * 4 + mask_bytes
         # headroom, not the whole budget: tables and deltas share the one
@@ -2128,12 +2358,18 @@ class HbmIndexCache(ResidentCacheBase):
         — ONE device dispatch over the resident codes, zero per-query
         H2D; left row i matches sorted-right positions [lo[i],
         lo[i]+counts[i]) which region.r_order maps back to rows. Device
-        errors propagate (the caller latches down to the host join)."""
-        from .join_residency import ranges_fn
+        errors propagate (the caller latches down to the host join).
+        FoR-delta-packed regions route through the fused-decode twin —
+        same protocol, smaller resident footprint."""
+        from .join_residency import ranges_fn, ranges_fn_packed
 
-        fn = ranges_fn()
         t0 = time.perf_counter()
-        lo, counts = fn(region.l_codes, region.r_codes)
+        if getattr(region, "r_pack", None) is not None:
+            fn = ranges_fn_packed(region.r_pack)
+            lo, counts = fn(region.l_codes, region.r_codes, region.r_refs)
+        else:
+            fn = ranges_fn()
+            lo, counts = fn(region.l_codes, region.r_codes)
         lo = np.asarray(lo)
         counts = np.asarray(counts)
         metrics.record_time(
@@ -2164,14 +2400,24 @@ class HbmIndexCache(ResidentCacheBase):
         if plan is None:
             metrics.incr(f"{self._metric_prefix}.join.declined.dtype")
             return None
-        fn = join_agg_fn(plan, region.n_l, region.n_r)
+        r_pack = getattr(region, "r_pack", None)
+        fn = join_agg_fn(plan, region.n_l, region.n_r, r_pack)
         arrays = plan_device_arrays(region, plan)
         slots = region.l_cols[plan.group].slots
         t0 = time.perf_counter()
         # x64 scope: the segment sums accumulate int64/float64 — exact
         # int arithmetic is the parity contract (module docstring)
         with enable_x64(True):
-            raw = fn(region.l_codes, region.r_codes, slots, arrays)
+            if r_pack is not None:
+                raw = fn(
+                    region.l_codes,
+                    region.r_codes,
+                    region.r_refs,
+                    slots,
+                    arrays,
+                )
+            else:
+                raw = fn(region.l_codes, region.r_codes, slots, arrays)
         outs = [np.asarray(o) for o in raw]
         metrics.record_time(
             "scan.resident_join_agg.device", time.perf_counter() - t0
@@ -2205,6 +2451,7 @@ class HbmIndexCache(ResidentCacheBase):
                         "rows": t.n_rows,
                         "columns": sorted(t.columns),
                         "mb": round(t.nbytes / 1e6, 1),
+                        "tier": getattr(t, "tier", "resident"),
                     }
                     for t in self._tables
                 ],

@@ -118,13 +118,21 @@ class JoinRegion:
     n_l: int
     n_r: int
     l_codes: object  # device i32 (n_l,)
-    r_codes: object  # device i32 (n_r,), globally sorted
+    # globally sorted right codes. FoR-delta packed when the codec wins
+    # (ops.bitpack.for_spec over the sorted stream — the PR-5 global
+    # sort is exactly what makes per-block offsets small): ``r_codes``
+    # then holds the packed WORDS, ``r_refs`` the per-block references,
+    # and the dispatch executables fuse the decode ahead of their
+    # searchsorted — budget accounting charges packed bytes.
+    r_codes: object  # device i32: (n_r,) raw, or packed words
     r_order: np.ndarray  # host: sorted position -> original right row
     uniq_right: bool  # right codes unique (the FK->PK / Q17 shape)
     l_cols: Dict[str, JoinPayloadColumn]
     r_cols: Dict[str, JoinPayloadColumn]  # pre-permuted by r_order
     nbytes: int = 0
     last_used: float = field(default_factory=time.monotonic)
+    r_pack: Optional[object] = None  # ops.bitpack.PackSpec (FoR) or None
+    r_refs: Optional[object] = None  # device i32 (n_r // block,) refs
 
 
 @dataclass
@@ -431,8 +439,30 @@ def build_join_region(
         bool((np.diff(r_sorted) > 0).all()) if len(r_sorted) > 1 else True
     )
     n_l, n_r = l_all.num_rows, r_all.num_rows
+    # FoR-delta the sorted right codes when the codec wins: the global
+    # sort bounds every in-block offset, so dense code domains (the
+    # FK->PK shape) pack to a fraction of the raw plane and the budget
+    # charge shrinks accordingly (hyperspace.residency.forDelta)
+    r_pack = None
+    r_pack_host = None
+    from ..residency import for_delta_enabled
+
+    if for_delta_enabled() and n_r:
+        from ..ops import bitpack
+
+        fspec = bitpack.for_spec(r_sorted, block=128)
+        if fspec is not None and fspec.packed_nbytes < r_sorted.nbytes:
+            r_pack = fspec
+            r_pack_host = bitpack.pack_for(r_sorted, fspec)
+            metrics.incr(f"{pfx}.join.for_delta_packed")
+            metrics.incr(
+                f"{pfx}.join.for_delta_saved_bytes",
+                int(r_sorted.nbytes) - int(fspec.packed_nbytes),
+            )
     specs = _payload_specs(l_all, r_all, payload_columns, n_l)
-    dev_bytes = 4 * (n_l + n_r)
+    dev_bytes = 4 * n_l + (
+        r_pack.packed_nbytes if r_pack is not None else 4 * n_r
+    )
     for _side, _name, planes, _e, service in specs:
         dev_bytes += sum(int(p.nbytes) for p in planes)
         if service is not None:
@@ -452,8 +482,14 @@ def build_join_region(
 
     try:
         dev_l = jax.device_put(l32)
-        dev_r = jax.device_put(r_sorted)
-        fences = [dev_l, dev_r]
+        dev_refs = None
+        if r_pack is not None:
+            words, refs = r_pack_host
+            dev_r = jax.device_put(words)
+            dev_refs = jax.device_put(refs)
+        else:
+            dev_r = jax.device_put(r_sorted)
+        fences = [dev_l, dev_r] + ([dev_refs] if dev_refs is not None else [])
         l_cols: Dict[str, JoinPayloadColumn] = {}
         r_cols: Dict[str, JoinPayloadColumn] = {}
         for side, name, planes, enc_s, service in specs:
@@ -495,6 +531,8 @@ def build_join_region(
             l_cols,
             r_cols,
             dev_bytes + host_bytes,
+            r_pack=r_pack,
+            r_refs=dev_refs,
         ),
         False,
     )
@@ -902,10 +940,40 @@ def ranges_fn():
     return _RANGES_FN
 
 
-def join_agg_fn(plan: AggPlan, n_l: int, n_r: int):
+def ranges_fn_packed(r_pack):
+    """The FoR-delta twin of ranges_fn: (l_codes, r_words, r_refs) ->
+    (lo, counts), the decode fused ahead of the searchsorted in the SAME
+    executable — decompression never round-trips to host. Memoized per
+    PackSpec (the decode structure) in the shared bounded cache."""
+    key = ("jranges-for", r_pack)
+    memo = _fn_cache()
+    fn = memo.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.bitpack import unpack_for_jnp
+
+    def body(l_codes, r_words, r_refs):
+        r_codes = unpack_for_jnp(r_words, r_refs, r_pack)
+        lo = jnp.searchsorted(r_codes, l_codes, side="left")
+        hi = jnp.searchsorted(r_codes, l_codes, side="right")
+        return lo.astype(jnp.int32), (hi - lo).astype(jnp.int32)
+
+    fn = jax.jit(body)
+    memo.put(key, fn)
+    return fn
+
+
+def join_agg_fn(plan: AggPlan, n_l: int, n_r: int, r_pack=None):
     """Jitted fused join-aggregate for the single-chip cache, memoized
-    on the plan STRUCTURE + shapes (hbm_cache compile-cache discipline)."""
-    key = ("jagg1", plan.signature(), n_l, n_r)
+    on the plan STRUCTURE + shapes (hbm_cache compile-cache discipline).
+    With ``r_pack`` set the signature grows a refs operand and the FoR
+    decode fuses ahead of the sorted-intersection (ranges_fn_packed
+    rationale)."""
+    key = ("jagg1", plan.signature(), n_l, n_r, r_pack)
     memo = _fn_cache()
     fn = memo.get(key)
     if fn is not None:
@@ -917,11 +985,23 @@ def join_agg_fn(plan: AggPlan, n_l: int, n_r: int):
     specs = [(c.side, c.enc, c.arity, c.ops) for c in plan.cols]
     span, uniq = plan.span, plan.uniq_right
 
-    def body(l_codes, r_codes, slots, flat):
-        outs, _ = _core_agg(
-            jnp, jax, specs, span, uniq, l_codes, r_codes, slots, flat
-        )
-        return tuple(outs)
+    if r_pack is not None:
+        from ..ops.bitpack import unpack_for_jnp
+
+        def body(l_codes, r_words, r_refs, slots, flat):
+            r_codes = unpack_for_jnp(r_words, r_refs, r_pack)
+            outs, _ = _core_agg(
+                jnp, jax, specs, span, uniq, l_codes, r_codes, slots, flat
+            )
+            return tuple(outs)
+
+    else:
+
+        def body(l_codes, r_codes, slots, flat):
+            outs, _ = _core_agg(
+                jnp, jax, specs, span, uniq, l_codes, r_codes, slots, flat
+            )
+            return tuple(outs)
 
     fn = jax.jit(body)
     memo.put(key, fn)

@@ -84,6 +84,10 @@ class MeshResidentColumn:
     nbytes: int
     vocab: Optional[np.ndarray] = None  # host-side global vocab (strings)
     data2: Optional[object] = None  # f64 low plane (ops.floatbits)
+    # compressed tier (ops.bitpack.PackSpec over ONE device shard's cap
+    # values — every shard shares the global frame, so one static spec
+    # serves the whole mesh): ``data`` holds (D, cap // vpw) packed words
+    pack: Optional[object] = None
 
 
 # one device's slice of one file: rows [file_lo, file_hi) of ``path`` live
@@ -136,6 +140,11 @@ class MeshResidentTable:
     n_rows: int
     nbytes: int
     last_used: float = field(default_factory=time.monotonic)
+    # tier ladder: "resident" or "compressed" only — the streaming tier
+    # is single-chip (a mesh table that large should shard wider; the
+    # decline is counted as hbm.mesh.residency.streaming_declined)
+    tier: str = "resident"
+    raw_nbytes: int = 0
 
     @property
     def n_blocks(self) -> int:
@@ -182,10 +191,14 @@ _counts_fn_lock = threading.Lock()
 
 
 def _mesh_counts_fn(mesh, bound_repr: str, bound: Expr, names: tuple,
-                    cap: int, block: int):
+                    cap: int, block: int, specs: Optional[tuple] = None):
     """Jitted shard_map: (dict of (D, cap) i32) -> (D, cap // block) i32
-    per-block match counts, one device round trip for the whole mesh."""
-    key = (mesh, bound_repr, names, cap, block)
+    per-block match counts, one device round trip for the whole mesh.
+    ``specs`` (per-name PackSpec/None, hbm_cache._counts_fn contract)
+    routes compressed shards through the fused in-shard decode."""
+    if specs is None:
+        specs = tuple(None for _ in names)
+    key = (mesh, bound_repr, names, cap, block, specs)
     with _counts_fn_lock:
         fn = _counts_fn_cache.get(key)
         if fn is not None:
@@ -196,6 +209,7 @@ def _mesh_counts_fn(mesh, bound_repr: str, bound: Expr, names: tuple,
     from jax.sharding import PartitionSpec
 
     from ..utils.jaxcompat import shard_map
+    from .hbm_cache import _flatten_operands
 
     shim = ColumnarBatch(
         {name: Column("int32", np.empty(0, dtype=np.int32)) for name in names}
@@ -203,7 +217,9 @@ def _mesh_counts_fn(mesh, bound_repr: str, bound: Expr, names: tuple,
     axis = mesh.axis_names[0]
 
     def shard_fn(arrays):
-        flat = {n: a.reshape(-1) for n, a in arrays.items()}
+        flat = _flatten_operands(
+            names, [arrays[n] for n in names], specs
+        )
         m = eval_mask(bound, shim, flat)
         return jnp.sum(
             m.reshape(cap // block, block).astype(jnp.int32), axis=1
@@ -227,17 +243,19 @@ def _mesh_counts_fn(mesh, bound_repr: str, bound: Expr, names: tuple,
 
 
 def _mesh_batched_counts_fn(mesh, structures: tuple, slot_names: tuple,
-                            exprs: list, cap: int, block: int):
+                            exprs: list, cap: int, block: int,
+                            spec_map: Optional[tuple] = None):
     """Jitted shard_map evaluating N predicate masks per device shard and
     reducing each to per-block counts: (cols dict, per-slot literal
     vectors) -> (D, N, cap // block) int32, one mesh round trip for the
     whole batch. Keyed on predicate STRUCTURE — literals are traced
     operands (hbm_cache._batched_counts_fn rationale); the memo is
     hbm_cache's shared BoundedFnCache (one compile-cache discipline for
-    both entry points)."""
+    both entry points). ``spec_map`` decodes compressed shards in-shard
+    (hbm_cache._batched_counts_fn contract)."""
     from .hbm_cache import _batch_fns
 
-    key = (mesh, structures, slot_names, cap, block)
+    key = (mesh, structures, slot_names, cap, block, spec_map)
     fn = _batch_fns.get(key)
     if fn is not None:
         return fn
@@ -247,7 +265,7 @@ def _mesh_batched_counts_fn(mesh, structures: tuple, slot_names: tuple,
     from jax.sharding import PartitionSpec
 
     from ..utils.jaxcompat import shard_map
-    from .hbm_cache import _eval_with_literals
+    from .hbm_cache import _eval_with_literals, _flatten_operands
 
     exprs = list(exprs)
     names_per_slot = list(slot_names)
@@ -255,9 +273,14 @@ def _mesh_batched_counts_fn(mesh, structures: tuple, slot_names: tuple,
     union_names = tuple(
         dict.fromkeys(n for names in slot_names for n in names)
     )
+    specs_by_name = dict(spec_map or ())
 
     def shard_fn(arrays, lit_vecs):
-        flat = {n: a.reshape(-1) for n, a in arrays.items()}
+        flat = _flatten_operands(
+            tuple(arrays),
+            [arrays[n] for n in arrays],
+            tuple(specs_by_name.get(n) for n in arrays),
+        )
         outs = []
         for expr, names, lits in zip(exprs, names_per_slot, lit_vecs):
             mask = _eval_with_literals(expr, flat, lits, [0])
@@ -502,6 +525,8 @@ class MeshHbmCache(ResidentCacheBase):
         cap = next_pow2(max(dev_rows))
 
         # budget pre-check before any read or upload (hbm_cache rationale)
+        from .bytecache import vocab_heap_bytes
+
         readers = {str(p): layout.cached_reader(p) for p in paths}
         first = readers[str(paths[0])]
         dtype_of = {m["name"]: m["dtype"] for m in first.footer["columns"]}
@@ -517,26 +542,26 @@ class MeshHbmCache(ResidentCacheBase):
                         None,
                     )
                     if m is not None:
-                        vocab_est += sum(len(v) + 50 for v in m.get("vocab", ()))
+                        vocab_est += vocab_heap_bytes(m.get("vocab", ()))
         planes = sum(
             2 if dtype_of[c] == "float64" else 1 for c in encodable
         )
-        if planes * D * cap * 4 + vocab_est > _budget_bytes():
+        from ..residency import knobs as _rknobs
+
+        # the ladder for mesh tables is resident -> compressed -> host:
+        # streaming is a single-chip tier, so the raw pre-check only
+        # relaxes when compression could still fit the table
+        if planes * D * cap * 4 + vocab_est > _budget_bytes() and (
+            _rknobs.compression_mode() == "off"
+        ):
             metrics.incr("hbm.mesh.over_budget_refused")
             return None, False
-
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        sharding = NamedSharding(
-            mesh, PartitionSpec(mesh.axis_names[0], None)
-        )
 
         def read_seg(path: str, lo: int, hi: int, name: str) -> Column:
             return readers[path].read([name], row_range=(lo, hi)).columns[name]
 
-        cols: Dict[str, MeshResidentColumn] = {}
-        nbytes = 0
+        # --- encode phase: host (D, cap) matrices, no uploads yet -----------
+        host_mats: Dict[str, tuple] = {}
         for name in encodable:
             present = all(
                 any(m["name"] == name for m in r.footer["columns"])
@@ -594,13 +619,9 @@ class MeshHbmCache(ResidentCacheBase):
                         break
                 if not ok:
                     continue
-                dev_hi = jax.device_put(packed, sharding)
-                dev_lo = jax.device_put(packed_lo, sharding)
-                col_bytes = packed.nbytes + packed_lo.nbytes
-                cols[name] = MeshResidentColumn(
-                    dev_hi, "float64", "f64", col_bytes, None, dev_lo
+                host_mats[name] = (
+                    "float64", "f64", None, {"hi": packed, "lo": packed_lo}
                 )
-                nbytes += col_bytes
                 continue
             else:
                 ok = True
@@ -621,13 +642,102 @@ class MeshHbmCache(ResidentCacheBase):
                         break
                 if not ok or enc is None:
                     continue
-            dev = jax.device_put(packed, sharding)
-            col_bytes = packed.nbytes + (
-                sum(len(v) + 50 for v in vocab) if vocab is not None else 0
-            )
-            cols[name] = MeshResidentColumn(
-                dev, dtype_of[name], enc, col_bytes, vocab
-            )
+            host_mats[name] = (dtype_of[name], enc, vocab, {"": packed})
+        if not host_mats:
+            return None, True
+
+        # --- tier plan (shared ladder; streaming declines on a mesh) --------
+        from ..ops import bitpack
+        from ..residency import plan_tier
+
+        pack_specs = {}
+        raw_plane_bytes = 0
+        unpacked_bytes = 0
+        side_bytes = 0
+        for name, (_dts, enc, vocab, mats) in host_mats.items():
+            if vocab is not None:
+                side_bytes += vocab_heap_bytes(vocab)
+            raw_plane_bytes += len(mats) * D * cap * 4
+            spec = None
+            if len(mats) == 1:
+                mat = mats[""]
+                # bounds from the REAL rows only: the matrix is
+                # zero-padded past each shard's dev_rows, and a padded 0
+                # would stretch the span of any offset-valued domain
+                # (e.g. ids around 10^6) past the pack budget — the
+                # single-chip path has the same rule via its unpadded
+                # flats
+                real = [mat[d, : dev_rows[d]] for d in range(D) if dev_rows[d]]
+                if real:
+                    vmin = min(int(r.min()) for r in real)
+                    vmax = max(int(r.max()) for r in real)
+                    spec = bitpack.pack_spec(vmin, vmax, cap)
+                    if spec is not None and cap % spec.vpw != 0:
+                        spec = None  # degenerate tiny shard: keep raw
+            if spec is not None:
+                pack_specs[name] = spec
+            else:
+                unpacked_bytes += len(mats) * D * cap * 4
+        plan = plan_tier(
+            raw_plane_bytes,
+            _budget_bytes(),
+            pack_specs,
+            unpacked_bytes,
+            side_bytes,
+            streaming_ok=False,
+            shard_count=D,  # per-shard specs upload D copies
+        )
+        if plan.tier == "host":
+            # the mesh ladder ends at compressed: streaming is a
+            # single-chip tier (shard wider instead) — count the decline
+            # so an oversubscribed mesh refusal is attributable
+            if _rknobs.streaming_enabled():
+                metrics.incr("hbm.mesh.residency.streaming_declined")
+            metrics.incr("hbm.mesh.over_budget_refused")
+            return None, False
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(
+            mesh, PartitionSpec(mesh.axis_names[0], None)
+        )
+        cols: Dict[str, MeshResidentColumn] = {}
+        nbytes = 0
+        for name, (dts, enc, vocab, mats) in host_mats.items():
+            vocab_heap = vocab_heap_bytes(vocab)
+            if enc == "f64":
+                dev_hi = jax.device_put(mats["hi"], sharding)
+                dev_lo = jax.device_put(mats["lo"], sharding)
+                col_bytes = mats["hi"].nbytes + mats["lo"].nbytes
+                cols[name] = MeshResidentColumn(
+                    dev_hi, dts, "f64", col_bytes, None, dev_lo
+                )
+                nbytes += col_bytes
+                continue
+            spec = plan.specs.get(name)
+            mat = mats[""]
+            if spec is not None:
+                # pad rows re-encode at the frame reference (they were
+                # zero-filled, which may sit OUTSIDE [ref0, ref0+2^bits)
+                # for offset domains); ref0 pads are in-range garbage
+                # the host leg clips, like every other tier's pads
+                for d in range(D):
+                    mat[d, dev_rows[d] :] = spec.ref0
+                words = np.stack(
+                    [bitpack.pack_plain(mat[d], spec) for d in range(D)]
+                )
+                dev = jax.device_put(words, sharding)
+                col_bytes = words.nbytes + vocab_heap
+                cols[name] = MeshResidentColumn(
+                    dev, dts, enc, col_bytes, vocab, None, spec
+                )
+            else:
+                dev = jax.device_put(mat, sharding)
+                col_bytes = mat.nbytes + vocab_heap
+                cols[name] = MeshResidentColumn(
+                    dev, dts, enc, col_bytes, vocab
+                )
             nbytes += col_bytes
         if not cols:
             return None, True
@@ -648,6 +758,12 @@ class MeshHbmCache(ResidentCacheBase):
         if nbytes > _budget_bytes():
             metrics.incr("hbm.mesh.over_budget_refused")
             return None, False
+        if plan.tier == "compressed":
+            metrics.incr("residency.tier.compressed_built")
+            metrics.incr("residency.compressed.packed_bytes", nbytes)
+            metrics.incr(
+                "residency.compressed.raw_bytes", raw_plane_bytes + side_bytes
+            )
         metrics.record_time("hbm.mesh.prefetch", time.perf_counter() - t0)
         return (
             MeshResidentTable(
@@ -661,6 +777,8 @@ class MeshHbmCache(ResidentCacheBase):
                 cols,
                 n_rows,
                 nbytes,
+                tier=plan.tier,
+                raw_nbytes=raw_plane_bytes + side_bytes,
             ),
             False,
         )
@@ -709,7 +827,11 @@ class MeshHbmCache(ResidentCacheBase):
         None when the predicate does not narrow to the resident encodings
         (caller routes the ship-per-query path)."""
         from ..ops import kernels as K
-        from .hbm_cache import prepare_resident_predicate, resident_arrays_for
+        from .hbm_cache import (
+            prepare_resident_predicate,
+            resident_arrays_for,
+            resident_specs_for,
+        )
 
         # bind (string vocab) -> expand (f64 two-plane) -> narrow (i32):
         # the shared resident pipeline (hbm_cache)
@@ -718,7 +840,13 @@ class MeshHbmCache(ResidentCacheBase):
             return None
         narrowed, names = prepared
         fn = _mesh_counts_fn(
-            table.mesh, repr(narrowed), narrowed, names, table.cap, table.block
+            table.mesh,
+            repr(narrowed),
+            narrowed,
+            names,
+            table.cap,
+            table.block,
+            resident_specs_for(table.columns, names),
         )
         cols = dict(
             zip(names, resident_arrays_for(table.columns, names))
@@ -751,6 +879,7 @@ class MeshHbmCache(ResidentCacheBase):
             _expr_structure,
             prepare_resident_predicate,
             resident_arrays_for,
+            resident_specs_for,
         )
 
         if prepared is None:
@@ -762,6 +891,9 @@ class MeshHbmCache(ResidentCacheBase):
             return None
         structures = tuple(_expr_structure(n) for n, _ in prepared)
         slot_names = tuple(names for _, names in prepared)
+        union_names = tuple(
+            dict.fromkeys(n for names in slot_names for n in names)
+        )
         fn = _mesh_batched_counts_fn(
             table.mesh,
             structures,
@@ -769,9 +901,9 @@ class MeshHbmCache(ResidentCacheBase):
             [n for n, _ in prepared],
             table.cap,
             table.block,
-        )
-        union_names = tuple(
-            dict.fromkeys(n for names in slot_names for n in names)
+            tuple(
+                zip(union_names, resident_specs_for(table.columns, union_names))
+            ),
         )
         cols = dict(
             zip(union_names, resident_arrays_for(table.columns, union_names))
@@ -993,9 +1125,14 @@ class MeshHbmCache(ResidentCacheBase):
         from ..storage import parquet_io
         from ..utils.deviceprobe import first_device_touch_ok
         from ..utils.intmath import next_pow2
-        from .bytecache import batch_nbytes
+        from .bytecache import batch_nbytes, vocab_heap_bytes
         from .delta import encode_delta_columns
 
+        if getattr(table, "tier", "resident") != "resident":
+            # the fused hybrid dispatch reads raw base shards — a
+            # compressed base cannot anchor a delta (hbm_cache rule)
+            metrics.incr("hbm.mesh.delta.declined.tier")
+            return None, True
         if not first_device_touch_ok():
             metrics.incr("hbm.mesh.device_unreachable")
             return None, False
@@ -1068,9 +1205,7 @@ class MeshHbmCache(ResidentCacheBase):
         if not flats:
             return None, True
         host_bytes = batch_nbytes(host_batch)
-        oov_bytes = sum(
-            sum(len(v) + 50 for v in side) for side in oov.values()
-        )
+        oov_bytes = sum(vocab_heap_bytes(side) for side in oov.values())
         mask_bytes = D * table.cap * 4 if dels else 0
         dev_bytes = planes * D * cap * 4 + mask_bytes
         # headroom against the resident tables, not the whole budget
@@ -1470,6 +1605,7 @@ class MeshHbmCache(ResidentCacheBase):
                         "cap": t.cap,
                         "columns": sorted(t.columns),
                         "mb": round(t.nbytes / 1e6, 1),
+                        "tier": getattr(t, "tier", "resident"),
                     }
                     for t in self._tables
                 ],

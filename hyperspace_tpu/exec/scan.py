@@ -400,9 +400,27 @@ def index_scan(
             if counts is not None:
                 from .scan_gate import scan_gate
 
-                scan_gate.note_resident_bypass("plain")
+                # the tier ladder keeps the gate bypass observable per
+                # rung: "plain" (raw planes), "compressed" (fused
+                # decode), "streaming" (window pipeline) — and the path
+                # metric names the tier so explain(verbose) can say
+                # which one served (docs/15-streaming-residency.md)
+                tier = getattr(table, "tier", "resident")
+                scan_gate.note_resident_bypass(
+                    "plain" if tier == "resident" else tier
+                )
+                path_metric = {
+                    "resident": "scan.path.resident_device",
+                    "compressed": "scan.path.resident_compressed",
+                    "streaming": "scan.path.resident_streaming",
+                }.get(tier, "scan.path.resident_device")
                 parts = _resident_parts(
-                    table, files, output_columns, predicate, counts
+                    table,
+                    files,
+                    output_columns,
+                    predicate,
+                    counts,
+                    path_metric=path_metric,
                 )
                 if parts:
                     return ColumnarBatch.concat(parts)

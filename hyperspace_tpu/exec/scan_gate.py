@@ -285,10 +285,17 @@ class ScanGate:
         gate's metrics explain why no probe ladder ran: plain resident
         scans, the hybrid base+delta fused path
         ("scan.gate.resident_bypass_hybrid" under continuous appends is
-        the delta fast path working, not a gate that went blind), and
+        the delta fast path working, not a gate that went blind),
         resident joins ("scan.gate.resident_bypass_join" — the join
         region's codes are already on device, so the per-query H2D the
-        gate's link arithmetic prices is zero by construction)."""
+        gate's link arithmetic prices is zero by construction), and the
+        oversubscribed tiers of the residency ladder ("…_compressed":
+        packed planes already on device, same zero-H2D argument;
+        "…_streaming": the window pipeline DOES re-pay H2D per query,
+        but against the packed bytes with upload/compute overlapped —
+        its admission ran at population time through the tier planner
+        (residency.tiers), not through this gate's per-size probe, and
+        the zone-fraction selectivity gate still applies upstream)."""
         metrics.incr(f"scan.gate.resident_bypass_{kind}")
 
     def reset(self) -> None:

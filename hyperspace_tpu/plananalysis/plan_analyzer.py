@@ -148,6 +148,24 @@ def explain_string(
             buf.write_line(_BANNER)
             buf.write_line("Last query metrics (scoped to that query):")
             buf.write_line(_BANNER)
+            # name the residency tier that served the scan (the ladder of
+            # docs/15-streaming-residency.md): the per-tier path counters
+            # are authoritative — "host" when no resident path fired
+            tier_paths = (
+                ("scan.path.resident_streaming", "streaming"),
+                ("scan.path.resident_compressed", "compressed"),
+                ("scan.path.resident_device", "resident"),
+                ("scan.path.resident_hybrid", "resident (hybrid)"),
+            )
+            served = [
+                label
+                for key, label in tier_paths
+                if last["counters"].get(key)
+            ]
+            buf.write_line(
+                "Residency tier served: "
+                + (", ".join(served) if served else "host")
+            )
             for name in sorted(last["counters"]):
                 buf.write_line(f"{name:<40}{last['counters'][name]:>12}")
             for name in sorted(last["timers_s"]):

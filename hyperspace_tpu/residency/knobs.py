@@ -1,0 +1,110 @@
+"""The ``hyperspace.residency.*`` knob family.
+
+The resident caches are process-global singletons while conf is
+per-session, so wiring follows the precedent of the HYPERSPACE_TPU_HBM
+family: env vars are authoritative (operators, tests), and the session
+pushes its conf values here as process DEFAULTS at construction
+(``HyperspaceSession.__init__`` -> ``adopt_conf``) — the last session's
+conf wins, which matches how the one shared budget already behaves.
+Every dotted key is declared in constants.py (the HS013 registry);
+malformed env values fall back to the default, never raise (the
+bytecache env_* discipline).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from .. import constants as C
+
+_lock = threading.Lock()
+_conf_defaults: dict = {}
+
+
+def adopt_conf(conf) -> None:
+    """Adopt a session conf's residency knobs as process defaults.
+    Absent keys leave the constants defaults in place. Values are read
+    THROUGH the typed config accessors so an invalid value raises
+    HyperspaceException at session construction — the value-typo twin of
+    HS013's key-typo failure mode must not be silently ignored here."""
+    vals = {}
+    if conf.contains(C.RESIDENCY_COMPRESSION):
+        vals[C.RESIDENCY_COMPRESSION] = conf.residency_compression()
+    if conf.contains(C.RESIDENCY_STREAMING):
+        vals[C.RESIDENCY_STREAMING] = conf.residency_streaming()
+    if conf.contains(C.RESIDENCY_STREAMING_WINDOW_ROWS):
+        vals[C.RESIDENCY_STREAMING_WINDOW_ROWS] = conf.residency_window_rows()
+    if conf.contains(C.RESIDENCY_FOR_DELTA):
+        vals[C.RESIDENCY_FOR_DELTA] = conf.residency_for_delta()
+    with _lock:
+        _conf_defaults.update(vals)
+
+
+def _value(env: str, key: str, default) -> object:
+    v = os.environ.get(env)
+    if v is not None and v != "":
+        return v
+    with _lock:
+        return _conf_defaults.get(key, default)
+
+
+def compression_mode() -> str:
+    v = str(
+        _value(
+            "HYPERSPACE_TPU_RESIDENCY_COMPRESSION",
+            C.RESIDENCY_COMPRESSION,
+            C.RESIDENCY_COMPRESSION_DEFAULT,
+        )
+    ).lower()
+    return (
+        v
+        if v in C.RESIDENCY_COMPRESSION_MODES
+        else C.RESIDENCY_COMPRESSION_DEFAULT
+    )
+
+
+def streaming_enabled() -> bool:
+    v = str(
+        _value(
+            "HYPERSPACE_TPU_RESIDENCY_STREAMING",
+            C.RESIDENCY_STREAMING,
+            C.RESIDENCY_STREAMING_DEFAULT,
+        )
+    ).lower()
+    # accept the common falsy spellings like for_delta_enabled does —
+    # an operator's STREAMING=false must not silently mean "on"
+    return v not in (C.RESIDENCY_STREAMING_OFF, "false", "0", "no")
+
+
+def streaming_window_rows() -> int:
+    raw = _value(
+        "HYPERSPACE_TPU_RESIDENCY_WINDOW_ROWS",
+        C.RESIDENCY_STREAMING_WINDOW_ROWS,
+        C.RESIDENCY_STREAMING_WINDOW_ROWS_DEFAULT,
+    )
+    try:
+        n = int(raw)
+    except (TypeError, ValueError):
+        return C.RESIDENCY_STREAMING_WINDOW_ROWS_DEFAULT
+    return n if n > 0 else C.RESIDENCY_STREAMING_WINDOW_ROWS_DEFAULT
+
+
+def for_delta_enabled() -> bool:
+    v = str(
+        _value(
+            "HYPERSPACE_TPU_RESIDENCY_FOR_DELTA",
+            C.RESIDENCY_FOR_DELTA,
+            C.RESIDENCY_FOR_DELTA_DEFAULT,
+        )
+    ).lower()
+    return v not in ("off", "false", "0", "no")
+
+
+def reset_conf_defaults(values: Optional[dict] = None) -> None:
+    """Test hook: clear (or replace) the adopted conf defaults."""
+    with _lock:
+        _conf_defaults.clear()
+        if values:
+            _conf_defaults.update(values)

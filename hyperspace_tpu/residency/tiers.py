@@ -1,0 +1,113 @@
+"""The ONE tier-planning procedure of the residency ladder.
+
+Both caches (exec/hbm_cache, exec/mesh_cache) size a candidate table
+here instead of comparing raw bytes to the budget inline — the rule that
+turned the budget from an admission wall into a ladder must have exactly
+one copy, or the two caches (and the bench's A/B legs) drift.
+
+The ladder, cheapest-at-query-time first:
+
+  resident    raw int32 planes fit the budget — the PR-3/PR-5 behavior.
+  compressed  bit-packed planes (ops.bitpack) fit where raw did not;
+              budget accounting charges COMPRESSED bytes, multiplying
+              effective capacity by the pack ratio.
+  streaming   even packed planes exceed headroom: host-pinned planes
+              staged through a fixed pair of HBM slabs, so the budget
+              charge is two windows regardless of table size.
+  host        streaming disabled or the slab pair itself cannot fit.
+
+Compression mode "force" skips the resident rung for packable columns
+(capacity-over-latency deployments, and the tests' way of exercising
+the codec without multi-GB fixtures).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..ops.bitpack import PackSpec
+from . import knobs
+
+
+@dataclass
+class TierPlan:
+    """Outcome of plan_tier. ``specs`` maps column name -> PackSpec for
+    every column the chosen tier packs (empty for tier "resident");
+    ``window_rows`` is set for tier "streaming" (pre-tile-padding)."""
+
+    tier: str  # "resident" | "compressed" | "streaming" | "host"
+    reason: str = ""
+    specs: Dict[str, PackSpec] = field(default_factory=dict)
+    window_rows: int = 0
+    raw_bytes: int = 0
+    packed_bytes: int = 0
+
+
+def plan_tier(
+    raw_plane_bytes: int,
+    budget_bytes: int,
+    pack_specs: Optional[Dict[str, PackSpec]] = None,
+    unpacked_plane_bytes: int = 0,
+    side_bytes: int = 0,
+    streaming_ok: bool = True,
+    shard_count: int = 1,
+) -> TierPlan:
+    """Pick the cheapest tier that fits ``budget_bytes``.
+
+    ``raw_plane_bytes``  — device bytes of every plane stored raw;
+    ``pack_specs``       — per-column PackSpec for the packable columns
+                           (None/empty = nothing packs);
+    ``unpacked_plane_bytes`` — device bytes of the planes that stay raw
+                           even under compression (unpackable columns);
+    ``side_bytes``       — budget-charged non-plane bytes (host vocab
+                           heaps) that ride along at every tier;
+    ``streaming_ok``     — caller-side eligibility (the mesh cache and
+                           delta/join regions pass False: streaming is a
+                           base-table, single-chip tier);
+    ``shard_count``      — device shards each pack spec materializes on
+                           (the mesh passes D: its per-shard specs cost
+                           D copies, and the fit check must price what
+                           the build will actually upload).
+    """
+    mode = knobs.compression_mode()
+    specs = dict(pack_specs or {})
+    packed_bytes = (
+        sum(s.packed_nbytes for s in specs.values()) * max(shard_count, 1)
+        + unpacked_plane_bytes
+    )
+    force = mode == "force" and specs
+    if raw_plane_bytes + side_bytes <= budget_bytes and not force:
+        return TierPlan(
+            "resident", "raw fits", {}, 0, raw_plane_bytes, packed_bytes
+        )
+    if mode != "off" and specs and packed_bytes + side_bytes <= budget_bytes:
+        return TierPlan(
+            "compressed",
+            "packed fits" if not force else "compression forced",
+            specs,
+            0,
+            raw_plane_bytes,
+            packed_bytes,
+        )
+    if force:
+        # forced but over budget: fall through the remaining rungs with
+        # the packed planes still in play (streaming streams packed)
+        pass
+    if streaming_ok and knobs.streaming_enabled():
+        return TierPlan(
+            "streaming",
+            "oversubscribed",
+            specs if mode != "off" else {},
+            knobs.streaming_window_rows(),
+            raw_plane_bytes,
+            packed_bytes,
+        )
+    return TierPlan(
+        "host",
+        "streaming disabled" if streaming_ok else "tier ineligible",
+        {},
+        0,
+        raw_plane_bytes,
+        packed_bytes,
+    )

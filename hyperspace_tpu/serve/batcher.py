@@ -144,13 +144,22 @@ def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
     prepared = prepare_resident_predicate(table.columns, predicate)
     if prepared is None:
         return None
+    # streaming-tier tables batch only within a WINDOW GENERATION: the
+    # generation bumps when a device failure tears the slab pair down
+    # (residency.streaming), and a batch must never span that
+    # discontinuity — half its queries would have classified against
+    # state the other half's windows no longer reflect
+    gen = getattr(table, "window_gen", None)
+    batch_key = (id(table), frozenset(prepared[1])) + (
+        (gen,) if gen is not None else ()
+    )
     return ResidentScanRequest(
         table,
         entry,
         files,
         predicate,
         output_columns,
-        (id(table), frozenset(prepared[1])),
+        batch_key,
         None,
         prepared,
     )

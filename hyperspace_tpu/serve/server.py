@@ -515,6 +515,12 @@ class QueryServer:
                 # this next to the serve counters to see whether
                 # aggregate-joins are being served fused or host-side
                 "join_regions": _join_region_stats(),
+                # residency tier surface: per-table tier ladder state
+                # (which rung each table landed on, compression ratio,
+                # window counters) — operators read this to see whether
+                # oversubscribed tables are serving compressed/streaming
+                # or falling off to host
+                "residency": _residency_stats(),
                 # reliability surface: what the lifecycle layer absorbed
                 # (retries) and healed (rollbacks) while this server ran
                 # — THIS server's sweeps plus the process-wide counters
@@ -534,6 +540,22 @@ class QueryServer:
             if waits:
                 out["mean_wait_ms"] = round(1e3 * statistics.fmean(waits), 3)
         return out
+
+
+def _residency_stats() -> dict:
+    """Tier-ladder snapshot for stats(): per-cache table tiers plus the
+    process-wide counter family (telemetry.residency_snapshot) — the
+    compact operator view; per-table detail stays on the cache
+    snapshots for drill-down (docs/15-streaming-residency.md)."""
+    from ..exec.hbm_cache import hbm_cache
+    from ..exec.mesh_cache import mesh_cache
+    from ..telemetry.metrics import residency_snapshot
+
+    return {
+        "hbm": hbm_cache.snapshot_residency(),
+        "mesh": mesh_cache.snapshot_residency(),
+        **residency_snapshot(),
+    }
 
 
 def _join_region_stats() -> dict:

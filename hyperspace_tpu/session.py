@@ -119,6 +119,14 @@ class HyperspaceSession:
     def __init__(self, conf: Optional[HyperspaceConf] = None, mesh=None):
         self.conf = conf or HyperspaceConf()
         self.mesh = mesh
+        # the residency tier ladder's knobs (hyperspace.residency.*) set
+        # PROCESS defaults here: the resident caches are process-global
+        # singletons, so the last-constructed session's conf wins — the
+        # same semantics the one shared HBM budget already has; the
+        # HYPERSPACE_TPU_RESIDENCY_* env vars override both
+        from .residency import adopt_conf
+
+        adopt_conf(self.conf)
         self.sources = FileBasedSourceProviderManager(self.conf)
         self.catalog = Catalog(self)
         self._hyperspace_enabled = False

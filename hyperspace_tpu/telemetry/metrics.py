@@ -181,6 +181,44 @@ def build_pipeline_snapshot(
     return out
 
 
+def residency_snapshot(
+    registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, object]:
+    """The tier-ladder counter family in one dict — which tier served
+    scans, what bit-packing bought (compressed vs raw bytes), and the
+    streaming pipeline's window/prefetch behavior. Consumed by
+    ``QueryServer.stats()["residency"]`` next to the per-cache table
+    snapshots (docs/15-streaming-residency.md)."""
+    r = registry if registry is not None else metrics
+    raw = r.counter("residency.compressed.raw_bytes")
+    packed = r.counter("residency.compressed.packed_bytes")
+    out: Dict[str, object] = {
+        "scans_resident": r.counter("scan.path.resident_device"),
+        "scans_compressed": r.counter("scan.path.resident_compressed"),
+        "scans_streaming": r.counter("scan.path.resident_streaming"),
+        "compressed_tables_built": r.counter(
+            "residency.tier.compressed_built"
+        ),
+        "streaming_tables_built": r.counter(
+            "residency.tier.streaming_built"
+        ),
+        "compressed_raw_bytes": raw,
+        "compressed_packed_bytes": packed,
+        "stream_windows": r.counter("residency.stream.windows"),
+        "stream_window_failures": r.counter(
+            "residency.stream.window_failed"
+        ),
+        "stream_prefetch_hit": r.counter("residency.stream.prefetch_hit"),
+        "stream_prefetch_stall": r.counter(
+            "residency.stream.prefetch_stall"
+        ),
+        "stream_h2d_bytes": r.counter("residency.stream.h2d_bytes"),
+    }
+    if packed:
+        out["effective_capacity_x"] = round(raw / packed, 2)
+    return out
+
+
 def reliability_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, int]:
     """The crash-consistency counter family in one dict — what the
     reliability layer absorbed (storage retries), refused (fenced

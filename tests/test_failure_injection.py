@@ -41,27 +41,44 @@ def _string_batch(values):
     )
 
 
-def test_vocab_union_stale_cache_retry_fires():
+def test_vocab_union_stale_cache_retry_fires(monkeypatch):
     """Peer file appears LATE (NFS-style staleness): the retry loop polls
-    until it lands and the union is still exact."""
+    until it lands and the union is still exact. EVENT-based
+    coordination: the peer file is written only after the reader's retry
+    counter has actually fired — the previous fixed 0.4 s sleep raced
+    the reader under CI load (a slow first poll meant the file was
+    already there and no retry ever happened, failing the >= 1
+    assertion on exactly the runs that were busiest)."""
     import tempfile
 
     scratch = Path(tempfile.mkdtemp())
     batch = _string_batch([b"aa", b"cc", b"aa"])
+    metrics.reset()
+
+    retried = threading.Event()
+    real_incr = metrics.incr
+
+    def incr_hook(name, by=1):
+        real_incr(name, by)
+        if name == "build.multihost.vocab_stale_retry":
+            retried.set()
+
+    monkeypatch.setattr(metrics, "incr", incr_hook)
 
     def late_peer():
-        time.sleep(0.4)
+        # wait for the RETRY, not a wall-clock guess: the file must land
+        # only after the reader has observed at least one miss
+        assert retried.wait(30.0)
         (scratch / ".late.tmp").write_bytes(
             pickle.dumps({"s": np.array([b"bb", b"dd"], dtype=object)})
         )
         (scratch / ".late.tmp").replace(scratch / "vocab-00001.pkl")
 
     t = threading.Thread(target=late_peer, daemon=True)
-    metrics.reset()
     t.start()
     out = unify_vocabs_shared_storage(
         batch, scratch, barrier=lambda: None, process_index=0,
-        process_count=2, timeout_s=10.0,
+        process_count=2, timeout_s=60.0,
     )
     t.join()
     assert metrics.counter("build.multihost.vocab_stale_retry") >= 1
@@ -753,3 +770,200 @@ def test_orphan_tmp_files_reported_by_fsck_and_swept_by_recovery(tmp_path):
     assert metrics.counter("recovery.orphan_tmp_swept") >= 1
     assert mgr.get_latest_log().state == st.ACTIVE
     assert doctor(idx).ok
+
+
+# ---------------------------------------------------------------------------
+# (f) oversubscribed-residency fault injection (residency/): device loss
+#     MID-WINDOW on the streaming tier and MID-POPULATION on the
+#     compressed tier must drop the region cleanly, answer the query
+#     host-side (latch), and leave the registry/epoch state consistent.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def oversub_env(tmp_path, monkeypatch):
+    """A table whose raw predicate planes exceed the (shrunken) HBM
+    budget — the ladder's streaming shape with windowRows forcing
+    multiple windows."""
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.exec.hbm_cache import hbm_cache
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_WINDOW_ROWS", "65536")
+    hbm_cache.reset()
+    rng = np.random.default_rng(9)
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 50, 200_000).astype(np.int64),
+            "v": rng.integers(0, 1 << 30, 200_000).astype(np.int64),
+        }
+    )
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "p0.parquet", batch)
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"), C.INDEX_NUM_BUCKETS: 2}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ovi", ["k"], ["v"])
+    )
+    session.enable_hyperspace()
+    yield session, hs, src, batch
+    hbm_cache.reset()
+
+
+def test_device_loss_mid_window_drops_region_and_answers_from_host(
+    oversub_env, monkeypatch
+):
+    """The streaming dispatch dies on window 2 of N: the query must still
+    answer exactly (host fallback), the streaming table must be dropped
+    (no retry against a dead device), the window generation must bump so
+    serve batches never span the discontinuity, and the registry must
+    hold no half-state."""
+    from hyperspace_tpu.exec.hbm_cache import hbm_cache
+    from hyperspace_tpu.plan.expr import col, lit
+    from hyperspace_tpu.residency import streaming as ST
+
+    session, hs, src, batch = oversub_env
+    assert hs.prefetch_index("ovi", ["k", "v"])
+    snap = hbm_cache.snapshot_residency()
+    assert snap["by_tier"] == {"streaming": 1}
+    assert snap["tables"][0]["windows"] >= 3
+    table = hbm_cache._tables[0]
+    gen0 = table.window_gen
+
+    q = lambda: (  # noqa: E731
+        session.read.parquet(str(src))
+        .filter((col("k") == lit(7)) & (col("v") >= lit(0)))
+        .select("k", "v")
+    )
+    session.disable_hyperspace()
+    off = q().collect()
+    session.enable_hyperspace()
+
+    real_upload = ST._upload_window
+    calls = {"n": 0}
+
+    def dying_upload(table_, names, w):
+        calls["n"] += 1
+        if calls["n"] >= 2:  # window 0 uploads fine, the next one dies
+            raise RuntimeError("DEADLINE_EXCEEDED: device tunnel wedged")
+        return real_upload(table_, names, w)
+
+    monkeypatch.setattr(ST, "_upload_window", dying_upload)
+    metrics.reset()
+    on = q().collect()
+
+    def rows(b):
+        return sorted(
+            zip(b.columns["k"].data.tolist(), b.columns["v"].data.tolist())
+        )
+
+    assert rows(on) == rows(off), "mid-window loss must degrade, not corrupt"
+    assert metrics.counter("residency.stream.window_failed") == 1
+    assert metrics.counter("scan.resident.device_failed") == 1
+    assert table.window_gen == gen0 + 1, "generation must bump on failure"
+    snap2 = hbm_cache.snapshot()
+    assert snap2["tables"] == 0, "dead streaming table must be dropped"
+    assert snap2["deltas"] == 0 and snap2["joins"] == 0
+
+    # healthy again: repopulation restores the streaming path exactly
+    monkeypatch.setattr(ST, "_upload_window", real_upload)
+    assert hs.prefetch_index("ovi", ["k", "v"])
+    metrics.reset()
+    again = q().collect()
+    assert rows(again) == rows(off)
+    assert metrics.counter("scan.path.resident_streaming") == 1
+
+
+def test_device_loss_mid_compressed_population_keeps_registry_clean(
+    oversub_env, monkeypatch
+):
+    """The compressed build's materializing fence dies (lost tunnel):
+    nothing may register, the failure is transient (not memoized), and a
+    healthy retry lands the compressed table."""
+    from hyperspace_tpu import ops
+    from hyperspace_tpu.exec.hbm_cache import hbm_cache
+    from hyperspace_tpu.plan.expr import col, lit
+
+    session, hs, src, batch = oversub_env
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "2")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_COMPRESSION", "force")
+
+    real_fence = ops.fence_chain
+
+    def dead_fence(arrays):
+        raise RuntimeError("DEADLINE_EXCEEDED: device tunnel wedged")
+
+    monkeypatch.setattr(ops, "fence_chain", dead_fence)
+    metrics.reset()
+    assert not hs.prefetch_index("ovi", ["k", "v"])
+    assert metrics.counter("hbm.device_transfer_error") >= 1
+    snap = hbm_cache.snapshot()
+    assert snap["tables"] == 0, "half-uploaded compressed table leaked"
+    # query still answers host-side, exactly
+    q = (
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(3))
+        .select("k", "v")
+    )
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    on = q.collect()
+    assert sorted(on.columns["v"].data.tolist()) == sorted(
+        off.columns["v"].data.tolist()
+    )
+    # transient: with the device healthy the same build succeeds
+    monkeypatch.setattr(ops, "fence_chain", real_fence)
+    assert hs.prefetch_index("ovi", ["k", "v"])
+    assert hbm_cache.snapshot_residency()["by_tier"] == {"compressed": 1}
+
+
+def test_reset_epoch_guard_refuses_stale_streaming_registration(
+    oversub_env, monkeypatch
+):
+    """A reset() while a background STREAMING build is in flight must
+    win: the build's table lands against a bumped epoch and is refused —
+    the same guard the resident tables and delta regions already have
+    (HS012's fence discipline at the registry seam)."""
+    from hyperspace_tpu.exec.hbm_cache import hbm_cache
+    from hyperspace_tpu.plan.expr import col, lit
+    from hyperspace_tpu.residency import streaming as ST
+
+    session, hs, src, batch = oversub_env
+    gate = threading.Event()
+    release = threading.Event()
+    real_pack = ST.pack_plain
+
+    def slow_pack(values, spec):
+        gate.set()
+        assert release.wait(30.0)
+        return real_pack(values, spec)
+
+    monkeypatch.setattr(ST, "pack_plain", slow_pack)
+    # first query schedules the background streaming build (note_touch);
+    # the predicate spans BOTH columns so the touched column set's raw
+    # planes exceed the 1 MB budget and the ladder lands on streaming
+    q = (
+        session.read.parquet(str(src))
+        .filter((col("k") == lit(5)) & (col("v") >= lit(0)))
+        .select("k", "v")
+    )
+    q.collect()
+    assert gate.wait(10.0), "background build never reached the packer"
+    hbm_cache.reset()  # bumps the epoch mid-build
+    release.set()
+    hbm_cache.wait_background(timeout_s=30.0)
+    assert hbm_cache.snapshot()["tables"] == 0, (
+        "stale streaming table registered across a reset()"
+    )

@@ -1,0 +1,552 @@
+"""Oversubscribed residency (hyperspace_tpu/residency/ + ops/bitpack):
+the resident -> compressed -> streaming -> host tier ladder.
+
+Covers: the bitpack codecs (plain + FoR-delta, host/device roundtrips,
+decline rules); the ONE tier-planning procedure; end-to-end scan parity
+at every rung under shrinking HBM budgets (the acceptance case: a table
+whose raw predicate planes exceed the budget still scans on the device
+streaming path with results exactly matching the host path); compressed
+budget accounting multiplying effective capacity; serve-path batching of
+streaming scans within a window generation; mesh compressed shards;
+FoR-delta join codes; knob plumbing (env > conf > default, HS013
+registry); the observability surface (snapshot_residency,
+server.stats()["residency"], explain(verbose) tier naming); and the
+hybrid path declining non-resident-tier bases."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exec.hbm_cache import hbm_cache
+from hyperspace_tpu.exec.mesh_cache import mesh_cache
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.ops import bitpack
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.residency import knobs as rknobs
+from hyperspace_tpu.residency import plan_tier
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def _force_residency(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    hbm_cache.reset()
+    mesh_cache.reset()
+    rknobs.reset_conf_defaults()
+    yield
+    hbm_cache.reset()
+    mesh_cache.reset()
+    rknobs.reset_conf_defaults()
+
+
+# ---------------------------------------------------------------------------
+# ops.bitpack codecs
+# ---------------------------------------------------------------------------
+
+
+def test_plain_pack_roundtrip_host_and_device():
+    rng = np.random.default_rng(0)
+    for lo, hi, n in [(0, 6, 1000), (-50, 13, 8192), (7, 7, 5), (0, 65535, 3000)]:
+        v = rng.integers(lo, hi + 1, n).astype(np.int64)
+        spec = bitpack.pack_spec(int(v.min()), int(v.max()), n)
+        assert spec is not None
+        assert spec.vpw >= 2 and (spec.vpw & (spec.vpw - 1)) == 0
+        words = bitpack.pack_plain(v, spec)
+        assert (bitpack.unpack_plain_host(words, spec) == v).all()
+        import jax
+
+        got = np.asarray(
+            jax.jit(lambda w, s=spec: bitpack.unpack_plain_jnp(w, s))(words)
+        )
+        assert (got == v).all()
+        # the capacity claim: packed words cost <= half the raw i32 plane
+        assert words.nbytes * 2 <= n * 4 + 4 * spec.vpw
+
+
+def test_pack_spec_declines_wide_spans_and_empty():
+    assert bitpack.pack_spec(0, 1 << 20, 100) is None  # 21 bits > 16
+    assert bitpack.pack_spec(0, 5, 0) is None
+    assert bitpack.pack_spec(5, 4, 10) is None  # inverted bounds
+
+
+def test_for_delta_roundtrip_and_sparse_decline():
+    rng = np.random.default_rng(1)
+    v = np.sort(rng.integers(0, 200_000, 300_000)).astype(np.int64)
+    spec = bitpack.for_spec(v, block=128)
+    assert spec is not None and spec.block == 128
+    words, refs = bitpack.pack_for(v, spec)
+    assert spec.packed_nbytes < 4 * len(v)
+    import jax
+
+    got = np.asarray(
+        jax.jit(lambda w, r, s=spec: bitpack.unpack_for_jnp(w, r, s))(
+            words, refs
+        )
+    )
+    assert (got == v).all()
+    # sparse stream: in-block spans beyond 16 bits decline
+    sparse = np.sort(rng.integers(0, 1 << 30, 5000)).astype(np.int64)
+    assert bitpack.for_spec(sparse, block=128) is None
+
+
+# ---------------------------------------------------------------------------
+# the tier planner (residency.tiers) — the ONE ladder procedure
+# ---------------------------------------------------------------------------
+
+
+def test_tier_planner_ladder(monkeypatch):
+    spec = bitpack.pack_spec(0, 100, 1 << 15)  # 7 bits -> vpw 4
+    specs = {"k": spec}
+    packed = spec.packed_nbytes
+    raw = 4 * (1 << 15)
+    # raw fits -> resident
+    assert plan_tier(raw, raw + 1, specs).tier == "resident"
+    # raw over, packed fits -> compressed
+    p = plan_tier(raw, packed + 1, specs)
+    assert p.tier == "compressed" and p.specs == specs
+    # even packed over -> streaming
+    assert plan_tier(raw, packed - 1, specs).tier == "streaming"
+    # streaming ineligible (mesh / regions) -> host
+    assert plan_tier(raw, packed - 1, specs, streaming_ok=False).tier == "host"
+    # knobs: compression off skips the packed rung
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_COMPRESSION", "off")
+    assert plan_tier(raw, packed + 1, specs).tier == "streaming"
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_STREAMING", "off")
+    assert plan_tier(raw, packed + 1, specs).tier == "host"
+    # force packs even when raw would fit
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_COMPRESSION", "force")
+    assert plan_tier(raw, raw + packed + 1, specs).tier == "compressed"
+
+
+def test_knob_precedence_env_over_conf(monkeypatch):
+    conf = HyperspaceConf({C.RESIDENCY_STREAMING_WINDOW_ROWS: 12345})
+    rknobs.adopt_conf(conf)
+    assert rknobs.streaming_window_rows() == 12345
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_WINDOW_ROWS", "54321")
+    assert rknobs.streaming_window_rows() == 54321
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_WINDOW_ROWS", "garbage")
+    assert (
+        rknobs.streaming_window_rows()
+        == C.RESIDENCY_STREAMING_WINDOW_ROWS_DEFAULT
+    )
+    # typed accessors validate (config registry, HS013)
+    assert conf.residency_window_rows() == 12345
+    from hyperspace_tpu.exceptions import HyperspaceException
+
+    with pytest.raises(HyperspaceException):
+        HyperspaceConf({C.RESIDENCY_COMPRESSION: "sideways"}).residency_compression()
+    # adopt_conf reads THROUGH the validating accessors: a value typo
+    # raises at session construction instead of silently falling back
+    with pytest.raises(HyperspaceException):
+        HyperspaceSession(HyperspaceConf({C.RESIDENCY_COMPRESSION: "of"}))
+    # and a validated bool for forDelta survives the round trip
+    rknobs.adopt_conf(HyperspaceConf({C.RESIDENCY_FOR_DELTA: "false"}))
+    assert rknobs.for_delta_enabled() is False
+
+
+# ---------------------------------------------------------------------------
+# end-to-end ladder: one source, shrinking budgets
+# ---------------------------------------------------------------------------
+
+N_ROWS = 200_000
+
+
+@pytest.fixture()
+def ladder_env(tmp_path):
+    rng = np.random.default_rng(7)
+    batch = ColumnarBatch.from_pydict(
+        {
+            # low-cardinality predicate column: the pack target
+            "k": rng.integers(0, 50, N_ROWS).astype(np.int64),
+            # high-cardinality column: stays a raw plane at every tier
+            "v": rng.integers(0, 1 << 30, N_ROWS).astype(np.int64),
+        }
+    )
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "p0.parquet", batch)
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"), C.INDEX_NUM_BUCKETS: 2}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("lidx", ["k"], ["v"])
+    )
+    session.enable_hyperspace()
+
+    def q():
+        return (
+            session.read.parquet(str(src))
+            .filter((col("k") == lit(7)) & (col("v") >= lit(0)))
+            .select("k", "v")
+        )
+
+    session.disable_hyperspace()
+    expect = q().collect()
+    session.enable_hyperspace()
+    return session, hs, q, expect
+
+
+def _rows(b):
+    return sorted(zip(b.columns["k"].data.tolist(), b.columns["v"].data.tolist()))
+
+
+def test_compressed_tier_parity_and_budget_accounting(ladder_env, monkeypatch):
+    session, hs, q, expect = ladder_env
+    # budget between packed (~1.1 MB) and raw (~1.8 MB): raw refuses,
+    # the ladder admits COMPRESSED — the effective-capacity claim
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "2")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_COMPRESSION", "force")
+    metrics.reset()
+    assert hs.prefetch_index("lidx", ["k", "v"])
+    snap = hbm_cache.snapshot_residency()
+    assert snap["by_tier"] == {"compressed": 1}
+    row = snap["tables"][0]
+    assert row["raw_mb"] > row["mb"], "compression must charge fewer bytes"
+    got = q().collect()
+    assert _rows(got) == _rows(expect)
+    assert metrics.counter("scan.path.resident_compressed") == 1
+    assert metrics.counter("scan.gate.resident_bypass_compressed") == 1
+    # the packed k column is >= 2x smaller than its raw plane
+    table = hbm_cache._tables[0]
+    assert table.columns["k"].pack is not None
+    assert table.columns["k"].nbytes * 2 <= table.n_pad * 4
+    assert table.columns["v"].pack is None  # high-card stays raw
+
+
+def test_streaming_tier_parity_over_multiple_windows(ladder_env, monkeypatch):
+    session, hs, q, expect = ladder_env
+    # budget below even the packed footprint: the acceptance shape — raw
+    # predicate planes exceed the budget, the scan still runs device-side
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_WINDOW_ROWS", "65536")
+    metrics.reset()
+    assert hs.prefetch_index("lidx", ["k", "v"])
+    snap = hbm_cache.snapshot_residency()
+    assert snap["by_tier"] == {"streaming": 1}
+    row = snap["tables"][0]
+    assert row["windows"] >= 3, "test must exercise multiple windows"
+    # the slab-pair charge is far below the host-pinned table
+    assert row["mb"] < row["host_mb"]
+    got = q().collect()
+    assert _rows(got) == _rows(expect)
+    assert metrics.counter("scan.path.resident_streaming") == 1
+    assert metrics.counter("residency.stream.windows") == row["windows"]
+    assert metrics.counter("scan.gate.resident_bypass_streaming") == 1
+    # per-window H2D happened; only count vectors came home
+    assert metrics.counter("residency.stream.h2d_bytes") > 0
+
+
+def test_streaming_serve_batch_parity_and_window_generation(
+    ladder_env, monkeypatch
+):
+    from hyperspace_tpu.serve import QueryServer, ServeConfig
+    from hyperspace_tpu.serve.batcher import classify
+
+    session, hs, q, expect = ladder_env
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_WINDOW_ROWS", "65536")
+    assert hs.prefetch_index("lidx", ["k", "v"])
+
+    # classify two compatible streaming queries: same window generation
+    # -> same batch key; a generation bump (device failure) splits them
+    plan = q().optimized_plan()
+    r1 = classify(session, plan)
+    r2 = classify(session, plan)
+    assert r1 is not None and r2 is not None
+    assert r1.batch_key == r2.batch_key
+    table = r1.table
+    assert table.tier == "streaming"
+    table.window_gen += 1
+    r3 = classify(session, plan)
+    assert r3.batch_key != r1.batch_key
+
+    # a served burst over the streaming table stays exact
+    server = QueryServer(session, ServeConfig(max_workers=2, autostart=False))
+    tickets = [server.submit(q()) for _ in range(6)]
+    server.start()
+    results = [t.result(timeout=120) for t in tickets]
+    for r in results:
+        assert _rows(r) == _rows(expect)
+    server.close()
+
+
+def test_ladder_off_knobs_route_host(ladder_env, monkeypatch):
+    session, hs, q, expect = ladder_env
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_COMPRESSION", "off")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_STREAMING", "off")
+    metrics.reset()
+    assert not hs.prefetch_index("lidx", ["k", "v"])
+    assert hbm_cache.snapshot()["tables"] == 0
+    assert metrics.counter("hbm.over_budget_refused") >= 1
+    got = q().collect()  # host path, still exact
+    assert _rows(got) == _rows(expect)
+
+
+def test_hybrid_declines_compressed_base(tmp_path, monkeypatch):
+    """A compressed base cannot anchor a delta region: hybrid queries
+    route the exact host union and no delta is ever registered."""
+    rng = np.random.default_rng(4)
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 50, 60_000).astype(np.int64),
+            "v": rng.integers(0, 100, 60_000).astype(np.int64),
+        }
+    )
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "p0.parquet", batch)
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.INDEX_NUM_BUCKETS: 2,
+            C.INDEX_HYBRID_SCAN_ENABLED: True,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("hc", ["k"], ["v"])
+    )
+    session.enable_hyperspace()
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_COMPRESSION", "force")
+    assert hs.prefetch_index("hc", ["k"])
+    assert hbm_cache.snapshot_residency()["by_tier"] == {"compressed": 1}
+    ap = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 50, 800).astype(np.int64),
+            "v": rng.integers(0, 100, 800).astype(np.int64),
+        }
+    )
+    parquet_io.write_parquet(src / "p1-append.parquet", ap)
+    key = int(batch.columns["k"].data[0])
+    q = (
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(key))
+        .select("k", "v")
+    )
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    metrics.reset()
+    on = q.collect()
+    assert sorted(on.columns["v"].data.tolist()) == sorted(
+        off.columns["v"].data.tolist()
+    )
+    hbm_cache.wait_background(timeout_s=30.0)
+    assert hbm_cache.snapshot()["deltas"] == 0
+    assert metrics.counter("scan.path.resident_hybrid") == 0
+
+
+# ---------------------------------------------------------------------------
+# mesh: compressed shards
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_compressed_parity(tmp_path, monkeypatch):
+    from hyperspace_tpu.parallel.mesh import make_mesh
+    from tests.e2e_utils import build_index, write_source
+
+    rng = np.random.default_rng(3)
+    # OFFSET domain (values far from 0): the pack spec must derive its
+    # frame from the REAL rows, not the zero-padded shard matrices — a
+    # padded 0 would stretch the span past the 16-bit budget and
+    # silently lose the compressed tier on the mesh
+    base = 1_000_000
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": (base + rng.integers(0, 500, 40_000)).astype(np.int64),
+            "v": rng.integers(0, 10**6, 40_000).astype(np.int64),
+        }
+    )
+    rel = write_source(tmp_path / "src", batch, n_files=3)
+    entry = build_index(
+        "mc", rel, ["k"], ["v"], tmp_path / "idx", num_buckets=16
+    )
+    files = entry.content.files()
+    mesh = make_mesh(8)
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_COMPRESSION", "force")
+    metrics.reset()
+    table = mesh_cache.prefetch(files, ["k"], mesh)
+    assert table is not None and table.tier == "compressed"
+    assert table.columns["k"].pack is not None
+    assert table.columns["k"].pack.ref0 >= base
+    predicate = col("k") == lit(base + 123)
+    counts = mesh_cache.block_counts(table, predicate)
+    assert counts is not None
+    # ground truth: the raw shards' per-block counts (fresh cache, knob off)
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_COMPRESSION", "off")
+    mesh_cache.reset()
+    raw_table = mesh_cache.prefetch(files, ["k"], mesh)
+    assert raw_table is not None and raw_table.tier == "resident"
+    raw_counts = mesh_cache.block_counts(raw_table, predicate)
+    assert (np.asarray(counts) == np.asarray(raw_counts)).all()
+
+
+# ---------------------------------------------------------------------------
+# join regions: FoR-delta right codes
+# ---------------------------------------------------------------------------
+
+
+def _join_fixture(tmp_path, seed=5):
+    rng = np.random.default_rng(seed)
+    left = ColumnarBatch.from_pydict(
+        {
+            "lk": rng.integers(0, 2000, 30_000).astype(np.int64),
+            "lg": rng.integers(0, 40, 30_000).astype(np.int64),
+            "lv": rng.integers(0, 100, 30_000).astype(np.int64),
+        }
+    )
+    right = ColumnarBatch.from_pydict(
+        {
+            "rk": rng.integers(0, 2000, 30_000).astype(np.int64),
+            "rv": rng.integers(0, 100, 30_000).astype(np.int64),
+        }
+    )
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"), C.INDEX_NUM_BUCKETS: 4}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    for sub, b in (("l", left), ("r", right)):
+        d = tmp_path / sub
+        d.mkdir()
+        parquet_io.write_parquet(d / "p0.parquet", b)
+    hs.create_index(
+        session.read.parquet(str(tmp_path / "l")),
+        IndexConfig("jli", ["lk"], ["lg", "lv"]),
+    )
+    hs.create_index(
+        session.read.parquet(str(tmp_path / "r")),
+        IndexConfig("jri", ["rk"], ["rv"]),
+    )
+    session.enable_hyperspace()
+    return session, hs
+
+
+def _join_q(session, tmp_path):
+    return (
+        session.read.parquet(str(tmp_path / "l"))
+        .join(
+            session.read.parquet(str(tmp_path / "r")),
+            col("lk") == col("rk"),
+        )
+        .select("lv", "rv")
+    )
+
+
+def test_join_for_delta_packs_and_stays_exact(tmp_path, monkeypatch):
+    session, hs = _join_fixture(tmp_path)
+    j = _join_q(session, tmp_path)
+    session.disable_hyperspace()
+    off = j.collect()
+    session.enable_hyperspace()
+
+    def run(knob):
+        monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_FOR_DELTA", knob)
+        hbm_cache.reset()
+        metrics.reset()
+        for _ in range(3):  # background population converges
+            j.collect()
+            hbm_cache.wait_background(timeout_s=60.0)
+            if hbm_cache.snapshot_joins()["regions"]:
+                break
+        snap = hbm_cache.snapshot_joins()
+        assert snap["regions"] == 1, f"region missing under forDelta={knob}"
+        got = j.collect()  # resident join
+        assert metrics.counter("scan.path.resident_join") >= 1
+        return got, hbm_cache._joins[0]
+
+    on_res, on_region = run("on")
+    assert on_region.r_pack is not None, "dense sorted codes must pack"
+    off_res, off_region = run("off")
+    assert off_region.r_pack is None
+
+    def rows(b):
+        return sorted(
+            zip(b.columns["lv"].data.tolist(), b.columns["rv"].data.tolist())
+        )
+
+    assert rows(on_res) == rows(off_res) == rows(off)
+    assert on_region.nbytes < off_region.nbytes
+
+
+def test_join_agg_for_delta_parity(tmp_path, monkeypatch):
+    from hyperspace_tpu.plan.aggregates import agg_count, agg_sum
+
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_FOR_DELTA", "on")
+    session, hs = _join_fixture(tmp_path, seed=6)
+    agg = (
+        session.read.parquet(str(tmp_path / "l"))
+        .join(
+            session.read.parquet(str(tmp_path / "r")),
+            col("lk") == col("rk"),
+        )
+        .group_by("lg")
+        .agg(agg_sum("rv", "srv"), agg_count())
+    )
+    session.disable_hyperspace()
+    off = agg.collect()
+    session.enable_hyperspace()
+    metrics.reset()
+    for _ in range(3):
+        agg.collect()
+        hbm_cache.wait_background(timeout_s=60.0)
+        if hbm_cache.snapshot_joins()["regions"]:
+            break
+    assert hbm_cache.snapshot_joins()["regions"] == 1
+    assert hbm_cache._joins[0].r_pack is not None
+    on = agg.collect()
+    assert metrics.counter("scan.path.resident_join_agg") >= 1
+
+    def rows(b):
+        cols = sorted(b.columns)
+        return sorted(
+            tuple(b.columns[c].data.tolist()[i] for c in cols)
+            for i in range(b.num_rows)
+        )
+
+    assert rows(on) == rows(off)
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def test_residency_surfaces_in_stats_and_explain(ladder_env, monkeypatch):
+    from hyperspace_tpu.serve import QueryServer, ServeConfig
+    from hyperspace_tpu.telemetry.metrics import residency_snapshot
+
+    session, hs, q, expect = ladder_env
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_WINDOW_ROWS", "65536")
+    metrics.reset()
+    assert hs.prefetch_index("lidx", ["k", "v"])
+    got = q().collect()
+    assert _rows(got) == _rows(expect)
+
+    snap = residency_snapshot()
+    assert snap["scans_streaming"] == 1
+    assert snap["streaming_tables_built"] == 1
+    assert snap["stream_windows"] >= 3
+
+    server = QueryServer(session, ServeConfig(max_workers=1, autostart=False))
+    stats = server.stats()["residency"]
+    assert stats["hbm"]["by_tier"] == {"streaming": 1}
+    assert "mesh" in stats and "stream_windows" in stats
+    server.close()
+
+    # explain(verbose) names the tier that served the last query
+    text = hs.explain(q(), verbose=True)
+    assert "Residency tier served: streaming" in text
