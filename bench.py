@@ -1818,6 +1818,244 @@ def main() -> None:
             _hbm14.reset()
         extras["oversubscribed"] = ov_detail
 
+    # ---- config 15: multi-tenant serving resilience ------------------------
+    # The tenancy claim (docs/16-multitenant-serving.md): under a
+    # 3-tenant mixed burst with a concurrent refresh and one injected
+    # device loss, (a) no query hangs or observes a torn snapshot —
+    # pre-refresh admissions serve the pre-refresh rows WHOLESALE,
+    # post-refresh admissions the post rows; (b) the weighted-fair
+    # dispatcher keeps each tenant's share within 2x of its weight; (c)
+    # the circuit breaker opens on consecutive deadline misses and
+    # recovers through a half-open probe. All three are hard gates here
+    # (they are device-independent invariants); the counters land in
+    # BENCH_DETAIL["multitenant"].
+    if (
+        os.environ.get("BENCH_MULTITENANT", "1") != "0"
+        and "resident_device_s" in extras
+    ):
+        from hyperspace_tpu.exec import hbm_cache as _hc15
+        from hyperspace_tpu.serve import (
+            AdmissionRejected as _AR15,
+            DeadlineExceeded as _DE15,
+            QueryServer as _QS15,
+            ServeConfig as _SC15,
+        )
+        from hyperspace_tpu.telemetry.metrics import (
+            serve_snapshot as _serve_snap15,
+        )
+
+        mt_detail: dict = {}
+        _prev_hbm15 = os.environ.get("HYPERSPACE_TPU_HBM")
+        os.environ["HYPERSPACE_TPU_HBM"] = "force"
+        # conf keys restored in the finally: a later config serving
+        # queries must not inherit the hair-trigger breaker/weights (and
+        # each key participates in the plan-cache version token)
+        _conf_keys15 = [
+            f"{C.SERVE_TENANT_PREFIX}.{n}.weight"
+            for n in ("bronze", "silver", "gold")
+        ] + [C.SERVE_BREAKER_MISS_THRESHOLD, C.SERVE_BREAKER_OPEN_SECONDS]
+        _prev_conf15 = {
+            k: session.conf.get(k)
+            for k in _conf_keys15
+            if session.conf.contains(k)
+        }
+        for name15, w15 in (("bronze", 1), ("silver", 2), ("gold", 4)):
+            session.conf.set(f"{C.SERVE_TENANT_PREFIX}.{name15}.weight", w15)
+        session.conf.set(C.SERVE_BREAKER_MISS_THRESHOLD, 2)
+        # cooldown long enough that a loaded-runner stall between the
+        # second miss and the open-rejection check cannot lapse it
+        session.conf.set(C.SERVE_BREAKER_OPEN_SECONDS, 2.0)
+        _real_bcb15 = _hc15.HbmIndexCache.block_counts_batch
+        t15_0 = time.perf_counter()
+        try:
+            _hc15.hbm_cache.reset()
+            if not hs.prefetch_index("li_res_idx"):
+                _fail("config15 resident prefetch refused")
+            mk15 = lambda k: (  # noqa: E731
+                session.read.parquet(str(WORKDIR / "resident"))
+                .filter(col("r_k") == lit(int(k)))
+                .select("r_k", "r_v")
+            )
+            canon15 = lambda b: sorted(  # noqa: E731
+                zip(
+                    b.columns["r_k"].data.tolist(),
+                    b.columns["r_v"].data.tolist(),
+                )
+            )
+            mt_keys = [
+                int(resident_tbl.columns["r_k"].data[(i * 104729) % RES_ROWS])
+                for i in range(12)
+            ]
+
+            # phase A — injected device loss mid-batch: a compatible
+            # cross-tenant burst coalesces into the FIRST dispatch,
+            # which dies; the server must latch host and answer the
+            # whole burst exactly, no error to any caller
+            loss15 = {"fired": False}
+
+            def _lossy15(self, table, predicates, prepared=None):
+                if not loss15["fired"]:
+                    loss15["fired"] = True
+                    raise RuntimeError("UNAVAILABLE: injected device loss")
+                return _real_bcb15(self, table, predicates, prepared)
+
+            _hc15.HbmIndexCache.block_counts_batch = _lossy15
+            want_a = canon15(mk15(mt_keys[0]).collect())
+            srv_a = _QS15(
+                session, _SC15(max_workers=1, max_queue=256, autostart=False)
+            )
+            burst_a = [
+                srv_a.submit(mk15(mt_keys[0]), tenant=t)
+                for t in ("bronze", "silver", "gold")
+                for _ in range(3)
+            ]
+            srv_a.start()
+            for tk in burst_a:
+                if canon15(tk.result(timeout=300)) != want_a:
+                    _fail("config15 device-loss burst parity violated")
+            if not loss15["fired"] or not srv_a.stats()["degraded"]:
+                _fail("config15 device loss never latched the server")
+            mt_detail["device_loss"] = {
+                "burst": len(burst_a),
+                "latched": True,
+                "parity_ok": True,
+            }
+            srv_a.close()
+            _hc15.HbmIndexCache.block_counts_batch = _real_bcb15
+
+            # phase B — refresh racing admitted queries: the pre-refresh
+            # burst (queued, pinned) must serve PRE rows wholesale even
+            # though the refresh commits before any of it executes;
+            # post-refresh admissions must serve POST rows wholesale
+            pre15 = {k: canon15(mk15(k).collect()) for k in mt_keys[:6]}
+            srv_b = _QS15(
+                session, _SC15(max_workers=2, max_queue=256, autostart=False)
+            )
+            tickets_b = [
+                srv_b.submit(mk15(k), tenant=t)
+                for k, t in zip(mt_keys[:6], ("bronze", "silver", "gold") * 2)
+            ]
+            pins = {t.pinned_log_version for t in tickets_b}
+            ap15 = resident_tbl.take(np.arange(2000))
+            parquet_io.write_parquet(
+                WORKDIR / "resident" / "part-mt-append.parquet", ap15
+            )
+            hs.refresh_index("li_res_idx", C.REFRESH_MODE_INCREMENTAL)
+            srv_b.start()
+            for k, tk in zip(mt_keys[:6], tickets_b):
+                if canon15(tk.result(timeout=300)) != pre15[k]:
+                    _fail(f"config15 torn snapshot: key {k} mixed generations")
+            post_tk = srv_b.submit(mk15(mt_keys[0]), tenant="gold")
+            post_rows = canon15(post_tk.result(timeout=300))
+            if post_tk.pinned_log_version in pins:
+                _fail("config15 post-refresh submission pinned the old version")
+            if post_rows != canon15(mk15(mt_keys[0]).collect()):
+                _fail("config15 post-refresh snapshot parity violated")
+            mt_detail["snapshot"] = {
+                "pre_burst": len(tickets_b),
+                "wholesale_ok": True,
+                "pinned_pre": len(pins),
+            }
+            srv_b.close()
+
+            # phase C — weighted-fair shares: every tenant backlogged on
+            # a paused 1-worker server; over the all-backlogged window
+            # each tenant's dispatch share must sit within 2x of its
+            # weight share (the scored fairness bound)
+            srv_c = _QS15(
+                session,
+                _SC15(
+                    max_workers=1, max_queue=256, batch_max=1, autostart=False
+                ),
+            )
+            tickets_c = []
+            for i in range(12):
+                for t in ("bronze", "silver", "gold"):
+                    tickets_c.append(
+                        srv_c.submit(mk15(mt_keys[i % len(mt_keys)]), tenant=t)
+                    )
+            srv_c.start()
+            for tk in tickets_c:
+                tk.result(timeout=300)
+            order15 = list(srv_c._dispatch_order)[:21]
+            shares15 = {
+                n: order15.count(n) / len(order15)
+                for n in ("bronze", "silver", "gold")
+            }
+            fair_maxdev = 0.0
+            for n, w in (("bronze", 1), ("silver", 2), ("gold", 4)):
+                want = w / 7.0
+                dev = max(shares15[n] / want, want / max(shares15[n], 1e-9))
+                fair_maxdev = max(fair_maxdev, dev)
+                if not (want / 2 <= shares15[n] <= want * 2):
+                    _fail(
+                        f"config15 fairness bound violated: {n} share "
+                        f"{shares15[n]:.3f} vs weight share {want:.3f}"
+                    )
+            mt_detail["fairness"] = {
+                "window_turns": len(order15),
+                "shares": {k: round(v, 3) for k, v in shares15.items()},
+                "max_weight_deviation_x": round(fair_maxdev, 2),
+            }
+            srv_c.close()
+
+            # phase D — circuit breaker: two consecutive deadline misses
+            # open bronze's circuit (threshold 2), the cooldown lapses,
+            # the half-open probe succeeds and closes it
+            srv_d = _QS15(
+                session, _SC15(max_workers=1, max_queue=64, autostart=False)
+            )
+            doomed15 = [
+                srv_d.submit(
+                    mk15(mt_keys[0]), deadline_s=0.001, tenant="bronze"
+                )
+                for _ in range(2)
+            ]
+            time.sleep(0.02)
+            srv_d.start()
+            for tk in doomed15:
+                try:
+                    tk.result(timeout=60)
+                    _fail("config15 doomed query beat its 1ms deadline")
+                except _DE15:
+                    pass
+            if srv_d.stats()["tenants"]["bronze"]["breaker"]["opens"] < 1:
+                _fail("config15 breaker never opened after 2 misses")
+            probe15 = None
+            try:
+                # normally rejected (cooldown running); under an extreme
+                # stall the cooldown may already have lapsed, in which
+                # case THIS submission is the half-open probe
+                probe15 = srv_d.submit(mk15(mt_keys[0]), tenant="bronze")
+            except _AR15 as e:
+                if e.reason != "breaker_open":
+                    _fail(f"config15 expected breaker_open, got {e.reason}")
+            if probe15 is None:
+                time.sleep(2.1)
+                probe15 = srv_d.submit(mk15(mt_keys[0]), tenant="bronze")
+            probe15.result(timeout=120)
+            br15 = srv_d.stats()["tenants"]["bronze"]["breaker"]
+            if br15["state"] != "closed" or br15["opens"] < 1 or br15["probes"] < 1:
+                _fail(f"config15 breaker did not recover via half-open: {br15}")
+            mt_detail["breaker"] = br15
+            srv_d.close()
+
+            mt_detail["wall_s"] = round(time.perf_counter() - t15_0, 3)
+            mt_detail["serve_counters"] = _serve_snap15()
+            extras["multitenant"] = mt_detail
+        finally:
+            _hc15.HbmIndexCache.block_counts_batch = _real_bcb15
+            for k15 in _conf_keys15:
+                if k15 in _prev_conf15:
+                    session.conf.set(k15, _prev_conf15[k15])
+                else:
+                    session.conf.unset(k15)
+            if _prev_hbm15 is None:
+                os.environ.pop("HYPERSPACE_TPU_HBM", None)
+            else:
+                os.environ["HYPERSPACE_TPU_HBM"] = _prev_hbm15
+            _hc15.hbm_cache.reset()
+
     # ---- mesh-path A/B (round-4 verdict next-round #1 "done" criterion) ----
     # run on the virtual 8-device CPU mesh in a subprocess (the bench host
     # has ONE physical chip; per-query link-bytes under each architecture
@@ -1964,6 +2202,19 @@ def main() -> None:
     ):
         if src_k in ov14:
             compact[dst_k] = ov14[src_k]
+    mt15 = extras.get("multitenant", {})
+    if mt15:
+        # headline tenancy gates only; the per-phase detail (snapshot
+        # pins, breaker transitions, counters) stays in the sidecar
+        compact["multitenant_fair_maxdev_x"] = mt15["fairness"][
+            "max_weight_deviation_x"
+        ]
+        compact["multitenant_breaker_recovered"] = (
+            mt15["breaker"]["state"] == "closed"
+        )
+        compact["multitenant_device_loss_latched"] = mt15["device_loss"][
+            "latched"
+        ]
     compact["detail"] = detail_path.name
     line = json.dumps(compact)
     while len(line) > 1900:

@@ -236,6 +236,94 @@ class HyperspaceConf:
             queue_depth=max(1, int(self.get(C.BUILD_QUEUE_DEPTH, auto.queue_depth))),
         )
 
+    def serve_tenant_policy(self, tenant: str):
+        """The TenantPolicy for ``tenant`` (serve.tenancy): per-tenant
+        override keys (``hyperspace.serve.tenant.<name>.weight`` /
+        ``.maxQueue`` / ``.maxInflight`` — the SERVE_TENANT_PREFIX
+        family) fall back to the declared defaults. Resolved at the
+        tenant's FIRST submit on a server; later conf edits apply to
+        tenants not yet seen."""
+        from .serve.tenancy import TenantPolicy
+
+        def _over(field: str, default):
+            return self.get(f"{C.SERVE_TENANT_PREFIX}.{tenant}.{field}", default)
+
+        weight = float(
+            _over(
+                "weight",
+                self.get(
+                    C.SERVE_TENANT_DEFAULT_WEIGHT,
+                    C.SERVE_TENANT_DEFAULT_WEIGHT_DEFAULT,
+                ),
+            )
+        )
+        if weight <= 0:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"tenant {tenant!r}: weight must be > 0, got {weight}."
+            )
+        return TenantPolicy(
+            weight=weight,
+            max_queue=int(
+                _over(
+                    "maxQueue",
+                    self.get(
+                        C.SERVE_TENANT_DEFAULT_MAX_QUEUE,
+                        C.SERVE_TENANT_DEFAULT_MAX_QUEUE_DEFAULT,
+                    ),
+                )
+            ),
+            max_inflight=int(
+                _over(
+                    "maxInflight",
+                    self.get(
+                        C.SERVE_TENANT_DEFAULT_MAX_INFLIGHT,
+                        C.SERVE_TENANT_DEFAULT_MAX_INFLIGHT_DEFAULT,
+                    ),
+                )
+            ),
+        )
+
+    def serve_breaker_miss_threshold(self) -> int:
+        return int(
+            self.get(
+                C.SERVE_BREAKER_MISS_THRESHOLD,
+                C.SERVE_BREAKER_MISS_THRESHOLD_DEFAULT,
+            )
+        )
+
+    def serve_breaker_open_seconds(self) -> float:
+        return float(
+            self.get(
+                C.SERVE_BREAKER_OPEN_SECONDS, C.SERVE_BREAKER_OPEN_SECONDS_DEFAULT
+            )
+        )
+
+    def serve_shed_highwater_fraction(self) -> float:
+        return float(
+            self.get(
+                C.SERVE_SHED_HIGHWATER_FRACTION,
+                C.SERVE_SHED_HIGHWATER_FRACTION_DEFAULT,
+            )
+        )
+
+    def serve_shed_batch_off_fraction(self) -> float:
+        return float(
+            self.get(
+                C.SERVE_SHED_BATCH_OFF_FRACTION,
+                C.SERVE_SHED_BATCH_OFF_FRACTION_DEFAULT,
+            )
+        )
+
+    def serve_drain_rate_window_seconds(self) -> float:
+        return float(
+            self.get(
+                C.SERVE_DRAIN_RATE_WINDOW_SECONDS,
+                C.SERVE_DRAIN_RATE_WINDOW_SECONDS_DEFAULT,
+            )
+        )
+
     def residency_compression(self) -> str:
         v = str(
             self.get(C.RESIDENCY_COMPRESSION, C.RESIDENCY_COMPRESSION_DEFAULT)

@@ -179,6 +179,56 @@ RELIABILITY_RETRY_BASE_DELAY_SECONDS_DEFAULT = 0.05
 RELIABILITY_RETRY_MAX_DELAY_SECONDS = "hyperspace.reliability.retry.maxDelaySeconds"
 RELIABILITY_RETRY_MAX_DELAY_SECONDS_DEFAULT = 2.0
 
+# --- multi-tenant serving ----------------------------------------------------
+# Per-tenant admission quotas, weighted-fair scheduling, and overload
+# degradation for the serve tier (docs/16-multitenant-serving.md; no
+# reference analog — Spark Hyperspace serves through Spark's own
+# scheduler). Per-tenant overrides are a RUNTIME-BUILT key family under
+# SERVE_TENANT_PREFIX (f"{prefix}.<tenant>.weight" etc.) — the prefix
+# constant is the HS013 registration act for the family.
+SERVE_TENANT_PREFIX = "hyperspace.serve.tenant"
+# Relative scheduling weight of a tenant with no per-tenant override:
+# the weighted deficit dispatcher grants device/worker turns in
+# proportion to weight, so a weight-4 tenant drains ~4x as fast as a
+# weight-1 tenant under contention.
+SERVE_TENANT_DEFAULT_WEIGHT = "hyperspace.serve.tenant.defaultWeight"
+SERVE_TENANT_DEFAULT_WEIGHT_DEFAULT = 1.0
+# Per-tenant queue-depth cap: a tenant whose own backlog reaches this
+# is rejected even when the global queue has room — one tenant's burst
+# cannot consume the whole admission budget.
+SERVE_TENANT_DEFAULT_MAX_QUEUE = "hyperspace.serve.tenant.defaultMaxQueue"
+SERVE_TENANT_DEFAULT_MAX_QUEUE_DEFAULT = 32
+# Per-tenant in-flight cap: how many of a tenant's queries may occupy
+# workers at once (0 or negative = no cap).
+SERVE_TENANT_DEFAULT_MAX_INFLIGHT = "hyperspace.serve.tenant.defaultMaxInflight"
+SERVE_TENANT_DEFAULT_MAX_INFLIGHT_DEFAULT = 0
+# Circuit breaker: this many CONSECUTIVE deadline misses open a
+# tenant's circuit; while open, submissions are rejected immediately
+# (retry-after = the remaining cooldown). After openSeconds the breaker
+# goes HALF-OPEN: exactly one probe query is admitted — a clean finish
+# closes the circuit, another miss re-opens it.
+SERVE_BREAKER_MISS_THRESHOLD = "hyperspace.serve.tenant.breaker.missThreshold"
+SERVE_BREAKER_MISS_THRESHOLD_DEFAULT = 5
+SERVE_BREAKER_OPEN_SECONDS = "hyperspace.serve.tenant.breaker.openSeconds"
+SERVE_BREAKER_OPEN_SECONDS_DEFAULT = 5.0
+# Load-shed ladder (least- to most-drastic as global occupancy climbs):
+#   depth >= highWaterFraction * global queue cap -> submissions from
+#     the LOWEST-weight tenant class are rejected first;
+#   depth >= batchOffFraction * cap -> micro-batch widening is disabled
+#     (each dispatch serves one query: no drain scan, lower per-dispatch
+#     latency variance under pressure);
+#   the third rung — host-latch degraded mode — is triggered by device
+#     failure, not load (the PR-2 latch).
+SERVE_SHED_HIGHWATER_FRACTION = "hyperspace.serve.shed.highWaterFraction"
+SERVE_SHED_HIGHWATER_FRACTION_DEFAULT = 0.75
+SERVE_SHED_BATCH_OFF_FRACTION = "hyperspace.serve.shed.batchOffFraction"
+SERVE_SHED_BATCH_OFF_FRACTION_DEFAULT = 0.9
+# Sliding window over which per-tenant completion (drain) rate is
+# measured; AdmissionRejected.retry_after_s = queued/(drain rate), so
+# backoff reflects the tenant's OBSERVED throughput, not a constant.
+SERVE_DRAIN_RATE_WINDOW_SECONDS = "hyperspace.serve.retryAfter.windowSeconds"
+SERVE_DRAIN_RATE_WINDOW_SECONDS_DEFAULT = 10.0
+
 # --- residency tier ladder ---------------------------------------------------
 # Oversubscribed residency (docs/15-streaming-residency.md; no reference
 # analog — Spark leans on the OS page cache). The exec caches are

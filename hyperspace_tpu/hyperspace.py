@@ -88,11 +88,14 @@ class Hyperspace:
         return self.session.doctor(repair=repair)
 
     def serve(self, **options):
-        """The session's QueryServer (serve.QueryServer): bounded-queue
-        admission, per-query deadlines, micro-batched resident scans and
-        plan caching over this session's indexes — the concurrent-traffic
-        surface of the north star (docs/10-serving.md). Options are
-        ServeConfig fields, applied on first creation only."""
+        """The session's QueryServer (serve.QueryServer): per-tenant
+        admission quotas with weighted-fair scheduling, per-query
+        deadlines with circuit breaking, micro-batched resident scans,
+        plan caching with snapshot-pinned reads, and graceful overload
+        degradation over this session's indexes — the concurrent-traffic
+        surface of the north star (docs/10-serving.md,
+        docs/16-multitenant-serving.md). Options are ServeConfig fields,
+        applied on first creation only."""
         return self.session.serve(**options)
 
     def explain(self, df: DataFrame, verbose: bool = False) -> str:

@@ -140,6 +140,21 @@ def explain_string(
             )
         buf.write_line()
 
+        # serve attribution: which tenant the last SERVED query ran as
+        # and which index-log version it pinned at admission — the
+        # multi-tenant twin of the scoped-metrics section below
+        serve_info = getattr(session, "last_serve_info", None)
+        if serve_info is not None:
+            buf.write_line(_BANNER)
+            buf.write_line("Last served query (serve tier):")
+            buf.write_line(_BANNER)
+            buf.write_line(f"Tenant: {serve_info.get('tenant')}")
+            buf.write_line(
+                "Pinned log version: "
+                f"{serve_info.get('pinned_log_version')}"
+            )
+            buf.write_line()
+
         # the last query's OWN scoped share (telemetry.metrics.scoped):
         # under concurrent serving the cumulative pool above mixes every
         # in-flight query; this section is attributable to exactly one

@@ -1,28 +1,40 @@
-"""Concurrent query serving: bounded admission, micro-batched resident
-scans, plan caching, graceful degradation.
+"""Concurrent multi-tenant query serving: per-tenant admission quotas,
+weighted-fair scheduling, micro-batched resident scans, plan caching,
+snapshot-pinned reads, and graceful overload degradation.
 
-Entry points: ``session.serve()`` / ``session.submit(df)`` (the facade
-verbs), or construct a ``QueryServer`` directly. See docs/10-serving.md
-for the architecture and the batching eligibility rules.
+Entry points: ``session.serve()`` / ``session.submit(df, tenant=...)``
+(the facade verbs), or construct a ``QueryServer`` directly;
+``serve.client.submit_with_retry`` adds jittered-backoff retry on
+admission rejection. See docs/10-serving.md for the architecture and
+docs/16-multitenant-serving.md for the tenancy/degradation model.
 """
 
+from .client import submit_with_retry
 from .plan_cache import PlanCache, plan_signature
 from .server import (
     AdmissionRejected,
     DeadlineExceeded,
+    QueryCancelled,
     QueryServer,
     QueryTicket,
     ServeConfig,
     ServerClosed,
 )
+from .tenancy import DEFAULT_TENANT, CircuitBreaker, TenantPolicy, TenantState
 
 __all__ = [
     "AdmissionRejected",
+    "CircuitBreaker",
+    "DEFAULT_TENANT",
     "DeadlineExceeded",
     "PlanCache",
+    "QueryCancelled",
     "QueryServer",
     "QueryTicket",
     "ServeConfig",
     "ServerClosed",
+    "TenantPolicy",
+    "TenantState",
     "plan_signature",
+    "submit_with_retry",
 ]

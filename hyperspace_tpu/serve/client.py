@@ -1,0 +1,71 @@
+"""Client-side submit helper: jittered-backoff retry on admission
+rejection.
+
+``AdmissionRejected`` is backpressure, not failure — the server tells
+the caller how long its tenant's backlog plausibly needs to drain
+(``retry_after_s``, derived from the observed drain rate). A fleet of
+clients that all sleep exactly that long re-arrives as one synchronized
+thundering herd, so the retry delay here is the MAX of the server's
+estimate and the reliability layer's deterministically-JITTERED
+exponential backoff (reliability.RetryPolicy — the same policy the
+storage seam uses). The jitter seed includes the submitted DataFrame's
+object identity, not just the tenant: a fleet of same-tenant clients
+rejected at the same instant would otherwise compute IDENTICAL delays
+(delay_for is a pure function of (seed, attempt)) and re-arrive in
+lockstep; object identity is client-unique yet stable across one
+call's attempts, so each client's backoff sequence stays deterministic
+while the fleet spreads.
+
+Breaker-open rejections retry the same way: the server's retry-after is
+the remaining cooldown, so the client naturally re-arrives around the
+half-open probe window.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..reliability.retry import RetryPolicy
+from ..telemetry.metrics import metrics
+from .server import AdmissionRejected, QueryTicket
+from .tenancy import DEFAULT_TENANT
+
+# client backoff is measured in queue-drain time, not storage-RPC time:
+# a slower base and more headroom than the storage default
+DEFAULT_CLIENT_POLICY = RetryPolicy(
+    max_attempts=5, base_delay_s=0.05, max_delay_s=5.0
+)
+
+
+def submit_with_retry(
+    server,
+    df,
+    *,
+    tenant: str = DEFAULT_TENANT,
+    deadline_s: Optional[float] = None,
+    policy: Optional[RetryPolicy] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> QueryTicket:
+    """``server.submit`` with jittered-backoff retry on AdmissionRejected.
+
+    Each rejection sleeps ``max(server retry_after, policy backoff)``
+    and retries, up to ``policy.max_attempts`` total submit attempts;
+    the final rejection propagates (``serve.client.exhausted``). Every
+    other outcome — including ServerClosed and planning failures riding
+    the ticket — is the caller's, first try."""
+    policy = policy or DEFAULT_CLIENT_POLICY
+    attempts = max(1, policy.max_attempts)
+    for attempt in range(1, attempts + 1):
+        try:
+            return server.submit(df, deadline_s=deadline_s, tenant=tenant)
+        except AdmissionRejected as e:
+            if attempt == attempts:
+                metrics.incr("serve.client.exhausted")
+                raise
+            metrics.incr("serve.client.retry")
+            delay = policy.delay_for(
+                attempt, seed_key=f"serve:{tenant}:{id(df)}"
+            )
+            sleep(max(e.retry_after_s, delay))
+    raise AssertionError("unreachable")

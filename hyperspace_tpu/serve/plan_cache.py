@@ -91,21 +91,56 @@ class PlanCache:
         Cache hits skip rewrite AND usage-event telemetry (the event
         already fired when the plan was first optimized; serving metrics
         count executions)."""
-        key = (plan_signature(df.plan), self._version_token(df.session))
-        with self._lock:
-            hit = self._plans.get(key)
+        return self.optimized_plan_with_token(df)[0]
+
+    def optimized_plan_with_token(self, df) -> "Tuple[LogicalPlan, Tuple]":
+        """``(optimized plan, version token)`` — the token is the exact
+        index-log/session snapshot the plan was resolved under; the
+        server pins it on the ticket so a query admitted under version V
+        serves V wholesale across any concurrent refresh/optimize
+        (token[1] is the sorted (name, id, state) tuple of ACTIVE
+        indexes — the human-readable log version).
+
+        Token and optimization are NOT naturally atomic: a refresh
+        committing between the token read and the rewrite would bake the
+        NEW generation's files into a plan pinned (and cached) under the
+        OLD token — the pin would lie and the cache would serve the
+        wrong generation to same-token callers. So the token is re-read
+        after optimizing and the pair is only trusted (and cached) when
+        both reads agree; a mismatch re-resolves under the new version."""
+        signature = plan_signature(df.plan)
+        token = self._version_token(df.session)
+        for _attempt in range(4):
+            key = (signature, token)
+            with self._lock:
+                hit = self._plans.get(key)
+                if hit is not None:
+                    self._plans.move_to_end(key)
             if hit is not None:
-                self._plans.move_to_end(key)
-        if hit is not None:
-            metrics.incr("serve.plan_cache.hit")
-            return hit
-        metrics.incr("serve.plan_cache.miss")
-        plan = df.optimized_plan(log_usage=True)
-        with self._lock:
-            self._plans[key] = plan
-            while len(self._plans) > self._max:
-                self._plans.popitem(last=False)
-        return plan
+                metrics.incr("serve.plan_cache.hit")
+                return hit, token
+            metrics.incr("serve.plan_cache.miss")
+            plan = df.optimized_plan(log_usage=True)
+            token_after = self._version_token(df.session)
+            if token_after == token:
+                with self._lock:
+                    self._plans[key] = plan
+                    while len(self._plans) > self._max:
+                        self._plans.popitem(last=False)
+                return plan, token
+            metrics.incr("serve.plan_cache.version_race")
+            token = token_after
+        # index log churning faster than we can replan (pathological):
+        # REFUSE rather than pin a generation the double-read never
+        # confirmed — a lying pin would serve torn snapshots silently.
+        # The error rides the ticket as a plan failure; the client
+        # retries into a (momentarily) quieter log.
+        from ..exceptions import HyperspaceException
+
+        raise HyperspaceException(
+            "index log version changed on every replan attempt; could "
+            "not resolve a stable snapshot to pin."
+        )
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -1,38 +1,44 @@
-"""QueryServer: concurrent query serving over one session.
+"""QueryServer: multi-tenant concurrent query serving over one session.
 
 The session API executes one query per ``collect()`` call on the calling
-thread; the north star serves heavy concurrent traffic. This server puts
-a BOUNDED admission queue and a worker pool between callers and the
-executor:
+thread; the north star serves heavy concurrent traffic from many
+tenants. This server puts per-tenant admission queues, a weighted-fair
+dispatcher, and a worker pool between callers and the executor:
 
-* **admission control** — a full queue rejects immediately with the
-  current depth and a retry-after estimate instead of queueing unbounded
-  latency (load shedding at the front door, not timeout storms at the
-  back);
+* **per-tenant admission control** — ``submit(df, tenant=...)`` routes
+  through per-tenant quotas (queue-depth and in-flight caps, weights —
+  the ``hyperspace.serve.tenant.*`` conf family) in front of the global
+  bound, so one tenant's burst is shed at ITS door while everyone
+  else's queries keep landing; ``AdmissionRejected`` carries the
+  tenant, its depth, and a retry-after derived from the tenant's
+  OBSERVED drain rate (queue depth / completions-per-second);
+* **weighted-fair dispatch** — workers pull the next query via smooth
+  weighted round-robin over the backlogged tenants (serve.tenancy), so
+  completed-query shares converge to weight shares under contention
+  instead of FIFO's arrival-order capture;
+* **snapshot-pinned reads** — each admitted query pins the index-log
+  version it admitted under (the plan-cache version token): the
+  optimized plan bakes that snapshot's file identities in, so a
+  concurrent refresh/optimize never tears a running query across two
+  index generations — it serves wholly pre- or wholly post-refresh;
 * **per-query deadlines** — a query whose deadline passes while queued
-  is failed without executing (its slot goes to a query that can still
-  make it); execution itself is not preempted, so the deadline bounds
-  QUEUE time exactly and service time statistically (see stats);
+  is failed without executing; repeated misses open the tenant's
+  CIRCUIT BREAKER (reject for a cooldown, then half-open: one probe
+  decides), so a tenant that cannot make its deadlines stops adding
+  queue wait for tenants that can;
 * **micro-batching** — a worker that dequeues a batchable resident scan
-  drains every compatible queued request and serves them with ONE device
-  dispatch (serve.batcher); incompatible traffic flows around the batch
-  through the other workers;
-* **plan caching** — optimized plans are cached across queries keyed by
-  normalized plan signature, invalidated by index-log version
-  (serve.plan_cache);
-* **graceful degradation** — a device failure mid-serve (or a
-  deviceprobe first-touch verdict of "wedged") latches the server onto
-  the host engine: the failed batch re-executes host-side with identical
-  results, the resident table is dropped, and every later query routes
-  host until the process is restarted. Latched beats flapping: the
-  wedged-tunnel failure mode hangs, so each retry would cost a timeout.
+  drains every compatible queued request (across tenants) and serves
+  them with ONE device dispatch (serve.batcher);
+* **graceful overload degradation** — a load-shed ladder as global
+  occupancy climbs: lowest-weight tenants shed first, then micro-batch
+  widening is disabled, and (on device failure, not load) the host
+  latch serves exact host paths until restart. Latched beats flapping:
+  the wedged-tunnel failure mode hangs, so each retry costs a timeout.
 
 Tickets: ``submit()`` returns a QueryTicket immediately; ``result()``
-blocks for that query only. Worker threads execute each query under a
-scoped metrics child (telemetry.metrics), so every ticket carries
-attributable counters/timers — its own for single execution, its
-batch's shared scope for coalesced execution (a per-query split of one
-stacked launch would be fiction).
+blocks for that query only, ``cancel()`` withdraws it if still queued.
+Worker threads execute each query under a scoped metrics child
+(telemetry.metrics), so every ticket carries attributable counters.
 """
 
 from __future__ import annotations
@@ -45,26 +51,47 @@ from typing import Dict, List, Optional
 
 from ..exceptions import HyperspaceException
 from ..storage.columnar import ColumnarBatch
-from ..telemetry.metrics import metrics, reliability_snapshot
-from . import batcher
+from ..telemetry.metrics import metrics, reliability_snapshot, serve_snapshot
+from . import batcher, tenancy
 from .plan_cache import PlanCache
+from .tenancy import DEFAULT_TENANT, CircuitBreaker, TenantState
 
 
 class AdmissionRejected(HyperspaceException):
-    """Queue full: retry after ``retry_after_s`` (an estimate from the
-    current depth and recent service times) or shed the request."""
+    """Admission refused. ``reason`` says which gate fired (queue_full /
+    tenant_queue_full / shed_lowweight / breaker_open); ``retry_after_s``
+    is derived from the tenant's observed drain rate where one exists."""
 
-    def __init__(self, queue_depth: int, retry_after_s: float):
+    def __init__(
+        self,
+        queue_depth: int,
+        retry_after_s: float,
+        tenant: Optional[str] = None,
+        tenant_depth: Optional[int] = None,
+        reason: str = "queue_full",
+    ):
         super().__init__(
-            f"admission rejected: queue full at depth {queue_depth}; "
-            f"retry after ~{retry_after_s:.3f}s"
+            f"admission rejected ({reason}): queue depth {queue_depth}"
+            + (
+                f", tenant {tenant!r} depth {tenant_depth}"
+                if tenant is not None
+                else ""
+            )
+            + f"; retry after ~{retry_after_s:.3f}s"
         )
         self.queue_depth = queue_depth
         self.retry_after_s = retry_after_s
+        self.tenant = tenant
+        self.tenant_depth = tenant_depth
+        self.reason = reason
 
 
 class DeadlineExceeded(HyperspaceException):
     pass
+
+
+class QueryCancelled(HyperspaceException):
+    """The ticket was withdrawn via cancel() before dispatch."""
 
 
 class ServerClosed(HyperspaceException):
@@ -74,10 +101,13 @@ class ServerClosed(HyperspaceException):
 @dataclass
 class ServeConfig:
     max_workers: int = 4
+    # GLOBAL queue bound (sum across tenants); per-tenant caps come from
+    # the hyperspace.serve.tenant.* conf family
     max_queue: int = 64
     # applied when submit() passes no deadline; None = no deadline
     default_deadline_s: Optional[float] = None
-    # largest number of compatible queries one dispatch coalesces
+    # largest number of compatible queries one dispatch coalesces;
+    # 1 disables micro-batch widening outright
     batch_max: int = 64
     plan_cache_entries: int = 256
     # tests construct paused servers (submit a burst, then start()) to
@@ -93,9 +123,10 @@ class ServeConfig:
 class QueryTicket:
     """Handle for one submitted query. ``result()`` blocks until the
     server finishes it (or ``timeout`` passes — TimeoutError), then
-    returns the ColumnarBatch or raises what execution raised."""
+    returns the ColumnarBatch or raises what execution raised.
+    ``cancel()`` withdraws the query if it is still queued."""
 
-    def __init__(self, deadline_at: Optional[float]):
+    def __init__(self, deadline_at: Optional[float], tenant: str = DEFAULT_TENANT):
         self._done = threading.Event()
         self._result: Optional[ColumnarBatch] = None
         self._error: Optional[BaseException] = None
@@ -103,11 +134,31 @@ class QueryTicket:
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.deadline_at = deadline_at
+        self.tenant = tenant
+        # the index-log snapshot this query admitted under — the sorted
+        # (name, id, state) tuple of ACTIVE indexes from the plan-cache
+        # version token; the optimized plan serves exactly this snapshot
+        self.pinned_log_version: Optional[tuple] = None
         self.batch_size = 1  # queries sharing this one's device dispatch
         self.metrics: Optional[dict] = None  # per-query scoped snapshot
+        # server-side backrefs for cancel(); None once no longer queued
+        self._server: Optional["QueryServer"] = None
+        self._request: Optional["_Request"] = None
+        self._tenant_state: Optional[TenantState] = None
+        self._is_probe = False  # this submission is a breaker half-open probe
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def cancel(self) -> bool:
+        """Withdraw the query if it is still QUEUED: True when this call
+        removed it (result() then raises QueryCancelled), False when it
+        already dispatched, finished, or was never enqueued — dispatch
+        and cancel race under the server lock, exactly one wins."""
+        server = self._server
+        if server is None or self._done.is_set():
+            return False
+        return server._cancel(self)
 
     def result(self, timeout: Optional[float] = None) -> ColumnarBatch:
         if not self._done.wait(timeout):
@@ -130,13 +181,26 @@ class QueryTicket:
 
 
 class _Request:
-    __slots__ = ("df", "plan", "resident", "ticket")
+    __slots__ = (
+        "df",
+        "plan",
+        "resident",
+        "ticket",
+        "tenant",
+        "inflight_charged",
+    )
 
-    def __init__(self, df, plan, resident, ticket):
+    def __init__(self, df, plan, resident, ticket, tenant):
         self.df = df
         self.plan = plan
         self.resident = resident  # Optional[batcher.ResidentScanRequest]
         self.ticket = ticket
+        self.tenant = tenant  # TenantState
+        # True once this request holds an in-flight slot; the worker's
+        # finally decrements only charged requests, so a kill landing
+        # between batch registration and the charge cannot corrupt the
+        # tenant's in-flight accounting in either direction
+        self.inflight_charged = False
 
 
 class QueryServer:
@@ -145,9 +209,28 @@ class QueryServer:
         self.config = config or ServeConfig()
         self.plan_cache = PlanCache(self.config.plan_cache_entries)
         self._cond = threading.Condition()
-        self._queue: "deque[_Request]" = deque()
+        self._tenants: Dict[str, TenantState] = {}
+        # O(1)/O(backlogged) admission bookkeeping (all under _cond): a
+        # running global depth, the registered-weight summary, and the
+        # set of tenants with queued work — admission and dispatch run
+        # per query under the one lock, so O(all-tenants-ever-seen)
+        # rescans there would serialize the serve tier at fleet scale
+        # (tenants never deregister; idle ones must cost nothing)
+        self._backlogged: Dict[str, TenantState] = {}
+        self._depth = 0
+        self._weight_set: set = set()
+        self._min_weight: Optional[float] = None
         self._workers: List[threading.Thread] = []
         self._closed = False
+        # conf-driven tenancy knobs, resolved once at construction (the
+        # per-tenant policy itself resolves lazily at first submit so
+        # conf edits before a tenant's first query apply to it)
+        conf = session.conf
+        self._breaker_miss_threshold = conf.serve_breaker_miss_threshold()
+        self._breaker_open_s = conf.serve_breaker_open_seconds()
+        self._shed_highwater = conf.serve_shed_highwater_fraction()
+        self._shed_batch_off = conf.serve_shed_batch_off_fraction()
+        self._drain_window_s = conf.serve_drain_rate_window_seconds()
         # host latch-down is an Event, not a lock-guarded bool: workers
         # consult it on every query's hot path, and an Event read is
         # race-free without taking _cond (the HS010 finding: the bool
@@ -159,6 +242,8 @@ class QueryServer:
         self._completed = 0
         self._failed = 0
         self._shed = 0
+        self._rejected_breaker = 0
+        self._cancelled = 0
         self._deadline_missed = 0
         self._dispatches = 0  # device dispatches for batched queries
         self._batched_queries = 0
@@ -166,6 +251,10 @@ class QueryServer:
         self._latencies: "deque[float]" = deque(maxlen=4096)
         self._waits: "deque[float]" = deque(maxlen=4096)
         self._ewma_service_s = 0.01
+        # scheduler-turn log: which tenant each dispatch slot went to —
+        # the fairness evidence stats()/bench config 15 score
+        self._dispatch_order: "deque[str]" = deque(maxlen=4096)
+        self._workers_killed = 0
         self._recovery_sweeps = 0
         self._recovered_indexes = 0
         self._next_recovery_sweep = 0.0  # monotonic; 0 = sweep on first submit
@@ -200,8 +289,12 @@ class QueryServer:
             if self._closed:
                 return
             self._closed = True
-            pending = list(self._queue)
-            self._queue.clear()
+            pending: List[_Request] = []
+            for t in self._tenants.values():
+                pending.extend(t.queue)
+                t.queue.clear()
+            self._backlogged.clear()
+            self._depth = 0
             self._cond.notify_all()
             workers = list(self._workers)
         for req in pending:
@@ -209,11 +302,118 @@ class QueryServer:
         for t in workers:
             t.join(timeout_s)
 
+    # -- tenancy -------------------------------------------------------------
+    def _tenant_locked(self, name: str) -> TenantState:
+        t = self._tenants.get(name)
+        if t is None:
+            t = TenantState(
+                name,
+                self.session.conf.serve_tenant_policy(name),
+                CircuitBreaker(
+                    self._breaker_miss_threshold, self._breaker_open_s
+                ),
+                self._drain_window_s,
+            )
+            self._tenants[name] = t
+            # tenants never deregister, so the weight summary only grows
+            self._weight_set.add(t.policy.weight)
+            if self._min_weight is None or t.policy.weight < self._min_weight:
+                self._min_weight = t.policy.weight
+        return t
+
+    def _global_depth_locked(self) -> int:
+        return self._depth
+
+    def _shed_stage_locked(self) -> int:
+        depth = self._global_depth_locked()
+        cap = max(self.config.max_queue, 1)
+        if depth >= self._shed_batch_off * cap:
+            return 2
+        if depth >= self._shed_highwater * cap:
+            return 1
+        return 0
+
+    def _reject_locked(
+        self, tenant: TenantState, reason: str, retry_after: Optional[float] = None
+    ) -> AdmissionRejected:
+        """Build (not raise) the rejection, with counters. Retry-after
+        comes from the tenant's observed drain rate unless the gate
+        supplies its own (breaker cooldown). Breaker rejections count
+        as rejected_breaker, NOT shed — stats()["shed"] stays equal to
+        the serve.shed counter and the per-tenant shed sum."""
+        if reason == "breaker_open":
+            self._rejected_breaker += 1
+            tenant.rejected_breaker += 1
+            metrics.incr("serve.breaker.rejected")
+        else:
+            self._shed += 1
+            tenant.shed += 1
+            metrics.incr("serve.shed")
+            if reason == "shed_lowweight":
+                metrics.incr("serve.shed.lowweight")
+        if retry_after is None:
+            retry_after = tenant.retry_after_locked(self._ewma_retry_locked())
+        return AdmissionRejected(
+            self._global_depth_locked(),
+            retry_after,
+            tenant=tenant.name,
+            tenant_depth=len(tenant.queue),
+            reason=reason,
+        )
+
+    def _ewma_retry_locked(self) -> float:
+        """Service-time fallback estimate for tenants with no windowed
+        completions yet: backlog drained at EWMA service time across the
+        worker pool."""
+        backlog = self._global_depth_locked() / max(self.config.max_workers, 1)
+        return max(backlog * self._ewma_service_s, 0.001)
+
+    def _admit_locked(self, tenant: TenantState, ticket: QueryTicket) -> None:
+        """Every admission gate, cheapest-rejection-first, called BEFORE
+        plan optimization so an overloaded server sheds without paying
+        the planner. Raises AdmissionRejected; marks probe tickets."""
+        now = time.monotonic()
+        admitted, retry_after = tenant.breaker.admit_locked(now)
+        if not admitted:
+            raise self._reject_locked(tenant, "breaker_open", retry_after)
+        if tenant.breaker.probe_inflight and tenant.breaker.state == tenancy.HALF_OPEN:
+            # admit_locked flipped probe_inflight for THIS submission
+            # exactly when it returned the probe slot
+            ticket._is_probe = True
+        try:
+            # load-shed ladder stage 1: lowest-weight tenant class first
+            # — only meaningful when registered weights actually differ
+            if (
+                self._shed_stage_locked() >= 1
+                and len(self._weight_set) > 1
+                and tenant.policy.weight == self._min_weight
+            ):
+                raise self._reject_locked(tenant, "shed_lowweight")
+            if len(tenant.queue) >= max(tenant.policy.max_queue, 1):
+                raise self._reject_locked(tenant, "tenant_queue_full")
+            if self._global_depth_locked() >= self.config.max_queue:
+                raise self._reject_locked(tenant, "queue_full")
+        except AdmissionRejected:
+            # a probe that a LATER gate rejected never ran: free the
+            # half-open slot so the next submission can probe
+            if ticket._is_probe:
+                tenant.breaker.probe_inflight = False
+            raise
+        if ticket._is_probe:
+            tenant.breaker.note_probe_admitted_locked()
+
     # -- admission -----------------------------------------------------------
-    def submit(self, df, deadline_s: Optional[float] = None) -> QueryTicket:
-        """Enqueue a DataFrame for execution. Raises AdmissionRejected
-        when the queue is full (backpressure — the caller decides whether
-        to retry, degrade, or shed), ServerClosed after close()."""
+    def submit(
+        self,
+        df,
+        deadline_s: Optional[float] = None,
+        tenant: str = DEFAULT_TENANT,
+    ) -> QueryTicket:
+        """Enqueue a DataFrame for execution under ``tenant``'s quotas.
+        Raises AdmissionRejected when a quota, the shed ladder, or the
+        tenant's circuit breaker refuses (backpressure — the caller
+        decides whether to retry, degrade, or shed; serve.client has the
+        jittered-backoff helper), ServerClosed after close()."""
         if df.session is not self.session:
             raise HyperspaceException(
                 "Cannot serve a DataFrame from a different session."
@@ -227,12 +427,26 @@ class QueryServer:
         # indexes whose writer died (the serving process is often the only
         # long-lived process around to notice)
         self._maybe_recovery_sweep()
+        ticket = QueryTicket(deadline_at, tenant)
+        ticket._server = self
+        # all admission gates run BEFORE planning: an overloaded or
+        # breaker-open tenant is rejected for two dict probes, not a
+        # full optimizer pass
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("query server is closed.")
+            tstate = self._tenant_locked(tenant)
+            ticket._tenant_state = tstate
+            self._admit_locked(tstate, ticket)
         # plan + batchability resolved at submit time: the plan cache
         # makes repeats ~two dict probes, and classified requests let the
-        # worker's coalescing scan stay a pure queue walk under the lock
-        ticket = QueryTicket(deadline_at)
+        # worker's coalescing scan stay a pure queue walk under the lock.
+        # The version token PINS the index-log snapshot: the optimized
+        # plan bakes this snapshot's files, so the query serves it
+        # wholesale across any concurrent refresh/optimize.
         try:
-            plan = self.plan_cache.optimized_plan(df)
+            plan, token = self.plan_cache.optimized_plan_with_token(df)
+            ticket.pinned_log_version = token[1]
             resident = (
                 None
                 if self._consult_device_latch()
@@ -247,23 +461,56 @@ class QueryServer:
             metrics.incr("serve.submitted")
             with self._cond:
                 self._submitted += 1
+                tstate.submitted += 1
             self._finish(ticket, error=e)
             return ticket
-        req = _Request(df, plan, resident, ticket)
+        req = _Request(df, plan, resident, ticket, tstate)
+        ticket._request = req
         with self._cond:
             if self._closed:
                 raise ServerClosed("query server is closed.")
-            if len(self._queue) >= self.config.max_queue:
-                self._shed += 1
-                metrics.incr("serve.shed")
-                raise AdmissionRejected(
-                    len(self._queue), self._retry_after_locked()
-                )
+            # caps re-checked: concurrent submits may have filled the
+            # queue while this one was planning
+            try:
+                if len(tstate.queue) >= max(tstate.policy.max_queue, 1):
+                    raise self._reject_locked(tstate, "tenant_queue_full")
+                if self._global_depth_locked() >= self.config.max_queue:
+                    raise self._reject_locked(tstate, "queue_full")
+            except AdmissionRejected:
+                if ticket._is_probe:
+                    # the already-counted probe lost the enqueue race:
+                    # un-count it with the slot — it never ran
+                    tstate.breaker.probe_inflight = False
+                    tstate.breaker.probes -= 1
+                    metrics.incr("serve.breaker.probe", -1)
+                raise
             self._submitted += 1
-            self._queue.append(req)
+            tstate.submitted += 1
+            tstate.queue.append(req)
+            self._backlogged[tenant] = tstate
+            self._depth += 1
             self._cond.notify()
         metrics.incr("serve.submitted")
         return ticket
+
+    def _cancel(self, ticket: QueryTicket) -> bool:
+        """Remove ``ticket``'s request from its tenant queue if still
+        queued; dispatch and cancel race under _cond, one wins."""
+        with self._cond:
+            req = ticket._request
+            tstate = ticket._tenant_state
+            if req is None or tstate is None or ticket._done.is_set():
+                return False
+            try:
+                tstate.queue.remove(req)
+            except ValueError:
+                return False  # already dispatched (or close() drained it)
+            self._depth -= 1
+            if not tstate.queue:
+                self._backlogged.pop(tstate.name, None)
+        metrics.incr("serve.cancelled")
+        self._finish(ticket, error=QueryCancelled("cancelled before dispatch."))
+        return True
 
     def _maybe_recovery_sweep(self) -> None:
         interval = self.config.recovery_sweep_interval_s
@@ -300,54 +547,158 @@ class QueryServer:
             # the transient view
             self.session.collection_manager.clear_cache()
 
-    def _retry_after_locked(self) -> float:
-        backlog = len(self._queue) / max(self.config.max_workers, 1)
-        return max(backlog * self._ewma_service_s, 0.001)
-
     # -- worker --------------------------------------------------------------
     def _worker_loop(self) -> None:
-        while True:
+        try:
+            self._worker_loop_inner()
+        except BaseException:  # noqa: BLE001 - worker killed mid-query
+            # a BaseException (injected crash, interpreter teardown)
+            # killed this worker; its in-flight tickets were already
+            # failed by the execute paths' guards. Replace the worker so
+            # the pool keeps serving, then die visibly.
             with self._cond:
-                while not self._queue and not self._closed:
-                    self._cond.wait()
-                if not self._queue:  # closed and drained
-                    return
-                req = self._queue.popleft()
-                batch = [req]
-                if req.resident is not None and not self._host_latch.is_set():
-                    batch += self._drain_compatible_locked(req)
-            now = time.monotonic()
-            live: List[_Request] = []
-            for r in batch:
-                if r.ticket.deadline_at is not None and now > r.ticket.deadline_at:
-                    self._miss_deadline(r)
-                else:
-                    live.append(r)
-            if not live:
-                continue
-            if len(live) == 1 or live[0].resident is None:
-                for r in live:
-                    self._execute_single(r)
-            else:
-                self._execute_batch(live)
+                me = threading.current_thread()
+                if me in self._workers:
+                    self._workers.remove(me)
+                self._workers_killed += 1
+                closed = self._closed
+            metrics.incr("serve.worker_killed")
+            if not closed:
+                try:
+                    self.start()
+                except ServerClosed:
+                    # close() won the race since the snapshot above: no
+                    # replacement needed, and the ORIGINAL kill cause
+                    # must stay the exception this thread dies with
+                    pass
+            raise
 
-    def _drain_compatible_locked(self, head: _Request) -> List[_Request]:
+    def _worker_loop_inner(self) -> None:
+        while True:
+            # batch accumulates INSIDE the guarded region: a kill landing
+            # anywhere after a request is popped (even mid-drain, before
+            # execution starts) must still resolve every popped ticket
+            # and return its in-flight slot — popped requests have no
+            # other owner who could ever pick them up again
+            batch: List[_Request] = []
+            try:
+                with self._cond:
+                    while not self._closed:
+                        if self._next_request_locked(batch):
+                            break
+                        self._cond.wait()
+                    if not batch:  # closed and drained
+                        return
+                    head = batch[0]
+                    if (
+                        head.resident is not None
+                        and self.config.batch_max > 1
+                        and not self._host_latch.is_set()
+                        and self._shed_stage_locked() < 2
+                    ):
+                        self._drain_compatible_locked(head, batch)
+                now = time.monotonic()
+                live: List[_Request] = []
+                for r in batch:
+                    if (
+                        r.ticket.deadline_at is not None
+                        and now > r.ticket.deadline_at
+                    ):
+                        self._miss_deadline(r)
+                    else:
+                        live.append(r)
+                if live:
+                    if len(live) == 1 or live[0].resident is None:
+                        for r in live:
+                            self._execute_single(r)
+                    else:
+                        self._execute_batch(live)
+            except BaseException as e:  # worker killed: resolve the batch
+                for r in batch:
+                    if not r.ticket.done():
+                        self._finish(r.ticket, error=e)
+                raise
+            finally:
+                if batch:
+                    with self._cond:
+                        capped = False
+                        for r in batch:
+                            if r.inflight_charged:
+                                r.inflight_charged = False
+                                r.tenant.inflight -= 1
+                                if r.tenant.policy.inflight_cap() is not None:
+                                    capped = True
+                        # wake workers ONLY when headroom was actually
+                        # freed under a finite cap — with no caps,
+                        # completions never unblock anyone, and a
+                        # broadcast per dispatch would cost O(workers)
+                        # spurious round-trips on the serializing lock
+                        if capped:
+                            self._cond.notify_all()
+
+    def _next_request_locked(self, batch: List[_Request]) -> bool:
+        """The weighted-fair pick: next backlogged tenant with in-flight
+        headroom via smooth WRR, then ITS oldest request (FIFO within a
+        tenant preserves per-client ordering). The popped request is
+        registered in ``batch`` BEFORE its in-flight slot is charged, so
+        the worker's resolve-all/decharge guards stay consistent no
+        matter where a kill lands. True when a request was taken."""
+        t = tenancy.pick_tenant_locked(self._backlogged)
+        if t is None:
+            return False
+        req = t.queue.popleft()
+        batch.append(req)
+        self._depth -= 1
+        if not t.queue:
+            del self._backlogged[t.name]
+        t.inflight += 1
+        req.inflight_charged = True
+        self._dispatch_order.append(t.name)
+        return True
+
+    def _drain_compatible_locked(
+        self, head: _Request, batch: List[_Request]
+    ) -> None:
         """Pull every queued request sharing ``head``'s batch key (same
-        resident table identity + resident column set), preserving the
-        queue order of everything else. Called with the lock held."""
+        resident table identity + resident column set) ACROSS backlogged
+        tenants into ``batch`` — coalesced queries ride one dispatch, so
+        widening the batch costs the batch nothing and saves each rider
+        a round trip. Per-tenant queue order is preserved; per-tenant
+        in-flight caps are honored. Called with the lock held."""
         key = head.resident.batch_key
-        taken: List[_Request] = []
-        keep: "deque[_Request]" = deque()
-        while self._queue and len(taken) + 1 < self.config.batch_max:
-            r = self._queue.popleft()
-            if r.resident is not None and r.resident.batch_key == key:
-                taken.append(r)
-            else:
-                keep.append(r)
-        keep.extend(self._queue)
-        self._queue.clear()
-        self._queue.extend(keep)
-        return taken
+        budget = self.config.batch_max - len(batch)
+        # head's tenant first (its own burst is the common case), then
+        # the other backlogged tenants in registration order —
+        # deterministic for tests; idle tenants cost nothing
+        tenants = [head.tenant] + [
+            t for t in self._backlogged.values() if t is not head.tenant
+        ]
+        for t in tenants:
+            if budget <= 0:
+                break
+            cap = t.policy.inflight_cap()
+            if (cap is not None and t.inflight >= cap) or not t.queue:
+                continue  # nothing takable: skip the O(queue) walk
+            keep: "deque[_Request]" = deque()
+            while t.queue and budget > 0:
+                r = t.queue.popleft()
+                if (
+                    r.resident is not None
+                    and r.resident.batch_key == key
+                    and (cap is None or t.inflight < cap)
+                ):
+                    batch.append(r)
+                    self._depth -= 1
+                    t.inflight += 1
+                    r.inflight_charged = True
+                    budget -= 1
+                else:
+                    keep.append(r)
+            keep.extend(t.queue)
+            t.queue.clear()
+            t.queue.extend(keep)
+            if not t.queue:
+                self._backlogged.pop(t.name, None)
 
     # -- execution -----------------------------------------------------------
     def _execute_single(self, req: _Request) -> None:
@@ -359,6 +710,9 @@ class QueryServer:
             self._finish(req.ticket, result=result)
         except Exception as e:  # noqa: BLE001 - one query's failure is its own
             self._finish(req.ticket, error=e)
+        except BaseException as e:  # worker being killed: resolve the ticket
+            self._finish(req.ticket, error=e)
+            raise
 
     def _run_plan(self, req: _Request) -> ColumnarBatch:
         from ..exec.executor import Executor
@@ -387,16 +741,34 @@ class QueryServer:
             # escapes to callers
             self._latch_host(repr(e), residents[0])
             results = None
+        except BaseException as e:  # worker being killed: resolve every ticket
+            for r in live:
+                if not r.ticket.done():
+                    self._finish(r.ticket, error=e)
+            raise
         if results is None:
             if not self._host_latch.is_set():
                 # stacked dispatch declined (not an error): per-query path
                 metrics.incr("serve.batch.declined")
-            for r in live:
-                self._execute_single(r)
+            try:
+                for r in live:
+                    self._execute_single(r)
+            except BaseException as e:  # worker killed mid-fallback: the
+                # remaining riders were already popped from their queues
+                # and no worker can re-pick them — resolve every one
+                for r in live:
+                    if not r.ticket.done():
+                        self._finish(r.ticket, error=e)
+                raise
             return
         with self._cond:
             self._dispatches += 1
             self._batched_queries += len(live)
+            # per-tenant twin counted HERE, over the same post-filter
+            # batch the global counter sees, so the per-tenant sum
+            # always reconciles with stats()["batched_queries"]
+            for r in live:
+                r.tenant.batched_queries += 1
             n = len(live)
             self._batch_sizes[n] = self._batch_sizes.get(n, 0) + 1
         snap = bm.snapshot()
@@ -419,8 +791,6 @@ class QueryServer:
             cache.drop(resident.table)
 
     def _miss_deadline(self, req: _Request) -> None:
-        with self._cond:
-            self._deadline_missed += 1
         metrics.incr("serve.deadline_missed")
         self._finish(
             req.ticket,
@@ -434,18 +804,53 @@ class QueryServer:
         ticket.finished_at = time.monotonic()
         ticket._result = result
         ticket._error = error
-        if ticket.started_at is not None:
-            service = ticket.finished_at - ticket.started_at
-            with self._cond:
+        ticket._request = None  # no longer cancellable
+        tstate = ticket._tenant_state
+        with self._cond:
+            if ticket.started_at is not None:
+                service = ticket.finished_at - ticket.started_at
                 self._ewma_service_s = (
                     0.8 * self._ewma_service_s + 0.2 * service
                 )
                 self._waits.append(ticket.wait_s or 0.0)
-        with self._cond:
+            now = time.monotonic()
             if error is None:
                 self._completed += 1
+                if tstate is not None:
+                    tstate.note_completion_locked(
+                        now,
+                        ticket.latency_s if ticket.started_at is not None else None,
+                    )
+                    # breaker: a probe success closes the circuit; a
+                    # success while OPEN (admitted pre-open) only clears
+                    # the consecutive-miss streak — the cooldown stands
+                    if ticket._is_probe or tstate.breaker.state == tenancy.CLOSED:
+                        tstate.breaker.record_success_locked()
+                    else:
+                        tstate.breaker.consecutive_misses = 0
+            elif isinstance(error, QueryCancelled):
+                self._cancelled += 1
+                if tstate is not None:
+                    tstate.cancelled += 1
+                    if ticket._is_probe:
+                        # a cancelled probe never decided anything: free
+                        # the half-open slot or the breaker wedges —
+                        # every later submission rejected forever
+                        tstate.breaker.probe_inflight = False
             else:
                 self._failed += 1
+                if tstate is not None:
+                    tstate.failed += 1
+                    if isinstance(error, DeadlineExceeded):
+                        self._deadline_missed += 1
+                        tstate.deadline_missed += 1
+                        tstate.breaker.record_miss_locked(
+                            now, probe=ticket._is_probe
+                        )
+                    elif ticket._is_probe:
+                        # probe died of an execution error, not a miss:
+                        # inconclusive — free the probe slot for the next
+                        tstate.breaker.probe_inflight = False
             # latency percentiles describe SERVED queries: tickets that
             # never started (deadline-missed, plan-error, close()-shed)
             # would pollute p50/p99 with pure queue wait
@@ -453,6 +858,12 @@ class QueryServer:
                 self._latencies.append(ticket.latency_s)
         if error is None:
             metrics.incr("serve.completed")
+            # explain(verbose) attribution: which tenant and which
+            # pinned snapshot the session's last served query ran under
+            self.session.last_serve_info = {
+                "tenant": ticket.tenant,
+                "pinned_log_version": ticket.pinned_log_version,
+            }
         ticket._done.set()
 
     # -- degradation surface -------------------------------------------------
@@ -488,17 +899,31 @@ class QueryServer:
     def stats(self) -> dict:
         import statistics
 
+        # copy raw reservoirs and scalars under the lock; sort/aggregate
+        # AFTER releasing it — a telemetry loop polling stats() must not
+        # stall admission and dispatch, which serialize on this lock
         with self._cond:
-            lat = sorted(self._latencies)
+            lats = list(self._latencies)
             waits = list(self._waits)
+            order = list(self._dispatch_order)
+            tenants_raw = {
+                name: (t.snapshot_locked(), list(t.latencies))
+                for name, t in sorted(self._tenants.items())
+            }
+            shed_stage = self._shed_stage_locked()
+            sweeps = self._recovery_sweeps
+            recovered = self._recovered_indexes
             out = {
                 "submitted": self._submitted,
                 "completed": self._completed,
                 "failed": self._failed,
                 "shed": self._shed,
+                "rejected_breaker": self._rejected_breaker,
+                "cancelled": self._cancelled,
                 "deadline_missed": self._deadline_missed,
-                "queue_depth": len(self._queue),
+                "queue_depth": self._global_depth_locked(),
                 "workers": len(self._workers),
+                "workers_killed": self._workers_killed,
                 "degraded": self._host_latch.is_set(),
                 "degraded_reason": self._degraded_reason,
                 "batch_dispatches": self._dispatches,
@@ -509,36 +934,52 @@ class QueryServer:
                 )
                 if self._dispatches
                 else None,
-                "plan_cache": self.plan_cache.snapshot(),
-                # join-region surface: what the resident join pipeline
-                # holds (regions, bytes, generation) — operators read
-                # this next to the serve counters to see whether
-                # aggregate-joins are being served fused or host-side
-                "join_regions": _join_region_stats(),
-                # residency tier surface: per-table tier ladder state
-                # (which rung each table landed on, compression ratio,
-                # window counters) — operators read this to see whether
-                # oversubscribed tables are serving compressed/streaming
-                # or falling off to host
-                "residency": _residency_stats(),
-                # reliability surface: what the lifecycle layer absorbed
-                # (retries) and healed (rollbacks) while this server ran
-                # — THIS server's sweeps plus the process-wide counters
-                "reliability": {
-                    "server_recovery_sweeps": self._recovery_sweeps,
-                    "recovered_indexes": self._recovered_indexes,
-                    **reliability_snapshot(),
-                },
             }
-            if lat:
-                out["latency_p50_ms"] = round(
-                    1e3 * lat[len(lat) // 2], 3
-                )
-                out["latency_p99_ms"] = round(
-                    1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3
-                )
-            if waits:
-                out["mean_wait_ms"] = round(1e3 * statistics.fmean(waits), 3)
+        # the multi-tenant surface: per-tenant quotas, depths, p50/p99,
+        # shed/rejected counters, breaker states — what an operator
+        # reads to see WHO is loading the server and who is being
+        # protected from whom
+        tenants = {}
+        for name, (snap, tl) in tenants_raw.items():
+            snap.update(tenancy.latency_percentiles_ms(tl))
+            tenants[name] = snap
+        out["tenants"] = tenants
+        dispatch_share: Dict[str, int] = {}
+        for name in order:
+            dispatch_share[name] = dispatch_share.get(name, 0) + 1
+        # load-shed ladder position + the scheduler-turn shares behind
+        # the fairness bound (window: last 4096 turns); widening is OFF
+        # under the host latch too — every post-latch dispatch is
+        # single-query regardless of the ladder
+        out["overload"] = {
+            "shed_stage": shed_stage,
+            "batch_widening": shed_stage < 2
+            and self.config.batch_max > 1
+            and not self._host_latch.is_set(),
+            "dispatch_share": dispatch_share,
+        }
+        # process-wide serve counter family (telemetry.metrics)
+        out["serve_counters"] = serve_snapshot()
+        out["plan_cache"] = self.plan_cache.snapshot()
+        # join-region surface: what the resident join pipeline holds
+        # (regions, bytes, generation) — operators read this next to the
+        # serve counters to see whether aggregate-joins are being served
+        # fused or host-side
+        out["join_regions"] = _join_region_stats()
+        # residency tier surface: per-table tier ladder state (which
+        # rung each table landed on, compression ratio, window counters)
+        out["residency"] = _residency_stats()
+        # reliability surface: what the lifecycle layer absorbed
+        # (retries) and healed (rollbacks) while this server ran — THIS
+        # server's sweeps plus the process-wide counters
+        out["reliability"] = {
+            "server_recovery_sweeps": sweeps,
+            "recovered_indexes": recovered,
+            **reliability_snapshot(),
+        }
+        out.update(tenancy.latency_percentiles_ms(lats))
+        if waits:
+            out["mean_wait_ms"] = round(1e3 * statistics.fmean(waits), 3)
         return out
 
 

@@ -134,6 +134,9 @@ class HyperspaceSession:
         # per-query scoped metrics snapshot of the last collect() on this
         # session (telemetry.metrics.scoped); explain(verbose) prints it
         self.last_query_metrics: Optional[dict] = None
+        # serve attribution of the last SERVED query: tenant + the
+        # index-log version it pinned at admission (explain(verbose))
+        self.last_serve_info: Optional[dict] = None
         self._server = None  # lazy QueryServer (serve())
         self._server_lock = threading.Lock()
 
@@ -158,11 +161,16 @@ class HyperspaceSession:
                 )
             return self._server
 
-    def submit(self, df, deadline_s: Optional[float] = None):
-        """Submit a DataFrame through the session's query server —
-        shorthand for ``session.serve().submit(df, deadline_s)``; returns
-        the QueryTicket."""
-        return self.serve().submit(df, deadline_s=deadline_s)
+    def submit(self, df, deadline_s: Optional[float] = None, tenant: Optional[str] = None):
+        """Submit a DataFrame through the session's query server under
+        ``tenant``'s quotas (None = the serve tier's default tenant) —
+        shorthand for ``session.serve().submit(df, deadline_s, tenant)``;
+        returns the QueryTicket."""
+        if tenant is None:
+            from .serve.tenancy import DEFAULT_TENANT
+
+            tenant = DEFAULT_TENANT
+        return self.serve().submit(df, deadline_s=deadline_s, tenant=tenant)
 
     def doctor(self, repair: bool = False):
         """fsck this session's index system path: verify log-chain

@@ -219,6 +219,32 @@ def residency_snapshot(
     return out
 
 
+def serve_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, int]:
+    """The serve-tier counter family in one dict — what admission let
+    in, shed, or breaker-rejected, what the overload ladder disabled,
+    and what the degradation paths absorbed (worker kills, host
+    latches). Consumed by ``QueryServer.stats()["serve_counters"]`` and
+    the multitenant bench config (docs/16-multitenant-serving.md)."""
+    r = registry if registry is not None else metrics
+    return {
+        "submitted": r.counter("serve.submitted"),
+        "completed": r.counter("serve.completed"),
+        "shed": r.counter("serve.shed"),
+        "shed_lowweight": r.counter("serve.shed.lowweight"),
+        "cancelled": r.counter("serve.cancelled"),
+        "deadline_missed": r.counter("serve.deadline_missed"),
+        "plan_errors": r.counter("serve.plan_error"),
+        "breaker_rejected": r.counter("serve.breaker.rejected"),
+        "breaker_opened": r.counter("serve.breaker.opened"),
+        "breaker_probes": r.counter("serve.breaker.probe"),
+        "breaker_closed": r.counter("serve.breaker.closed"),
+        "degraded_latches": r.counter("serve.degraded"),
+        "workers_killed": r.counter("serve.worker_killed"),
+        "client_retries": r.counter("serve.client.retry"),
+        "client_retries_exhausted": r.counter("serve.client.exhausted"),
+    }
+
+
 def reliability_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, int]:
     """The crash-consistency counter family in one dict — what the
     reliability layer absorbed (storage retries), refused (fenced

@@ -377,3 +377,101 @@ def test_queries_see_stable_snapshot_during_refresh(env):
     )
     # listing still shows the index (stable view)
     assert [s.name for s in hs.indexes()] == ["snapIdx"]
+
+
+def test_refresh_and_optimize_race_served_burst_wholesale_snapshots(env):
+    """Snapshot-pinned serving under a LIVE race: producer threads pump
+    lookups through a running QueryServer while refresh and optimize
+    land concurrently. Every completed result must equal the pre- or
+    post-refresh row set WHOLESALE (never a mix of index generations),
+    and the serving tier must never hang or leak an unclassified error."""
+    import time as _time
+
+    from hyperspace_tpu.plan.expr import col, lit
+    from hyperspace_tpu.serve import QueryServer, ServeConfig
+
+    session, hs, src, root = env
+    session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+    base = sample_batch(2000, seed=1)
+    parquet_io.write_parquet(src / "part-0.parquet", base)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("raceidx", ["k"], ["v"])
+    )
+    session.enable_hyperspace()
+
+    def lookup(key):
+        return (
+            session.read.parquet(str(src))
+            .filter(col("k") == lit(int(key)))
+            .select("k", "v")
+        )
+
+    def canon(b):
+        return sorted(
+            zip(b.columns["k"].data.tolist(), b.columns["v"].data.tolist())
+        )
+
+    keys = [int(base.columns["k"].data[i * 17 % 2000]) for i in range(8)]
+    pre = {k: canon(lookup(k).collect()) for k in keys}
+    appended = sample_batch(500, seed=7)
+    post = {}
+    for k in keys:
+        extra = [
+            (int(k), int(v))
+            for kk, v in zip(
+                appended.columns["k"].data.tolist(),
+                appended.columns["v"].data.tolist(),
+            )
+            if kk == k
+        ]
+        post[k] = sorted(pre[k] + extra)
+
+    server = QueryServer(session, ServeConfig(max_workers=3, max_queue=256))
+    outcomes = []
+    lock = threading.Lock()
+    gate = threading.Event()
+
+    def producer(seed):
+        gate.wait(10)
+        for i in range(12):
+            k = keys[(i + seed) % len(keys)]
+            try:
+                t = server.submit(lookup(k))
+                rows = canon(t.result(timeout=300))
+                with lock:
+                    outcomes.append((k, rows, None))
+            except Exception as e:  # noqa: BLE001 - asserted classified below
+                with lock:
+                    outcomes.append((k, None, e))
+
+    def mutator():
+        gate.wait(10)
+        _time.sleep(0.02)
+        parquet_io.write_parquet(src / "part-append.parquet", appended)
+        hs.refresh_index("raceidx", C.REFRESH_MODE_INCREMENTAL)
+        hs.optimize_index("raceidx", C.OPTIMIZE_MODE_QUICK)
+
+    threads = [threading.Thread(target=producer, args=(s,)) for s in range(3)]
+    threads.append(threading.Thread(target=mutator))
+    for t in threads:
+        t.start()
+    gate.set()
+    for t in threads:
+        t.join(300)
+        assert not t.is_alive(), "serving or lifecycle thread hung"
+
+    from hyperspace_tpu.serve import AdmissionRejected
+
+    completed = 0
+    for k, rows, err in outcomes:
+        if err is not None:
+            assert isinstance(err, AdmissionRejected), err
+            continue
+        completed += 1
+        assert rows in (pre[k], post[k]), (
+            f"key {k} observed a TORN snapshot across refresh/optimize"
+        )
+    assert completed >= len(keys)  # the storm actually served queries
+    stats = server.stats()
+    assert stats["submitted"] == stats["completed"] + stats["failed"]
+    server.close()

@@ -279,3 +279,253 @@ def test_chaos_storage_weather_every_op_flakes_once(tmp_path, kind):
     mgr = IndexLogManagerImpl(indexes_dir / IDX)
     assert mgr.get_latest_log().state == final_state
     assert doctor(indexes_dir).ok
+
+
+# ---------------------------------------------------------------------------
+# serve-tier chaos (the ISSUE-9 acceptance sweep): kill a worker
+# mid-query, and lose the device mid-batch, at EVERY dispatch point of a
+# burst. The invariant is the serving twin of the lifecycle one above:
+#
+#   1. every ticket RESOLVES — a result or a classified error, never a
+#      hang (the worker-death guard fails in-flight tickets and the
+#      pool respawns the dead worker);
+#   2. results that do come back are bit-identical to serial execution
+#      (device loss re-executes host-side; no error escapes);
+#   3. stats() stays consistent: submitted == completed + failed, and
+#      the pool reports its full worker count after every kill.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def serve_chaos_env(tmp_path, monkeypatch):
+    from hyperspace_tpu.exec.hbm_cache import hbm_cache
+    from hyperspace_tpu.hyperspace import Hyperspace
+
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    hbm_cache.reset()
+    src = tmp_path / "data"
+    src.mkdir()
+    # high-cardinality keys: point lookups must PRUNE blocks or the
+    # selectivity zone gate (correctly) refuses the batched device path
+    rng = np.random.default_rng(3)
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 20_000, 40_000).astype(np.int64),
+            "v": rng.integers(0, 1000, 40_000).astype(np.int64),
+        }
+    )
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"), C.INDEX_NUM_BUCKETS: 2}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("svidx", ["k"], ["v"])
+    )
+    session.enable_hyperspace()
+    assert hs.prefetch_index("svidx")
+    yield session, hs, src, batch
+    hbm_cache.reset()
+
+
+def _chaos_lookup(session, src, key):
+    from hyperspace_tpu.plan.expr import col, lit
+
+    return (
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(int(key)))
+        .select("k", "v")
+    )
+
+
+def _chaos_rows(b):
+    return sorted(zip(b.columns["k"].data.tolist(), b.columns["v"].data.tolist()))
+
+
+def test_chaos_serve_worker_killed_at_every_dispatch_point(serve_chaos_env):
+    """A BaseException (process-death stand-in) out of the executor at
+    dispatch point N: the victim ticket resolves with that error, every
+    other ticket completes correctly, and the pool heals (worker
+    respawned) — for every N in the burst."""
+    from hyperspace_tpu.serve import QueryServer, ServeConfig
+    from hyperspace_tpu.telemetry.metrics import metrics as _metrics
+
+    session, hs, src, batch = serve_chaos_env
+    keys = [int(batch.columns["k"].data[i]) for i in range(4)]
+    serial = [
+        _chaos_rows(_chaos_lookup(session, src, k).collect()) for k in keys
+    ]
+    orig = QueryServer._run_plan
+    try:
+        for point in range(len(keys)):
+            counter = {"n": 0}
+
+            def killing(self, req, _point=point, _counter=counter):
+                i = _counter["n"]
+                _counter["n"] += 1
+                if i == _point:
+                    raise InjectedCrash(f"worker killed at dispatch {i}")
+                return orig(self, req)
+
+            QueryServer._run_plan = killing
+            killed_before = _metrics.counter("serve.worker_killed")
+            # batch_max=1: every query is its own dispatch point
+            server = QueryServer(
+                session,
+                ServeConfig(max_workers=1, batch_max=1, autostart=False),
+            )
+            tickets = [
+                server.submit(_chaos_lookup(session, src, k)) for k in keys
+            ]
+            server.start()
+            outcomes = []
+            for t in tickets:
+                try:
+                    outcomes.append(_chaos_rows(t.result(timeout=120)))
+                except InjectedCrash:
+                    outcomes.append("killed")
+            # exactly one victim; everyone else exact — never a hang
+            assert outcomes.count("killed") == 1, f"point {point}: {outcomes}"
+            for got, want in zip(outcomes, serial):
+                if got != "killed":
+                    assert got == want
+            stats = server.stats()
+            assert stats["submitted"] == stats["completed"] + stats["failed"]
+            assert stats["failed"] == 1 and stats["completed"] == len(keys) - 1
+            # the pool healed: dead worker replaced, counter advanced.
+            # Tickets resolve BEFORE the dying worker's cleanup runs
+            # (the _finish happens inside the guarded region, the
+            # respawn in the outer handler), so poll with a deadline
+            # instead of racing that window
+            healed_by = time.monotonic() + 30
+            while True:
+                stats = server.stats()
+                if (
+                    stats["workers"] == 1
+                    and stats["workers_killed"] == 1
+                    and _metrics.counter("serve.worker_killed")
+                    == killed_before + 1
+                ):
+                    break
+                assert time.monotonic() < healed_by, f"pool never healed: {stats}"
+                time.sleep(0.01)
+            # and the healed pool still serves
+            QueryServer._run_plan = orig
+            follow = server.submit(_chaos_lookup(session, src, keys[0]))
+            assert _chaos_rows(follow.result(timeout=120)) == serial[0]
+            server.close()
+    finally:
+        QueryServer._run_plan = orig
+
+
+def test_chaos_serve_device_loss_mid_batch_at_every_dispatch_point(
+    serve_chaos_env,
+):
+    """The stacked device dispatch dies at batch N of the burst: the
+    server latches host, THAT batch re-executes exactly, later batches
+    serve host-side — parity for every ticket at every loss point."""
+    from hyperspace_tpu.exec import hbm_cache as hc
+    from hyperspace_tpu.serve import QueryServer, ServeConfig
+
+    session, hs, src, batch = serve_chaos_env
+    keys = [int(batch.columns["k"].data[i * 3]) for i in range(6)]
+    serial = [
+        _chaos_rows(_chaos_lookup(session, src, k).collect()) for k in keys
+    ]
+    real = hc.HbmIndexCache.block_counts_batch
+    try:
+        # batch_max=2 over 6 compatible lookups -> 3 stacked dispatches;
+        # lose the device at each one in turn
+        for point in range(3):
+            counter = {"n": 0}
+
+            def lossy(self, table, predicates, prepared=None, _point=point, _c=counter):
+                i = _c["n"]
+                _c["n"] += 1
+                if i == _point:
+                    raise RuntimeError("UNAVAILABLE: device lost mid-batch")
+                return real(self, table, predicates, prepared)
+
+            hc.HbmIndexCache.block_counts_batch = lossy
+            # fresh residency for each point: the previous round's latch
+            # dropped the table
+            hc.hbm_cache.reset()
+            assert hs.prefetch_index("svidx")
+            server = QueryServer(
+                session,
+                ServeConfig(max_workers=1, batch_max=2, autostart=False),
+            )
+            tickets = [
+                server.submit(_chaos_lookup(session, src, k)) for k in keys
+            ]
+            server.start()
+            for t, want in zip(tickets, serial):
+                # no error escapes: the lost batch re-ran host-side
+                assert _chaos_rows(t.result(timeout=120)) == want
+            stats = server.stats()
+            assert stats["degraded"] is True
+            assert "UNAVAILABLE" in stats["degraded_reason"]
+            assert stats["submitted"] == stats["completed"]
+            assert stats["failed"] == 0
+            server.close()
+    finally:
+        hc.HbmIndexCache.block_counts_batch = real
+
+
+def test_chaos_serve_worker_killed_in_declined_batch_fallback(serve_chaos_env):
+    """The coalesced batch declines (per-query fallback path), then the
+    worker is killed mid-fallback: every rider of the abandoned batch
+    must still RESOLVE — the riders were already popped from their
+    queues, so nothing else could ever pick them up again (regression:
+    the fallback loop lacked the BaseException resolve-all guard)."""
+    from hyperspace_tpu.serve import QueryServer, ServeConfig
+    from hyperspace_tpu.serve import batcher as _batcher
+
+    session, hs, src, batch = serve_chaos_env
+    keys = [int(batch.columns["k"].data[i * 5]) for i in range(3)]
+    real_eb = _batcher.execute_batch
+    orig_run = QueryServer._run_plan
+    try:
+        _batcher.execute_batch = lambda requests: None  # stacked path declines
+        state = {"n": 0}
+
+        def killing(self, req):
+            if state["n"] == 0:
+                state["n"] += 1
+                raise InjectedCrash("worker killed in declined-batch fallback")
+            return orig_run(self, req)
+
+        QueryServer._run_plan = killing
+        server = QueryServer(
+            session, ServeConfig(max_workers=1, batch_max=4, autostart=False)
+        )
+        tickets = [server.submit(_chaos_lookup(session, src, k)) for k in keys]
+        assert all(t._request.resident is not None for t in tickets)
+        server.start()
+        resolved = []
+        for t in tickets:
+            try:
+                resolved.append(_chaos_rows(t.result(timeout=60)))
+            except InjectedCrash:
+                resolved.append("killed")
+        # the victim AND every abandoned rider resolved (with the crash);
+        # nothing hung
+        assert resolved.count("killed") >= 1
+        stats = server.stats()
+        assert stats["submitted"] == stats["completed"] + stats["failed"]
+        # pool healed — polled: the respawn runs after the victim
+        # tickets resolve, so an immediate read races it
+        healed_by = time.monotonic() + 30
+        while server.stats()["workers"] != 1:
+            assert time.monotonic() < healed_by, "pool never healed"
+            time.sleep(0.01)
+        # the healed pool still serves, per-query
+        QueryServer._run_plan = orig_run
+        follow = server.submit(_chaos_lookup(session, src, keys[0]))
+        assert follow.result(timeout=120) is not None
+        server.close()
+    finally:
+        _batcher.execute_batch = real_eb
+        QueryServer._run_plan = orig_run

@@ -317,6 +317,77 @@ def test_plan_signature_distinguishes_file_snapshots(env):
 
 
 # ---------------------------------------------------------------------------
+# snapshot pinning: refresh/optimize racing in-flight queries
+# ---------------------------------------------------------------------------
+def test_snapshot_pinned_reads_refresh_mid_burst(env):
+    """Queries admitted BEFORE a refresh serve the pre-refresh snapshot
+    wholesale (their plans baked that index-log version's files in);
+    queries admitted AFTER serve the post-refresh snapshot wholesale.
+    No query ever observes a mix, and the pinned version on the ticket
+    names which side it served."""
+    session, hs, src, batch = env
+    session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+    keys = [int(batch.columns["k"].data[i]) for i in range(0, 120, 15)]
+    pre = {k: _sorted_rows(_lookup(session, src, k).collect()) for k in keys}
+
+    server = QueryServer(session, ServeConfig(max_workers=2, autostart=False))
+    # the burst admits (and PINS) against the pre-refresh version...
+    tickets = [server.submit(_lookup(session, src, k)) for k in keys]
+    pinned_pre = {t.pinned_log_version for t in tickets}
+    assert len(pinned_pre) == 1  # one burst, one snapshot
+    # ...then the refresh lands while they are still queued
+    appended = _source(2000, seed=11)
+    parquet_io.write_parquet(src / "part-append.parquet", appended)
+    hs.refresh_index("sidx", C.REFRESH_MODE_INCREMENTAL)
+    server.start()
+    results = [t.result(timeout=300) for t in tickets]
+    for k, r in zip(keys, results):
+        # wholesale pre-refresh rows: the pinned plan reads the admitted
+        # snapshot's files even though the log has moved on
+        assert _sorted_rows(r) == pre[k], f"key {k} tore across the refresh"
+    # a post-refresh submission pins the NEW version and sees the
+    # appended rows — also wholesale
+    t2 = server.submit(_lookup(session, src, keys[0]))
+    assert t2.pinned_log_version not in pinned_pre
+    extra = [
+        (int(keys[0]), int(v))
+        for kk, v in zip(
+            appended.columns["k"].data.tolist(),
+            appended.columns["v"].data.tolist(),
+        )
+        if kk == keys[0]
+    ]
+    assert _sorted_rows(t2.result(timeout=300)) == sorted(pre[keys[0]] + extra)
+    server.close()
+
+
+def test_snapshot_pinned_reads_optimize_mid_burst(env):
+    """Same invariant under optimize(): the compaction rewrites index
+    files into a new version while admitted queries hold plans over the
+    old one — every result stays bit-identical to the pre-optimize
+    snapshot (optimize must never change results anyway, so here the
+    pin is about the FILES resolving, not the rows differing)."""
+    session, hs, src, batch = env
+    # a second small file so quick-optimize has something to compact
+    parquet_io.write_parquet(src / "part-1.parquet", _source(1500, seed=4))
+    hs.refresh_index("sidx", C.REFRESH_MODE_INCREMENTAL)
+    keys = [int(batch.columns["k"].data[i]) for i in range(6)]
+    pre = {k: _sorted_rows(_lookup(session, src, k).collect()) for k in keys}
+    server = QueryServer(session, ServeConfig(max_workers=2, autostart=False))
+    tickets = [server.submit(_lookup(session, src, k)) for k in keys]
+    pinned = tickets[0].pinned_log_version
+    hs.optimize_index("sidx", C.OPTIMIZE_MODE_QUICK)
+    server.start()
+    for k, t in zip(keys, tickets):
+        assert _sorted_rows(t.result(timeout=300)) == pre[k]
+        assert t.pinned_log_version == pinned
+    t2 = server.submit(_lookup(session, src, keys[0]))
+    assert t2.pinned_log_version != pinned  # the log moved
+    assert _sorted_rows(t2.result(timeout=300)) == pre[keys[0]]
+    server.close()
+
+
+# ---------------------------------------------------------------------------
 # admission + lifecycle
 # ---------------------------------------------------------------------------
 def test_queue_full_rejects_with_depth_and_retry_after(env):
