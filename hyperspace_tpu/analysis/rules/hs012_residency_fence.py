@@ -20,10 +20,14 @@ hard-won discipline:
 Detection (whole-program, documented blind spots):
   * a RESIDENCY CACHE CLASS is any class whose MRO owns a ``_lock`` in
     the lock inventory AND writes a ``self._epoch`` field — structural,
-    so fixtures and future caches are covered without a name list;
+    so fixtures and future caches are covered without a name list; the
+    whole-plan compile caches (compile.cache.PipelineCache /
+    compile.result_cache.ResultCache) opt into the same scope by
+    carrying an ``_epoch``;
   * registry fields are matched by name:
-    ``_tables/_deltas/_joins/_pending/_failed/_join_version/_epoch``
-    and ``_budget*``;
+    ``_tables/_deltas/_joins/_pending/_failed/_join_version/_epoch``,
+    ``_budget*``, and the compile-cache registries
+    ``_pipelines``/``_results``;
   * check 1 fires on any write/mutating call on a registry field with
     the cache's ``_lock`` not lexically held (``__init__`` excluded —
     construction precedes sharing; ``*_locked`` helper methods excluded
@@ -43,7 +47,8 @@ from typing import Iterator, Set, Tuple
 from ..core import ProjectRule
 
 _REGISTRY_FIELD_RE = re.compile(
-    r"^_(tables|deltas|joins|pending|failed|join_version|epoch|budget\w*)$"
+    r"^_(tables|deltas|joins|pending|failed|join_version|epoch|budget\w*"
+    r"|pipelines|results)$"
 )
 _REGISTRATION_LISTS = {"_tables", "_deltas", "_joins"}
 

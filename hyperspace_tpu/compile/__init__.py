@@ -1,0 +1,28 @@
+"""Whole-plan compilation (docs/17-plan-compilation.md): lower optimized
+plan subtrees to ONE fused pipeline — the interpreter becomes the
+fallback leg of a compiler. Public surface:
+
+* ``pipeline_cache.get_or_lower(plan, executor, version_token)`` — the
+  compiled-pipeline cache (exec.executor's entry point);
+* ``result_cache`` — the RESULT memo stub riding the same tokens;
+* ``plan_fingerprint`` / ``batch_fingerprint`` — the structural keys
+  (the serve micro-batcher folds the coarse one into batch keys).
+"""
+
+from .cache import PipelineCache, pipeline_cache
+from .fingerprint import batch_fingerprint, plan_fingerprint
+from .lowering import classify_shape, lower
+from .pipeline import CompiledPipeline
+from .result_cache import ResultCache, result_cache
+
+__all__ = [
+    "CompiledPipeline",
+    "PipelineCache",
+    "ResultCache",
+    "batch_fingerprint",
+    "classify_shape",
+    "lower",
+    "pipeline_cache",
+    "plan_fingerprint",
+    "result_cache",
+]

@@ -1,0 +1,157 @@
+"""The compiled-pipeline cache: (fingerprint, index-log version token,
+conf) -> CompiledPipeline.
+
+This replaces the serve tier's per-scan executable reuse with WHOLE
+pipelines: the serve plan cache still memoizes plan optimization, and
+this cache memoizes the lowering/routing above execution — keyed so that
+snapshot-pinned reads (PR 9) serve whole compiled pipelines wholesale.
+Invalidation rides the same tokens the plan cache pins:
+
+* the FINGERPRINT carries every index leaf's (name, log id) and every
+  source leaf's file snapshot, so any refresh/optimize/create/delete
+  that touches a leaf re-keys naturally;
+* the VERSION TOKEN (the server passes the ticket's pinned index-log
+  snapshot) keeps two pinned generations of one structure apart during
+  a concurrent refresh;
+* ``invalidate(index_root)`` drops entries scoped to a rewritten
+  index's directory — a JOIN pipeline carries both sides' roots, so it
+  drops on EITHER side's change (mirroring invalidate_joins); the
+  collection manager calls this from refresh/optimize/delete.
+
+Lock discipline: every ``_pipelines``/``_epoch`` mutation happens under
+``_lock`` (enforced by hslint HS012's compile-cache extension); lookups
+that MISS lower OUTSIDE the lock (lowering does IO-free probes but is
+not free) and re-check under the lock before registering. Unlike the
+residency caches, entries hold no device arrays, so a registration that
+races reset() is harmless — the epoch exists for observability and to
+keep the HS012 structural scope honest.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Optional
+
+from ..telemetry.metrics import metrics
+from .pipeline import CompiledPipeline
+
+# per-conf-object memo of the serialized token, keyed on the conf's
+# mutation generation: the token is needed on EVERY execute (cache hits
+# included) and re-sorting the whole conf dict per query would sit on
+# the hot path the pipeline cache exists to shorten
+_conf_token_memo: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_conf_token_lock = threading.Lock()
+
+
+def _conf_token(conf) -> tuple:
+    gen = getattr(conf, "generation", None)
+    if gen is not None:
+        with _conf_token_lock:
+            hit = _conf_token_memo.get(conf)
+            if hit is not None and hit[0] == gen:
+                return hit[1]
+    token = tuple(sorted((k, str(v)) for k, v in conf.as_dict().items()))
+    if gen is not None:
+        with _conf_token_lock:
+            _conf_token_memo[conf] = (gen, token)
+    return token
+
+
+class PipelineCache:
+    """Bounded LRU of compiled pipelines (module note)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pipelines: "OrderedDict[tuple, CompiledPipeline]" = (
+            OrderedDict()
+        )
+        self._epoch = 0
+
+    def get_or_lower(
+        self, plan, executor, version_token: Optional[tuple] = None
+    ) -> Optional[CompiledPipeline]:
+        """The pipeline for ``plan`` under ``executor``'s conf/mesh —
+        cached by structural fingerprint, lowered on miss. None when the
+        fingerprint cannot be computed (the caller interprets)."""
+        from .fingerprint import plan_fingerprint
+        from .lowering import lower
+
+        conf = executor.conf
+        try:
+            fp = plan_fingerprint(plan, executor.mesh)
+        except Exception:  # noqa: BLE001 - fingerprint error: interpret
+            metrics.incr("compile.fingerprint_error")
+            return None
+        key = (fp, version_token, _conf_token(conf))
+        with self._lock:
+            hit = self._pipelines.get(key)
+            if hit is not None:
+                self._pipelines.move_to_end(key)
+        if hit is not None:
+            metrics.incr("compile.cache.hit")
+            return hit
+        metrics.incr("compile.cache.miss")
+        pipeline = lower(plan, conf, executor.mesh, fingerprint=fp)
+        max_entries = max(int(conf.compile_cache_entries()), 1)
+        with self._lock:
+            racer = self._pipelines.get(key)
+            if racer is not None:
+                return racer  # a concurrent miss lowered first: share its
+            pipeline.cache = self
+            pipeline.cache_key = key
+            self._pipelines[key] = pipeline
+            while len(self._pipelines) > max_entries:
+                self._pipelines.popitem(last=False)
+                metrics.incr("compile.cache.evicted")
+        return pipeline
+
+    def forget(self, pipeline: CompiledPipeline) -> None:
+        """Evict exactly ``pipeline``'s entry (device loss mid-dispatch)
+        — the rest of the cache keeps serving."""
+        key = pipeline.cache_key
+        if key is None:
+            return
+        with self._lock:
+            if self._pipelines.get(key) is pipeline:
+                del self._pipelines[key]
+
+    def invalidate(self, index_root: Optional[str] = None) -> int:
+        """Drop pipelines whose index leaves live under ``index_root``
+        (None drops everything). Returns the number dropped."""
+        prefix = None
+        if index_root is not None:
+            prefix = str(index_root).rstrip("/") + "/"
+        with self._lock:
+            if prefix is None:
+                n = len(self._pipelines)
+                self._pipelines.clear()
+            else:
+                doomed = [
+                    k
+                    for k, p in self._pipelines.items()
+                    if p.matches_root(prefix)
+                ]
+                for k in doomed:
+                    del self._pipelines[k]
+                n = len(doomed)
+        if n:
+            metrics.incr("compile.cache.invalidated", n)
+        return n
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pipelines.clear()
+            self._epoch += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            entries = list(self._pipelines.values())
+        kinds: dict = {}
+        for p in entries:
+            kinds[p.kind] = kinds.get(p.kind, 0) + 1
+        return {"entries": len(entries), "kinds": kinds}
+
+
+pipeline_cache = PipelineCache()

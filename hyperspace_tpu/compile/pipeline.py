@@ -1,0 +1,213 @@
+"""CompiledPipeline: one lowered plan subtree, runnable against any
+literal binding that shares its structural fingerprint.
+
+A pipeline stores ROUTING, not values: which fused arm serves the
+subtree and how to re-bind per-query operands (predicate literals,
+projection order) from the concrete plan each run receives. The fused
+arms reach the structure-keyed executables (literals as traced int32
+operands), so every run of a pipeline — across a whole serving burst of
+distinct keys — shares one compiled device program and ships home at
+most ONE D2H transfer between plan arms (the count vector / finished
+group table); the interpreter is the fallback leg for every per-query
+eligibility miss, with results identical by the shared-procedure
+argument (the fused arms and the interpreter call the same resolution
+and host-leg code).
+
+Device loss mid-fused-dispatch degrades exactly like the interpreter's
+fused arms (the shared procedures drop the resident state and latch the
+QUERY host-side), and additionally evicts THIS pipeline's cache entry —
+not the whole cache — so the next structurally-equal query re-lowers
+against post-loss residency instead of re-entering a dead routing
+decision.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from ..storage.columnar import ColumnarBatch
+from ..telemetry.metrics import metrics
+
+# the device-failure counters every fused arm's degradation path bumps
+# (exec.scan / exec.executor / exec.join_residency): a run that moved any
+# of them hit a dead device mid-dispatch. Read from the run's SCOPED
+# child registry, never the global one — two concurrent queries' device
+# failures must not cross-attribute (a global delta would evict a
+# healthy pipeline because an unrelated table died on another worker)
+_DEVICE_FAIL_COUNTERS = (
+    "scan.resident.device_failed",
+    "scan.resident_mesh.device_failed",
+    "scan.resident_join.device_failed",
+)
+
+
+def _device_failures(registry) -> int:
+    return sum(registry.counter(c) for c in _DEVICE_FAIL_COUNTERS)
+
+
+class CompiledPipeline:
+    """One lowered subtree. ``run(plan, executor)`` executes a concrete
+    plan whose fingerprint equals this pipeline's."""
+
+    def __init__(
+        self,
+        kind: str,
+        fingerprint: Optional[tuple],
+        tier: str,
+        index_roots: Tuple[str, ...],
+        boundary: tuple,
+    ):
+        self.kind = kind
+        self.fingerprint = fingerprint
+        self.tier = tier
+        self.index_roots = index_roots
+        self.boundary = boundary
+        # set by PipelineCache when the pipeline is cached; forget-on-
+        # device-loss needs them to evict exactly one entry
+        self.cache = None
+        self.cache_key = None
+        # observability tallies, mutated by concurrent runs: guarded by
+        # their own lock (a pipeline is shared across serve workers)
+        self._stats_lock = threading.Lock()
+        self.runs = 0
+        self.fused_dispatches = 0
+
+    # -- observability -------------------------------------------------------
+    def describe(self) -> dict:
+        return {
+            "kind": self.kind,
+            "tier": self.tier,
+            "boundary": list(self.boundary),
+            "runs": self.runs,
+            "fused_dispatches": self.fused_dispatches,
+        }
+
+    def matches_root(self, prefix: str) -> bool:
+        return any(p.startswith(prefix) for p in self.index_roots)
+
+    # -- execution -----------------------------------------------------------
+    def run(self, plan, executor) -> ColumnarBatch:
+        with self._stats_lock:
+            self.runs += 1
+        metrics.incr(f"compile.run.{self.kind}")
+        # a scoped child registry attributes THIS run's recordings (the
+        # fused arms record on this thread; the union host legs copy the
+        # context into their pool threads) — global counter deltas would
+        # cross-talk between concurrent queries
+        with metrics.scoped() as run_metrics:
+            try:
+                with metrics.timer("compile.pipeline_run"):
+                    out = self._run_kind(plan, executor)
+            finally:
+                with self._stats_lock:
+                    self.fused_dispatches += run_metrics.counter(
+                        "compile.fused.dispatches"
+                    )
+                if _device_failures(run_metrics) > 0:
+                    # the query already latched host-side through the
+                    # shared degradation path; evict ONLY this pipeline
+                    # so the next structurally-equal query re-lowers
+                    # against post-loss residency (fault-injection-
+                    # tested)
+                    metrics.incr("compile.pipeline.dropped_on_device_loss")
+                    if self.cache is not None:
+                        self.cache.forget(self)
+        return out
+
+    def _run_kind(self, plan, executor) -> ColumnarBatch:
+        from .lowering import classify_shape
+
+        if self.kind == "interpret":
+            return executor._exec(plan, predicate=None)
+        shape = classify_shape(plan, executor.mesh)
+        if shape.kind != self.kind:
+            # fingerprint/classification drift (cannot happen for equal
+            # fingerprints; guards a future structural-walk change):
+            # interpret exactly
+            metrics.incr("compile.shape_drift")
+            return executor._exec(plan, predicate=None)
+        if self.kind == "scan":
+            out = self._run_scan(shape, executor)
+            return _apply_projects(out, shape.projects)
+        if self.kind == "agg_scan":
+            from ..exec.aggregate import hash_aggregate
+
+            out = self._run_scan(shape, executor)
+            out = _apply_projects(out, shape.inner_projects)
+            out = hash_aggregate(
+                out, list(shape.agg.group_by), list(shape.agg.aggs)
+            )
+            return _apply_projects(out, shape.projects)
+        if self.kind == "hybrid":
+            out = self._run_hybrid(shape, executor)
+            return _apply_projects(out, shape.projects)
+        if self.kind == "join_agg":
+            out = self._run_join_agg(shape, executor)
+            return _apply_projects(out, shape.projects)
+        raise AssertionError(f"unknown pipeline kind {self.kind!r}")
+
+    def _run_scan(self, shape, executor) -> ColumnarBatch:
+        """The fused scan arm: exec.scan.index_scan with the structure-
+        keyed counts dispatch — the ONE serving procedure (residency
+        resolution, zone gate, host legs, empty-schema handling) the
+        interpreter uses, so per-query eligibility misses degrade
+        identically; only the executable keying differs (literals traced
+        instead of baked in)."""
+        from ..exec.scan import index_scan
+
+        scan = shape.scan
+        entry = scan.entry
+        return index_scan(
+            entry.content.files(),
+            list(scan.required_columns),
+            shape.condition,
+            device=executor.device,
+            indexed_columns=entry.indexed_columns,
+            dtypes=entry.schema,
+            num_buckets=entry.num_buckets,
+            structure_keyed=True,
+        )
+
+    def _run_hybrid(self, shape, executor) -> ColumnarBatch:
+        """The fused hybrid arm: the executor's delta-resident base+delta
+        dispatch, falling to the concurrent per-side host union — the
+        split entry points guarantee the fallback never re-runs the
+        residency resolution (no double-counted declines)."""
+        fused = executor._try_resident_hybrid(shape.union, shape.condition)
+        if fused is not None:
+            metrics.incr("compile.fused.dispatches")
+            return fused
+        columns = (
+            list(shape.projects[-1].columns) if shape.projects else None
+        )
+        return executor._exec_union_host(
+            shape.union, shape.condition, columns
+        )
+
+    def _run_join_agg(self, shape, executor) -> ColumnarBatch:
+        """The fused aggregate-join arm: the executor's Aggregate
+        procedure (resident fused region dispatch first — single-chip
+        AND mesh — then the host range-fusion, then gather+hash), as one
+        lowered pipeline stage. Whether THIS run dispatched fused is
+        read from a scoped child registry — a global counter diff would
+        misattribute a concurrent query's dispatch (the same rule the
+        device-failure check follows)."""
+        with metrics.scoped() as jm:
+            out = executor._exec_aggregate(shape.agg, None)
+        if (
+            jm.counter("scan.path.resident_join_agg")
+            + jm.counter("scan.path.resident_join_agg_mesh")
+            > 0
+        ):
+            metrics.incr("compile.fused.dispatches")
+        return out
+
+
+def _apply_projects(batch: ColumnarBatch, projects) -> ColumnarBatch:
+    """Apply a collected Project stack innermost-first (``projects`` is
+    outermost-first, the classify_shape order) — mirrors the
+    interpreter's bottom-up select chain."""
+    for p in reversed(projects):
+        batch = batch.select(list(p.columns))
+    return batch

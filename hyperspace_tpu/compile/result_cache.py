@@ -1,0 +1,128 @@
+"""RESULT cache stub riding the pipeline fingerprint machinery (the
+ROADMAP PR-9 follow-up): memoize FINISHED result tables keyed on
+(value-level plan signature, index-log version token).
+
+Unlike the pipeline cache, results depend on literal VALUES — the key is
+the serve plan cache's ``plan_signature`` (tree string with literals +
+every leaf's file snapshot) plus the full version token, so a hit is
+sound by construction: same literals, same source snapshot, same index
+generation, same conf. Scoped invalidation rides the same version
+tokens PR 9 pins — any create/refresh/optimize/delete changes the token
+and old entries age out of the LRU; ``invalidate(index_root)`` drops a
+rewritten index's entries eagerly (the collection-manager hook).
+
+Off by default (``hyperspace.compile.resultCache``); bounded by entry
+count AND a per-entry byte ceiling — this is a stub for point lookups
+and small aggregates, not a materialized-view store. Served batches are
+shared objects: ColumnarBatch is treated as immutable everywhere in the
+executor (transforms build new batches), the same contract the serve
+micro-batcher relies on.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from ..telemetry.metrics import metrics
+
+
+class ResultCache:
+    """Bounded LRU: (plan signature, version token) -> (batch, roots)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._results: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._epoch = 0
+
+    def get(self, key: tuple) -> Optional[object]:
+        with self._lock:
+            hit = self._results.get(key)
+            if hit is not None:
+                self._results.move_to_end(key)
+        if hit is None:
+            metrics.incr("compile.result_cache.miss")
+            return None
+        metrics.incr("compile.result_cache.hit")
+        return hit[0]
+
+    def put(
+        self,
+        key: tuple,
+        batch,
+        index_roots: Tuple[str, ...],
+        max_entries: int,
+        max_bytes: int,
+    ) -> bool:
+        """Memoize ``batch`` (False when it exceeds the byte ceiling)."""
+        from ..exec.bytecache import batch_nbytes
+
+        if batch_nbytes(batch) > max_bytes:
+            metrics.incr("compile.result_cache.too_large")
+            return False
+        with self._lock:
+            self._results[key] = (batch, tuple(index_roots))
+            self._results.move_to_end(key)
+            while len(self._results) > max(int(max_entries), 1):
+                self._results.popitem(last=False)
+                metrics.incr("compile.result_cache.evicted")
+        metrics.incr("compile.result_cache.stored")
+        return True
+
+    def invalidate(self, index_root: Optional[str] = None) -> int:
+        prefix = None
+        if index_root is not None:
+            prefix = str(index_root).rstrip("/") + "/"
+        with self._lock:
+            if prefix is None:
+                n = len(self._results)
+                self._results.clear()
+            else:
+                doomed = [
+                    k
+                    for k, (_b, roots) in self._results.items()
+                    if any(p.startswith(prefix) for p in roots)
+                ]
+                for k in doomed:
+                    del self._results[k]
+                n = len(doomed)
+        if n:
+            metrics.incr("compile.result_cache.invalidated", n)
+        return n
+
+    def reset(self) -> None:
+        with self._lock:
+            self._results.clear()
+            self._epoch += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._results)}
+
+
+result_cache = ResultCache()
+
+
+def result_key(
+    plan, version_token: tuple, signature: Optional[tuple] = None
+) -> tuple:
+    """The ONE memo-key convention: the serve plan cache's value-level
+    signature (literals + file snapshots) plus the full version token
+    (index generation + conf). ``signature`` accepts a caller-
+    precomputed ``plan_signature(plan)`` so the server path shares one
+    tree walk with the plan cache."""
+    if signature is None:
+        from ..serve.plan_cache import plan_signature
+
+        signature = plan_signature(plan)
+    return (signature, version_token)
+
+
+def result_roots(optimized_plan) -> Tuple[str, ...]:
+    """Scoped-invalidation anchors of the OPTIMIZED plan (what actually
+    served the result) — the fingerprint module's ONE anchor convention,
+    shared with the pipeline cache."""
+    from .fingerprint import index_roots
+
+    return index_roots(optimized_plan)

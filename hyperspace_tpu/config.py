@@ -24,14 +24,20 @@ class HyperspaceConf:
 
     def __init__(self, values: Optional[Dict[str, Any]] = None):
         self._values: Dict[str, Any] = dict(values or {})
+        # mutation generation: bumped by every set/unset so per-conf
+        # memos (the compiled-pipeline cache's conf token) can key on
+        # (conf, generation) instead of re-serializing the dict per read
+        self.generation = 0
 
     # -- generic access ------------------------------------------------------
     def set(self, key: str, value: Any) -> "HyperspaceConf":
         self._values[key] = value
+        self.generation += 1
         return self
 
     def unset(self, key: str) -> "HyperspaceConf":
         self._values.pop(key, None)
+        self.generation += 1
         return self
 
     def get(self, key: str, default: Any = None) -> Any:
@@ -361,6 +367,51 @@ class HyperspaceConf:
     def residency_for_delta(self) -> bool:
         return self._to_bool(
             self.get(C.RESIDENCY_FOR_DELTA, C.RESIDENCY_FOR_DELTA_DEFAULT)
+        )
+
+    def compile_mode(self) -> str:
+        v = str(self.get(C.COMPILE_MODE, C.COMPILE_MODE_DEFAULT)).lower()
+        if v not in C.COMPILE_MODES:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"Unknown {C.COMPILE_MODE}={v!r}; expected one of "
+                f"{C.COMPILE_MODES}."
+            )
+        return v
+
+    def compile_cache_entries(self) -> int:
+        return int(
+            self.get(C.COMPILE_CACHE_ENTRIES, C.COMPILE_CACHE_ENTRIES_DEFAULT)
+        )
+
+    def compile_result_cache_enabled(self) -> bool:
+        v = str(
+            self.get(C.COMPILE_RESULT_CACHE, C.COMPILE_RESULT_CACHE_DEFAULT)
+        ).lower()
+        if v not in C.COMPILE_RESULT_CACHE_MODES:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"Unknown {C.COMPILE_RESULT_CACHE}={v!r}; expected one of "
+                f"{C.COMPILE_RESULT_CACHE_MODES}."
+            )
+        return v == C.COMPILE_RESULT_CACHE_ON
+
+    def compile_result_cache_entries(self) -> int:
+        return int(
+            self.get(
+                C.COMPILE_RESULT_CACHE_ENTRIES,
+                C.COMPILE_RESULT_CACHE_ENTRIES_DEFAULT,
+            )
+        )
+
+    def compile_result_cache_max_bytes(self) -> int:
+        return int(
+            self.get(
+                C.COMPILE_RESULT_CACHE_MAX_BYTES,
+                C.COMPILE_RESULT_CACHE_MAX_BYTES_DEFAULT,
+            )
         )
 
     def distributed_min_rows(self) -> int:

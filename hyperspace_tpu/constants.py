@@ -288,3 +288,37 @@ TPU_DISTRIBUTED_MIN_ROWS_DEFAULT = 1_000_000
 # engine-level metrics registry (SURVEY §5.1: "JAX profiler + per-kernel
 # timing"). The reference delegates the equivalent to the Spark UI.
 TPU_PROFILE_DIR = "hyperspace.tpu.profile.dir"
+
+# --- whole-plan compilation (hyperspace_tpu/compile) -------------------------
+# Lower an optimized plan subtree to ONE fused pipeline (docs/17): "auto"
+# compiles every executed plan (interpreter stays the fallback leg),
+# "off" restores pure per-operator interpretation (the A/B lever bench
+# config 16 pulls).
+COMPILE_MODE = "hyperspace.compile.mode"
+COMPILE_MODE_AUTO = "auto"
+COMPILE_MODE_OFF = "off"
+COMPILE_MODES = (COMPILE_MODE_AUTO, COMPILE_MODE_OFF)
+COMPILE_MODE_DEFAULT = COMPILE_MODE_AUTO
+# Compiled-pipeline cache bound (entries are routing state, not data —
+# a few hundred bytes each; the jitted executables they reach live in
+# their own bounded caches).
+COMPILE_CACHE_ENTRIES = "hyperspace.compile.cacheEntries"
+COMPILE_CACHE_ENTRIES_DEFAULT = 256
+# RESULT cache stub riding the pipeline fingerprint (ROADMAP PR-9
+# follow-up): memoize finished result tables keyed on (value-level plan
+# signature, index-log version token). Off by default — result reuse is
+# only sound for workloads that tolerate snapshot-stale reads within one
+# log version, which is exactly what the version-token key guarantees,
+# but the memory trade is the operator's call.
+COMPILE_RESULT_CACHE = "hyperspace.compile.resultCache"
+COMPILE_RESULT_CACHE_ON = "on"
+COMPILE_RESULT_CACHE_OFF = "off"
+COMPILE_RESULT_CACHE_MODES = (COMPILE_RESULT_CACHE_ON, COMPILE_RESULT_CACHE_OFF)
+COMPILE_RESULT_CACHE_DEFAULT = COMPILE_RESULT_CACHE_OFF
+COMPILE_RESULT_CACHE_ENTRIES = "hyperspace.compile.resultCache.entries"
+COMPILE_RESULT_CACHE_ENTRIES_DEFAULT = 64
+# Per-entry byte ceiling: a memoized result larger than this never
+# enters the cache (point lookups and small aggregates are the target;
+# memoizing scans-of-everything would just mirror the page cache).
+COMPILE_RESULT_CACHE_MAX_BYTES = "hyperspace.compile.resultCache.maxResultBytes"
+COMPILE_RESULT_CACHE_MAX_BYTES_DEFAULT = 8 * 1024 * 1024

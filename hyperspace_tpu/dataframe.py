@@ -142,6 +142,13 @@ class DataFrame(EventLogging):
         with tracer, metrics.scoped() as query_metrics:
             result = executor.execute(plan)
         self.session.last_query_metrics = query_metrics.snapshot()
+        # whole-plan compilation attribution: which pipeline (fused
+        # subtree boundary, serving tier) the query rode — explain
+        # (verbose) prints it next to the scoped metrics
+        pipeline = executor.last_pipeline
+        self.session.last_pipeline_info = (
+            pipeline.describe() if pipeline is not None else None
+        )
         return result
 
     def to_pandas(self):

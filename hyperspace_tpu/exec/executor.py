@@ -73,9 +73,33 @@ class Executor:
             if dist_min_rows is not None
             else self.conf.distributed_min_rows()
         )
+        # the CompiledPipeline the last execute() ran (None when the
+        # interpreter served directly) — explain(verbose) attribution
+        self.last_pipeline = None
 
     # -- public --------------------------------------------------------------
-    def execute(self, plan: LogicalPlan) -> ColumnarBatch:
+    def execute(
+        self, plan: LogicalPlan, version_token: Optional[tuple] = None
+    ) -> ColumnarBatch:
+        """Execute ``plan`` — through the whole-plan compiler when
+        enabled (hyperspace_tpu/compile): the plan's structural
+        fingerprint resolves a CompiledPipeline from the process cache
+        (lowered on miss) and the pipeline runs with the interpreter as
+        its fallback leg. ``version_token`` is the serve tier's pinned
+        index-log snapshot (folded into the pipeline cache key so
+        snapshot-pinned reads serve whole compiled pipelines wholesale);
+        None outside serving — the fingerprint already pins every leaf's
+        log id and file snapshot. Host-latched executors (device=False)
+        interpret directly: every fused arm is a device arm."""
+        if self.device and self.conf.compile_mode() != "off":
+            from ..compile.cache import pipeline_cache
+
+            pipeline = pipeline_cache.get_or_lower(
+                plan, self, version_token
+            )
+            if pipeline is not None:
+                self.last_pipeline = pipeline
+                return pipeline.run(plan, self)
         return self._exec(plan, predicate=None)
 
     # -- dispatch ------------------------------------------------------------
@@ -183,21 +207,7 @@ class Executor:
                 return self._apply_predicate(batch, predicate)
             return self._exec_join(plan)
         if isinstance(plan, Aggregate):
-            from .aggregate import hash_aggregate
-
-            if self.mesh is not None:
-                fused = self._try_distributed_aggregate(plan)
-                if fused is not None:
-                    return self._apply_predicate(fused, predicate)
-            fused = self._try_join_aggregate(plan)
-            if fused is not None:
-                return self._apply_predicate(fused, predicate)
-            need = plan.input_columns()
-            child = self._exec(plan.child, None, need)
-            result = hash_aggregate(child, list(plan.group_by), list(plan.aggs))
-            # a predicate above the aggregate (HAVING shape) applies to the
-            # aggregated rows, never the child's
-            return self._apply_predicate(result, predicate)
+            return self._exec_aggregate(plan, predicate)
         if isinstance(plan, Union):
             return self._exec_union(plan, predicate, columns)
         if isinstance(plan, (BucketUnion, Repartition)):
@@ -209,7 +219,47 @@ class Executor:
             return ColumnarBatch.concat(parts)
         raise HyperspaceException(f"Cannot execute node {plan.node_name}.")
 
+    def _exec_aggregate(
+        self, plan: "Aggregate", predicate: Optional[Expr]
+    ) -> ColumnarBatch:
+        """The whole Aggregate procedure — fused arms first (mesh
+        two-phase, resident/host aggregate-join), then gather +
+        hash_aggregate. ONE entry point shared by the interpreter's
+        dispatch and the compiled join_agg pipeline (compile.pipeline),
+        so lowering can never reorder the arm preference."""
+        from .aggregate import hash_aggregate
+
+        if self.mesh is not None:
+            fused = self._try_distributed_aggregate(plan)
+            if fused is not None:
+                return self._apply_predicate(fused, predicate)
+        fused = self._try_join_aggregate(plan)
+        if fused is not None:
+            return self._apply_predicate(fused, predicate)
+        need = plan.input_columns()
+        child = self._exec(plan.child, None, need)
+        result = hash_aggregate(child, list(plan.group_by), list(plan.aggs))
+        # a predicate above the aggregate (HAVING shape) applies to the
+        # aggregated rows, never the child's
+        return self._apply_predicate(result, predicate)
+
     def _exec_union(
+        self,
+        plan: Union,
+        predicate: Optional[Expr],
+        columns: Optional[List[str]],
+    ) -> ColumnarBatch:
+        # delta residency: a hybrid union whose base AND appended delta
+        # are device-resident collapses into ONE fused mask+count
+        # dispatch (exec.hbm_cache/mesh_cache) — the appended side's
+        # per-query parquet decode and the second pipeline both vanish
+        if predicate is not None:
+            fused = self._try_resident_hybrid(plan, predicate)
+            if fused is not None:
+                return fused
+        return self._exec_union_host(plan, predicate, columns)
+
+    def _exec_union_host(
         self,
         plan: Union,
         predicate: Optional[Expr],
@@ -224,7 +274,10 @@ class Executor:
         next #8), so the sides execute CONCURRENTLY: the appended side's
         parquet decode (pyarrow, GIL-released C++) overlaps the index
         side's mmap + mask. Per-side ``union.side.{index,source}`` timers
-        stay observable; single-child unions skip the thread."""
+        stay observable; single-child unions skip the thread. Split from
+        the fused-arm attempt above so the compiled hybrid pipeline's
+        fallback (compile.pipeline._run_hybrid) never re-runs — and
+        never double-counts — the residency resolution."""
         import contextvars
         import time as _time
         from concurrent.futures import ThreadPoolExecutor
@@ -237,15 +290,6 @@ class Executor:
             side = "index" if _has_index_scan(c) else "source"
             metrics.record_time(f"union.side.{side}", _time.perf_counter() - t0)
             return out
-
-        # delta residency: a hybrid union whose base AND appended delta
-        # are device-resident collapses into ONE fused mask+count
-        # dispatch (exec.hbm_cache/mesh_cache) — the appended side's
-        # per-query parquet decode and the second pipeline both vanish
-        if predicate is not None:
-            fused = self._try_resident_hybrid(plan, predicate)
-            if fused is not None:
-                return fused
 
         children = list(plan.children)
         if len(children) < 2:

@@ -1689,6 +1689,7 @@ class HbmIndexCache(ResidentCacheBase):
         table: ResidentTable,
         predicates: List[Expr],
         prepared: Optional[list] = None,
+        metric_ns: str = "serve.batch",
     ) -> Optional[np.ndarray]:
         """(N, n_blocks) per-BLOCK_ROWS match counts for N predicates over
         one resident table in ONE device dispatch — the micro-batcher's
@@ -1700,7 +1701,11 @@ class HbmIndexCache(ResidentCacheBase):
         narrow to the resident encodings (the caller serves that batch
         per-query instead; mixing one host-routed straggler into a device
         batch would force a second dispatch anyway). Tier-transparent
-        like block_counts: streaming tables window the whole batch."""
+        like block_counts: streaming tables window the whole batch.
+        ``metric_ns`` names the counter family — "serve.batch" for the
+        micro-batcher, "compile.fused" for the compiled pipeline's N=1
+        structure-keyed singles (compile.pipeline) — so serving stats
+        never conflate the two dispatch populations."""
         if getattr(table, "tier", "resident") == "streaming":
             from ..residency.streaming import stream_block_counts_batch
 
@@ -1741,9 +1746,9 @@ class HbmIndexCache(ResidentCacheBase):
         t0 = time.perf_counter()
         with K._x32():
             counts = np.asarray(fn(cols, tuple(lit_vecs)))
-        metrics.record_time("serve.batch.device", time.perf_counter() - t0)
-        metrics.incr("serve.batch.dispatches")
-        metrics.incr("serve.batch.queries", len(predicates))
+        metrics.record_time(f"{metric_ns}.device", time.perf_counter() - t0)
+        metrics.incr(f"{metric_ns}.dispatches")
+        metrics.incr(f"{metric_ns}.queries", len(predicates))
         metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
         n_blocks = -(-table.n_rows // BLOCK_ROWS)
         return counts[:, :n_blocks]

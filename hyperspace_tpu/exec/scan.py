@@ -333,12 +333,28 @@ def index_scan(
     dtypes: Optional[dict] = None,
     num_buckets: Optional[int] = None,
     min_device_rows: Optional[int] = None,
+    structure_keyed: bool = False,
 ) -> ColumnarBatch:
     """Scan index data files, returning the filtered projection.
 
     When ``indexed_columns``/``dtypes``/``num_buckets`` describe the
     index's bucketing, equality predicates prune to their hash buckets
-    before any file is opened."""
+    before any file is opened.
+
+    ``structure_keyed`` (the compiled-pipeline entry, compile.pipeline):
+    the resident counts dispatch rides the batched executable keyed on
+    predicate STRUCTURE with literals as traced int32 operands — a burst
+    of structurally-equal queries with fresh literals shares ONE
+    compiled program instead of recompiling per literal. Identical
+    eligibility, gating, host legs, and results; streaming-tier tables
+    keep the single-predicate window loop either way. KNOWN TRADE: the
+    batched executable is XLA-only, so compiled singles skip the Pallas
+    mask-kernel arm block_counts would pick on a TPU backend (the same
+    trade the serve micro-batcher made in its round — the Pallas call
+    cache is ALSO literal-keyed, so it re-pays its build per fresh
+    literal; scan.path.pallas_mask counts only the per-operator arm,
+    compile.fused.* counts this one; hyperspace.compile.mode=off
+    restores the kernel arm for singles)."""
     all_files = [Path(p) for p in data_files]
     pinned = None
     if predicate is not None and indexed_columns and dtypes and num_buckets:
@@ -392,7 +408,18 @@ def index_scan(
             # (identical result — same invariant as _routed_mask) and
             # drops the table so later queries don't retry a dead device
             try:
-                counts = hbm_cache.block_counts(table, predicate)
+                if (
+                    structure_keyed
+                    and getattr(table, "tier", "resident") != "streaming"
+                ):
+                    m = hbm_cache.block_counts_batch(
+                        table,
+                        [predicate],
+                        metric_ns="compile.fused",
+                    )
+                    counts = None if m is None else m[0]
+                else:
+                    counts = hbm_cache.block_counts(table, predicate)
             except Exception:  # noqa: BLE001 - device loss degrades
                 hbm_cache.drop(table)
                 metrics.incr("scan.resident.device_failed")

@@ -52,6 +52,23 @@ def _invalidate_resident_deltas(index_root) -> None:
     mesh_cache.invalidate_deltas(str(index_root))
     hbm_cache.invalidate_joins(str(index_root))
     mesh_cache.invalidate_joins(str(index_root))
+    _invalidate_compiled(index_root)
+
+
+def _invalidate_compiled(index_root) -> None:
+    """Drop compiled pipelines and memoized results scoped to THIS
+    index's directory (compile.cache / compile.result_cache): an
+    index-data-rewriting or -removing action changes what the pipeline's
+    leaves serve, and a JOIN pipeline carries both sides' roots so it
+    drops on EITHER side's change. The version-token/fingerprint keys
+    would miss stale entries naturally; the eager drop keeps the bounded
+    caches from pinning dead routing state until LRU pressure finds it.
+    Quick refresh does NOT route here (no index data files change)."""
+    from ..compile.cache import pipeline_cache
+    from ..compile.result_cache import result_cache
+
+    pipeline_cache.invalidate(str(index_root))
+    result_cache.invalidate(str(index_root))
 
 
 class IndexCollectionManager:
@@ -116,6 +133,10 @@ class IndexCollectionManager:
 
     def delete(self, name: str) -> None:
         DeleteAction(self._existing_log_manager(name), self.conf).run()
+        # compiled pipelines over a deleted index could only serve until
+        # their token/fingerprint missed; drop them (and their memoized
+        # results) eagerly, scoped to this index
+        _invalidate_compiled(self.path_resolver.get_index_path(name))
 
     def restore(self, name: str) -> None:
         RestoreAction(self._existing_log_manager(name), self.conf).run()

@@ -155,6 +155,25 @@ def explain_string(
             )
             buf.write_line()
 
+        # whole-plan compilation: the pipeline the last collect() rode —
+        # its fused subtree boundary (which operators shared ONE device
+        # dispatch) and the residency tier it lowered against
+        # (docs/17-plan-compilation.md)
+        pipe_info = getattr(session, "last_pipeline_info", None)
+        if pipe_info is not None:
+            buf.write_line(_BANNER)
+            buf.write_line("Whole-plan compilation (last query):")
+            buf.write_line(_BANNER)
+            buf.write_line(f"Pipeline kind: {pipe_info.get('kind')}")
+            buf.write_line(f"Residency tier at lowering: {pipe_info.get('tier')}")
+            for line in pipe_info.get("boundary", ()):
+                buf.write_line(line)
+            buf.write_line(
+                f"Pipeline runs: {pipe_info.get('runs')}"
+                f" (fused dispatches: {pipe_info.get('fused_dispatches')})"
+            )
+            buf.write_line()
+
         # the last query's OWN scoped share (telemetry.metrics.scoped):
         # under concurrent serving the cumulative pool above mixes every
         # in-flight query; this section is attributable to exactly one

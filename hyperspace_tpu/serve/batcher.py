@@ -82,20 +82,38 @@ def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
     )
     from ..exec.scan import prune_index_files
 
+    # every batch key folds the plan's COARSE pipeline fingerprint
+    # (compile.fingerprint.batch_fingerprint): shape class + index-leaf
+    # versions + predicate/projection column sets — the whole-plan twin
+    # of the table-identity component, so two structurally-incompatible
+    # pipelines can never share a stacked dispatch even if they resolve
+    # to the same resident table. Full predicate structure stays
+    # per-slot in the batched executable (mixed point/range bursts keep
+    # coalescing). Computed only AFTER the structural early-returns —
+    # the common non-batchable plan must not pay the fingerprint walk.
+    from ..compile.fingerprint import batch_fingerprint
+
     output_columns = list(plan.output_columns())
     node = plan
     while isinstance(node, Project):
         node = node.child
     if isinstance(node, Aggregate):
-        return _classify_join_aggregate(session, node, output_columns)
+        return _classify_join_aggregate(
+            session, node, output_columns, batch_fingerprint(plan)
+        )
     if not isinstance(node, Filter):
         return None
     if isinstance(node.child, Union):
         return _classify_hybrid(
-            session, node.condition, node.child, output_columns
+            session,
+            node.condition,
+            node.child,
+            output_columns,
+            batch_fingerprint(plan),
         )
     if not isinstance(node.child, IndexScan):
         return None
+    fp = batch_fingerprint(plan)
     predicate = node.condition
     scan = node.child
     entry = scan.entry
@@ -128,7 +146,7 @@ def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
             files,
             predicate,
             output_columns,
-            (id(table), frozenset(prepared[1])),
+            (fp, id(table), frozenset(prepared[1])),
             mesh,
             prepared,
         )
@@ -150,7 +168,7 @@ def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
     # discontinuity — half its queries would have classified against
     # state the other half's windows no longer reflect
     gen = getattr(table, "window_gen", None)
-    batch_key = (id(table), frozenset(prepared[1])) + (
+    batch_key = (fp, id(table), frozenset(prepared[1])) + (
         (gen,) if gen is not None else ()
     )
     return ResidentScanRequest(
@@ -166,7 +184,11 @@ def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
 
 
 def _classify_hybrid(
-    session, predicate: Expr, union: LogicalPlan, output_columns: List[str]
+    session,
+    predicate: Expr,
+    union: LogicalPlan,
+    output_columns: List[str],
+    fp: Tuple,
 ) -> Optional[ResidentScanRequest]:
     """Classify a filter-shape hybrid union for the batched hybrid
     dispatch: base table AND delta region must be resident and the
@@ -206,7 +228,7 @@ def _classify_hybrid(
         res.files,
         predicate,
         output_columns,
-        (id(res.table), id(res.delta), frozenset(prepared[1])),
+        (fp, id(res.table), id(res.delta), frozenset(prepared[1])),
         None,
         prepared,
         res.delta,
@@ -215,7 +237,7 @@ def _classify_hybrid(
 
 
 def _classify_join_aggregate(
-    session, agg: Aggregate, output_columns: List[str]
+    session, agg: Aggregate, output_columns: List[str], fp: Tuple
 ) -> Optional[ResidentScanRequest]:
     """Classify an Aggregate([Project](Join)) plan for the batched
     resident aggregate-join: both sides must resolve to pristine
@@ -255,7 +277,7 @@ def _classify_join_aggregate(
         [],
         None,
         output_columns,
-        (id(res.region), "join_agg", spec),
+        (fp, id(res.region), "join_agg", spec),
         None,
         None,
         None,

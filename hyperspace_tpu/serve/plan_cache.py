@@ -93,7 +93,9 @@ class PlanCache:
         count executions)."""
         return self.optimized_plan_with_token(df)[0]
 
-    def optimized_plan_with_token(self, df) -> "Tuple[LogicalPlan, Tuple]":
+    def optimized_plan_with_token(
+        self, df, signature: "Tuple" = None
+    ) -> "Tuple[LogicalPlan, Tuple]":
         """``(optimized plan, version token)`` — the token is the exact
         index-log/session snapshot the plan was resolved under; the
         server pins it on the ticket so a query admitted under version V
@@ -107,8 +109,13 @@ class PlanCache:
         OLD token — the pin would lie and the cache would serve the
         wrong generation to same-token callers. So the token is re-read
         after optimizing and the pair is only trusted (and cached) when
-        both reads agree; a mismatch re-resolves under the new version."""
-        signature = plan_signature(df.plan)
+        both reads agree; a mismatch re-resolves under the new version.
+
+        ``signature``: a caller-precomputed ``plan_signature(df.plan)``
+        (the server's result-cache path already built one — the tree
+        walk must not run twice per submission)."""
+        if signature is None:
+            signature = plan_signature(df.plan)
         token = self._version_token(df.session)
         for _attempt in range(4):
             key = (signature, token)

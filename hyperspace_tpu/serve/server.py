@@ -188,9 +188,10 @@ class _Request:
         "ticket",
         "tenant",
         "inflight_charged",
+        "result_key",
     )
 
-    def __init__(self, df, plan, resident, ticket, tenant):
+    def __init__(self, df, plan, resident, ticket, tenant, result_key=None):
         self.df = df
         self.plan = plan
         self.resident = resident  # Optional[batcher.ResidentScanRequest]
@@ -201,6 +202,9 @@ class _Request:
         # between batch registration and the charge cannot corrupt the
         # tenant's in-flight accounting in either direction
         self.inflight_charged = False
+        # RESULT-cache memo key (compile.result_cache) when the conf
+        # enables it — a successful single execution stores under it
+        self.result_key = result_key
 
 
 class QueryServer:
@@ -445,8 +449,37 @@ class QueryServer:
         # plan bakes this snapshot's files, so the query serves it
         # wholesale across any concurrent refresh/optimize.
         try:
-            plan, token = self.plan_cache.optimized_plan_with_token(df)
+            # the result-cache path shares ONE plan_signature walk with
+            # the plan cache — the tree string + leaf snapshots must not
+            # be computed twice per submission
+            signature = None
+            rc_enabled = self.session.conf.compile_result_cache_enabled()
+            if rc_enabled:
+                from .plan_cache import plan_signature
+
+                signature = plan_signature(df.plan)
+            plan, token = self.plan_cache.optimized_plan_with_token(
+                df, signature=signature
+            )
             ticket.pinned_log_version = token[1]
+            # RESULT cache (compile.result_cache, conf-gated off by
+            # default): a value-level hit under the SAME pinned token
+            # serves the memoized table without touching a worker —
+            # sound because the key carries literals, file snapshots,
+            # index generation, and conf (the PR-9 follow-up stub)
+            rc_key = None
+            if rc_enabled:
+                from ..compile.result_cache import result_cache, result_key
+
+                rc_key = result_key(df.plan, token, signature=signature)
+                cached = result_cache.get(rc_key)
+                if cached is not None:
+                    metrics.incr("serve.submitted")
+                    with self._cond:
+                        self._submitted += 1
+                        tstate.submitted += 1
+                    self._finish(ticket, result=cached)
+                    return ticket
             resident = (
                 None
                 if self._consult_device_latch()
@@ -464,7 +497,7 @@ class QueryServer:
                 tstate.submitted += 1
             self._finish(ticket, error=e)
             return ticket
-        req = _Request(df, plan, resident, ticket, tstate)
+        req = _Request(df, plan, resident, ticket, tstate, rc_key)
         ticket._request = req
         with self._cond:
             if self._closed:
@@ -707,6 +740,26 @@ class QueryServer:
             with metrics.scoped() as qm:
                 result = self._run_plan(req)
             req.ticket.metrics = qm.snapshot()
+            if req.result_key is not None:
+                # the memo is best-effort: a store failure (bad conf
+                # value, exotic batch) must NEVER convert an already-
+                # successful query into a caller-visible error
+                try:
+                    from ..compile.result_cache import (
+                        result_cache,
+                        result_roots,
+                    )
+
+                    conf = self.session.conf
+                    result_cache.put(
+                        req.result_key,
+                        result,
+                        result_roots(req.plan),
+                        conf.compile_result_cache_entries(),
+                        conf.compile_result_cache_max_bytes(),
+                    )
+                except Exception:  # noqa: BLE001 - memo only, counted
+                    metrics.incr("compile.result_cache.store_error")
             self._finish(req.ticket, result=result)
         except Exception as e:  # noqa: BLE001 - one query's failure is its own
             self._finish(req.ticket, error=e)
@@ -721,7 +774,12 @@ class QueryServer:
             executor = Executor(self.session.conf, device=False, mesh=None)
         else:
             executor = Executor(self.session.conf, mesh=self.session.mesh)
-        return executor.execute(req.plan)
+        # the ticket's pinned index-log snapshot folds into the compiled-
+        # pipeline cache key: a query admitted under version V serves V's
+        # whole compiled pipeline across any concurrent refresh/optimize
+        return executor.execute(
+            req.plan, version_token=req.ticket.pinned_log_version
+        )
 
     def _execute_batch(self, live: List[_Request]) -> None:
         now = time.monotonic()
@@ -961,6 +1019,11 @@ class QueryServer:
         # process-wide serve counter family (telemetry.metrics)
         out["serve_counters"] = serve_snapshot()
         out["plan_cache"] = self.plan_cache.snapshot()
+        # whole-plan compilation surface: the compiled-pipeline cache,
+        # the result-cache stub, and the compile.* counter family —
+        # whether bursts are reusing pipelines or re-lowering per query
+        # (docs/17-plan-compilation.md)
+        out["compile"] = _compile_stats()
         # join-region surface: what the resident join pipeline holds
         # (regions, bytes, generation) — operators read this next to the
         # serve counters to see whether aggregate-joins are being served
@@ -981,6 +1044,21 @@ class QueryServer:
         if waits:
             out["mean_wait_ms"] = round(1e3 * statistics.fmean(waits), 3)
         return out
+
+
+def _compile_stats() -> dict:
+    """Whole-plan-compilation snapshot for stats(): pipeline/result cache
+    occupancy plus the process-wide compile.* counter family
+    (telemetry.compile_snapshot)."""
+    from ..compile.cache import pipeline_cache
+    from ..compile.result_cache import result_cache
+    from ..telemetry.metrics import compile_snapshot
+
+    return {
+        "pipelines": pipeline_cache.snapshot(),
+        "results": result_cache.snapshot(),
+        **compile_snapshot(),
+    }
 
 
 def _residency_stats() -> dict:

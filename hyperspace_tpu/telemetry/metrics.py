@@ -219,6 +219,41 @@ def residency_snapshot(
     return out
 
 
+def compile_snapshot(
+    registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, object]:
+    """The whole-plan-compilation counter family in one dict — how often
+    lowering ran vs the pipeline cache served (a repeated-structure burst
+    keeps ``pipelines_lowered`` flat while ``cache_hits`` climbs), which
+    kinds lowered, what the fused arms dispatched, and what degradation
+    dropped. Consumed by ``QueryServer.stats()["compile"]`` and bench
+    config 16 (docs/17-plan-compilation.md)."""
+    r = registry if registry is not None else metrics
+    out: Dict[str, object] = {
+        "pipelines_lowered": r.counter("compile.lowered"),
+        "lower_errors": r.counter("compile.lower_error"),
+        "cache_hits": r.counter("compile.cache.hit"),
+        "cache_misses": r.counter("compile.cache.miss"),
+        "cache_evicted": r.counter("compile.cache.evicted"),
+        "cache_invalidated": r.counter("compile.cache.invalidated"),
+        "fused_dispatches": r.counter("compile.fused.dispatches"),
+        "fused_queries": r.counter("compile.fused.queries"),
+        "dropped_on_device_loss": r.counter(
+            "compile.pipeline.dropped_on_device_loss"
+        ),
+        "result_hits": r.counter("compile.result_cache.hit"),
+        "result_misses": r.counter("compile.result_cache.miss"),
+        "result_stored": r.counter("compile.result_cache.stored"),
+        "result_invalidated": r.counter("compile.result_cache.invalidated"),
+    }
+    runs = {
+        kind: r.counter(f"compile.run.{kind}")
+        for kind in ("scan", "agg_scan", "hybrid", "join_agg", "interpret")
+    }
+    out["runs"] = {k: v for k, v in runs.items() if v}
+    return out
+
+
 def serve_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict[str, int]:
     """The serve-tier counter family in one dict — what admission let
     in, shed, or breaker-rejected, what the overload ladder disabled,

@@ -1083,6 +1083,66 @@ def test_hs012_non_residency_class_is_out_of_scope():
     assert codes(run_project(sources), "HS012") == []
 
 
+def test_hs012_covers_compile_cache_registries():
+    """The whole-plan compile caches opted into HS012's structural scope
+    (``_lock`` + ``_epoch``): an unfenced mutation of the ``_pipelines``
+    or ``_results`` registries fires exactly like a residency-cache
+    ``_tables`` write would."""
+    sources = {
+        "pkg/pcache.py": """
+        import threading
+
+        class PipelineCache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pipelines = {}
+                self._results = {}
+                self._epoch = 0
+
+            def reset(self):
+                with self._lock:
+                    self._pipelines.clear()
+                    self._results.clear()
+                    self._epoch += 1
+
+            def forget_unlocked(self, key):
+                self._pipelines.pop(key, None)
+
+            def drop_results_unlocked(self):
+                self._results.clear()
+        """
+    }
+    findings = run_project(sources)
+    got = [f for f in findings if f.code == "HS012" and not f.suppressed]
+    assert len(got) == 2
+    msgs = " | ".join(f.message for f in got)
+    assert "_pipelines" in msgs and "_results" in msgs
+
+
+def test_hs012_compile_cache_clean_under_lock():
+    sources = {
+        "pkg/pcache.py": """
+        import threading
+
+        class PipelineCache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pipelines = {}
+                self._epoch = 0
+
+            def put(self, key, p):
+                with self._lock:
+                    self._pipelines[key] = p
+
+            def invalidate(self):
+                with self._lock:
+                    self._pipelines.clear()
+                    self._epoch += 1
+        """
+    }
+    assert codes(run_project(sources), "HS012") == []
+
+
 def test_hs012_suppressed():
     sources = {
         "pkg/cache.py": _HS012_GOOD
