@@ -1164,6 +1164,54 @@ def main() -> None:
                 "latency_p50_ms": sstats.get("latency_p50_ms"),
                 "latency_p99_ms": sstats.get("latency_p99_ms"),
             }
+            # ---- tracing overhead gate (PR 11, docs/18) -----------------
+            # The span-tracing claim: per-query traces cost <3% on this
+            # same serve burst. A/B over the serial burst (every query
+            # pays trace creation + its span sites), tracing on (the
+            # default) vs hyperspace.telemetry.tracing=off. The two
+            # sides run INTERLEAVED in adjacent pairs and each side
+            # takes its best-of — a sequential block A/B on this
+            # single-core host measures load drift, not the tracer (the
+            # observed jitter between identical bursts exceeds the gate
+            # by itself; min-vs-min over interleaved samples converges
+            # both sides to the same noise floor).
+            if os.environ.get("BENCH_TRACE_GATE", "1") != "0":
+                from hyperspace_tpu import constants as HC
+
+                treps = int(os.environ.get("BENCH_TRACE_REPS", 7))
+
+                def _burst_once():
+                    for kk in skeys:
+                        mk(kk).collect()
+
+                best = {"on": math.inf, "off": math.inf}
+                for mode in ("on", "off"):
+                    # warm each mode's code path AND its conf-token
+                    # keyed pipeline-cache entries before any timing
+                    session.conf.set(HC.TELEMETRY_TRACING, mode)
+                    _burst_once()
+                for _ in range(treps):
+                    for mode in ("on", "off"):
+                        session.conf.set(HC.TELEMETRY_TRACING, mode)
+                        t0 = time.perf_counter()
+                        _burst_once()
+                        best[mode] = min(
+                            best[mode], time.perf_counter() - t0
+                        )
+                session.conf.unset(HC.TELEMETRY_TRACING)
+                overhead_pct = max(
+                    (best["on"] - best["off"]) / best["off"] * 100.0, 0.0
+                )
+                extras["serve"]["trace_on_s"] = round(best["on"], 4)
+                extras["serve"]["trace_off_s"] = round(best["off"], 4)
+                extras["serve"]["trace_overhead_pct"] = round(
+                    overhead_pct, 2
+                )
+                if overhead_pct >= 3.0:
+                    _fail(
+                        "config10 tracing overhead "
+                        f"{overhead_pct:.2f}% >= 3% gate"
+                    )
         finally:
             if _prev_hbm10 is None:
                 os.environ.pop("HYPERSPACE_TPU_HBM", None)

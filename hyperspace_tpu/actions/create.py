@@ -215,6 +215,47 @@ class CreateActionBase:
         lineage: bool,
         tracker: FileIdTracker,
     ) -> List[Path]:
+        # build-pipeline trace: stage spans (ingest loop, finalize —
+        # index/stream_builder) land under one per-build trace, rung
+        # into the flight recorder like query traces so a slow build
+        # leaves attributable evidence (docs/18-observability.md)
+        import contextlib
+
+        from ..telemetry.recorder import flight_recorder
+        from ..telemetry.trace import start_trace
+
+        tracing = self.conf.telemetry_tracing_enabled()
+        trace_cm = (
+            start_trace("build.index", index=config.index_name)
+            if tracing
+            else contextlib.nullcontext()
+        )
+        with trace_cm as btrace:
+            try:
+                out = self._write_inner(
+                    relation, config, version_dir, num_buckets, lineage,
+                    tracker,
+                )
+            except BaseException as e:
+                # a failed build is the trace the post-mortem needs
+                if btrace is not None:
+                    btrace.finish(e)
+                    flight_recorder.record(btrace)
+                raise
+        if btrace is not None:
+            btrace.finish()
+            flight_recorder.record(btrace)
+        return out
+
+    def _write_inner(
+        self,
+        relation: FileRelation,
+        config: IndexConfig,
+        version_dir: Path,
+        num_buckets: int,
+        lineage: bool,
+        tracker: FileIdTracker,
+    ) -> List[Path]:
         indexed, included = self.resolved_columns(relation, config)
         extra_meta = {"indexName": config.index_name}
         pipeline = self.conf.build_pipeline()

@@ -25,6 +25,7 @@ from .hs010_guarded_fields import GuardedFieldRule
 from .hs011_interproc_blocking import InterprocBlockingRule
 from .hs012_residency_fence import ResidencyFenceRule
 from .hs013_config_keys import ConfigKeyRule
+from .hs014_metric_names import MetricNameRule
 
 REGISTRY: List[Rule] = [
     HostSyncRule(),
@@ -40,6 +41,7 @@ REGISTRY: List[Rule] = [
     InterprocBlockingRule(),
     ResidencyFenceRule(),
     ConfigKeyRule(),
+    MetricNameRule(),
 ]
 
 __all__ = [
@@ -57,4 +59,5 @@ __all__ = [
     "InterprocBlockingRule",
     "ResidencyFenceRule",
     "ConfigKeyRule",
+    "MetricNameRule",
 ]

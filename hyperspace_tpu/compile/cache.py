@@ -35,6 +35,7 @@ from collections import OrderedDict
 from typing import Optional
 
 from ..telemetry.metrics import metrics
+from ..telemetry.trace import annotate, span
 from .pipeline import CompiledPipeline
 
 # per-conf-object memo of the serialized token, keyed on the conf's
@@ -91,9 +92,12 @@ class PipelineCache:
                 self._pipelines.move_to_end(key)
         if hit is not None:
             metrics.incr("compile.cache.hit")
+            annotate(compile_cache="hit")
             return hit
         metrics.incr("compile.cache.miss")
-        pipeline = lower(plan, conf, executor.mesh, fingerprint=fp)
+        annotate(compile_cache="miss")
+        with span("compile.lower"):
+            pipeline = lower(plan, conf, executor.mesh, fingerprint=fp)
         max_entries = max(int(conf.compile_cache_entries()), 1)
         with self._lock:
             racer = self._pipelines.get(key)

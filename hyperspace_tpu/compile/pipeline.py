@@ -28,6 +28,7 @@ from typing import Optional, Tuple
 
 from ..storage.columnar import ColumnarBatch
 from ..telemetry.metrics import metrics
+from ..telemetry.trace import span
 
 # the device-failure counters every fused arm's degradation path bumps
 # (exec.scan / exec.executor / exec.join_residency): a run that moved any
@@ -63,6 +64,18 @@ class CompiledPipeline:
         self.tier = tier
         self.index_roots = index_roots
         self.boundary = boundary
+        # short stable id of the structural fingerprint — the
+        # "which executable" label every trace span and describe() carry
+        # (the full tuple is unwieldy in a span tree)
+        import hashlib
+
+        self.fingerprint_id = (
+            hashlib.blake2s(
+                repr(fingerprint).encode("utf-8"), digest_size=4
+            ).hexdigest()
+            if fingerprint is not None
+            else None
+        )
         # set by PipelineCache when the pipeline is cached; forget-on-
         # device-loss needs them to evict exactly one entry
         self.cache = None
@@ -78,6 +91,7 @@ class CompiledPipeline:
         return {
             "kind": self.kind,
             "tier": self.tier,
+            "fingerprint": self.fingerprint_id,
             "boundary": list(self.boundary),
             "runs": self.runs,
             "fused_dispatches": self.fused_dispatches,
@@ -97,7 +111,14 @@ class CompiledPipeline:
         # cross-talk between concurrent queries
         with metrics.scoped() as run_metrics:
             try:
-                with metrics.timer("compile.pipeline_run"):
+                # the trace's "which executable" span: kind + residency
+                # tier at lowering + the structural fingerprint id
+                with span(
+                    "compile.pipeline_run",
+                    kind=self.kind,
+                    tier=self.tier,
+                    fingerprint=self.fingerprint_id,
+                ), metrics.timer("compile.pipeline_run"):
                     out = self._run_kind(plan, executor)
             finally:
                 with self._stats_lock:

@@ -414,6 +414,62 @@ class HyperspaceConf:
             )
         )
 
+    def telemetry_tracing_enabled(self) -> bool:
+        v = str(
+            self.get(C.TELEMETRY_TRACING, C.TELEMETRY_TRACING_DEFAULT)
+        ).lower()
+        if v not in C.TELEMETRY_TRACING_MODES:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"Unknown {C.TELEMETRY_TRACING}={v!r}; expected one of "
+                f"{C.TELEMETRY_TRACING_MODES}."
+            )
+        return v == C.TELEMETRY_TRACING_ON
+
+    def telemetry_recorder_entries(self) -> int:
+        return int(
+            self.get(
+                C.TELEMETRY_RECORDER_ENTRIES,
+                C.TELEMETRY_RECORDER_ENTRIES_DEFAULT,
+            )
+        )
+
+    def telemetry_recorder_snapshots(self) -> int:
+        return int(
+            self.get(
+                C.TELEMETRY_RECORDER_SNAPSHOTS,
+                C.TELEMETRY_RECORDER_SNAPSHOTS_DEFAULT,
+            )
+        )
+
+    def telemetry_export_dir(self) -> Optional[str]:
+        """The metrics-rotation directory, or None (the default: off).
+        "auto" resolves next to the operation log under the system
+        path (docs/18-observability.md)."""
+        v = self.get(C.TELEMETRY_EXPORT_DIR)
+        if not v:
+            return None
+        v = str(v)
+        if v.lower() == C.TELEMETRY_EXPORT_DIR_AUTO:
+            from pathlib import Path
+
+            return str(Path(self.system_path()) / C.TELEMETRY_METRICS_DIRNAME)
+        return v
+
+    def telemetry_export_rotate_bytes(self) -> int:
+        return int(
+            self.get(
+                C.TELEMETRY_EXPORT_ROTATE_BYTES,
+                C.TELEMETRY_EXPORT_ROTATE_BYTES_DEFAULT,
+            )
+        )
+
+    def telemetry_export_keep(self) -> int:
+        return int(
+            self.get(C.TELEMETRY_EXPORT_KEEP, C.TELEMETRY_EXPORT_KEEP_DEFAULT)
+        )
+
     def distributed_min_rows(self) -> int:
         return int(
             self.get(

@@ -269,6 +269,36 @@ RESIDENCY_FOR_DELTA_DEFAULT = "on"
 # (reference: telemetry/Constants.scala:20)
 EVENT_LOGGER_CLASS = "hyperspace.eventLoggerClass"
 
+# Per-query span tracing (telemetry/trace.py; docs/18-observability.md).
+# "on" opens a trace per collect()/served ticket (span sites then record;
+# the flight recorder rings completed traces); "off" restores the
+# pre-tracing entry points — the A/B lever the bench config-10 overhead
+# gate pulls. No reference analog: Spark delegates this to its UI.
+TELEMETRY_TRACING = "hyperspace.telemetry.tracing"
+TELEMETRY_TRACING_ON = "on"
+TELEMETRY_TRACING_OFF = "off"
+TELEMETRY_TRACING_MODES = (TELEMETRY_TRACING_ON, TELEMETRY_TRACING_OFF)
+TELEMETRY_TRACING_DEFAULT = TELEMETRY_TRACING_ON
+# Flight recorder bounds (telemetry/recorder.py): how many completed
+# traces the ring keeps, and how many failure snapshots (device-loss /
+# breaker-open / shed) are retained. Process-global; adopted at session
+# construction like the residency knobs.
+TELEMETRY_RECORDER_ENTRIES = "hyperspace.telemetry.recorder.entries"
+TELEMETRY_RECORDER_ENTRIES_DEFAULT = 64
+TELEMETRY_RECORDER_SNAPSHOTS = "hyperspace.telemetry.recorder.snapshots"
+TELEMETRY_RECORDER_SNAPSHOTS_DEFAULT = 8
+# Opt-in on-disk metrics rotation (telemetry/export.py): unset = off;
+# "auto" resolves to <system path>/_hyperspace_metrics (next to the
+# operation log); any other value is the directory itself. stats()
+# appends one JSON-lines snapshot per call, size-rotated.
+TELEMETRY_EXPORT_DIR = "hyperspace.telemetry.export.dir"
+TELEMETRY_EXPORT_DIR_AUTO = "auto"
+TELEMETRY_METRICS_DIRNAME = "_hyperspace_metrics"
+TELEMETRY_EXPORT_ROTATE_BYTES = "hyperspace.telemetry.export.rotateBytes"
+TELEMETRY_EXPORT_ROTATE_BYTES_DEFAULT = 4 * 1024 * 1024
+TELEMETRY_EXPORT_KEEP = "hyperspace.telemetry.export.keep"
+TELEMETRY_EXPORT_KEEP_DEFAULT = 4
+
 # --- signature provider ------------------------------------------------------
 SIGNATURE_PROVIDER = "hyperspace.index.signatureProvider"
 

@@ -121,34 +121,64 @@ class DataFrame(EventLogging):
     def collect(self) -> ColumnarBatch:
         from .exec.executor import Executor
         from .telemetry.metrics import metrics
+        from .telemetry.recorder import flight_recorder
+        from .telemetry.trace import span, start_trace
 
         import contextlib
 
         executor = Executor(self.session.conf, mesh=self.session.mesh)
-        plan = self.optimized_plan(log_usage=True)
-        profile_dir = self.session.conf.profile_dir()
-        if profile_dir:
-            # XLA-level trace (per-op device timing, HLO) for this query —
-            # view with tensorboard/xprof; complements the engine-level
-            # metrics registry (SURVEY §5.1)
-            import jax
-
-            tracer = jax.profiler.trace(profile_dir)
-        else:
-            tracer = contextlib.nullcontext()
-        # per-query scoped registry: global counters accumulate exactly as
-        # before, and this query's own share lands on the session for
-        # explain(verbose) — concurrent queries each see only their own
-        with tracer, metrics.scoped() as query_metrics:
-            result = executor.execute(plan)
-        self.session.last_query_metrics = query_metrics.snapshot()
-        # whole-plan compilation attribution: which pipeline (fused
-        # subtree boundary, serving tier) the query rode — explain
-        # (verbose) prints it next to the scoped metrics
-        pipeline = executor.last_pipeline
-        self.session.last_pipeline_info = (
-            pipeline.describe() if pipeline is not None else None
+        # per-query span trace (telemetry.trace): plan -> execute stage
+        # boundaries, recorded into the flight recorder on completion;
+        # its meta is the ONE record explain(verbose) renders from
+        tracing = self.session.conf.telemetry_tracing_enabled()
+        trace_cm = (
+            start_trace("query.collect") if tracing else contextlib.nullcontext()
         )
+        with trace_cm as qtrace:
+            try:
+                with span("plan.optimize"):
+                    plan = self.optimized_plan(log_usage=True)
+                profile_dir = self.session.conf.profile_dir()
+                if profile_dir:
+                    # XLA-level trace (per-op device timing, HLO) for
+                    # this query — view with tensorboard/xprof;
+                    # complements the engine-level metrics registry
+                    # (SURVEY §5.1)
+                    import jax
+
+                    tracer = jax.profiler.trace(profile_dir)
+                else:
+                    tracer = contextlib.nullcontext()
+                # per-query scoped registry: global counters accumulate
+                # exactly as before, and this query's own share lands on
+                # the trace meta for explain(verbose) — concurrent
+                # queries each see only their own
+                with tracer, metrics.scoped() as query_metrics:
+                    with span("query.execute"):
+                        result = executor.execute(plan)
+            except BaseException as e:
+                # a FAILED query is exactly the trace a post-mortem
+                # needs: finish it errored and ring it before re-raising
+                # (the serve path records errored tickets the same way)
+                if qtrace is not None:
+                    qtrace.finish(e)
+                    flight_recorder.record(qtrace)
+                raise
+        if qtrace is not None:
+            qtrace.meta["metrics"] = query_metrics.snapshot()
+            # whole-plan compilation attribution: which pipeline (fused
+            # subtree boundary, serving tier) the query rode
+            pipeline = executor.last_pipeline
+            qtrace.meta["pipeline"] = (
+                pipeline.describe() if pipeline is not None else None
+            )
+            qtrace.finish()
+            self.session.last_trace = qtrace
+            flight_recorder.record(qtrace)
+        else:
+            # tracing off: clear the attribution rather than let
+            # explain(verbose) describe a PREVIOUS query as this one
+            self.session.last_trace = None
         return result
 
     def to_pandas(self):

@@ -125,7 +125,7 @@ def _segment_minmax(
     return Column(col.dtype_str, out)
 
 
-@metrics.timer("aggregate")
+@metrics.timer("aggregate.total")
 def hash_aggregate(
     batch: ColumnarBatch,
     group_by: Sequence[str],

@@ -100,7 +100,10 @@ class Executor:
             if pipeline is not None:
                 self.last_pipeline = pipeline
                 return pipeline.run(plan, self)
-        return self._exec(plan, predicate=None)
+        from ..telemetry.trace import span as _span
+
+        with _span("query.interpret"):
+            return self._exec(plan, predicate=None)
 
     # -- dispatch ------------------------------------------------------------
     def _exec(

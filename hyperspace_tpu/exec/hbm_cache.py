@@ -72,6 +72,7 @@ import numpy as np
 from ..plan.expr import Expr, eval_mask
 from ..storage.columnar import Column, ColumnarBatch, is_string
 from ..telemetry.metrics import metrics
+from ..telemetry.trace import add_bytes as _trace_bytes
 
 BLOCK_ROWS = 8192  # count granularity: 4 B D2H per 8 K rows scanned
 
@@ -1682,6 +1683,7 @@ class HbmIndexCache(ResidentCacheBase):
             metrics.incr("scan.path.pallas_mask")
         n_blocks = -(-table.n_rows // BLOCK_ROWS)
         metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
+        _trace_bytes("d2h_bytes", int(counts.nbytes))
         return counts[:n_blocks]
 
     def block_counts_batch(
@@ -1750,6 +1752,7 @@ class HbmIndexCache(ResidentCacheBase):
         metrics.incr(f"{metric_ns}.dispatches")
         metrics.incr(f"{metric_ns}.queries", len(predicates))
         metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
+        _trace_bytes("d2h_bytes", int(counts.nbytes))
         n_blocks = -(-table.n_rows // BLOCK_ROWS)
         return counts[:, :n_blocks]
 
@@ -2025,6 +2028,7 @@ class HbmIndexCache(ResidentCacheBase):
             return None, False
         nbytes = dev_bytes + host_bytes + oov_bytes
         metrics.incr(f"{self._metric_prefix}.delta.h2d_bytes", dev_bytes)
+        _trace_bytes("h2d_bytes", dev_bytes)
         metrics.record_time(
             f"{self._metric_prefix}.delta.prefetch", time.perf_counter() - t0
         )
@@ -2106,6 +2110,7 @@ class HbmIndexCache(ResidentCacheBase):
             "scan.resident_hybrid.device", time.perf_counter() - t0
         )
         metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
+        _trace_bytes("d2h_bytes", int(counts.nbytes))
         nb_pad = table.n_pad // BLOCK_ROWS
         nb = -(-table.n_rows // BLOCK_ROWS)
         nd = -(-delta.n_rows // BLOCK_ROWS)
@@ -2174,6 +2179,7 @@ class HbmIndexCache(ResidentCacheBase):
         metrics.incr("serve.batch.dispatches")
         metrics.incr("serve.batch.queries", len(predicates))
         metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
+        _trace_bytes("d2h_bytes", int(counts.nbytes))
         nb_pad = table.n_pad // BLOCK_ROWS
         nb = -(-table.n_rows // BLOCK_ROWS)
         nd = -(-delta.n_rows // BLOCK_ROWS)
@@ -2384,6 +2390,7 @@ class HbmIndexCache(ResidentCacheBase):
             "scan.resident_join.d2h_bytes",
             int(lo.nbytes + counts.nbytes),
         )
+        _trace_bytes("d2h_bytes", int(lo.nbytes + counts.nbytes))
         return lo.astype(np.int64), counts.astype(np.int64)
 
     def join_agg(self, region, group_by, aggs):
@@ -2431,6 +2438,7 @@ class HbmIndexCache(ResidentCacheBase):
             "scan.resident_join.d2h_bytes",
             sum(int(o.nbytes) for o in outs),
         )
+        _trace_bytes("d2h_bytes", sum(int(o.nbytes) for o in outs))
         return finish_join_agg(region, plan, list(group_by), list(aggs), outs)
 
     # -- observability -------------------------------------------------------

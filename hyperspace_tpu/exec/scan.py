@@ -29,6 +29,8 @@ from ..plan.expr import Expr, bounds_for_column, eval_mask, pinned_values
 from ..storage import layout
 from ..storage.columnar import Column, ColumnarBatch
 from ..telemetry.metrics import metrics
+from ..telemetry.trace import annotate as _trace_annotate
+from ..telemetry.trace import span as _trace_span
 
 
 def buckets_for_predicate(
@@ -246,6 +248,8 @@ def _resident_parts(
     metrics.incr("scan.resident.blocks_total", int(len(counts)))
     if candid.size == 0:
         return []
+    # the exact host leg's footprint on whatever stage span is open
+    _trace_annotate(host_blocks=int(len(candid)))
     need = list(dict.fromkeys(list(output_columns) + sorted(predicate.columns())))
     parts: List[ColumnarBatch] = []
     for f in files:
@@ -408,18 +412,26 @@ def index_scan(
             # (identical result — same invariant as _routed_mask) and
             # drops the table so later queries don't retry a dead device
             try:
-                if (
-                    structure_keyed
-                    and getattr(table, "tier", "resident") != "streaming"
+                # the trace's "which tier, how many bytes" span: one
+                # fused mask+count dispatch plus its count-vector D2H
+                # (hbm_cache adds d2h_bytes via trace.add_bytes)
+                with _trace_span(
+                    "scan.device_dispatch",
+                    tier=getattr(table, "tier", "resident"),
+                    structure_keyed=bool(structure_keyed),
                 ):
-                    m = hbm_cache.block_counts_batch(
-                        table,
-                        [predicate],
-                        metric_ns="compile.fused",
-                    )
-                    counts = None if m is None else m[0]
-                else:
-                    counts = hbm_cache.block_counts(table, predicate)
+                    if (
+                        structure_keyed
+                        and getattr(table, "tier", "resident") != "streaming"
+                    ):
+                        m = hbm_cache.block_counts_batch(
+                            table,
+                            [predicate],
+                            metric_ns="compile.fused",
+                        )
+                        counts = None if m is None else m[0]
+                    else:
+                        counts = hbm_cache.block_counts(table, predicate)
             except Exception:  # noqa: BLE001 - device loss degrades
                 hbm_cache.drop(table)
                 metrics.incr("scan.resident.device_failed")
@@ -472,28 +484,32 @@ def index_scan(
     # pruning). These are synchronous mmap row-range slices (footer
     # cached, page-granular IO) under their own timer — NOT inside
     # io_dispatch, whose contract is dispatch-only time.
-    special: dict = {}
-    if pinned is not None and any(layout.is_run_file(f) for f in files):
-        with metrics.timer("scan.run_segment_io"):
-            for f in files:
-                if layout.is_run_file(f):
-                    special[f] = _read_run_segments(f, need, pinned)
-    bulk_files = [f for f in files if f not in special]
-    with metrics.timer("scan.io_dispatch"):
-        bulk = layout.read_batches(bulk_files, columns=need)
-    bmap = dict(zip(bulk_files, bulk))
-    bmap.update(special)
-    for f in files:
-        batch = bmap[f]
-        if batch is None or batch.num_rows == 0:
-            continue
-        if predicate is not None:
-            mask = _routed_mask(predicate, batch, device, min_device_rows)
-            idx = np.flatnonzero(mask)
-            if idx.size == 0:
+    # the host leg (also the resident paths' fallback): IO dispatch +
+    # per-file routed mask, one span so a trace shows where a query
+    # that DIDN'T ride a resident tier spent its time
+    with _trace_span("scan.host_scan", files=len(files)):
+        special: dict = {}
+        if pinned is not None and any(layout.is_run_file(f) for f in files):
+            with metrics.timer("scan.run_segment_io"):
+                for f in files:
+                    if layout.is_run_file(f):
+                        special[f] = _read_run_segments(f, need, pinned)
+        bulk_files = [f for f in files if f not in special]
+        with metrics.timer("scan.io_dispatch"):
+            bulk = layout.read_batches(bulk_files, columns=need)
+        bmap = dict(zip(bulk_files, bulk))
+        bmap.update(special)
+        for f in files:
+            batch = bmap[f]
+            if batch is None or batch.num_rows == 0:
                 continue
-            batch = batch.take(idx)
-        parts.append(batch.select(output_columns))
+            if predicate is not None:
+                mask = _routed_mask(predicate, batch, device, min_device_rows)
+                idx = np.flatnonzero(mask)
+                if idx.size == 0:
+                    continue
+                batch = batch.take(idx)
+            parts.append(batch.select(output_columns))
     if not parts:
         return _empty_result(files, output_columns, dtypes)
     return ColumnarBatch.concat(parts)

@@ -1079,21 +1079,31 @@ def write_index_data_streaming(
             batched = True
         # time spent blocked on ingest = source decode is the bottleneck
         # (the producers can't keep the device/sort stage fed)
+        from ..telemetry.trace import span as _span
+
         wait_s = 0.0
-        while True:
-            t0 = time.perf_counter()
-            try:
-                item = next(it)
-            except StopIteration:
-                break
-            wait_s += time.perf_counter() - t0
-            if batched:
-                for chunk in item:
-                    writer.add_chunk(chunk)
-            else:
-                writer.add_chunk(item)
+        # build-pipeline stage spans (under the per-build trace actions/
+        # create.py opens): the driver-side stages — chunk ingest+dispatch
+        # loop, then finalize — with the ingest-wait attribution as a
+        # label; worker-pool busy time stays on the stage timers
+        with _span("build.ingest_dispatch") as ingest_span:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                wait_s += time.perf_counter() - t0
+                if batched:
+                    for chunk in item:
+                        writer.add_chunk(chunk)
+                else:
+                    writer.add_chunk(item)
+            if ingest_span is not None:
+                ingest_span.labels["ingest_wait_s"] = round(wait_s, 4)
         metrics.record_time("build.stream.ingest_wait", wait_s)
-        return writer.finalize()
+        with _span("build.finalize"):
+            return writer.finalize()
     except BaseException:
         if it is not None and hasattr(it, "close"):
             it.close()  # join ingest workers before spill teardown

@@ -140,10 +140,23 @@ def explain_string(
             )
         buf.write_line()
 
+        # ---- last-query attribution: ONE source of truth ----------------
+        # Everything below renders from the last query's recorded TRACE
+        # (telemetry.trace.QueryTrace on session.last_trace): its meta
+        # carries the serve identity, the compiled-pipeline description,
+        # and the query's scoped metrics snapshot — previously four
+        # independent counter reads, now one record (PR 11), so the
+        # sections can never describe different queries.
+        last_trace = getattr(session, "last_trace", None)
+        serve_info = None if last_trace is None else last_trace.meta.get("serve")
+        pipe_info = (
+            None if last_trace is None else last_trace.meta.get("pipeline")
+        )
+        last = None if last_trace is None else last_trace.meta.get("metrics")
+
         # serve attribution: which tenant the last SERVED query ran as
         # and which index-log version it pinned at admission — the
         # multi-tenant twin of the scoped-metrics section below
-        serve_info = getattr(session, "last_serve_info", None)
         if serve_info is not None:
             buf.write_line(_BANNER)
             buf.write_line("Last served query (serve tier):")
@@ -155,11 +168,10 @@ def explain_string(
             )
             buf.write_line()
 
-        # whole-plan compilation: the pipeline the last collect() rode —
-        # its fused subtree boundary (which operators shared ONE device
+        # whole-plan compilation: the pipeline the last query rode — its
+        # fused subtree boundary (which operators shared ONE device
         # dispatch) and the residency tier it lowered against
         # (docs/17-plan-compilation.md)
-        pipe_info = getattr(session, "last_pipeline_info", None)
         if pipe_info is not None:
             buf.write_line(_BANNER)
             buf.write_line("Whole-plan compilation (last query):")
@@ -174,10 +186,21 @@ def explain_string(
             )
             buf.write_line()
 
+        # the last query's span tree: where ITS wall time went, stage by
+        # stage (admission/queue/plan/compile/dispatch/D2H with tier +
+        # fingerprint + byte labels) — the per-query view the SF100 and
+        # device-build investigations read first (docs/18-observability)
+        if last_trace is not None:
+            buf.write_line(_BANNER)
+            buf.write_line("Last query trace (spans):")
+            buf.write_line(_BANNER)
+            for line in last_trace.root.render():
+                buf.write_line(line)
+            buf.write_line()
+
         # the last query's OWN scoped share (telemetry.metrics.scoped):
         # under concurrent serving the cumulative pool above mixes every
         # in-flight query; this section is attributable to exactly one
-        last = getattr(session, "last_query_metrics", None)
         if last is not None:
             buf.write_line(_BANNER)
             buf.write_line("Last query metrics (scoped to that query):")

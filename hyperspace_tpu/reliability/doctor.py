@@ -91,6 +91,10 @@ class DoctorReport:
     indexes_checked: int = 0
     issues: List[Issue] = field(default_factory=list)
     repaired: bool = False
+    # flight-recorder dump (recent query traces + failure snapshots),
+    # attached on request — doctor(include_traces=True) is the
+    # post-mortem entry point (telemetry/recorder.py)
+    traces: Optional[dict] = None
 
     @property
     def inconsistencies(self) -> List[Issue]:
@@ -105,7 +109,7 @@ class DoctorReport:
         return not self.inconsistencies
 
     def to_json_dict(self) -> dict:
-        return {
+        out = {
             "root": self.root,
             "indexesChecked": self.indexes_checked,
             "repairMode": self.repaired,
@@ -113,18 +117,32 @@ class DoctorReport:
             "issueCount": len([i for i in self.issues if not i.informational]),
             "issues": [i.to_json_dict() for i in self.issues],
         }
+        if self.traces is not None:
+            out["traces"] = self.traces
+        return out
 
 
 def _is_index_dir(d: Path) -> bool:
     return (d / C.HYPERSPACE_LOG).is_dir()
 
 
-def doctor(path, repair: bool = False, conf=None) -> DoctorReport:
+def doctor(
+    path,
+    repair: bool = False,
+    conf=None,
+    include_traces: bool = False,
+) -> DoctorReport:
     """fsck ``path``: either one index directory or a system path holding
     many. Pure scan by default; ``repair=True`` rolls back abandoned
-    writers, rebuilds latestStable, and vacuums orphans."""
+    writers, rebuilds latestStable, and vacuums orphans.
+    ``include_traces=True`` attaches the flight recorder's dump for
+    post-mortems (telemetry/recorder.py)."""
     root = Path(path)
     report = DoctorReport(root=str(root), repaired=repair)
+    if include_traces:
+        from ..telemetry.recorder import flight_recorder
+
+        report.traces = flight_recorder.dump()
     if not root.is_dir():
         return report
     if _is_index_dir(root):

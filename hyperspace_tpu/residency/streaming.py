@@ -43,6 +43,7 @@ import numpy as np
 from ..exec.bytecache import vocab_heap_bytes
 from ..ops.bitpack import PackSpec, pack_plain
 from ..telemetry.metrics import metrics
+from ..telemetry.trace import add_bytes as _trace_bytes
 
 # window padding grain: BLOCK_ROWS (8192) is a multiple of the mask tile
 # (1024) and of every straddle-free word width, so one grain serves the
@@ -247,6 +248,7 @@ def _windowed_counts_locked(table, dispatch, union_names, jax, out, slots):
         for w in range(table.n_windows):
             cols, specs, up_bytes = slots[w % 2]
             metrics.incr("residency.stream.h2d_bytes", up_bytes)
+            _trace_bytes("h2d_bytes", up_bytes)
             # the slot's upload was dispatched while the PREVIOUS window
             # computed; if it is already on device this wait is ~zero
             # (prefetch hit), else the pipeline stalled on the link
@@ -311,6 +313,7 @@ def stream_block_counts(table: StreamingResidentTable, predicate):
     metrics.record_time("scan.resident.device", time.perf_counter() - t0)
     counts = np.concatenate(parts)
     metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
+    _trace_bytes("d2h_bytes", int(counts.nbytes))
     n_blocks = -(-table.n_rows // BLOCK_ROWS)
     return counts[:n_blocks]
 
@@ -369,5 +372,6 @@ def stream_block_counts_batch(
     metrics.incr("serve.batch.queries", len(predicates))
     counts = np.concatenate(parts, axis=1)
     metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
+    _trace_bytes("d2h_bytes", int(counts.nbytes))
     n_blocks = -(-table.n_rows // BLOCK_ROWS)
     return counts[:, :n_blocks]

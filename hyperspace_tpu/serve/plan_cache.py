@@ -30,6 +30,7 @@ from collections import OrderedDict
 from typing import Tuple
 
 from ..plan.ir import LogicalPlan, Scan
+from ..telemetry import trace
 from ..telemetry.metrics import metrics
 
 
@@ -125,8 +126,10 @@ class PlanCache:
                     self._plans.move_to_end(key)
             if hit is not None:
                 metrics.incr("serve.plan_cache.hit")
+                trace.annotate(plan_cache="hit")
                 return hit, token
             metrics.incr("serve.plan_cache.miss")
+            trace.annotate(plan_cache="miss")
             plan = df.optimized_plan(log_usage=True)
             token_after = self._version_token(df.session)
             if token_after == token:

@@ -52,6 +52,8 @@ from typing import Dict, List, Optional
 from ..exceptions import HyperspaceException
 from ..storage.columnar import ColumnarBatch
 from ..telemetry.metrics import metrics, reliability_snapshot, serve_snapshot
+from ..telemetry.recorder import flight_recorder
+from ..telemetry.trace import QueryTrace, span
 from . import batcher, tenancy
 from .plan_cache import PlanCache
 from .tenancy import DEFAULT_TENANT, CircuitBreaker, TenantState
@@ -141,6 +143,11 @@ class QueryTicket:
         self.pinned_log_version: Optional[tuple] = None
         self.batch_size = 1  # queries sharing this one's device dispatch
         self.metrics: Optional[dict] = None  # per-query scoped snapshot
+        # per-query span trace (telemetry.trace.QueryTrace; None when
+        # hyperspace.telemetry.tracing=off): admission -> queue-wait ->
+        # dispatch -> D2H stage boundaries, finished and rung into the
+        # flight recorder by _finish
+        self.trace: Optional[QueryTrace] = None
         # server-side backrefs for cancel(); None once no longer queued
         self._server: Optional["QueryServer"] = None
         self._request: Optional["_Request"] = None
@@ -355,6 +362,10 @@ class QueryServer:
             metrics.incr("serve.shed")
             if reason == "shed_lowweight":
                 metrics.incr("serve.shed.lowweight")
+            # post-mortem: the FIRST shed of a storm freezes the flight
+            # recorder (rate-limited per reason inside; capture is an
+            # O(ring) deque copy, safe under _cond)
+            flight_recorder.snapshot("shed")
         if retry_after is None:
             retry_after = tenant.retry_after_locked(self._ewma_retry_locked())
         return AdmissionRejected(
@@ -433,15 +444,32 @@ class QueryServer:
         self._maybe_recovery_sweep()
         ticket = QueryTicket(deadline_at, tenant)
         ticket._server = self
+        if self.session.conf.telemetry_tracing_enabled():
+            ticket.trace = QueryTrace("serve.query", tenant=tenant)
+        import contextlib
+
+        tcm = (
+            ticket.trace.activate()
+            if ticket.trace is not None
+            else contextlib.nullcontext()
+        )
+        with tcm:
+            return self._submit_traced(df, ticket, tenant)
+
+    def _submit_traced(self, df, ticket: QueryTicket, tenant: str) -> QueryTicket:
         # all admission gates run BEFORE planning: an overloaded or
         # breaker-open tenant is rejected for two dict probes, not a
         # full optimizer pass
-        with self._cond:
-            if self._closed:
-                raise ServerClosed("query server is closed.")
-            tstate = self._tenant_locked(tenant)
-            ticket._tenant_state = tstate
-            self._admit_locked(tstate, ticket)
+        with span("serve.admission"):
+            with self._cond:
+                if self._closed:
+                    raise ServerClosed("query server is closed.")
+                tstate = self._tenant_locked(tenant)
+                ticket._tenant_state = tstate
+                self._admit_locked(tstate, ticket)
+                # queue depth is a LEVEL (gauge), sampled per admission —
+                # the load evidence next to the shed ladder's counters
+                metrics.gauge("serve.queue_depth", self._depth)
         # plan + batchability resolved at submit time: the plan cache
         # makes repeats ~two dict probes, and classified requests let the
         # worker's coalescing scan stay a pure queue walk under the lock.
@@ -458,9 +486,10 @@ class QueryServer:
                 from .plan_cache import plan_signature
 
                 signature = plan_signature(df.plan)
-            plan, token = self.plan_cache.optimized_plan_with_token(
-                df, signature=signature
-            )
+            with span("serve.plan"):
+                plan, token = self.plan_cache.optimized_plan_with_token(
+                    df, signature=signature
+                )
             ticket.pinned_log_version = token[1]
             # RESULT cache (compile.result_cache, conf-gated off by
             # default): a value-level hit under the SAME pinned token
@@ -478,6 +507,8 @@ class QueryServer:
                     with self._cond:
                         self._submitted += 1
                         tstate.submitted += 1
+                    if ticket.trace is not None:
+                        ticket.trace.root.labels["result_cache"] = "hit"
                     self._finish(ticket, result=cached)
                     return ticket
             resident = (
@@ -735,10 +766,25 @@ class QueryServer:
 
     # -- execution -----------------------------------------------------------
     def _execute_single(self, req: _Request) -> None:
+        import contextlib
+
         req.ticket.started_at = time.monotonic()
+        tr = req.ticket.trace
+        if tr is not None and tr.find("serve.queue_wait") is None:
+            # the ticket's wait, as a span with explicit monotonic ends
+            # (submit and dispatch run on different threads by design);
+            # skipped when the batch path already recorded it — declined
+            # or failed batches fall back through here per rider
+            tr.add_span(
+                "serve.queue_wait",
+                req.ticket.submitted_at,
+                req.ticket.started_at,
+            )
+        tcm = tr.activate() if tr is not None else contextlib.nullcontext()
         try:
-            with metrics.scoped() as qm:
-                result = self._run_plan(req)
+            with tcm, span("serve.execute", tenant=req.ticket.tenant):
+                with metrics.scoped() as qm:
+                    result = self._run_plan(req)
             req.ticket.metrics = qm.snapshot()
             if req.result_key is not None:
                 # the memo is best-effort: a store failure (bad conf
@@ -777,27 +823,56 @@ class QueryServer:
         # the ticket's pinned index-log snapshot folds into the compiled-
         # pipeline cache key: a query admitted under version V serves V's
         # whole compiled pipeline across any concurrent refresh/optimize
-        return executor.execute(
+        out = executor.execute(
             req.plan, version_token=req.ticket.pinned_log_version
         )
+        tr = req.ticket.trace
+        if tr is not None:
+            p = executor.last_pipeline
+            tr.meta["pipeline"] = p.describe() if p is not None else None
+        return out
 
     def _execute_batch(self, live: List[_Request]) -> None:
+        import contextlib
+
         now = time.monotonic()
         for r in live:
             r.ticket.started_at = now
+            if r.ticket.trace is not None:
+                r.ticket.trace.add_span(
+                    "serve.queue_wait", r.ticket.submitted_at, now
+                )
         residents = [r.resident for r in live]
+        # the coalesced dispatch records under the HEAD ticket's trace;
+        # riders adopt the shared span subtree afterwards (a per-rider
+        # split of one stacked launch would be fiction — the batched-
+        # metrics rule applied to spans)
+        head_tr = live[0].ticket.trace
+        tcm = (
+            head_tr.activate() if head_tr is not None else contextlib.nullcontext()
+        )
+        batch_span = None
         try:
             # one scope for the whole coalesced dispatch + host legs:
             # batched tickets share their batch's metrics snapshot (a
             # per-query split of one stacked launch would be fiction)
-            with metrics.scoped() as bm:
-                results = batcher.execute_batch(residents)
+            with tcm, span(
+                "serve.batch_dispatch", batch=len(live)
+            ) as batch_span:
+                with metrics.scoped() as bm:
+                    results = batcher.execute_batch(residents)
         except Exception as e:  # noqa: BLE001 - device loss mid-serve
             # the wedge path: drop the table so no later query retries the
             # dead device, latch the server host-side, and serve THIS
             # batch from the host engine — identical results, no error
-            # escapes to callers
-            self._latch_host(repr(e), residents[0])
+            # escapes to callers. The failing span is already marked in
+            # the head trace; the recorder snapshot captures the batch's
+            # in-flight traces around the failure.
+            self._latch_host(
+                repr(e),
+                residents[0],
+                traces=[r.ticket.trace for r in live],
+            )
             results = None
         except BaseException as e:  # worker being killed: resolve every ticket
             for r in live:
@@ -833,9 +908,12 @@ class QueryServer:
         for r, result in zip(live, results):
             r.ticket.batch_size = len(live)
             r.ticket.metrics = snap
+            tr = r.ticket.trace
+            if tr is not None and tr is not head_tr and batch_span is not None:
+                tr.adopt(batch_span)
             self._finish(r.ticket, result=result)
 
-    def _latch_host(self, reason: str, resident) -> None:
+    def _latch_host(self, reason: str, resident, traces=None) -> None:
         from ..exec.hbm_cache import hbm_cache
         from ..exec.mesh_cache import mesh_cache
 
@@ -843,6 +921,10 @@ class QueryServer:
             already = self._host_latch.is_set()
             self._host_latch.set()
             self._degraded_reason = self._degraded_reason or reason
+        # post-mortem: freeze the flight recorder around the loss, with
+        # the failing dispatch's in-flight traces attached (their failed
+        # span is already marked error)
+        flight_recorder.snapshot("device_loss", extra_traces=traces or ())
         if not already:
             metrics.incr("serve.degraded")
             cache = mesh_cache if resident.mesh is not None else hbm_cache
@@ -916,12 +998,30 @@ class QueryServer:
                 self._latencies.append(ticket.latency_s)
         if error is None:
             metrics.incr("serve.completed")
-            # explain(verbose) attribution: which tenant and which
-            # pinned snapshot the session's last served query ran under
-            self.session.last_serve_info = {
+        # latency/wait histograms describe SERVED queries, same rule as
+        # the percentile reservoirs above
+        if ticket.started_at is not None and ticket.latency_s is not None:
+            metrics.observe("serve.latency_seconds", ticket.latency_s)
+            metrics.observe("serve.wait_seconds", ticket.wait_s or 0.0)
+        tr = ticket.trace
+        if tr is not None:
+            # the ticket's trace is the one attribution record: serve
+            # identity, scoped metrics, and (set by _run_plan) the
+            # compiled pipeline — explain(verbose) renders from it
+            tr.meta["serve"] = {
                 "tenant": ticket.tenant,
                 "pinned_log_version": ticket.pinned_log_version,
             }
+            if ticket.metrics is not None:
+                tr.meta["metrics"] = ticket.metrics
+            tr.finish(error)
+            flight_recorder.record(tr)
+            if error is None:
+                self.session.last_trace = tr
+        elif error is None:
+            # tracing off: clear the attribution rather than let
+            # explain(verbose) describe a previous query as this one
+            self.session.last_trace = None
         ticket._done.set()
 
     # -- degradation surface -------------------------------------------------
@@ -1043,6 +1143,36 @@ class QueryServer:
         out.update(tenancy.latency_percentiles_ms(lats))
         if waits:
             out["mean_wait_ms"] = round(1e3 * statistics.fmean(waits), 3)
+        # exporter surface (telemetry/export.py): the WHOLE registry as
+        # Prometheus text + JSON-lines, for scrapes that read stats()
+        # over an RPC shim; with hyperspace.telemetry.export.dir set,
+        # each stats() call also appends a rotated on-disk snapshot
+        # (failures counted, never raised — telemetry must not take
+        # down serving)
+        from ..telemetry import export as texport
+
+        exp = {
+            "prometheus": texport.render_prometheus(),
+            "jsonl": texport.render_jsonl(),
+            "recorder": {
+                "traces": len(flight_recorder.last()),
+                "snapshots": len(flight_recorder.snapshots()),
+            },
+            "written_to": None,
+        }
+        exp_dir = self.session.conf.telemetry_export_dir()
+        if exp_dir:
+            try:
+                exp["written_to"] = str(
+                    texport.export_to_dir(
+                        exp_dir,
+                        self.session.conf.telemetry_export_rotate_bytes(),
+                        self.session.conf.telemetry_export_keep(),
+                    )
+                )
+            except OSError:
+                metrics.incr("telemetry.export.write_error")
+        out["export"] = exp
         return out
 
 

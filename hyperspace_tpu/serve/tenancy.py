@@ -50,6 +50,15 @@ OPEN = "open"
 HALF_OPEN = "half_open"
 
 
+def _snapshot_recorder(reason: str) -> None:
+    """Freeze the flight recorder around a breaker transition — the
+    post-mortem wants the queries that led to the open (rate-limited
+    per reason; an O(ring) copy, safe under the server lock)."""
+    from ..telemetry.recorder import flight_recorder
+
+    flight_recorder.snapshot(reason)
+
+
 def latency_percentiles_ms(latencies) -> dict:
     """``{"latency_p50_ms", "latency_p99_ms"}`` from a latency-seconds
     reservoir (empty dict when empty) — the ONE percentile formula both
@@ -134,6 +143,7 @@ class CircuitBreaker:
                 self.probe_inflight = False
                 self.opens += 1
                 metrics.incr("serve.breaker.opened")
+                _snapshot_recorder("breaker_open")
             return
         if (
             self.state == CLOSED
@@ -144,6 +154,7 @@ class CircuitBreaker:
             self.probe_inflight = False
             self.opens += 1
             metrics.incr("serve.breaker.opened")
+            _snapshot_recorder("breaker_open")
 
     def record_success_locked(self) -> None:
         self.consecutive_misses = 0

@@ -127,18 +127,51 @@ class HyperspaceSession:
         from .residency import adopt_conf
 
         adopt_conf(self.conf)
+        # flight-recorder bounds adopt the same way (process-global
+        # rings, last-constructed session's conf wins)
+        from .telemetry.recorder import adopt_conf as adopt_recorder_conf
+
+        adopt_recorder_conf(self.conf)
         self.sources = FileBasedSourceProviderManager(self.conf)
         self.catalog = Catalog(self)
         self._hyperspace_enabled = False
         self._collection_manager = None  # lazy (circular import)
-        # per-query scoped metrics snapshot of the last collect() on this
-        # session (telemetry.metrics.scoped); explain(verbose) prints it
-        self.last_query_metrics: Optional[dict] = None
-        # serve attribution of the last SERVED query: tenant + the
-        # index-log version it pinned at admission (explain(verbose))
-        self.last_serve_info: Optional[dict] = None
+        # the last finished query's trace (telemetry.trace.QueryTrace) —
+        # the ONE record explain(verbose)'s "last query" sections render
+        # from: its meta carries the scoped metrics snapshot, the serve
+        # attribution, and the compiled-pipeline description
+        self.last_trace = None
         self._server = None  # lazy QueryServer (serve())
         self._server_lock = threading.Lock()
+
+    # -- last-query attribution (all derived from last_trace) ----------------
+    @property
+    def last_query_metrics(self) -> Optional[dict]:
+        """The last query's scoped metrics snapshot — read from its
+        recorded trace (one source of truth; PR-11)."""
+        t = self.last_trace
+        return None if t is None else t.meta.get("metrics")
+
+    @property
+    def last_serve_info(self) -> Optional[dict]:
+        """Serve attribution (tenant + pinned log version) of the last
+        query, when it ran through the serve tier."""
+        t = self.last_trace
+        return None if t is None else t.meta.get("serve")
+
+    @property
+    def last_pipeline_info(self) -> Optional[dict]:
+        """The CompiledPipeline description the last query rode (None
+        when the interpreter served directly)."""
+        t = self.last_trace
+        return None if t is None else t.meta.get("pipeline")
+
+    def last_traces(self, n: Optional[int] = None):
+        """The flight recorder's most recent completed query traces,
+        newest first (telemetry.recorder; docs/18-observability.md)."""
+        from .telemetry.recorder import flight_recorder
+
+        return flight_recorder.last(n)
 
     def serve(self, **options) -> "QueryServer":
         """The session's query server (serve.QueryServer), created on
@@ -172,15 +205,22 @@ class HyperspaceSession:
             tenant = DEFAULT_TENANT
         return self.serve().submit(df, deadline_s=deadline_s, tenant=tenant)
 
-    def doctor(self, repair: bool = False):
+    def doctor(self, repair: bool = False, include_traces: bool = False):
         """fsck this session's index system path: verify log-chain
         integrity, data-file presence, and crash litter (orphaned temp
         files, torn builds, stale leases); ``repair=True`` rolls back
-        abandoned writers and vacuums orphans. Returns a DoctorReport
-        (reliability.doctor, docs/12-reliability.md)."""
+        abandoned writers and vacuums orphans. ``include_traces=True``
+        attaches the flight recorder's dump (recent query traces +
+        failure snapshots) to the report for post-mortems. Returns a
+        DoctorReport (reliability.doctor, docs/12-reliability.md)."""
         from .reliability.doctor import doctor
 
-        return doctor(self.conf.system_path(), repair=repair, conf=self.conf)
+        return doctor(
+            self.conf.system_path(),
+            repair=repair,
+            conf=self.conf,
+            include_traces=include_traces,
+        )
 
     def table(self, name: str):
         """DataFrame over a registered view or table (Catalog.table)."""

@@ -14,3 +14,5 @@ from .events import (  # noqa: F401
     HyperspaceIndexUsageEvent,
 )
 from .logging import EventLogger, NoOpEventLogger, EventLogging, get_event_logger  # noqa: F401
+from .trace import QueryTrace, Span, annotate, span, start_trace  # noqa: F401
+from .recorder import FlightRecorder, flight_recorder  # noqa: F401

@@ -35,7 +35,7 @@ import contextvars
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence, Tuple
 
 # the per-query child registry active on this thread/context (None = no
 # scope; recording goes to the global registry only)
@@ -43,15 +43,74 @@ _SCOPE: "contextvars.ContextVar[Optional[MetricsRegistry]]" = (
     contextvars.ContextVar("hyperspace_tpu_metrics_scope", default=None)
 )
 
+# fixed histogram bucket ladders (telemetry/export.py renders them as
+# Prometheus histograms). Seconds cover the serve tier's realistic range
+# (sub-ms cache hits to multi-second SF100 scans); bytes cover link
+# transfers (count-vector D2H to slab H2D). A name ending in ``_bytes``
+# defaults to the byte ladder — one convention, no per-site buckets.
+TIME_BUCKETS_S: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+BYTE_BUCKETS: Tuple[float, ...] = (
+    1024.0, 8192.0, 65536.0, 524288.0, 4194304.0,
+    33554432.0, 268435456.0, 1073741824.0,
+)
+
+
+class _Histogram:
+    """Fixed-bucket histogram cell: cumulative-style counts are derived
+    at snapshot time; recording is one bisect + three adds."""
+
+    __slots__ = ("bounds", "counts", "total", "count")
+
+    def __init__(self, bounds: Tuple[float, ...]):
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # last = > max bound
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        import bisect
+
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.total += value
+        self.count += 1
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "buckets": list(self.bounds),
+            "counts": list(self.counts),
+            "sum": round(self.total, 6),
+            "count": self.count,
+        }
+
+
+def default_buckets(name: str) -> Tuple[float, ...]:
+    return BYTE_BUCKETS if name.endswith("_bytes") else TIME_BUCKETS_S
+
 
 class MetricsRegistry:
-    """Thread-safe counters + cumulative timers."""
+    """Thread-safe counters + cumulative timers + gauges + histograms.
+
+    Metric TYPES (the export/snapshot contract, docs/18-observability.md):
+    ``incr`` accumulates a counter; ``gauge`` SETS a level (PR-6
+    semantics — repeated recordings report the level, not a sum) and the
+    name is remembered in the ``gauges`` snapshot view so the exporter
+    types it correctly; ``record_time``/``timer`` accumulate seconds with
+    a call count; ``observe`` feeds a fixed-bucket histogram."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._timers: Dict[str, float] = {}
         self._timer_counts: Dict[str, int] = {}
+        # gauge VALUES live in _counters (so counter() reads them and
+        # every pre-histogram snapshot consumer keeps working); this set
+        # records which names are levels — the type bit snapshot() and
+        # the Prometheus exporter need
+        self._gauge_names: set = set()
+        self._hists: Dict[str, _Histogram] = {}
         # enclosing scope at scoped()-entry time; mirroring walks this
         # chain so a nested scope feeds every scope around it exactly once
         self._parent: Optional["MetricsRegistry"] = None
@@ -67,17 +126,51 @@ class MetricsRegistry:
             node = node._parent
 
     def gauge(self, name: str, value: int) -> None:
-        """SET a counter to a level (worker counts, pool widths): unlike
-        incr, repeated recordings of the same configuration don't
-        accumulate across builds in one process — the snapshot reports
-        the level, not a running total."""
+        """SET a counter to a level (worker counts, pool widths, queue
+        depths): unlike incr, repeated recordings of the same
+        configuration don't accumulate across builds in one process —
+        the snapshot reports the level, not a running total. The name is
+        recorded as a gauge so snapshot()["gauges"] and the Prometheus
+        exporter type it as a level (never ``_total``)."""
         with self._lock:
             self._counters[name] = int(value)
+            self._gauge_names.add(name)
         node = _SCOPE.get()
         while node is not None:
             if node is not self:
                 with node._lock:
                     node._counters[name] = int(value)
+                    node._gauge_names.add(name)
+            node = node._parent
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        buckets: Optional[Sequence[float]] = None,
+    ) -> None:
+        """Record ``value`` into the fixed-bucket histogram ``name``
+        (latency seconds or transfer bytes — default_buckets picks the
+        ladder from the name). Bounds are fixed at the FIRST recording;
+        later ``buckets`` arguments are ignored so concurrent recorders
+        can never disagree about the cell layout."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = _Histogram(
+                    tuple(buckets) if buckets else default_buckets(name)
+                )
+                self._hists[name] = h
+            h.observe(float(value))
+        node = _SCOPE.get()
+        while node is not None:
+            if node is not self:
+                with node._lock:
+                    nh = node._hists.get(name)
+                    if nh is None:
+                        nh = _Histogram(h.bounds)
+                        node._hists[name] = nh
+                    nh.observe(float(value))
             node = node._parent
 
     def record_time(self, name: str, seconds: float) -> None:
@@ -132,6 +225,17 @@ class MetricsRegistry:
                 "counters": dict(self._counters),
                 "timers_s": {k: round(v, 6) for k, v in self._timers.items()},
                 "timer_counts": dict(self._timer_counts),
+                # TYPE view: gauge names -> current level (values also
+                # stay in "counters" for the pre-histogram consumers);
+                # the exporter reads this to emit TYPE gauge vs counter
+                "gauges": {
+                    k: self._counters[k]
+                    for k in self._gauge_names
+                    if k in self._counters
+                },
+                "histograms": {
+                    k: h.snapshot() for k, h in self._hists.items()
+                },
             }
 
     def reset(self) -> None:
@@ -139,6 +243,8 @@ class MetricsRegistry:
             self._counters.clear()
             self._timers.clear()
             self._timer_counts.clear()
+            self._gauge_names.clear()
+            self._hists.clear()
 
 
 metrics = MetricsRegistry()

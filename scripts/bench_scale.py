@@ -256,6 +256,16 @@ def main() -> None:
         IndexConfig("li_idx", ["l_orderkey"], ["l_partkey", "l_extendedprice"]),
     )
     build_s = time.perf_counter() - t0
+    # per-phase trace attribution (PR 11): the build trace's stage spans
+    # (ingest dispatch loop with wait label, finalize) land in the
+    # artifact so an SF100 rerun carries WHERE the 348 s went, not just
+    # that it happened (docs/18-observability.md)
+    from hyperspace_tpu.telemetry.recorder import flight_recorder
+
+    _build_traces = flight_recorder.last(1)
+    phase_traces = {
+        "build": _build_traces[0].to_dict() if _build_traces else None
+    }
     snap = metrics.snapshot()
     timers, counters = snap["timers_s"], snap["counters"]
     build = {
@@ -425,6 +435,9 @@ def main() -> None:
     session.enable_hyperspace()
     q3_on = q3().collect()
     q3on_s = _time(lambda: q3().collect(), REPEATS)
+    phase_traces["q3"] = (
+        session.last_trace.to_dict() if session.last_trace else None
+    )
     if q3_off.num_rows != q3_on.num_rows:
         _fail("q3 row-count parity violated")
     if int(q3_off.columns["l_partkey"].data.sum()) != int(
@@ -476,6 +489,9 @@ def main() -> None:
     session.enable_hyperspace()
     q17_on = q17().collect()
     q17on_s = _time(lambda: q17().collect(), REPEATS)
+    phase_traces["q17"] = (
+        session.last_trace.to_dict() if session.last_trace else None
+    )
     if q17_off.num_rows != q17_on.num_rows:
         _fail("q17 group-count parity violated")
     ref_sum = float(q17_off.columns["rev"].data.sum())
@@ -659,6 +675,10 @@ def main() -> None:
         **{f"speedup_{k}": round(v, 2) for k, v in speed.items()},
         **{f"ext_speedup_{k}": round(v, 2) for k, v in ext_speed.items()},
         **extras,
+        # per-phase span traces (build / q3 / q17): wall-time
+        # attribution with tier + fingerprint + byte labels, so the
+        # SF100 rerun lands with evidence built in
+        "traces": phase_traces,
         "final_rss_gb": _rss_gb(),
     }
     if args.write:

@@ -1218,6 +1218,60 @@ def test_hs013_suppressed():
     assert any(f.suppressed and f.code == "HS013" for f in findings)
 
 
+# --- HS014: metric/span name discipline --------------------------------------
+
+
+def test_hs014_fires_on_bad_grammar_and_unknown_prefix():
+    src = """
+    from hyperspace_tpu.telemetry.metrics import metrics
+    from hyperspace_tpu.telemetry.trace import span
+
+    def record():
+        metrics.incr("Serve.Shed")          # uppercase
+        metrics.incr("standalone")          # single segment
+        metrics.gauge("widget.pool.width", 3)  # unknown subsystem
+        with span("scan-host-leg"):         # dashes
+            pass
+    """
+    got = [f for f in run(src) if f.code == "HS014" and not f.suppressed]
+    assert len(got) == 4
+    assert any("'widget.pool.width'" in f.message and "prefix" in f.message
+               for f in got)
+
+
+def test_hs014_clean_on_wellformed_names_and_nonliterals():
+    src = """
+    from hyperspace_tpu.telemetry.metrics import metrics
+    from hyperspace_tpu.telemetry.trace import span, start_trace
+
+    def record(kind):
+        metrics.incr("serve.shed.lowweight")
+        metrics.record_time("build.stream.spill_write", 0.1)
+        metrics.observe("serve.latency_seconds", 0.01)
+        metrics.incr(f"compile.run.{kind}")  # runtime-built: invisible
+        with span("scan.device_dispatch", tier="resident"):
+            pass
+        with start_trace("query.collect"):
+            pass
+        # unrelated .span()/.timer-free calls never match
+        m = kind.split(".", 1)
+        return m
+    """
+    assert codes(run(src), "HS014") == []
+
+
+def test_hs014_suppressed():
+    src = """
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    def record():
+        metrics.incr("LegacyDashboardKey")  # hslint: disable=HS014
+    """
+    findings = run(src)
+    assert codes(findings, "HS014") == []
+    assert any(f.suppressed and f.code == "HS014" for f in findings)
+
+
 # --- the project model: call-graph resolution over a synthetic package ------
 
 

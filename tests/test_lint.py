@@ -58,7 +58,7 @@ def test_cli_json_format_and_failure_exit(tmp_path):
     assert payload["findings"][0]["code"] == "HS006"
 
 
-def test_cli_list_rules_names_all_thirteen():
+def test_cli_list_rules_names_all_fourteen():
     proc = subprocess.run(
         [sys.executable, "scripts/lint.py", "--list-rules"],
         cwd=REPO,
@@ -69,9 +69,42 @@ def test_cli_list_rules_names_all_thirteen():
     assert proc.returncode == 0
     for code in (
         "HS001", "HS002", "HS003", "HS004", "HS005", "HS006", "HS007",
-        "HS008", "HS009", "HS010", "HS011", "HS012", "HS013",
+        "HS008", "HS009", "HS010", "HS011", "HS012", "HS013", "HS014",
     ):
         assert code in proc.stdout
+
+
+# --- metrics exporter validation (runs in the lint tier alongside hslint) ---
+
+
+def test_metrics_cli_check_validates_prometheus_rendering():
+    """``scripts/metrics.py --check`` renders a synthetic registry
+    exercising every metric type (plus the live one) and validates the
+    Prometheus text the way a scraper would — a malformed metric name
+    or duplicate family fails HERE, not the fleet's scrape."""
+    proc = subprocess.run(
+        [sys.executable, "scripts/metrics.py", "--check"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "metrics check: OK" in proc.stdout
+
+
+def test_metrics_cli_renders_both_formats():
+    for fmt, needle in (("prom", "# TYPE "), ("jsonl", '"type"')):
+        proc = subprocess.run(
+            [sys.executable, "scripts/metrics.py", "--format", fmt,
+             "--demo"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert needle in proc.stdout
 
 
 def test_cli_missing_path_is_usage_error():

@@ -29,7 +29,88 @@ def test_registry_basics():
     assert snap["timer_counts"]["t"] == 1
     assert reg.counter("missing") == 0
     reg.reset()
-    assert reg.snapshot() == {"counters": {}, "timers_s": {}, "timer_counts": {}}
+    assert reg.snapshot() == {
+        "counters": {},
+        "timers_s": {},
+        "timer_counts": {},
+        "gauges": {},
+        "histograms": {},
+    }
+
+
+def test_gauge_counter_snapshot_roundtrip_types():
+    """PR-6 semantics regression (PR-11 audit): gauges are LEVELS —
+    repeated recordings report the level, never a sum — and the
+    snapshot's type view round-trips into the exporter: gauge names
+    render as TYPE gauge (no ``_total``), counters as TYPE counter."""
+    from hyperspace_tpu.telemetry.export import (
+        check_prometheus,
+        render_prometheus,
+    )
+
+    reg = MetricsRegistry()
+    reg.gauge("build.stream.workers.ingest", 4)
+    reg.gauge("build.stream.workers.ingest", 4)  # re-record: level, not 8
+    reg.incr("build.stream.chunks")
+    reg.incr("build.stream.chunks")
+    snap = reg.snapshot()
+    assert snap["gauges"] == {"build.stream.workers.ingest": 4}
+    assert snap["counters"]["build.stream.workers.ingest"] == 4  # readable
+    assert snap["counters"]["build.stream.chunks"] == 2
+    assert "build.stream.chunks" not in snap["gauges"]
+    text = render_prometheus(reg)
+    assert "# TYPE hyperspace_build_stream_workers_ingest gauge" in text
+    assert "hyperspace_build_stream_workers_ingest 4" in text
+    assert "# TYPE hyperspace_build_stream_chunks_total counter" in text
+    assert check_prometheus(text) == []
+
+
+def test_histograms_record_and_export():
+    from hyperspace_tpu.telemetry.export import (
+        check_prometheus,
+        render_prometheus,
+    )
+
+    reg = MetricsRegistry()
+    for v in (0.0005, 0.004, 0.04, 2.0):
+        reg.observe("serve.latency_seconds", v)
+    reg.observe("scan.d2h_bytes", 4096)  # byte ladder via name suffix
+    snap = reg.snapshot()
+    h = snap["histograms"]["serve.latency_seconds"]
+    assert h["count"] == 4
+    assert abs(h["sum"] - 2.0445) < 1e-9
+    assert sum(h["counts"]) == 4
+    b = snap["histograms"]["scan.d2h_bytes"]
+    assert b["buckets"][0] == 1024.0
+    text = render_prometheus(reg)
+    assert 'hyperspace_serve_latency_seconds_bucket{le="+Inf"} 4' in text
+    assert "# TYPE hyperspace_serve_latency_seconds histogram" in text
+    assert check_prometheus(text) == []
+
+
+def test_histograms_mirror_into_scopes():
+    reg = MetricsRegistry()
+    with reg.scoped() as child:
+        reg.observe("serve.latency_seconds", 0.01)
+    reg.observe("serve.latency_seconds", 0.02)
+    assert reg.snapshot()["histograms"]["serve.latency_seconds"]["count"] == 2
+    assert (
+        child.snapshot()["histograms"]["serve.latency_seconds"]["count"] == 1
+    )
+
+
+def test_prometheus_check_catches_malformed():
+    from hyperspace_tpu.telemetry.export import check_prometheus
+
+    bad = (
+        "# TYPE hyperspace_x counter\n"
+        "# TYPE hyperspace_x counter\n"  # duplicate TYPE
+        'hyperspace_y{tenant="a\nb"} 1\n'  # unescaped newline -> unparseable
+        "9bad_name 2\n"
+    )
+    problems = check_prometheus(bad)
+    assert any("duplicate TYPE" in p for p in problems)
+    assert any("bad metric name" in p or "unparseable" in p for p in problems)
 
 
 def _setup(tmp_path, n=1500):

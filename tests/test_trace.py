@@ -1,0 +1,409 @@
+"""Per-query span tracing, flight recorder, and exporter wiring
+(PR 11, docs/18-observability.md).
+
+The load-bearing assertions: (1) under a CONCURRENT multi-tenant serve
+burst every ticket's trace is complete and non-interleaved — no orphan
+or cross-talk spans (the PR-10 scoped-registry attribution bug class,
+here closed by the contextvar span discipline); (2) device loss
+mid-dispatch produces a flight-recorder snapshot whose in-flight trace
+carries the failing span marked error.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exec.hbm_cache import hbm_cache
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.serve import QueryServer, ServeConfig
+from hyperspace_tpu.serve import server as server_mod
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.telemetry.recorder import FlightRecorder, flight_recorder
+from hyperspace_tpu.telemetry.trace import (
+    QueryTrace,
+    annotate,
+    span,
+    start_trace,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    hbm_cache.reset()
+    flight_recorder.reset()
+    yield
+    hbm_cache.reset()
+    flight_recorder.reset()
+
+
+# --- span tree mechanics ----------------------------------------------------
+
+
+def test_span_mechanics_and_error_marking():
+    with start_trace("query.collect", origin="test") as t:
+        with span("plan.optimize"):
+            annotate(plan_cache="miss")
+        with pytest.raises(ValueError):
+            with span("serve.execute"):
+                raise ValueError("boom")
+    t.finish()
+    assert t.complete
+    names = t.spans()
+    assert names[0] == "query.collect"
+    assert "plan.optimize" in names and "serve.execute" in names
+    assert t.find("plan.optimize").labels == {"plan_cache": "miss"}
+    failed = t.find("serve.execute")
+    assert failed.status == "error" and "boom" in failed.error
+    d = t.to_dict()
+    assert d["complete"] and d["root"]["spans"][1]["status"] == "error"
+    assert "query.collect" in t.render()
+
+
+def test_span_is_noop_without_active_trace():
+    with span("scan.device_dispatch") as s:
+        annotate(tier="resident")  # must not raise either
+        assert s is None
+
+
+# --- end-to-end: collect() records a trace ----------------------------------
+
+
+def _env(tmp_path, n=60_000):
+    # enough rows that each bucket spans multiple 8192-row blocks, so
+    # the resident zone gate can prune and point lookups actually ride
+    # the device dispatch (same sizing rationale as test_serve)
+    rng = np.random.default_rng(0)
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 20_000, n).astype(np.int64),
+            "v": rng.integers(0, 1000, n).astype(np.int64),
+        }
+    )
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"), C.INDEX_NUM_BUCKETS: 4}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    df = session.read.parquet(str(src))
+    hs.create_index(df, IndexConfig("tidx", ["k"], ["v"]))
+    session.enable_hyperspace()
+    assert hs.prefetch_index("tidx")
+    return session, src, batch
+
+
+def _lookup(session, src, key):
+    return (
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(int(key)))
+        .select("k", "v")
+    )
+
+
+def test_collect_records_trace_with_stages(tmp_path):
+    session, src, batch = _env(tmp_path)
+    q = _lookup(session, src, batch.columns["k"].data[0])
+    q.collect()
+    t = session.last_trace
+    assert t is not None and t.complete
+    names = t.spans()
+    assert names[0] == "query.collect"
+    assert "plan.optimize" in names and "query.execute" in names
+    # the resident dispatch span carries tier + D2H bytes
+    ds = t.find("scan.device_dispatch")
+    assert ds is not None
+    assert ds.labels.get("tier") == "resident"
+    assert ds.labels.get("d2h_bytes", 0) > 0
+    # one-source-of-truth meta: scoped metrics + pipeline description
+    assert t.meta["metrics"]["counters"].get("scan.files_read", 0) >= 0
+    assert t.meta["pipeline"] is None or "kind" in t.meta["pipeline"]
+    # the ring holds it, newest first
+    assert session.last_traces(1)[0] is t
+    # explain(verbose) renders the span tree from the SAME record
+    out = q.explain(verbose=True)
+    assert "Last query trace (spans):" in out
+    assert "scan.device_dispatch" in out
+
+
+def test_tracing_off_disables_traces(tmp_path):
+    session, src, batch = _env(tmp_path)
+    session.conf.set(C.TELEMETRY_TRACING, "off")
+    flight_recorder.reset()  # drop the create_index build trace
+    q = _lookup(session, src, batch.columns["k"].data[0])
+    q.collect()
+    assert session.last_trace is None
+    assert session.last_traces() == []
+    # the serve tier honors the same switch
+    server = session.serve(max_workers=1)
+    tk = server.submit(q)
+    tk.result(timeout=120)
+    assert tk.trace is None
+    server.close()
+
+
+# --- trace correctness under concurrency ------------------------------------
+
+
+def test_concurrent_serve_burst_traces_complete_and_disjoint(tmp_path):
+    """Every ticket of a concurrent two-tenant burst gets ONE complete
+    span tree — admission -> queue_wait -> execute — labeled with ITS
+    tenant, and no span object appears in two traces (cross-talk)."""
+    session, src, batch = _env(tmp_path)
+    keys = [int(batch.columns["k"].data[i * 13]) for i in range(12)]
+    server = QueryServer(
+        session, ServeConfig(max_workers=3, batch_max=1)
+    )
+    tickets = []
+    tlock = threading.Lock()
+
+    def submit_from(tenant, my_keys):
+        for k in my_keys:
+            tk = server.submit(_lookup(session, src, k), tenant=tenant)
+            with tlock:
+                tickets.append((tenant, tk))
+
+    threads = [
+        threading.Thread(target=submit_from, args=("alpha", keys[:6])),
+        threading.Thread(target=submit_from, args=("beta", keys[6:])),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for _tenant, tk in tickets:
+        tk.result(timeout=120)
+    server.close()
+    seen_span_ids = {}
+    for tenant, tk in tickets:
+        tr = tk.trace
+        assert tr is not None and tr.complete
+        assert tr.root.labels["tenant"] == tenant
+        names = tr.spans()
+        for required in ("serve.admission", "serve.queue_wait",
+                         "serve.execute"):
+            assert required in names, (tenant, names)
+        ex = tr.find("serve.execute")
+        assert ex.labels["tenant"] == tenant
+        # non-interleaved: no span object shared across traces
+        for s in tr.root.walk():
+            owner = seen_span_ids.setdefault(id(s), tr.trace_id)
+            assert owner == tr.trace_id, "span cross-talk between traces"
+        # serve meta rides the trace (the explain source of truth)
+        assert tr.meta["serve"]["tenant"] == tenant
+    # the acceptance shape: admission -> dispatch -> D2H with tier +
+    # executable-fingerprint labels present in the burst's traces
+    any_tr = tickets[0][1].trace
+    pr = any_tr.find("compile.pipeline_run")
+    assert pr is not None and pr.labels.get("fingerprint")
+    ds = any_tr.find("scan.device_dispatch")
+    assert ds is not None and ds.labels.get("tier") == "resident"
+    assert ds.labels.get("d2h_bytes", 0) > 0
+
+
+def test_batched_tickets_adopt_shared_dispatch_span(tmp_path):
+    session, src, batch = _env(tmp_path)
+    k = int(batch.columns["k"].data[7])
+    server = QueryServer(
+        session,
+        ServeConfig(max_workers=1, batch_max=8, autostart=False),
+    )
+    tickets = [
+        server.submit(_lookup(session, src, k)) for _ in range(4)
+    ]
+    server.start()
+    for tk in tickets:
+        tk.result(timeout=120)
+    stats = server.stats()
+    server.close()
+    if stats["batch_dispatches"] < 1:
+        pytest.skip("burst did not coalesce on this run")
+    dispatch_spans = set()
+    for tk in tickets:
+        if tk.batch_size > 1:
+            ds = tk.trace.find("serve.batch_dispatch")
+            assert ds is not None
+            assert ds.labels["batch"] == tk.batch_size
+            dispatch_spans.add(id(ds))
+    # coalesced riders share the ONE dispatch subtree (batched-metrics
+    # rule applied to spans)
+    assert len(dispatch_spans) == 1
+
+
+def test_device_loss_snapshot_marks_failing_span(tmp_path, monkeypatch):
+    """Device loss mid-batched-dispatch: the recorder snapshots the
+    queries around the failure, the in-flight traces carry the failing
+    serve.batch_dispatch span marked error, and the queries still serve
+    host-side (the latch parity invariant)."""
+    session, src, batch = _env(tmp_path)
+    k = int(batch.columns["k"].data[3])
+    expected = {
+        (int(a), int(b))
+        for a, b in zip(batch.columns["k"].data, batch.columns["v"].data)
+        if int(a) == k
+    }
+    server = QueryServer(
+        session,
+        ServeConfig(max_workers=1, batch_max=8, autostart=False),
+    )
+    tickets = [server.submit(_lookup(session, src, k)) for _ in range(3)]
+
+    def boom(requests):
+        raise RuntimeError("injected device loss")
+
+    monkeypatch.setattr(server_mod.batcher, "execute_batch", boom)
+    server.start()
+    for tk in tickets:
+        got = tk.result(timeout=120)
+        rows = {
+            (int(a), int(b))
+            for a, b in zip(
+                got.columns["k"].data, got.columns["v"].data
+            )
+        }
+        assert rows == expected  # host fallback, identical results
+    assert server.degraded
+    server.close()
+    snaps = flight_recorder.snapshots()
+    loss = [s for s in snaps if s["reason"] == "device_loss"]
+    assert loss, [s["reason"] for s in snaps]
+    inflight = loss[0]["inflight"]
+    assert inflight, "snapshot carries the failing batch's traces"
+    failing = [
+        sp
+        for t in inflight
+        for sp in _walk_dict(t["root"])
+        if sp["name"] == "serve.batch_dispatch"
+    ]
+    assert failing and failing[0]["status"] == "error"
+    assert "injected device loss" in failing[0]["error"]
+    # the host fallback re-executes each rider through the single path:
+    # queue wait must not be double-recorded on their traces
+    for tk in tickets:
+        waits = [s for s in tk.trace.spans() if s == "serve.queue_wait"]
+        assert len(waits) == 1
+
+
+def _walk_dict(span_dict):
+    yield span_dict
+    for c in span_dict.get("spans", ()):
+        yield from _walk_dict(c)
+
+
+# --- failure-event snapshots (breaker / shed) -------------------------------
+
+
+def test_breaker_open_takes_snapshot():
+    from hyperspace_tpu.serve.tenancy import CircuitBreaker
+
+    flight_recorder.record(_dummy_trace("query.collect"))
+    b = CircuitBreaker(miss_threshold=1, open_s=5.0)
+    b.record_miss_locked(now=100.0)
+    snaps = flight_recorder.snapshots()
+    assert [s["reason"] for s in snaps] == ["breaker_open"]
+    assert len(snaps[0]["traces"]) == 1
+
+
+def test_shed_takes_snapshot(tmp_path):
+    session, src, batch = _env(tmp_path)
+    server = QueryServer(
+        session,
+        ServeConfig(max_workers=1, max_queue=1, autostart=False),
+    )
+    k = int(batch.columns["k"].data[0])
+    server.submit(_lookup(session, src, k))
+    from hyperspace_tpu.serve import AdmissionRejected
+
+    with pytest.raises(AdmissionRejected):
+        server.submit(_lookup(session, src, k))
+    assert any(
+        s["reason"] == "shed" for s in flight_recorder.snapshots()
+    )
+    server.close()
+
+
+# --- recorder bounds and surfaces -------------------------------------------
+
+
+def _dummy_trace(name):
+    t = QueryTrace(name)
+    t.finish()
+    return t
+
+
+def test_recorder_ring_bounds_and_order():
+    rec = FlightRecorder(entries=3, snapshots=2)
+    traces = [_dummy_trace("query.collect") for _ in range(5)]
+    for t in traces:
+        rec.record(t)
+    last = rec.last()
+    assert len(last) == 3
+    assert last[0] is traces[-1]  # newest first
+    assert rec.last(1) == [traces[-1]]
+    for i in range(4):
+        rec._last_snapshot_at.clear()  # defeat rate limit for the test
+        rec.snapshot(f"reason_{i}")
+    assert len(rec.snapshots()) == 2  # bounded
+
+
+def test_recorder_snapshot_rate_limited():
+    rec = FlightRecorder()
+    assert rec.snapshot("shed") is not None
+    assert rec.snapshot("shed") is None  # within the interval
+    assert rec.snapshot("device_loss") is not None  # other reasons free
+
+
+def test_recorder_conf_adoption_and_doctor_dump(tmp_path):
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.TELEMETRY_RECORDER_ENTRIES: 2,
+        }
+    )
+    session = HyperspaceSession(conf)
+    for _ in range(4):
+        flight_recorder.record(_dummy_trace("query.collect"))
+    assert len(session.last_traces()) == 2  # conf bound adopted
+    report = session.doctor(include_traces=True)
+    assert report.traces is not None
+    assert len(report.traces["traces"]) == 2
+    assert "snapshots" in report.traces
+    assert "traces" in report.to_json_dict()
+    # without the flag the report stays lean
+    assert session.doctor().traces is None
+
+
+# --- exporter wiring through stats() ----------------------------------------
+
+
+def test_stats_export_surface_and_rotation(tmp_path):
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.TELEMETRY_EXPORT_DIR: "auto",
+            C.TELEMETRY_EXPORT_ROTATE_BYTES: 1,  # rotate every write
+        }
+    )
+    session = HyperspaceSession(conf)
+    server = session.serve(max_workers=1)
+    from hyperspace_tpu.telemetry.export import check_prometheus
+
+    stats = server.stats()
+    exp = stats["export"]
+    assert check_prometheus(exp["prometheus"]) == []
+    assert exp["written_to"] is not None
+    stats = server.stats()  # second write rotates the first
+    server.close()
+    mdir = tmp_path / "indexes" / "_hyperspace_metrics"
+    assert (mdir / "metrics.jsonl").exists()
+    assert (mdir / "metrics.jsonl.1").exists()
