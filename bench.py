@@ -2133,9 +2133,13 @@ def main() -> None:
         os.environ["HYPERSPACE_TPU_HBM"] = "auto"
         try:
             # config 15's teardown reset the residency caches: re-pin
-            # the predicate column so the fused arm measures the device
-            # leg, not a host fallback (refusal recorded like config 9)
-            if not hs.prefetch_index("li_res_idx", ["r_k"]):
+            # the predicate column PLUS the group/agg columns — the
+            # device aggregation (exec.scan_agg) needs r_q/r_v resident
+            # to lower the agg_scan group-by onto the device
+            wp_prefetched = hs.prefetch_index(
+                "li_res_idx", ["r_k", "r_q", "r_v"]
+            )
+            if not wp_prefetched:
                 extras["whole_plan_error"] = "prefetch refused"
             WP_BURST = int(os.environ.get("BENCH_WHOLE_PLAN_BURST", 16))
             # a DIFFERENT stride than configs 10/15: the cold-burst
@@ -2251,6 +2255,138 @@ def main() -> None:
                             f"config16 fused {name} pipeline paid {d2h} "
                             "device round trips (bound: 1)"
                         )
+            # hard gate: the agg_scan pipeline executed its group-by ON
+            # DEVICE (exec.scan_agg segment reduction, ONE dispatch ==
+            # the finished group table D2H — no candidate blocks), not
+            # the host hash tail. Declines would be counted, so a silent
+            # regression to the host tail is impossible to miss here.
+            # armed only when residency admitted the table — a budget
+            # refusal is already recorded as whole_plan_error above and
+            # must not masquerade as a device-agg regression
+            if wp_prefetched and q16.get("scan.path.resident_agg", 0) != 1:
+                declines = {
+                    k: v
+                    for k, v in q16.items()
+                    if k.startswith("compile.agg.declined")
+                }
+                _fail(
+                    "config16 agg_scan did not aggregate on device "
+                    f"(declines: {declines})"
+                )
+
+            # ---- hybrid burst: compile count flat, ONE executable ------
+            # the tentpole acceptance for the hybrid arm: a fresh-literal
+            # hybrid burst shares one structure-keyed batched executable
+            # (hbm_cache.hybrid_block_counts_batch N=1) instead of
+            # recompiling per literal. Reuses config 11's hybrid_res
+            # source (base index + appended file + deleted file).
+            hyb16: dict = {}
+            if "hybrid_resident_rows" in extras:
+                from hyperspace_tpu.exec.hbm_cache import (
+                    _hybrid_fns as _hf16,
+                )
+                from hyperspace_tpu.exec.hbm_cache import hbm_cache as _hc16
+                from hyperspace_tpu.plan.ir import Union as _U16
+                from hyperspace_tpu.plan.rules.hybrid_scan import (
+                    parse_hybrid_union as _phu16,
+                )
+
+                session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, "true")
+                session.conf.set(
+                    C.INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD, "0.5"
+                )
+                hyb_keys = [
+                    int(
+                        hyb_batch.columns["r_k"].data[
+                            (i * 9973 + 5) % HR_ROWS
+                        ]
+                    )
+                    for i in range(WP_BURST)
+                ]
+                mk16h = lambda k: (  # noqa: E731
+                    session.read.parquet(str(WORKDIR / "hybrid_res"))
+                    .filter(col("r_k") == lit(k))
+                    .select("r_k", "r_v")
+                )
+                session.conf.set(C.COMPILE_MODE, C.COMPILE_MODE_OFF)
+                interp_h = [mk16h(k).collect() for k in hyb_keys]
+                session.conf.unset(C.COMPILE_MODE)
+                # config 15's teardown cleared residency: re-pin base +
+                # delta so the burst measures the fused arm
+                delta16 = None
+                if hs.prefetch_index("li_hyb_idx", ["r_k"]):
+                    union16 = (
+                        mk16h(hyb_keys[0])
+                        .optimized_plan()
+                        .collect(lambda n_: isinstance(n_, _U16))
+                    )
+                    if union16:
+                        info16 = _phu16(union16[0])
+                        t16 = _hc16.resident_for(
+                            info16.entry.content.files(), ["r_k"]
+                        )
+                        if t16 is not None:
+                            delta16 = _hc16.prefetch_delta(
+                                t16,
+                                info16.appended,
+                                info16.relation,
+                                list(info16.user_cols),
+                                info16.deleted_ids,
+                            )
+                _pc16.reset()
+                mk16h(hyb_keys[0]).collect()  # warm: lower + trace
+                lowered_h0 = metrics.counter("compile.lowered")
+                fns_h0 = len(_hf16._fns)
+                fused_h0 = metrics.counter("scan.path.resident_hybrid")
+                t0 = time.perf_counter()
+                compiled_h = [mk16h(k).collect() for k in hyb_keys]
+                hyb_burst_s = time.perf_counter() - t0
+                for a, b in zip(interp_h, compiled_h):
+                    if sorted(
+                        zip(
+                            a.columns["r_k"].data.tolist(),
+                            a.columns["r_v"].data.tolist(),
+                        )
+                    ) != sorted(
+                        zip(
+                            b.columns["r_k"].data.tolist(),
+                            b.columns["r_v"].data.tolist(),
+                        )
+                    ):
+                        _fail("config16 hybrid burst parity violated")
+                served_fused = (
+                    metrics.counter("scan.path.resident_hybrid") - fused_h0
+                )
+                new_fns = len(_hf16._fns) - fns_h0
+                # hard gate: the distinct-literal burst re-lowered NOTHING
+                if metrics.counter("compile.lowered") != lowered_h0:
+                    _fail(
+                        "config16 hybrid compile count moved across a "
+                        "repeated-structure burst"
+                    )
+                # hard gates (armed when residency served the fused arm):
+                # every query fused, all through <= 1 new executable
+                if delta16 is not None:
+                    if served_fused != len(hyb_keys):
+                        _fail(
+                            "config16 hybrid burst fell off the fused arm "
+                            f"({served_fused}/{len(hyb_keys)} fused)"
+                        )
+                    if new_fns > 1:
+                        _fail(
+                            "config16 hybrid burst compiled per literal "
+                            f"({new_fns} executables for {len(hyb_keys)} "
+                            "fresh literals)"
+                        )
+                hyb16 = {
+                    "burst": len(hyb_keys),
+                    "burst_s": round(hyb_burst_s, 4),
+                    "fused_served": int(served_fused),
+                    "new_executables": int(new_fns),
+                    "compile_count_flat": True,
+                    "delta_resident": delta16 is not None,
+                }
+                session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, "false")
             extras["whole_plan"] = {
                 "burst": WP_BURST,
                 "interp_cold_burst_s": round(interp_cold_s, 4),
@@ -2271,6 +2407,13 @@ def main() -> None:
                 "fused_d2h_per_query": int(
                     p16.get("compile.fused.dispatches", 0)
                 ),
+                # device aggregation (exec.scan_agg): the agg_scan
+                # pipeline's group-by ran on device — gated above
+                "agg_device_path": int(q16.get("scan.path.resident_agg", 0)),
+                "agg_fused_d2h": int(
+                    q16.get("compile.fused.dispatches", 0)
+                ),
+                "hybrid_burst": hyb16,
                 "pipeline_cache": _pc16.snapshot(),
             }
         finally:
@@ -2310,6 +2453,22 @@ def main() -> None:
             )
         except Exception as e:  # noqa: BLE001 - A/B extra must not fail the bench
             extras["mesh_ab"] = {"error": repr(e)[:400]}
+        # config-16 hard gate (mesh leg): when the whole-plan gates are
+        # armed, the mesh A/B must have proven fused-scan parity and the
+        # device-lowered aggregate — a silent mesh regression (compile
+        # declines, agg back on the host) must fail the bench, not hide
+        # in an "error" extra
+        if os.environ.get("BENCH_WHOLE_PLAN", "1") != "0" and (
+            "resident_device_s" in extras
+        ):
+            mab = extras["mesh_ab"]
+            if mab.get("fused_scan_parity") is not True:
+                _fail(
+                    "config16 mesh fused-scan parity gate failed: "
+                    f"{mab.get('error', mab)}"[:400]
+                )
+            if mab.get("agg_path") != "device_segment":
+                _fail("config16 mesh aggregate did not lower to device")
 
     # ---- device-kernel microbench (north star evidence) --------------------
     # warm per-kernel device throughput at the bench's shapes, recorded even
@@ -2446,9 +2605,16 @@ def main() -> None:
         ("agg_speedup_vs_per_operator", "whole_plan_agg_speedup_x"),
         ("compile_count_flat", "whole_plan_compile_flat"),
         ("fused_d2h_per_query", "whole_plan_d2h_per_query"),
+        ("agg_device_path", "whole_plan_agg_device"),
     ):
         if src_k in wp16:
             compact[dst_k] = wp16[src_k]
+    hb16 = wp16.get("hybrid_burst") or {}
+    if hb16:
+        compact["whole_plan_hybrid_fused"] = hb16.get("fused_served")
+        compact["whole_plan_hybrid_executables"] = hb16.get(
+            "new_executables"
+        )
     compact["detail"] = detail_path.name
     line = json.dumps(compact)
     while len(line) > 1900:

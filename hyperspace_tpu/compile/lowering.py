@@ -9,18 +9,27 @@ The interpreter (exec.executor._exec) is the fallback leg of every
 pipeline: a shape the lowering doesn't recognize, a mesh arm it declines,
 or a per-query eligibility miss all land there with identical results.
 
-Shape classes (single-chip unless noted):
+Shape classes (single-chip AND mesh unless noted):
 
 * ``scan``       — ``[Project]* Filter IndexScan``: the filter-pushdown
   pipeline serves as ONE fused mask+count dispatch whose executable is
   keyed on predicate STRUCTURE with literals as traced operands
-  (exec.scan.index_scan structure_keyed=True), host legs exact.
+  (exec.scan.index_scan structure_keyed=True; mesh sessions ride the
+  structure-keyed shard_map batched entry the same way), host legs
+  exact.
 * ``agg_scan``   — ``[Project]* Aggregate([Project]* Filter IndexScan)``:
-  the scan arm fuses as above, the hash aggregate runs on the candidate
-  rows host-side — the whole pipeline still ships ONE count vector D2H.
-* ``hybrid``     — ``[Project]* Filter Union(...)``: the delta-resident
-  hybrid arm (fused base+delta dispatch, deletion bitmask on device)
-  with the concurrent per-side host union as fallback.
+  the group-by lowers ONTO THE DEVICE when the resident table covers
+  the group/agg columns (exec.scan_agg — mask + dense-key segment
+  sum/count/min/max in one executable, mesh partials psum-merged; ONE
+  D2H ships the finished group table, no candidate blocks); device-
+  ineligible specs route the count-vector scan + host hash-aggregate
+  tail with a ``compile.agg.declined.<reason>`` counter.
+* ``hybrid``     — ``[Project]* Filter Union(...)`` (single-chip): the
+  delta-resident hybrid arm on the STRUCTURE-KEYED batched entry
+  (fused base+delta dispatch, deletion bitmask on device, literals as
+  traced operands — a fresh-literal hybrid burst shares one
+  executable) with the concurrent per-side host union as fallback.
+  Mesh hybrids stay with the interpreter's literal-keyed fused arm.
 * ``join_agg``   — ``[Project]* Aggregate([Project](Join))``: the
   resident aggregate-join arm (single-chip AND mesh — the PR-5/8 fused
   kernels are the lowering targets), host range-fusion fallback.
@@ -101,11 +110,7 @@ def classify_shape(plan: LogicalPlan, mesh=None) -> Shape:
             inner = inner.child
         if isinstance(inner, Join):
             return Shape("join_agg", projects, agg=node)
-        if (
-            mesh is None
-            and isinstance(inner, Filter)
-            and isinstance(inner.child, IndexScan)
-        ):
+        if isinstance(inner, Filter) and isinstance(inner.child, IndexScan):
             return Shape(
                 "agg_scan",
                 projects,
@@ -115,29 +120,48 @@ def classify_shape(plan: LogicalPlan, mesh=None) -> Shape:
                 inner_projects=inner_projects,
             )
         return Shape("interpret")
-    if isinstance(node, Filter) and mesh is None:
+    if isinstance(node, Filter):
         child = node.child
         if isinstance(child, IndexScan):
             return Shape("scan", projects, node.condition, child)
-        if isinstance(child, Union):
+        if isinstance(child, Union) and mesh is None:
+            # mesh hybrids keep the interpreter's literal-keyed fused
+            # arm — the structure-keyed hybrid batch entry is single-chip
             return Shape("hybrid", projects, node.condition, union=child)
     return Shape("interpret")
 
 
-def _tier_label(shape: Shape) -> str:
+def _tier_label(shape: Shape, mesh=None) -> str:
     """Advisory residency label for the pipeline (explain/observability):
     which rung the fused arm WOULD serve on right now. Counter-free —
     registry probes only, never the counting eligibility procedures (a
     lowering must not skew per-query decline counters)."""
     try:
         if shape.kind in ("scan", "agg_scan") and shape.scan is not None:
-            from ..exec.hbm_cache import hbm_cache
-
             entry = shape.scan.entry
             pred_cols = sorted(shape.condition.columns())
-            table = hbm_cache.resident_for(
-                entry.content.files(), pred_cols
-            )
+            if shape.kind == "agg_scan" and shape.agg is not None:
+                # the device-agg arm needs the GROUP/AGG columns resident
+                # too — labeling from predicate coverage alone would
+                # print a device tier above an "Aggregate ran: host hash"
+                # line (explain contradiction)
+                pred_cols = sorted(
+                    set(pred_cols)
+                    | set(shape.agg.group_by)
+                    | {a.column for a in shape.agg.aggs if a.column}
+                )
+            if mesh is not None:
+                from ..exec.mesh_cache import mesh_cache
+
+                table = mesh_cache.resident_for(
+                    entry.content.files(), pred_cols, mesh
+                )
+            else:
+                from ..exec.hbm_cache import hbm_cache
+
+                table = hbm_cache.resident_for(
+                    entry.content.files(), pred_cols
+                )
             return getattr(table, "tier", "resident") if table else "host"
         if shape.kind == "hybrid":
             from ..exec.hbm_cache import hbm_cache
@@ -181,7 +205,7 @@ def lower(
             pipeline = CompiledPipeline(
                 kind=shape.kind,
                 fingerprint=fingerprint,
-                tier=_tier_label(shape),
+                tier=_tier_label(shape, mesh),
                 index_roots=index_roots(plan),
                 boundary=_boundary(plan, shape),
             )
@@ -207,7 +231,10 @@ def _boundary(plan: LogicalPlan, shape: Shape) -> tuple:
     lines = [f"fused[{shape.kind}]:"]
     fused_nodes = {
         "scan": "Filter→IndexScan (one mask+count dispatch)",
-        "agg_scan": "Aggregate→Filter→IndexScan (one dispatch + host agg)",
+        "agg_scan": (
+            "Aggregate→Filter→IndexScan (one dispatch: device "
+            "segment-agg, host hash tail on decline)"
+        ),
         "hybrid": "Filter→Union base+delta (one fused dispatch)",
         "join_agg": "Aggregate→Join (resident region dispatch)",
     }

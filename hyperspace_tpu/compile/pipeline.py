@@ -152,13 +152,7 @@ class CompiledPipeline:
             out = self._run_scan(shape, executor)
             return _apply_projects(out, shape.projects)
         if self.kind == "agg_scan":
-            from ..exec.aggregate import hash_aggregate
-
-            out = self._run_scan(shape, executor)
-            out = _apply_projects(out, shape.inner_projects)
-            out = hash_aggregate(
-                out, list(shape.agg.group_by), list(shape.agg.aggs)
-            )
+            out = self._run_agg_scan(shape, executor)
             return _apply_projects(out, shape.projects)
         if self.kind == "hybrid":
             out = self._run_hybrid(shape, executor)
@@ -174,9 +168,11 @@ class CompiledPipeline:
         resolution, zone gate, host legs, empty-schema handling) the
         interpreter uses, so per-query eligibility misses degrade
         identically; only the executable keying differs (literals traced
-        instead of baked in)."""
+        instead of baked in). Mesh sessions route the mesh arm."""
         from ..exec.scan import index_scan
 
+        if executor.mesh is not None:
+            return self._run_scan_mesh(shape, executor)
         scan = shape.scan
         entry = scan.entry
         return index_scan(
@@ -190,14 +186,180 @@ class CompiledPipeline:
             structure_keyed=True,
         )
 
-    def _run_hybrid(self, shape, executor) -> ColumnarBatch:
-        """The fused hybrid arm: the executor's delta-resident base+delta
-        dispatch, falling to the concurrent per-side host union — the
-        split entry points guarantee the fallback never re-runs the
-        residency resolution (no double-counted declines)."""
-        fused = executor._try_resident_hybrid(shape.union, shape.condition)
+    def _run_scan_mesh(self, shape, executor) -> ColumnarBatch:
+        """The fused MESH scan arm: when the shards are resident, the
+        counts dispatch rides the structure-keyed shard_map batched
+        entry (N=1, literals as traced operands — a fresh-literal burst
+        shares one executable, the single-chip rule on the mesh); every
+        miss — no table, narrow failure, device loss — falls to the
+        interpreter's distributed scan, which owns population scheduling
+        and the ship-per-query path."""
+        from pathlib import Path
+
+        from ..exec.mesh_cache import mesh_cache
+        from ..exec.scan import _empty_result, prune_index_files
+
+        scan = shape.scan
+        entry = scan.entry
+        predicate = shape.condition
+        # resolve against the version's FULL file list (a table always
+        # covers it, so pruning cannot change the hit outcome) and prune
+        # only on a hit: the common miss then pays ONE registry probe —
+        # which early-outs on an empty cache — before handing the query
+        # to the interpreter's distributed scan, instead of re-running
+        # file pruning the fallback repeats anyway
+        all_files = entry.content.files()
+        counts = None
+        table = None
+        files: list = []
+        if all_files:
+            table = mesh_cache.resident_for(
+                all_files, sorted(predicate.columns()), executor.mesh
+            )
+        if table is not None:
+            # the query's pruned subset restricts the host leg's reads
+            files = prune_index_files(
+                [Path(p) for p in all_files],
+                predicate,
+                entry.indexed_columns,
+                entry.schema,
+                entry.num_buckets,
+            )
+            try:
+                with span(
+                    "scan.device_dispatch",
+                    tier=getattr(table, "tier", "resident"),
+                    structure_keyed=True,
+                    mesh=table.n_devices,
+                ):
+                    m = mesh_cache.block_counts_batch(
+                        table, [predicate], metric_ns="compile.fused"
+                    )
+                counts = None if m is None else m[0]
+            except Exception:  # noqa: BLE001 - device loss degrades
+                mesh_cache.drop(table)
+                metrics.incr("scan.resident_mesh.device_failed")
+                counts = None
+        if counts is None:
+            return executor._exec_index_scan_distributed(scan, predicate)
+        metrics.incr("scan.files_read", len(files))
+        parts = mesh_cache.collect_parts(
+            table, files, list(scan.required_columns), predicate, counts
+        )
+        if parts:
+            return ColumnarBatch.concat(parts)
+        # the ONE empty-result construction (exec.scan) — the host and
+        # interpreter legs build theirs through the same helper
+        return _empty_result(
+            files, list(scan.required_columns), entry.schema
+        )
+
+    def _run_agg_scan(self, shape, executor) -> ColumnarBatch:
+        """The agg_scan arm: DEVICE aggregation first — one executable
+        fuses the predicate mask with dense-key segment reductions and
+        ships the FINISHED group table home (exec.scan_agg; the PR-5
+        resident_join_agg machinery generalized to single-table
+        aggregates). Device-ineligible specs fall to the count-vector
+        scan + host hash-aggregate tail, each decline counted under
+        compile.agg.declined.<reason> — never a silent host tail."""
+        from ..exec.aggregate import hash_aggregate
+
+        fused = self._try_device_agg(shape, executor)
         if fused is not None:
-            metrics.incr("compile.fused.dispatches")
+            return fused
+        if executor.mesh is not None:
+            # the interpreter's whole Aggregate procedure: the mesh tail
+            # keeps its two-phase distributed aggregate (per-device
+            # partials, psum-style host merge) and its path counters —
+            # a decline must not demote the mesh to gather-then-hash
+            return executor._exec_aggregate(shape.agg, None)
+        out = self._run_scan(shape, executor)
+        out = _apply_projects(out, shape.inner_projects)
+        return hash_aggregate(
+            out, list(shape.agg.group_by), list(shape.agg.aggs)
+        )
+
+    def _try_device_agg(self, shape, executor) -> Optional[ColumnarBatch]:
+        """The device-aggregation attempt, or None with its decline
+        counted. Population: a no_table miss schedules the predicate AND
+        group/agg columns, so the NEXT structurally-equal query
+        aggregates on device. No selectivity zone gate applies — the
+        device-agg host leg reads nothing, so a broad predicate has no
+        host-read cost for the gate to protect (exec.scan_agg note)."""
+        group_by = list(shape.agg.group_by)
+        aggs = list(shape.agg.aggs)
+
+        def decline(reason: str):
+            metrics.incr(f"compile.agg.declined.{reason}")
+            return None
+
+        if not group_by:
+            # the global-aggregate empty-input contract (one NULL-ish
+            # row) belongs to the host tail
+            return decline("shape")
+        need = list(
+            dict.fromkeys(group_by + [a.column for a in aggs if a.column])
+        )
+        # an inner projection that starves the aggregate must raise on
+        # the host path, not silently aggregate on device
+        for p in shape.inner_projects:
+            if not set(need) <= set(p.columns):
+                return decline("shape")
+        entry = shape.scan.entry
+        if any(c not in entry.schema for c in need):
+            return decline("column")
+        all_files = entry.content.files()
+        if not all_files:
+            return decline("no_table")
+        pred_cols = sorted(shape.condition.columns())
+        want_cols = sorted(set(pred_cols) | set(need))
+        if executor.mesh is not None:
+            from ..exec.mesh_cache import mesh_cache as cache
+
+            table = cache.resident_for(all_files, want_cols, executor.mesh)
+            fail_metric = "scan.resident_mesh.device_failed"
+        else:
+            from ..exec.hbm_cache import hbm_cache as cache
+
+            table = cache.resident_for(all_files, want_cols)
+            fail_metric = "scan.resident.device_failed"
+        if table is None:
+            if cache.auto_enabled():
+                if executor.mesh is not None:
+                    cache.note_touch(all_files, want_cols, executor.mesh)
+                else:
+                    cache.note_touch(all_files, want_cols)
+            return decline("no_table")
+        try:
+            out, reason = cache.agg_scan(
+                table, shape.condition, group_by, aggs
+            )
+        except Exception:  # noqa: BLE001 - device loss degrades
+            # drop the table and latch THIS query host through the host
+            # tail; the scoped failure counter also evicts this pipeline
+            # (run()'s device-failure check)
+            cache.drop(table)
+            metrics.incr(fail_metric)
+            return decline("device")
+        if out is None:
+            return decline(reason)
+        metrics.incr("compile.fused.dispatches")
+        metrics.incr("compile.agg.device")
+        return out
+
+    def _run_hybrid(self, shape, executor) -> ColumnarBatch:
+        """The fused hybrid arm on the STRUCTURE-KEYED batched entry
+        (structure_keyed=True routes hybrid_block_counts_batch N=1 with
+        literals as traced operands, so a fresh-literal hybrid burst
+        shares ONE executable — the same trick the scan arm rode since
+        PR 10; the dispatch itself counts compile.fused.dispatches),
+        falling to the concurrent per-side host union — the split entry
+        points guarantee the fallback never re-runs the residency
+        resolution (no double-counted declines)."""
+        fused = executor._try_resident_hybrid(
+            shape.union, shape.condition, structure_keyed=True
+        )
+        if fused is not None:
             return fused
         columns = (
             list(shape.projects[-1].columns) if shape.projects else None

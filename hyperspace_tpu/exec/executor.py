@@ -314,7 +314,7 @@ class Executor:
         return ColumnarBatch.concat(parts)
 
     def _try_resident_hybrid(
-        self, plan: Union, predicate: Expr
+        self, plan: Union, predicate: Expr, structure_keyed: bool = False
     ) -> Optional[ColumnarBatch]:
         """The delta-resident hybrid fast path: when ``plan`` is a hybrid
         union whose base table AND appended delta are device-resident,
@@ -325,7 +325,15 @@ class Executor:
         per-side union (which schedules background delta population, so
         the NEXT query lands here). Row-identical to the host union by
         the same argument as the plain resident scan: the host re-
-        evaluates every candidate block exactly."""
+        evaluates every candidate block exactly.
+
+        ``structure_keyed`` (the compiled hybrid pipeline, compile.
+        pipeline): the single-chip dispatch rides the batched entry
+        (hybrid_block_counts_batch N=1, metric_ns "compile.fused") —
+        literals as traced operands, so a fresh-literal hybrid burst
+        shares ONE executable instead of recompiling per literal.
+        Identical eligibility, host legs and results; the mesh arm keeps
+        its literal-keyed fused dispatch either way."""
         from ..plan.rules.hybrid_scan import parse_hybrid_union
         from ..telemetry.metrics import metrics
         from .delta import resolve_hybrid_residency
@@ -404,9 +412,18 @@ class Executor:
             from .scan import _resident_parts
 
             try:
-                counts = hbm_cache.hybrid_block_counts(
-                    table, delta, predicate
-                )
+                if structure_keyed:
+                    pairs = hbm_cache.hybrid_block_counts_batch(
+                        table,
+                        delta,
+                        [predicate],
+                        metric_ns="compile.fused",
+                    )
+                    counts = None if pairs is None else pairs[0]
+                else:
+                    counts = hbm_cache.hybrid_block_counts(
+                        table, delta, predicate
+                    )
             except Exception:  # noqa: BLE001 - device loss degrades
                 hbm_cache.drop(table)
                 metrics.incr("scan.resident.device_failed")

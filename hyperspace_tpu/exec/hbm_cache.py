@@ -73,6 +73,7 @@ from ..plan.expr import Expr, eval_mask
 from ..storage.columnar import Column, ColumnarBatch, is_string
 from ..telemetry.metrics import metrics
 from ..telemetry.trace import add_bytes as _trace_bytes
+from ..telemetry.trace import span as _trace_span
 
 BLOCK_ROWS = 8192  # count granularity: 4 B D2H per 8 K rows scanned
 
@@ -2122,11 +2123,17 @@ class HbmIndexCache(ResidentCacheBase):
         delta: DeltaRegion,
         predicates: List[Expr],
         prepared: Optional[list] = None,
+        metric_ns: str = "serve.batch",
     ) -> Optional[list]:
         """Per-predicate (base counts, delta counts) pairs for N
         compatible hybrid queries in ONE device dispatch — the serving
-        micro-batcher's hybrid leg. None when any predicate fails to
-        narrow (caller serves the batch per-query)."""
+        micro-batcher's hybrid leg, and (N=1, ``metric_ns``
+        "compile.fused") the compiled hybrid pipeline's structure-keyed
+        single: literals ride as traced operands, so a fresh-literal
+        hybrid burst shares ONE executable instead of recompiling per
+        literal (the _batched_counts_fn rationale — the literal-keyed
+        single-query twin bakes literals into its key). None when any
+        predicate fails to narrow (caller serves the batch per-query)."""
         from ..ops import kernels as K
         from .delta import prepare_hybrid_predicate
 
@@ -2175,9 +2182,9 @@ class HbmIndexCache(ResidentCacheBase):
                 )
             else:
                 counts = np.asarray(fn(bcols, dcols, tuple(lit_vecs)))
-        metrics.record_time("serve.batch.device", time.perf_counter() - t0)
-        metrics.incr("serve.batch.dispatches")
-        metrics.incr("serve.batch.queries", len(predicates))
+        metrics.record_time(f"{metric_ns}.device", time.perf_counter() - t0)
+        metrics.incr(f"{metric_ns}.dispatches")
+        metrics.incr(f"{metric_ns}.queries", len(predicates))
         metrics.incr("scan.resident.d2h_bytes", int(counts.nbytes))
         _trace_bytes("d2h_bytes", int(counts.nbytes))
         nb_pad = table.n_pad // BLOCK_ROWS
@@ -2440,6 +2447,81 @@ class HbmIndexCache(ResidentCacheBase):
         )
         _trace_bytes("d2h_bytes", sum(int(o.nbytes) for o in outs))
         return finish_join_agg(region, plan, list(group_by), list(aggs), outs)
+
+    # -- the fused scan-aggregate query --------------------------------------
+    def agg_scan(self, table: ResidentTable, predicate: Expr, group_by, aggs):
+        """The device aggregation of an ``agg_scan`` pipeline: predicate
+        mask (literals as TRACED operands — a distinct-literal burst
+        shares one executable) feeding dense-key segment reductions in
+        ONE executable under enable_x64 (exec.scan_agg); ONE D2H ships
+        the span-sized group vectors — the finished group table, no
+        candidate blocks. Returns ``(batch, "ok")`` or ``(None, decline
+        reason)`` — the caller counts ``compile.agg.declined.<reason>``
+        and routes the exact host hash-aggregate. Device errors
+        propagate (caller drops the table and latches the query host).
+        No selectivity gate applies: unlike the count-vector protocol
+        the host leg reads nothing, so a broad predicate costs only
+        device rows."""
+        from ..utils.jaxcompat import enable_x64
+        from .scan_agg import (
+            finish_scan_agg,
+            plan_plane_names,
+            scan_agg_fn,
+            scan_agg_plan,
+        )
+
+        plan, reason = scan_agg_plan(table, list(group_by), list(aggs))
+        if plan is None:
+            return None, reason
+        prepared = prepare_resident_predicate(table.columns, predicate)
+        if prepared is None:
+            return None, "predicate"
+        narrowed, names = prepared
+        union_names = tuple(
+            dict.fromkeys(tuple(names) + plan_plane_names(plan))
+        )
+        spec_map = tuple(
+            zip(union_names, resident_specs_for(table.columns, union_names))
+        )
+        fn = scan_agg_fn(
+            _expr_structure(narrowed),
+            names,
+            narrowed,
+            union_names,
+            spec_map,
+            plan,
+            table.n_pad,
+            table.n_rows,
+        )
+        cols = dict(
+            zip(union_names, resident_arrays_for(table.columns, union_names))
+        )
+        vals: list = []
+        _expr_literals(narrowed, vals)
+        lits = np.asarray(vals, dtype=np.int32)
+        t0 = time.perf_counter()
+        # the trace's fused-dispatch span names the agg kind — one
+        # source of truth for explain(verbose)'s "Aggregate ran" line
+        with _trace_span(
+            "scan.agg_dispatch",
+            tier=getattr(table, "tier", "resident"),
+            agg="segment_" + ",".join(sorted({a.fn for a in aggs})),
+            span_slots=plan.span,
+        ):
+            # x64 scope: segment sums accumulate int64/float64 — exact
+            # int arithmetic is the parity contract (join_agg's rule)
+            with enable_x64(True):
+                raw = fn(cols, lits)
+            outs = [np.asarray(o) for o in raw]
+        metrics.record_time(
+            "scan.resident_agg.device", time.perf_counter() - t0
+        )
+        d2h = sum(int(o.nbytes) for o in outs)
+        metrics.incr("scan.resident.d2h_bytes", d2h)
+        _trace_bytes("d2h_bytes", d2h)
+        batch = finish_scan_agg(table, plan, list(group_by), list(aggs), outs)
+        metrics.incr("scan.path.resident_agg")
+        return batch, "ok"
 
     # -- observability -------------------------------------------------------
     def snapshot(self) -> dict:

@@ -59,6 +59,7 @@ from ..plan.expr import Expr, eval_mask
 from ..storage import layout
 from ..storage.columnar import Column, ColumnarBatch, is_string
 from ..telemetry.metrics import metrics
+from ..telemetry.trace import span as _trace_span
 from .hbm_cache import (
     BLOCK_ROWS,
     _MAX_FAILED_MEMO,
@@ -88,6 +89,11 @@ class MeshResidentColumn:
     # values — every shard shares the global frame, so one static spec
     # serves the whole mesh): ``data`` holds (D, cap // vpw) packed words
     pack: Optional[object] = None
+    # int-encoded columns only: value-space bounds over the REAL rows
+    # (mesh shards build no zone vectors, so the device scan-aggregate's
+    # dense-key planner reads these — exec.scan_agg.column_value_bounds)
+    vmin: Optional[int] = None
+    vmax: Optional[int] = None
 
 
 # one device's slice of one file: rows [file_lo, file_hi) of ``path`` live
@@ -140,9 +146,11 @@ class MeshResidentTable:
     n_rows: int
     nbytes: int
     last_used: float = field(default_factory=time.monotonic)
-    # tier ladder: "resident" or "compressed" only — the streaming tier
-    # is single-chip (a mesh table that large should shard wider; the
-    # decline is counted as hbm.mesh.residency.streaming_declined)
+    # tier ladder: "resident" or "compressed" — the streaming rung
+    # registers its own table type (residency.streaming's mesh twin:
+    # host-pinned shard matrices staged through a per-device slab pair);
+    # hbm.mesh.residency.streaming_declined now counts only GENUINE
+    # declines (the slab pair itself over budget)
     tier: str = "resident"
     raw_nbytes: int = 0
 
@@ -548,11 +556,16 @@ class MeshHbmCache(ResidentCacheBase):
         )
         from ..residency import knobs as _rknobs
 
-        # the ladder for mesh tables is resident -> compressed -> host:
-        # streaming is a single-chip tier, so the raw pre-check only
-        # relaxes when compression could still fit the table
+        # the mesh ladder is resident -> compressed -> streaming -> host
+        # (the full single-chip ladder since the mesh accepted the
+        # compressed-streaming rung): the raw pre-check only refuses
+        # outright when every lower rung is switched off
+        ladder_open = (
+            _rknobs.compression_mode() != "off"
+            or _rknobs.streaming_enabled()
+        )
         if planes * D * cap * 4 + vocab_est > _budget_bytes() and (
-            _rknobs.compression_mode() == "off"
+            not ladder_open
         ):
             metrics.incr("hbm.mesh.over_budget_refused")
             return None, False
@@ -654,6 +667,7 @@ class MeshHbmCache(ResidentCacheBase):
         raw_plane_bytes = 0
         unpacked_bytes = 0
         side_bytes = 0
+        col_bounds: Dict[str, Tuple[int, int]] = {}
         for name, (_dts, enc, vocab, mats) in host_mats.items():
             if vocab is not None:
                 side_bytes += vocab_heap_bytes(vocab)
@@ -671,6 +685,10 @@ class MeshHbmCache(ResidentCacheBase):
                 if real:
                     vmin = min(int(r.min()) for r in real)
                     vmax = max(int(r.max()) for r in real)
+                    if enc == "int":
+                        # mesh shards carry no zone vectors; the device
+                        # scan-aggregate's dense-key planner reads these
+                        col_bounds[name] = (vmin, vmax)
                     spec = bitpack.pack_spec(vmin, vmax, cap)
                     if spec is not None and cap % spec.vpw != 0:
                         spec = None  # degenerate tiny shard: keep raw
@@ -684,17 +702,39 @@ class MeshHbmCache(ResidentCacheBase):
             pack_specs,
             unpacked_bytes,
             side_bytes,
-            streaming_ok=False,
+            streaming_ok=True,
             shard_count=D,  # per-shard specs upload D copies
         )
         if plan.tier == "host":
-            # the mesh ladder ends at compressed: streaming is a
-            # single-chip tier (shard wider instead) — count the decline
-            # so an oversubscribed mesh refusal is attributable
-            if _rknobs.streaming_enabled():
-                metrics.incr("hbm.mesh.residency.streaming_declined")
+            # with streaming_ok=True the planner only lands here when
+            # streaming is switched OFF — a knob refusal, not a decline
             metrics.incr("hbm.mesh.over_budget_refused")
             return None, False
+        if plan.tier == "streaming":
+            from ..residency.streaming import build_mesh_streaming_table
+
+            table = build_mesh_streaming_table(
+                key,
+                mesh,
+                dev_segs,
+                dev_rows,
+                n_rows,
+                host_mats,
+                plan.specs,
+                _rknobs.streaming_window_rows(),
+                col_bounds,
+            )
+            if table.nbytes > _budget_bytes():
+                # even the per-device slab pair cannot fit: the ONE
+                # genuine mesh streaming decline left
+                metrics.incr("hbm.mesh.residency.streaming_declined")
+                metrics.incr("hbm.mesh.over_budget_refused")
+                return None, False
+            metrics.incr("residency.tier.streaming_built")
+            metrics.record_time(
+                "hbm.mesh.prefetch", time.perf_counter() - t0
+            )
+            return table, False
 
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
@@ -717,6 +757,7 @@ class MeshHbmCache(ResidentCacheBase):
                 continue
             spec = plan.specs.get(name)
             mat = mats[""]
+            vmin, vmax = col_bounds.get(name, (None, None))
             if spec is not None:
                 # pad rows re-encode at the frame reference (they were
                 # zero-filled, which may sit OUTSIDE [ref0, ref0+2^bits)
@@ -730,13 +771,14 @@ class MeshHbmCache(ResidentCacheBase):
                 dev = jax.device_put(words, sharding)
                 col_bytes = words.nbytes + vocab_heap
                 cols[name] = MeshResidentColumn(
-                    dev, dts, enc, col_bytes, vocab, None, spec
+                    dev, dts, enc, col_bytes, vocab, None, spec,
+                    vmin, vmax,
                 )
             else:
                 dev = jax.device_put(mat, sharding)
                 col_bytes = mat.nbytes + vocab_heap
                 cols[name] = MeshResidentColumn(
-                    dev, dts, enc, col_bytes, vocab
+                    dev, dts, enc, col_bytes, vocab, vmin=vmin, vmax=vmax
                 )
             nbytes += col_bytes
         if not cols:
@@ -825,7 +867,9 @@ class MeshHbmCache(ResidentCacheBase):
     ) -> Optional[np.ndarray]:
         """(D, n_blocks) per-block match counts in ONE mesh round trip.
         None when the predicate does not narrow to the resident encodings
-        (caller routes the ship-per-query path)."""
+        (caller routes the ship-per-query path). Tier-transparent like
+        the single-chip twin: streaming tables run the per-shard
+        double-buffered window loop (residency.streaming)."""
         from ..ops import kernels as K
         from .hbm_cache import (
             prepare_resident_predicate,
@@ -833,6 +877,10 @@ class MeshHbmCache(ResidentCacheBase):
             resident_specs_for,
         )
 
+        if getattr(table, "tier", "resident") == "streaming":
+            from ..residency.streaming import mesh_stream_block_counts
+
+            return mesh_stream_block_counts(table, predicate)
         # bind (string vocab) -> expand (f64 two-plane) -> narrow (i32):
         # the shared resident pipeline (hbm_cache)
         prepared = prepare_resident_predicate(table.columns, predicate)
@@ -865,14 +913,18 @@ class MeshHbmCache(ResidentCacheBase):
         table: MeshResidentTable,
         predicates: List[Expr],
         prepared: Optional[list] = None,
+        metric_ns: str = "serve.batch",
     ) -> Optional[np.ndarray]:
         """(N, D, n_blocks) match counts for N predicates in ONE mesh
         round trip — the mesh leg of the serving micro-batcher
         (hbm_cache.block_counts_batch rationale: literal values ride as
         traced operands so serving bursts reuse the compiled executable;
         ``prepared`` optionally reuses the classifier's submit-time
-        prepare_resident_predicate results). None when any predicate
-        fails to narrow (caller serves the batch per-query)."""
+        prepare_resident_predicate results), and (N=1, ``metric_ns``
+        "compile.fused") the compiled mesh scan pipeline's structure-
+        keyed single. None when any predicate fails to narrow (caller
+        serves the batch per-query). Streaming tables window the whole
+        batch through the per-shard slab pair."""
         from ..ops import kernels as K
         from .hbm_cache import (
             _expr_literals,
@@ -882,6 +934,12 @@ class MeshHbmCache(ResidentCacheBase):
             resident_specs_for,
         )
 
+        if getattr(table, "tier", "resident") == "streaming":
+            from ..residency.streaming import mesh_stream_block_counts_batch
+
+            return mesh_stream_block_counts_batch(
+                table, predicates, prepared, metric_ns
+            )
         if prepared is None:
             prepared = [
                 prepare_resident_predicate(table.columns, p)
@@ -917,9 +975,11 @@ class MeshHbmCache(ResidentCacheBase):
         t0 = time.perf_counter()
         with K._x32():
             counts = np.asarray(fn(cols, lit_vecs))
-        metrics.record_time("serve.batch.mesh_device", time.perf_counter() - t0)
-        metrics.incr("serve.batch.dispatches")
-        metrics.incr("serve.batch.queries", len(predicates))
+        metrics.record_time(
+            f"{metric_ns}.mesh_device", time.perf_counter() - t0
+        )
+        metrics.incr(f"{metric_ns}.dispatches")
+        metrics.incr(f"{metric_ns}.queries", len(predicates))
         metrics.incr("scan.resident_mesh.d2h_bytes", int(counts.nbytes))
         # (D, N, n_blocks) -> per-predicate (D, n_blocks) slices, stacked
         # predicate-major so callers index counts[i] like block_counts()
@@ -1580,6 +1640,81 @@ class MeshHbmCache(ResidentCacheBase):
             "scan.resident_join.d2h_bytes", sum(int(o.nbytes) for o in outs)
         )
         return finish_join_agg(region, plan, list(group_by), list(aggs), outs)
+
+    # -- the fused scan-aggregate query --------------------------------------
+    def agg_scan(self, table: MeshResidentTable, predicate: Expr, group_by, aggs):
+        """The mesh device aggregation of an ``agg_scan`` pipeline:
+        per-shard predicate mask + dense-key segment partials over the
+        full slot space, psum/pmin/pmax into ONE replicated group table
+        (exec.scan_agg's shard_map twin — the two-phase distributed
+        aggregate with zero shuffles), ONE D2H. Same contract as the
+        single-chip twin: ``(batch, "ok")`` or ``(None, reason)``;
+        device errors propagate."""
+        from ..utils.jaxcompat import enable_x64
+        from .hbm_cache import (
+            _expr_literals,
+            _expr_structure,
+            prepare_resident_predicate,
+            resident_arrays_for,
+            resident_specs_for,
+        )
+        from .scan_agg import (
+            finish_scan_agg,
+            mesh_scan_agg_fn,
+            plan_plane_names,
+            scan_agg_plan,
+        )
+
+        plan, reason = scan_agg_plan(table, list(group_by), list(aggs))
+        if plan is None:
+            return None, reason
+        prepared = prepare_resident_predicate(table.columns, predicate)
+        if prepared is None:
+            return None, "predicate"
+        narrowed, names = prepared
+        union_names = tuple(
+            dict.fromkeys(tuple(names) + plan_plane_names(plan))
+        )
+        spec_map = tuple(
+            zip(union_names, resident_specs_for(table.columns, union_names))
+        )
+        fn = mesh_scan_agg_fn(
+            table.mesh,
+            _expr_structure(narrowed),
+            names,
+            narrowed,
+            union_names,
+            spec_map,
+            plan,
+            table.cap,
+        )
+        cols = dict(
+            zip(union_names, resident_arrays_for(table.columns, union_names))
+        )
+        vals: list = []
+        _expr_literals(narrowed, vals)
+        lits = np.asarray(vals, dtype=np.int32)
+        t0 = time.perf_counter()
+        with _trace_span(
+            "scan.agg_dispatch",
+            tier=getattr(table, "tier", "resident"),
+            agg="segment_" + ",".join(sorted({a.fn for a in aggs})),
+            span_slots=plan.span,
+            mesh=table.n_devices,
+        ):
+            with enable_x64(True):
+                raw = fn(
+                    cols, lits, np.asarray(table.dev_rows, dtype=np.int32)
+                )
+            outs = [np.asarray(o) for o in raw]
+        metrics.record_time(
+            "scan.resident_agg.mesh_device", time.perf_counter() - t0
+        )
+        d2h = sum(int(o.nbytes) for o in outs)
+        metrics.incr("scan.resident_mesh.d2h_bytes", d2h)
+        batch = finish_scan_agg(table, plan, list(group_by), list(aggs), outs)
+        metrics.incr("scan.path.resident_agg_mesh")
+        return batch, "ok"
 
     # -- observability -------------------------------------------------------
     def snapshot(self) -> dict:

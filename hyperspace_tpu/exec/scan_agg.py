@@ -1,0 +1,518 @@
+"""Device single-table aggregation over resident scan tables.
+
+The PR-5 ``resident_join_agg`` machinery (sorted-intersection feeding
+segment-sum/count/min/max in ONE executable under enable_x64)
+generalized to the ``agg_scan`` pipeline shape: the predicate mask
+evaluates over the resident planes (literals as TRACED operands — the
+structure-keyed discipline of the batched counts executables, so a
+distinct-literal burst shares one compiled program), matching rows route
+their group key into dense segment slots, and the per-group
+sum/count/min/max reduce IN THE SAME EXECUTABLE. ONE D2H ships the
+span-sized group vectors home — the finished group table, never
+candidate blocks: unlike the count-vector protocol, the host leg reads
+NOTHING, which is exactly why the selectivity zone gate does not apply
+here (a broad predicate costs the device more rows but the host zero
+reads either way).
+
+Exactness contract (the PR-5 enable_x64 rules):
+
+* int aggregates are BIT-EXACT — int32 resident codes are
+  value-preserving, sums accumulate in int64 segment sums (wraparound
+  identical to the host's int64 accumulator);
+* float32/float64 values decode from their order-preserving resident
+  encodings (ops.floatbits — exact bit transforms, no rounding) and sum
+  in float64: equal to the host up to f64 summation order;
+* resident numeric columns are NULL-free by the residency refusal rules
+  (NaN data never encodes), so count(col) == count(*) per group exactly
+  like the host path sees on the same data;
+* string columns group and min/max through the table-GLOBAL sorted
+  vocab codes (order-preserving; NULL code -1 is its own group / skipped
+  by min/max/count like SQL requires).
+
+Shapes that cannot ride exactly DECLINE with a reason — multi-key or
+non-dense group keys, unresident columns, streaming-tier tables, string
+sums — and the caller routes the host hash-aggregate, counting
+``compile.agg.declined.<reason>`` (the PR-5 decline discipline).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..plan.aggregates import output_dtype
+from ..storage.columnar import Column, ColumnarBatch, numpy_dtype
+from ..telemetry.metrics import metrics
+
+# the same dense-domain rule as aggregate._dense: the executable
+# allocates span+1 segment slots, so a wide key domain over few rows
+# would cost far more than host hashing
+_SPAN_FLOOR = 1 << 16
+
+_AGG_FNS = ("sum", "count", "min", "max", "avg")
+
+
+@dataclass(frozen=True)
+class ScanAggCol:
+    """One aggregated value column: its resident encoding, plane arity,
+    and the sorted device ops ('max'/'min'/'nn'/'sum') it needs."""
+
+    name: str
+    enc: str  # 'int' | 'float32' | 'f64' | 'string'
+    arity: int  # device planes consumed (2 for f64)
+    ops: tuple
+
+
+@dataclass(frozen=True)
+class ScanAggPlan:
+    group: str
+    group_enc: str  # 'int' | 'string'
+    mn: int  # group-key offset (-1 for strings: NULL code shifts to 0)
+    span: int
+    cols: tuple  # ScanAggCol, deterministic order
+
+    def signature(self) -> tuple:
+        """Compile-cache key component — everything the traced fn's
+        STRUCTURE depends on (names are positional at trace time)."""
+        return (
+            self.span,
+            self.mn,
+            self.group_enc,
+            tuple((c.enc, c.arity, c.ops) for c in self.cols),
+        )
+
+
+def column_value_bounds(table, name: str) -> Optional[Tuple[int, int]]:
+    """(min, max) of an int-encoded resident column's VALUE space, from
+    whatever the build recorded: zone vectors (single-chip), the
+    explicit vmin/vmax fields (mesh shards carry no zones), or the pack
+    spec's frame as a conservative fallback. None = unknown (decline)."""
+    zones = getattr(table, "zones", None) or {}
+    z = zones.get(name)
+    if z is not None and z[0] == "value" and len(z[1]):
+        return int(z[1].min()), int(z[2].max())
+    col = table.columns[name]
+    vmin = getattr(col, "vmin", None)
+    vmax = getattr(col, "vmax", None)
+    if vmin is not None and vmax is not None:
+        return int(vmin), int(vmax)
+    pack = getattr(col, "pack", None)
+    if pack is not None:
+        return int(pack.ref0), int(pack.ref0) + (1 << pack.bits) - 1
+    return None
+
+
+def scan_agg_plan(table, group_by, aggs):
+    """(ScanAggPlan, "ok") or (None, decline reason) for (group_by,
+    aggs) over ``table``'s resident columns. Reasons mirror the PR-5
+    decline taxonomy: 'shape' (multi/zero-key grouping, projection
+    starvation belongs to the caller), 'column' (unresident), 'dtype'
+    (float group keys — their ordered codes are equality-preserving but
+    never dense — or string sums), 'span' (non-dense key domain),
+    'tier' (streaming tables keep the count-vector protocol)."""
+    if getattr(table, "tier", "resident") == "streaming":
+        return None, "tier"
+    if len(group_by) != 1:
+        return None, "shape"
+    g = group_by[0]
+    gcol = table.columns.get(g)
+    if gcol is None:
+        return None, "column"
+    if gcol.enc == "string":
+        mn = -1  # NULL code -1 shifts to slot 0 (its own SQL group)
+        span = len(gcol.vocab) + 1
+        n_rows = int(getattr(table, "n_rows", 0))
+        if span > max(4 * n_rows, _SPAN_FLOOR):
+            return None, "span"
+    elif gcol.enc == "int":
+        bounds = column_value_bounds(table, g)
+        if bounds is None:
+            return None, "dtype"
+        mn, mx = bounds
+        span = mx - mn + 1
+        n_rows = int(getattr(table, "n_rows", 0))
+        if span <= 0 or span > max(4 * n_rows, _SPAN_FLOOR):
+            return None, "span"
+    else:
+        return None, "dtype"
+    wants: Dict[str, set] = {}
+    for a in aggs:
+        if a.fn not in _AGG_FNS:
+            return None, "dtype"
+        if a.column is None:
+            continue  # count(*) rides the rows vector
+        pc = table.columns.get(a.column)
+        if pc is None:
+            return None, "column"
+        need = wants.setdefault(a.column, set())
+        if pc.enc == "string":
+            # strings: min/max/count over the order-preserving global
+            # codes; sum/avg decline (the host raises the same error)
+            if a.fn in ("sum", "avg"):
+                return None, "dtype"
+            need.add("nn")
+            if a.fn in ("min", "max"):
+                need.add(a.fn)
+        else:
+            # numeric resident columns are NULL-free by construction:
+            # count(col) == count(*) and avg divides by the rows vector
+            if a.fn in ("sum", "avg"):
+                need.add("sum")
+            elif a.fn in ("min", "max"):
+                need.add(a.fn)
+    cols = tuple(
+        ScanAggCol(
+            name,
+            table.columns[name].enc,
+            2 if table.columns[name].enc == "f64" else 1,
+            tuple(sorted(ops)),
+        )
+        for name, ops in sorted(wants.items())
+    )
+    return ScanAggPlan(g, gcol.enc, mn, span, cols), "ok"
+
+
+def plan_plane_names(plan: ScanAggPlan) -> tuple:
+    """The (possibly plane-suffixed) resident names the executable's
+    group/value operands ride — resident_arrays_for's name convention,
+    in (group, plan.cols) order."""
+    names = [plan.group]
+    for c in plan.cols:
+        if c.enc == "f64":
+            names.append(c.name + "\x00hi")
+            names.append(c.name + "\x00lo")
+        else:
+            names.append(c.name)
+    return tuple(names)
+
+
+# ---------------------------------------------------------------------------
+# traced core — shared by the single-chip jit and the mesh shard_fn
+# ---------------------------------------------------------------------------
+
+
+def _decode_value(jnp, jax, enc: str, planes: list):
+    """(values, valid-or-None) decoded from int32 resident planes inside
+    the executable — the exact inverses of the host encodings
+    (ops.floatbits transforms are bit-exact bijections)."""
+    top32 = jnp.int32(-(1 << 31))
+    if enc == "int":
+        return planes[0].astype(jnp.int64), None
+    if enc == "float32":
+        o = planes[0]
+        bits = jnp.where(o < 0, ~jnp.bitwise_xor(o, top32), o)
+        v = jax.lax.bitcast_convert_type(bits, jnp.float32).astype(
+            jnp.float64
+        )
+        return v, None
+    if enc == "f64":
+        hi, lo = planes
+        top64 = jnp.int64(-(1 << 63))
+        low_bits = jnp.bitwise_and(
+            jnp.bitwise_xor(lo, top32).astype(jnp.int64),
+            jnp.int64(0xFFFFFFFF),
+        )
+        o = jnp.bitwise_or(hi.astype(jnp.int64) << jnp.int64(32), low_bits)
+        bits = jnp.where(o < 0, ~jnp.bitwise_xor(o, top64), o)
+        return jax.lax.bitcast_convert_type(bits, jnp.float64), None
+    # string: global vocab codes, -1 = NULL
+    codes = planes[0].astype(jnp.int64)
+    return codes, codes >= 0
+
+
+def _core_scan_agg(jnp, jax, sig, mask, gvals, flats):
+    """The fused mask -> segment-aggregate body. ``mask`` is the
+    predicate mask AND'd with the real-row mask (pad rows excluded);
+    rows failing it route to a trash slot (span) that the finish drops —
+    unlike the count-vector protocol there is no host re-check, so the
+    executable itself must be exact. Returns (outputs, kinds): kinds[i]
+    in {'sum','min','max'} names the collective each partial needs under
+    a mesh (the _core_agg convention of exec.join_residency)."""
+    span, mn, group_enc, col_specs = sig
+    code = gvals.astype(jnp.int64) - jnp.int64(mn)
+    in_range = (code >= 0) & (code < span)
+    slot = jnp.where(mask & in_range, code, jnp.int64(span))
+
+    def seg_sum(x):
+        return jax.ops.segment_sum(x, slot, num_segments=span + 1)
+
+    ones = jnp.ones_like(slot)
+    outs = [seg_sum(ones)]  # rows per group (count(*))
+    kinds = ["sum"]
+    i = 0
+    for enc, arity, ops in col_specs:
+        v, valid = _decode_value(jnp, jax, enc, list(flats[i : i + arity]))
+        i += arity
+        for op in ops:
+            if op == "sum":
+                outs.append(seg_sum(v))
+                kinds.append("sum")
+            elif op == "nn":  # strings only: count non-NULL codes
+                outs.append(
+                    seg_sum(jnp.where(valid, jnp.int64(1), jnp.int64(0)))
+                )
+                kinds.append("sum")
+            elif op == "min":
+                big = (
+                    jnp.asarray(jnp.inf, v.dtype)
+                    if v.dtype == jnp.float64
+                    else jnp.asarray(jnp.iinfo(jnp.int64).max, v.dtype)
+                )
+                vv = v if valid is None else jnp.where(valid, v, big)
+                outs.append(
+                    jax.ops.segment_min(vv, slot, num_segments=span + 1)
+                )
+                kinds.append("min")
+            else:  # max
+                small = (
+                    jnp.asarray(-jnp.inf, v.dtype)
+                    if v.dtype == jnp.float64
+                    else jnp.asarray(jnp.iinfo(jnp.int64).min, v.dtype)
+                )
+                vv = v if valid is None else jnp.where(valid, v, small)
+                outs.append(
+                    jax.ops.segment_max(vv, slot, num_segments=span + 1)
+                )
+                kinds.append("max")
+    return outs, kinds
+
+
+def _fn_cache():
+    from .hbm_cache import BoundedFnCache
+
+    global _FNS_MEMO
+    if _FNS_MEMO is None:
+        _FNS_MEMO = BoundedFnCache(64)
+    return _FNS_MEMO
+
+
+_FNS_MEMO = None
+
+
+def scan_agg_fn(
+    structure: str,
+    mask_names: tuple,
+    expr,
+    union_names: tuple,
+    spec_map: tuple,
+    plan: ScanAggPlan,
+    n_pad: int,
+    n_rows: int,
+):
+    """Jitted (cols dict, literal vector) -> group-vector tuple for the
+    single-chip cache. Keyed on predicate STRUCTURE + plan signature +
+    shapes — literal values ride as traced int32 operands, so a
+    distinct-literal burst shares ONE compiled program (the
+    _batched_counts_fn discipline applied to the aggregate)."""
+    key = (
+        "sagg1",
+        structure,
+        mask_names,
+        union_names,
+        spec_map,
+        plan.signature(),
+        n_pad,
+        n_rows,
+    )
+    memo = _fn_cache()
+    fn = memo.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+
+    from .hbm_cache import _eval_with_literals, _flatten_operands
+
+    sig = (
+        plan.span,
+        plan.mn,
+        plan.group_enc,
+        tuple((c.enc, c.arity, c.ops) for c in plan.cols),
+    )
+    specs_by_name = dict(spec_map)
+    g_planes = plan_plane_names(plan)
+
+    def body(cols: dict, lits):
+        flat_all = _flatten_operands(
+            union_names,
+            [cols[n] for n in union_names],
+            tuple(specs_by_name.get(n) for n in union_names),
+        )
+        pred = _eval_with_literals(
+            expr, {n: flat_all[n] for n in mask_names}, lits, [0]
+        )
+        real = jnp.arange(n_pad, dtype=jnp.int64) < jnp.int64(n_rows)
+        flats = tuple(flat_all[n] for n in g_planes[1:])
+        outs, _ = _core_scan_agg(
+            jnp, jax, sig, pred & real, flat_all[g_planes[0]], flats
+        )
+        return tuple(outs)
+
+    fn = jax.jit(body)
+    memo.put(key, fn)
+    return fn
+
+
+def mesh_scan_agg_fn(
+    mesh,
+    structure: str,
+    mask_names: tuple,
+    expr,
+    union_names: tuple,
+    spec_map: tuple,
+    plan: ScanAggPlan,
+    cap: int,
+):
+    """Jitted shard_map twin: per-device partials over the full slot
+    space merged via psum/pmin/pmax into ONE replicated group table —
+    the two-phase distributed aggregate with zero shuffles
+    (mesh_join_agg_fn's collective pattern over the scan shape).
+    ``dev_rows`` rides as a sharded operand because shards hold
+    different real-row counts under one static cap."""
+    key = (
+        "saggM",
+        mesh,
+        structure,
+        mask_names,
+        union_names,
+        spec_map,
+        plan.signature(),
+        cap,
+    )
+    memo = _fn_cache()
+    fn = memo.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec
+
+    from ..utils.jaxcompat import shard_map
+    from .hbm_cache import _eval_with_literals, _flatten_operands
+
+    sig = (
+        plan.span,
+        plan.mn,
+        plan.group_enc,
+        tuple((c.enc, c.arity, c.ops) for c in plan.cols),
+    )
+    specs_by_name = dict(spec_map)
+    g_planes = plan_plane_names(plan)
+    axis = mesh.axis_names[0]
+
+    def shard_fn(cols: dict, lits, dev_rows):
+        flat_all = _flatten_operands(
+            tuple(cols),
+            [cols[n] for n in cols],
+            tuple(specs_by_name.get(n) for n in cols),
+        )
+        pred = _eval_with_literals(
+            expr, {n: flat_all[n] for n in mask_names}, lits, [0]
+        )
+        real = jnp.arange(cap, dtype=jnp.int64) < dev_rows.reshape(-1)[0]
+        flats = tuple(flat_all[n] for n in g_planes[1:])
+        outs, kinds = _core_scan_agg(
+            jnp, jax, sig, pred & real, flat_all[g_planes[0]], flats
+        )
+        merged = []
+        for o, kind in zip(outs, kinds):
+            if kind == "sum":
+                merged.append(jax.lax.psum(o, axis))
+            elif kind == "min":
+                merged.append(jax.lax.pmin(o, axis))
+            else:
+                merged.append(jax.lax.pmax(o, axis))
+        return tuple(merged)
+
+    col_spec = {name: PartitionSpec(axis, None) for name in union_names}
+    n_out = 1 + sum(len(c.ops) for c in plan.cols)
+    fn = jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(col_spec, PartitionSpec(), PartitionSpec(axis)),
+            out_specs=tuple(PartitionSpec() for _ in range(n_out)),
+            check_vma=False,
+        )
+    )
+    memo.put(key, fn)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# host finish — identical construction to hash_aggregate's output shapes
+# ---------------------------------------------------------------------------
+
+
+def finish_scan_agg(table, plan: ScanAggPlan, group_by, aggs, outs):
+    """Assemble the group table from the D2H'd span-sized vectors.
+    Groups with zero matching rows do not appear; output order is
+    ascending group key (hash_aggregate's first-occurrence order differs
+    — callers compare sorted, exactly like the join-agg finish)."""
+    rows = outs[0][: plan.span]
+    idx = 1
+    per_col: Dict[str, tuple] = {}
+    for c in plan.cols:
+        got = {}
+        for op in c.ops:
+            got[op] = outs[idx][: plan.span]
+            idx += 1
+        per_col[c.name] = (c, got)
+    keep = np.flatnonzero(rows > 0)
+    rows_kept = rows[keep].astype(np.int64)
+    g = group_by[0]
+    gcol = table.columns[g]
+    out: Dict[str, Column] = {}
+    if plan.group_enc == "string":
+        out[g] = Column(
+            gcol.dtype_str,
+            (keep + plan.mn).astype(np.int32),
+            gcol.vocab,
+        )
+    else:
+        out[g] = Column(
+            gcol.dtype_str,
+            (keep + plan.mn).astype(numpy_dtype(gcol.dtype_str)),
+        )
+    for a in aggs:
+        if a.column is None:
+            out[a.name] = Column("int64", rows_kept)
+            continue
+        c, got = per_col[a.column]
+        pc = table.columns[a.column]
+        dt = output_dtype(a, pc.dtype_str)
+        nn_k = (
+            got["nn"][keep].astype(np.int64)
+            if "nn" in got
+            else rows_kept
+        )
+        if a.fn == "count":
+            out[a.name] = Column("int64", nn_k)
+        elif a.fn == "avg":
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out[a.name] = Column(
+                    "float64", got["sum"][keep].astype(np.float64) / nn_k
+                )
+        elif a.fn == "sum":
+            s = got["sum"][keep].astype(numpy_dtype(dt))
+            if dt.startswith("float"):
+                # SQL NULL: sum of an all-NULL group is NULL (cannot
+                # occur for NULL-free resident numerics, kept for the
+                # construction parity with the host finish)
+                s = np.where(nn_k == 0, np.nan, s)
+            out[a.name] = Column(dt, s)
+        else:  # min / max
+            vals = got[a.fn][keep]
+            if c.enc == "string":
+                codes = np.where(nn_k == 0, -1, vals).astype(np.int32)
+                out[a.name] = Column(pc.dtype_str, codes, pc.vocab)
+            else:
+                if dt.startswith("float"):
+                    vals = np.where(nn_k == 0, np.nan, vals)
+                out[a.name] = Column(dt, vals.astype(numpy_dtype(dt)))
+    metrics.incr("aggregate.path.scan_fused")
+    return ColumnarBatch(out)

@@ -184,6 +184,23 @@ def explain_string(
                 f"Pipeline runs: {pipe_info.get('runs')}"
                 f" (fused dispatches: {pipe_info.get('fused_dispatches')})"
             )
+            # which engine the aggregate actually ran on, from the
+            # recorded trace's scoped counters (ONE source of truth):
+            # the device segment-agg paths fire their own path counters,
+            # anything else on an aggregating pipeline is the host hash
+            if pipe_info.get("kind") in ("agg_scan", "join_agg") and last:
+                c = last["counters"]
+                if c.get("scan.path.resident_agg") or c.get(
+                    "scan.path.resident_agg_mesh"
+                ):
+                    where = "device segment-sum"
+                elif c.get("scan.path.resident_join_agg") or c.get(
+                    "scan.path.resident_join_agg_mesh"
+                ):
+                    where = "device segment-sum (join region)"
+                else:
+                    where = "host hash"
+                buf.write_line(f"Aggregate ran: {where}")
             buf.write_line()
 
         # the last query's span tree: where ITS wall time went, stage by

@@ -76,6 +76,11 @@ class StreamColumn:
     planes: Dict[str, StreamPlane]  # '' single-plane; 'hi'/'lo' for f64
     nbytes: int  # host bytes (pinned planes + vocab heap)
     vocab: Optional[np.ndarray] = None
+    # int-encoded columns: value-space bounds over the real rows (the
+    # scan-aggregate planner's input on tables without zone vectors;
+    # streaming itself declines aggregation, so these are informational)
+    vmin: Optional[int] = None
+    vmax: Optional[int] = None
 
 
 @dataclass
@@ -221,27 +226,38 @@ def _upload_window(table, names, w):
     return cols, tuple(specs), nbytes
 
 
-def _windowed_counts(table: StreamingResidentTable, dispatch, union_names):
+def _windowed_counts(table, dispatch, union_names):
     """The double-buffered window loop shared by the single and batched
     entry points. ``dispatch(cols, specs)`` enqueues the window's jitted
     mask+count and returns the un-fetched device result; this loop owns
     the overlap, the prefetch-hit/stall accounting and the generation
     bump on device failure. Returns the per-window numpy results in
     window order."""
+    return _run_window_loop(
+        table, lambda w: _upload_window(table, union_names, w), dispatch
+    )
+
+
+def _run_window_loop(table, upload, dispatch):
+    """The tier's one pipeline loop, shared by the single-chip and mesh
+    tables: ``upload(w)`` stages window ``w``'s operand slices into the
+    free slab slot (single-chip: one HBM pair; mesh: one pair PER
+    DEVICE, the upload device_put'ing (D, window) slices under the shard
+    sharding) and returns (cols, specs, bytes)."""
     import jax
 
-    out = []
+    out: list = []
     slots: list = [None, None]
     with table._stream_lock:
         return _windowed_counts_locked(
-            table, dispatch, union_names, jax, out, slots
+            table, upload, dispatch, jax, out, slots
         )
 
 
-def _windowed_counts_locked(table, dispatch, union_names, jax, out, slots):
+def _windowed_counts_locked(table, upload, dispatch, jax, out, slots):
     try:
         t0 = time.perf_counter()
-        slots[0] = _upload_window(table, union_names, 0)
+        slots[0] = upload(0)
         metrics.record_time(
             "residency.stream.h2d", time.perf_counter() - t0
         )
@@ -264,9 +280,7 @@ def _windowed_counts_locked(table, dispatch, union_names, jax, out, slots):
             pending = dispatch(cols, specs)  # enqueue compute, no fetch
             if w + 1 < table.n_windows:
                 t0 = time.perf_counter()
-                slots[(w + 1) % 2] = _upload_window(
-                    table, union_names, w + 1
-                )
+                slots[(w + 1) % 2] = upload(w + 1)
                 metrics.record_time(
                     "residency.stream.h2d", time.perf_counter() - t0
                 )
@@ -375,3 +389,263 @@ def stream_block_counts_batch(
     _trace_bytes("d2h_bytes", int(counts.nbytes))
     n_blocks = -(-table.n_rows // BLOCK_ROWS)
     return counts[:, :n_blocks]
+
+
+# ---------------------------------------------------------------------------
+# the MESH streaming rung: host-pinned shard matrices, a slab pair per
+# device — the compressed-streaming tier the mesh ladder declined until
+# now. Window w stages the (D, W) column slices under the mesh sharding
+# (one device_put lands every shard's slab), the shard_map mask+count
+# runs per device, and only (D, W // block) count partials come home.
+# The budget charge is the PER-DEVICE slab pair times D — two windows of
+# operand bytes across the mesh, regardless of table size.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MeshStreamingResidentTable:
+    """A MeshResidentTable stand-in at the streaming tier: same
+    identity, coverage, segments and block geometry (collect_parts and
+    the registry serve it unchanged), but its planes are host-pinned
+    (D, padded-cap) matrices and the budget charge is the slab pair."""
+
+    tier = "streaming"
+
+    key: tuple
+    mesh: object
+    n_devices: int
+    cap: int  # per-device rows padded to the window multiple
+    block: int
+    dev_rows: List[int]
+    segments: List[List]  # per device, dev_off-ascending (mesh_cache)
+    columns: Dict[str, StreamColumn]  # planes hold (D, ...) matrices
+    n_rows: int
+    n_pad: int  # == n_devices * cap (total padded rows)
+    window_rows: int
+    n_windows: int
+    nbytes: int  # budget-charged: 2 windows of operand bytes (all shards)
+    host_bytes: int
+    raw_nbytes: int
+    window_gen: int = 0
+    last_used: float = field(default_factory=time.monotonic)
+    _stream_lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False
+    )
+
+    @property
+    def n_blocks(self) -> int:
+        return self.cap // self.block
+
+
+def build_mesh_streaming_table(
+    key: tuple,
+    mesh,
+    dev_segs,
+    dev_rows,
+    n_rows: int,
+    host_mats: dict,
+    specs: Dict[str, PackSpec],
+    window_rows: int,
+    col_bounds: Optional[dict] = None,
+) -> MeshStreamingResidentTable:
+    """Assemble the mesh streaming table from the mesh build's host
+    (D, cap) matrices. ``host_mats`` maps column name -> (dtype_str,
+    enc, vocab, {plane_key: (D, cap) int32 matrix}); ``specs`` carries
+    the adopted PackSpec per packable column (global frame, one static
+    spec serves every shard — the mesh compressed rule)."""
+    D = int(mesh.devices.size)
+    some = next(iter(host_mats.values()))
+    cap_in = next(iter(some[3].values())).shape[1]
+    W = window_pad_rows(window_rows)
+    cap = -(-cap_in // W) * W
+    n_windows = cap // W
+    columns: Dict[str, StreamColumn] = {}
+    host_bytes = 0
+    raw_bytes = 0
+    window_operand_bytes = 0
+    for name, (dtype_str, enc, vocab, planes) in host_mats.items():
+        sp: Dict[str, StreamPlane] = {}
+        vocab_heap = vocab_heap_bytes(vocab)
+        col_bytes = vocab_heap
+        for pkey, mat in planes.items():
+            raw_bytes += D * cap * 4
+            spec = specs.get(name) if pkey == "" else None
+            if spec is not None:
+                # pad rows re-encode at the frame reference (zero pads
+                # may sit outside the frame for offset domains — the
+                # mesh compressed rule); ref0 pads are in-range garbage
+                # the host leg clips
+                wspec = dataclasses.replace(spec, n=cap)
+                padded = np.full((D, cap), wspec.ref0, dtype=np.int64)
+                for d in range(D):
+                    padded[d, : dev_rows[d]] = mat[d, : dev_rows[d]]
+                words = np.stack(
+                    [pack_plain(padded[d], wspec) for d in range(D)]
+                )
+                sp[pkey] = StreamPlane(words, wspec)
+                col_bytes += words.nbytes
+                window_operand_bytes += 4 * D * (W // wspec.vpw)
+            else:
+                padded32 = np.zeros((D, cap), dtype=np.int32)
+                padded32[:, :cap_in] = mat
+                sp[pkey] = StreamPlane(padded32, None)
+                col_bytes += padded32.nbytes
+                window_operand_bytes += 4 * D * W
+        bounds = (col_bounds or {}).get(name, (None, None))
+        columns[name] = StreamColumn(
+            dtype_str, enc, sp, col_bytes, vocab, bounds[0], bounds[1]
+        )
+        host_bytes += col_bytes
+    return MeshStreamingResidentTable(
+        key,
+        mesh,
+        D,
+        cap,
+        min(8192, cap),
+        list(dev_rows),
+        dev_segs,
+        columns,
+        n_rows,
+        D * cap,
+        W,
+        n_windows,
+        2 * window_operand_bytes
+        + sum(vocab_heap_bytes(c.vocab) for c in columns.values()),
+        host_bytes,
+        raw_bytes,
+    )
+
+
+def _mesh_upload_window(table: MeshStreamingResidentTable, names, w: int):
+    """device_put one window's (D, slice) operand matrices under the
+    mesh sharding — ONE put per column lands every shard's slab slot."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(
+        table.mesh, PartitionSpec(table.mesh.axis_names[0], None)
+    )
+    W = table.window_rows
+    cols = {}
+    specs = []
+    nbytes = 0
+    for n in names:
+        plane = _resolve_plane(table, n)
+        if plane.spec is None:
+            sl = plane.data[:, w * W : (w + 1) * W]
+            wspec = None
+        else:
+            vpw = plane.spec.vpw
+            sl = plane.data[:, w * W // vpw : (w + 1) * W // vpw]
+            wspec = dataclasses.replace(plane.spec, n=W)
+        cols[n] = jax.device_put(np.ascontiguousarray(sl), sharding)
+        specs.append(wspec)
+        nbytes += int(sl.nbytes)
+    return cols, tuple(specs), nbytes
+
+
+def mesh_stream_block_counts(table: MeshStreamingResidentTable, predicate):
+    """(D, n_blocks) match counts over the streamed mesh shards — the
+    streaming twin of MeshHbmCache.block_counts. None when the
+    predicate cannot ride the resident encodings; device errors
+    propagate (caller drops + degrades)."""
+    from ..exec.hbm_cache import prepare_resident_predicate
+    from ..exec.mesh_cache import _mesh_counts_fn
+    from ..ops import kernels as K
+
+    prepared = prepare_resident_predicate(table.columns, predicate)
+    if prepared is None:
+        return None
+    narrowed, names = prepared
+    t0 = time.perf_counter()
+
+    def dispatch(cols, specs):
+        fn = _mesh_counts_fn(
+            table.mesh,
+            repr(narrowed),
+            narrowed,
+            names,
+            table.window_rows,
+            table.block,
+            specs,
+        )
+        with K._x32():
+            return fn(cols)
+
+    parts = _run_window_loop(
+        table, lambda w: _mesh_upload_window(table, names, w), dispatch
+    )
+    metrics.record_time(
+        "scan.resident_mesh.device", time.perf_counter() - t0
+    )
+    counts = np.concatenate(parts, axis=1)
+    metrics.incr("scan.resident_mesh.d2h_bytes", int(counts.nbytes))
+    _trace_bytes("d2h_bytes", int(counts.nbytes))
+    return counts
+
+
+def mesh_stream_block_counts_batch(
+    table: MeshStreamingResidentTable,
+    predicates,
+    prepared=None,
+    metric_ns: str = "serve.batch",
+):
+    """Per-predicate (D, n_blocks) counts for N compatible predicates,
+    every window dispatched ONCE for the whole batch — the mesh
+    streaming leg of the serve micro-batcher and (N=1) the compiled
+    mesh scan pipeline. None when any predicate fails to narrow."""
+    from ..exec.hbm_cache import (
+        _expr_literals,
+        _expr_structure,
+        prepare_resident_predicate,
+    )
+    from ..exec.mesh_cache import _mesh_batched_counts_fn
+    from ..ops import kernels as K
+
+    if prepared is None:
+        prepared = [
+            prepare_resident_predicate(table.columns, p) for p in predicates
+        ]
+    if any(p is None for p in prepared):
+        return None
+    structures = tuple(_expr_structure(n) for n, _ in prepared)
+    slot_names = tuple(names for _, names in prepared)
+    exprs = [n for n, _ in prepared]
+    union_names = tuple(
+        dict.fromkeys(n for names in slot_names for n in names)
+    )
+    lit_vecs = []
+    for narrowed, _ in prepared:
+        vals: list = []
+        _expr_literals(narrowed, vals)
+        lit_vecs.append(np.asarray(vals, dtype=np.int32))
+    lit_vecs = tuple(lit_vecs)
+    t0 = time.perf_counter()
+
+    def dispatch(cols, specs):
+        spec_map = tuple(zip(union_names, specs))
+        fn = _mesh_batched_counts_fn(
+            table.mesh,
+            structures,
+            slot_names,
+            exprs,
+            table.window_rows,
+            table.block,
+            spec_map,
+        )
+        with K._x32():
+            return fn(cols, lit_vecs)
+
+    parts = _run_window_loop(
+        table,
+        lambda w: _mesh_upload_window(table, union_names, w),
+        dispatch,
+    )
+    metrics.record_time(f"{metric_ns}.mesh_device", time.perf_counter() - t0)
+    metrics.incr(f"{metric_ns}.dispatches")
+    metrics.incr(f"{metric_ns}.queries", len(predicates))
+    # per-window (D, N, W // block) -> (D, N, blocks) -> predicate-major
+    counts = np.concatenate(parts, axis=2)
+    metrics.incr("scan.resident_mesh.d2h_bytes", int(counts.nbytes))
+    _trace_bytes("d2h_bytes", int(counts.nbytes))
+    return np.swapaxes(counts, 0, 1)

@@ -140,13 +140,20 @@ def classify(session, plan: LogicalPlan) -> Optional[ResidentScanRequest]:
         prepared = prepare_resident_predicate(table.columns, predicate)
         if prepared is None:
             return None
+        # mesh streaming tables batch only within a WINDOW GENERATION —
+        # the single-chip rule below, now that the mesh ladder accepts
+        # the compressed-streaming rung
+        gen = getattr(table, "window_gen", None)
+        batch_key = (fp, id(table), frozenset(prepared[1])) + (
+            (gen,) if gen is not None else ()
+        )
         return ResidentScanRequest(
             table,
             entry,
             files,
             predicate,
             output_columns,
-            (fp, id(table), frozenset(prepared[1])),
+            batch_key,
             mesh,
             prepared,
         )

@@ -43,13 +43,21 @@ SCALE_WORKDIR (.bench_scale_workspace), SCALE_KEEP=1 keeps the workspace
 (generated source data is reused across runs automatically when present),
 SCALE_FINALIZE (runs|merge), SCALE_COMPARE_MERGE (1|0),
 SCALE_PRUNE_OLD_VERSIONS=1 removes version dirs unreferenced by the
-latest entry after optimize (disk headroom for SF100), --out FILE writes
-the JSON artifact to a custom path.
+latest entry after optimize (disk headroom for SF100),
+SCALE_COMPILE (on|off — "off" pins hyperspace.compile.mode=off so the
+rerun records whole-plan compilation ON vs per-operator interpretation;
+the artifact carries which mode ran),
+SCALE_HBM (off|auto|force — "force" switches the residency ladder ON for
+the q3/q17 phase after an explicit, separately-timed prefetch; the build
+and filter phases always run residency-off so background population
+never skews a timed query), --out FILE writes the JSON artifact to a
+custom path.
 
 Run:  PYTHONPATH=/root/repo:/root/.axon_site python scripts/bench_scale.py --write
 SF100: SCALE_ROWS=600000000 SCALE_REPEATS=1 SCALE_COMPARE_MERGE=0 \
        SCALE_PRUNE_OLD_VERSIONS=1 SCALE_WORKDIR=/root/.bench_sf100 \
-       python scripts/bench_scale.py --write --out BENCH_SCALE_SF100.json
+       SCALE_HBM=force python scripts/bench_scale.py --write \
+       --out BENCH_SCALE_SF100.json
 """
 
 from __future__ import annotations
@@ -217,11 +225,17 @@ def main() -> None:
     from hyperspace_tpu.session import HyperspaceSession
     from hyperspace_tpu.telemetry.metrics import build_pipeline_snapshot, metrics
 
-    # this artifact measures the runs-layout + host engine paths; HBM
+    # build + filter phases always run residency-off: HBM
     # auto-population would upload hundreds of MB on daemon threads
     # DURING timed queries and silently flip repeats to the resident
-    # path mid-measurement (the resident story is bench.py's config 9)
+    # path mid-measurement (the resident story is bench.py's config 9).
+    # SCALE_HBM != off re-enables the ladder for the q3/q17 phase below,
+    # behind an explicit synchronous prefetch timed as its own phase.
+    scale_hbm = os.environ.get("SCALE_HBM", "off").lower()
+    if scale_hbm not in ("off", "auto", "force"):
+        scale_hbm = "off"
     os.environ["HYPERSPACE_TPU_HBM"] = "off"
+    scale_compile = os.environ.get("SCALE_COMPILE", "on").lower()
 
     n_orders = max(N_ROWS // 4, 2)
     gen_s = _ensure_data(N_ROWS, n_orders)
@@ -240,6 +254,13 @@ def main() -> None:
             # SCALE_PIPELINE=off reproduces the pre-pipeline serial build
             C.BUILD_PIPELINE: os.environ.get(
                 "SCALE_PIPELINE", C.BUILD_PIPELINE_DEFAULT
+            ),
+            # SCALE_COMPILE=off reproduces per-operator interpretation
+            # (the pre-PR-10 engine); default rides whole-plan pipelines
+            **(
+                {C.COMPILE_MODE: C.COMPILE_MODE_OFF}
+                if scale_compile == "off"
+                else {}
             ),
         }
     )
@@ -416,6 +437,29 @@ def main() -> None:
         filter_index_s=round(on_s, 4),
         filter_external_s=round(ext2_s, 3),
     )
+
+    # ---- residency ladder ON (SCALE_HBM): explicit, timed prefetch ---------
+    # the q3/q17 phases then serve from whatever rung the ladder admits
+    # (resident/compressed/streaming), with the selectivity zone gate
+    # still free to route host — the artifact records the snapshot and
+    # the traces carry per-query tier attribution either way
+    if scale_hbm != "off":
+        os.environ["HYPERSPACE_TPU_HBM"] = scale_hbm
+        from hyperspace_tpu.exec.hbm_cache import hbm_cache
+
+        residency_prefetch = {}
+        for idx_name, cols in (
+            ("li_q3_idx", ["l_quantity"]),
+            ("or_idx", ["o_totalprice"]),
+        ):
+            t0 = time.perf_counter()
+            ok = hs.prefetch_index(idx_name, cols)
+            residency_prefetch[idx_name] = {
+                "ok": bool(ok),
+                "s": round(time.perf_counter() - t0, 2),
+            }
+        extras["residency_prefetch"] = residency_prefetch
+        extras["residency"] = hbm_cache.snapshot_residency()
 
     # ---- Q3-shaped filtered join -------------------------------------------
     qty_cut, price_cut = 45, 40_000.0
@@ -671,6 +715,14 @@ def main() -> None:
         "gen_s": round(gen_s, 1),
         "rss_after_gen_gb": rss_after_gen,
         "host_cores": os.cpu_count(),
+        # the rerun levers (ISSUE 12): whole-plan compilation, the
+        # residency ladder, and the build pipeline all record which mode
+        # actually ran so artifacts across PRs compare like-for-like
+        "scale_compile": scale_compile,
+        "scale_hbm": scale_hbm,
+        "scale_pipeline": os.environ.get(
+            "SCALE_PIPELINE", C.BUILD_PIPELINE_DEFAULT
+        ),
         **build,
         **{f"speedup_{k}": round(v, 2) for k, v in speed.items()},
         **{f"ext_speedup_{k}": round(v, 2) for k, v in ext_speed.items()},

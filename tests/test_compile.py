@@ -232,6 +232,60 @@ def test_hybrid_pipeline_parity_after_append(tmp_path):
         assert metrics.counter("compile.fused.dispatches") >= 1
 
 
+def test_hybrid_burst_shares_one_executable_compile_flat(tmp_path):
+    """Tentpole acceptance: a fresh-literal hybrid serving burst shares
+    ONE compiled executable (the structure-keyed batched entry, N=1) —
+    one lowering, at most one new hybrid fn, every dispatch fused."""
+    from hyperspace_tpu.exec.hbm_cache import _hybrid_fns
+
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.INDEX_NUM_BUCKETS: 4,
+            C.INDEX_HYBRID_SCAN_ENABLED: True,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    src = tmp_path / "data"
+    src.mkdir()
+    batch = _source(20_000, seed=9)
+    parquet_io.write_parquet(src / "p0.parquet", batch)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("hb", ["k"], ["v"])
+    )
+    parquet_io.write_parquet(src / "p1-append.parquet", _source(900, seed=10))
+    session.enable_hyperspace()
+
+    keys = [int(batch.columns["k"].data[i * 731]) for i in range(10)]
+
+    def q(k):
+        return (
+            session.read.parquet(str(src))
+            .filter(col("k") == lit(int(k)))
+            .select("k", "v")
+        )
+
+    q(keys[0]).collect()  # schedules base+delta population
+    hbm_cache.wait_background(timeout_s=30.0)
+    expected = _with_compile_off(
+        session, lambda: [q(k).collect() for k in keys]
+    )
+    pipeline_cache.reset()
+    metrics.reset()
+    fns_before = len(_hybrid_fns._fns)
+    got = [q(k).collect() for k in keys]
+    for e, g in zip(expected, got):
+        assert_row_parity(e, g)
+    snap = metrics.snapshot()["counters"]
+    # one STRUCTURE -> one lowering; the whole distinct-literal burst
+    # rides ONE structure-keyed executable (vs one per literal before)
+    assert snap.get("compile.lowered") == 1
+    assert snap.get("scan.path.resident_hybrid") == len(keys)
+    assert snap.get("compile.fused.dispatches") == len(keys)
+    assert len(_hybrid_fns._fns) - fns_before <= 1
+
+
 # ---------------------------------------------------------------------------
 # join-aggregate pipelines + either-side invalidation
 # ---------------------------------------------------------------------------

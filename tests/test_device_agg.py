@@ -1,0 +1,523 @@
+"""Device single-table aggregation (exec.scan_agg) + mesh pipeline
+lowering: parity vs the host hash-aggregate across sum/count/min/max/avg
+(int bit-exactness, float tolerance, string vocab-order min/max,
+NaN/-0.0 group-key edge cases through the decline discipline), the
+compile.agg.declined.<reason> counter family, mesh scan/agg_scan
+lowering parity vs the interpreter, and device loss mid-device-agg
+(host latch + surgical pipeline eviction).
+
+Parity discipline: every compiled execution is compared against the
+SAME query with ``hyperspace.compile.mode=off`` — device aggregation
+must be invisible in results, visible only in counters.
+"""
+
+import numpy as np
+import numpy.testing as npt
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.compile.cache import pipeline_cache
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exec.hbm_cache import HbmIndexCache, hbm_cache
+from hyperspace_tpu.exec.mesh_cache import mesh_cache
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.aggregates import (
+    agg_avg,
+    agg_count,
+    agg_max,
+    agg_min,
+    agg_sum,
+)
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def _force_residency(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MAX_BLOCK_FRAC", "1.0")
+    hbm_cache.reset()
+    mesh_cache.reset()
+    pipeline_cache.reset()
+    yield
+    hbm_cache.reset()
+    mesh_cache.reset()
+    pipeline_cache.reset()
+
+
+N_ROWS = 40_000
+
+
+def _source(n=N_ROWS, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 10_000, n).astype(np.int64),
+            # negative ints + magnitudes that overflow int32 SUMS: a
+            # segment sum accumulated in 32 bits would corrupt these
+            "v": rng.integers(-(1 << 30), 1 << 30, n).astype(np.int64),
+            "g": rng.integers(0, 40, n).astype(np.int64),
+            "f": rng.uniform(-5.0, 5.0, n).astype(np.float32),
+            "d": np.round(rng.uniform(0.0, 100.0, n), 3),
+            "s": rng.choice([b"aa", b"bb", b"cc", b"dd"], n).astype(object),
+        },
+        {
+            "k": "int64",
+            "v": "int64",
+            "g": "int64",
+            "f": "float32",
+            "d": "float64",
+            "s": "string",
+        },
+    )
+
+
+def _env(tmp_path, batch, included):
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "p0.parquet", batch)
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"), C.INDEX_NUM_BUCKETS: 4}
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("aidx", ["k"], included)
+    )
+    session.enable_hyperspace()
+    return session, hs, src
+
+
+def _with_compile_off(session, fn):
+    session.conf.set(C.COMPILE_MODE, C.COMPILE_MODE_OFF)
+    try:
+        return fn()
+    finally:
+        session.conf.unset(C.COMPILE_MODE)
+
+
+def _sorted_rows(b, cols):
+    return sorted(zip(*[b.columns[c].data.tolist() for c in cols]))
+
+
+def _assert_group_parity(off, on, int_cols, float_cols, key):
+    """Exact parity on the int columns, f64-tolerance on the float ones
+    — the PR-5 enable_x64 exactness contract applied to scan agg."""
+    assert off.num_rows == on.num_rows
+    assert _sorted_rows(off, [key] + int_cols) == _sorted_rows(
+        on, [key] + int_cols
+    )
+    ko = np.argsort(off.columns[key].data, kind="stable")
+    kn = np.argsort(on.columns[key].data, kind="stable")
+    for c in float_cols:
+        npt.assert_allclose(
+            off.columns[c].data[ko],
+            on.columns[c].data[kn],
+            rtol=1e-9,
+            equal_nan=True,
+        )
+
+
+# ---------------------------------------------------------------------------
+# single-chip device aggregation
+# ---------------------------------------------------------------------------
+def test_device_agg_parity_all_fns_and_int64_exactness(tmp_path):
+    batch = _source()
+    session, hs, src = _env(tmp_path, batch, ["v", "g", "f", "d", "s"])
+    assert hs.prefetch_index("aidx", ["k", "v", "g", "f", "d", "s"])
+
+    def q():
+        return (
+            session.read.parquet(str(src))
+            .filter(col("k") >= lit(3000))
+            .group_by("g")
+            .agg(
+                agg_sum("v", "sv"),
+                agg_count(),
+                agg_count("f", "cf"),
+                agg_min("v", "mv"),
+                agg_max("v", "xv"),
+                agg_min("f", "mf"),
+                agg_max("d", "xd"),
+                agg_avg("d", "ad"),
+                agg_min("s", "ms"),
+                agg_max("s", "xs"),
+            )
+        )
+
+    off = _with_compile_off(session, lambda: q().collect())
+    metrics.reset()
+    with metrics.scoped() as qm:
+        on = q().collect()
+    # exact int sums: per-group |sum| can exceed 2^31 — a 32-bit segment
+    # accumulator (no enable_x64) would corrupt them
+    _assert_group_parity(
+        off,
+        on,
+        ["sv", "count", "cf", "mv", "xv"],
+        ["mf", "xd", "ad"],
+        "g",
+    )
+    # string min/max resolve through the vocab identically
+    assert _sorted_rows(off, ["g", "ms", "xs"]) == _sorted_rows(
+        on, ["g", "ms", "xs"]
+    )
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("scan.path.resident_agg") == 1
+    assert snap.get("compile.agg.device") == 1
+    # the WHOLE pipeline shipped ONE fused dispatch (== one D2H): the
+    # finished group table, no candidate blocks
+    assert qm.snapshot()["counters"].get("compile.fused.dispatches") == 1
+    assert not any(k.startswith("compile.agg.declined") for k in snap)
+
+
+def test_device_agg_string_group_key_and_null_group(tmp_path):
+    from hyperspace_tpu.storage.columnar import Column
+
+    rng = np.random.default_rng(4)
+    n = 20_000
+    svals = [
+        [b"x", b"y", b"zz", None][i] for i in rng.integers(0, 4, n)
+    ]
+    batch = ColumnarBatch(
+        {
+            "k": Column.from_values(
+                rng.integers(0, 5000, n).astype(np.int64)
+            ),
+            "v": Column.from_values(
+                rng.integers(0, 100, n).astype(np.int64)
+            ),
+            "s": Column.from_optional_values(svals),
+        }
+    )
+    session, hs, src = _env(tmp_path, batch, ["v", "s"])
+    assert hs.prefetch_index("aidx", ["k", "v", "s"])
+
+    def q():
+        return (
+            session.read.parquet(str(src))
+            .filter(col("k") >= lit(1000))
+            .group_by("s")
+            .agg(agg_sum("v", "sv"), agg_count(), agg_count("s", "cs"))
+        )
+
+    off = _with_compile_off(session, lambda: q().collect())
+    metrics.reset()
+    on = q().collect()
+    assert metrics.counter("scan.path.resident_agg") == 1
+    # NULL string keys form their own group on both paths; count(s) of
+    # the NULL group is 0 per SQL
+    o = sorted(
+        zip(
+            [x for x in off.to_pandas()["s"]],
+            off.columns["sv"].data.tolist(),
+            off.columns["count"].data.tolist(),
+            off.columns["cs"].data.tolist(),
+        ),
+        key=repr,
+    )
+    nn = sorted(
+        zip(
+            [x for x in on.to_pandas()["s"]],
+            on.columns["sv"].data.tolist(),
+            on.columns["count"].data.tolist(),
+            on.columns["cs"].data.tolist(),
+        ),
+        key=repr,
+    )
+    assert o == nn
+
+
+def test_float_group_keys_decline_to_host_with_parity(tmp_path):
+    """NaN/-0.0 group keys: NaN data refuses residency for the column
+    (no table covers it) and float keys decline the dense-key planner —
+    both route the EXACT host hash-aggregate, counted, with the host's
+    canonicalization (one NaN group; -0.0 == +0.0) intact."""
+    rng = np.random.default_rng(5)
+    n = 8_000
+    f = rng.uniform(-1, 1, n).astype(np.float32)
+    f[::7] = np.float32(0.0)
+    f[1::7] = np.float32(-0.0)  # must collapse into ONE group with +0.0
+    fn = f.copy()
+    fn[2::11] = np.nan  # NaN keys: one canonical NaN group
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 2000, n).astype(np.int64),
+            "v": rng.integers(0, 100, n).astype(np.int64),
+            "fz": f,
+            "fn": fn,
+        },
+        {"k": "int64", "v": "int64", "fz": "float32", "fn": "float32"},
+    )
+    session, hs, src = _env(tmp_path, batch, ["v", "fz", "fn"])
+    # fn carries NaN -> its column refuses residency; fz encodes fine
+    hs.prefetch_index("aidx", ["k", "v", "fz"])
+
+    for key, reason in (("fz", "dtype"), ("fn", "no_table")):
+
+        def q():
+            return (
+                session.read.parquet(str(src))
+                .filter(col("k") >= lit(500))
+                .group_by(key)
+                .agg(agg_sum("v", "sv"), agg_count())
+            )
+
+        off = _with_compile_off(session, lambda: q().collect())
+        metrics.reset()
+        on = q().collect()
+        assert metrics.counter(f"compile.agg.declined.{reason}") == 1
+        assert metrics.counter("scan.path.resident_agg") == 0
+        assert off.num_rows == on.num_rows
+        ko = np.lexsort((off.columns["sv"].data, off.columns[key].data))
+        kn = np.lexsort((on.columns["sv"].data, on.columns[key].data))
+        npt.assert_array_equal(
+            off.columns["sv"].data[ko], on.columns["sv"].data[kn]
+        )
+        npt.assert_allclose(
+            off.columns[key].data[ko],
+            on.columns[key].data[kn],
+            equal_nan=True,
+        )
+
+
+def test_device_agg_declines_counted_not_silent(tmp_path):
+    batch = _source(8_000, seed=6)
+    session, hs, src = _env(tmp_path, batch, ["v", "g", "s"])
+    assert hs.prefetch_index("aidx", ["k", "v", "g", "s"])
+
+    # multi-key grouping: 'shape' decline, host tail serves exactly
+    def q_multi():
+        return (
+            session.read.parquet(str(src))
+            .filter(col("k") >= lit(100))
+            .group_by("g", "v")
+            .agg(agg_count())
+        )
+
+    off = _with_compile_off(session, lambda: q_multi().collect())
+    metrics.reset()
+    on = q_multi().collect()
+    assert metrics.counter("compile.agg.declined.shape") == 1
+    assert _sorted_rows(off, ["g", "v", "count"]) == _sorted_rows(
+        on, ["g", "v", "count"]
+    )
+
+    # string sum: 'dtype' decline, both paths raise identically
+    def q_ssum():
+        return (
+            session.read.parquet(str(src))
+            .filter(col("k") >= lit(100))
+            .group_by("g")
+            .agg(agg_sum("s", "ss"))
+        )
+
+    from hyperspace_tpu.exceptions import HyperspaceException
+
+    metrics.reset()
+    with pytest.raises(HyperspaceException):
+        q_ssum().collect()
+    assert metrics.counter("compile.agg.declined.dtype") == 1
+
+
+def test_agg_burst_shares_one_executable_compile_flat(tmp_path):
+    """The structure-keyed aggregate: a distinct-literal agg burst keeps
+    the compile count flat AND shares one traced executable."""
+    from hyperspace_tpu.exec import scan_agg as SA
+
+    batch = _source()
+    session, hs, src = _env(tmp_path, batch, ["v", "g"])
+    assert hs.prefetch_index("aidx", ["k", "v", "g"])
+    keys = [int(batch.columns["k"].data[i * 997]) for i in range(8)]
+
+    def q(k):
+        return (
+            session.read.parquet(str(src))
+            .filter((col("k") >= lit(k)) & (col("k") <= lit(k + 500)))
+            .group_by("g")
+            .agg(agg_sum("v", "sv"), agg_count())
+        )
+
+    expected = _with_compile_off(
+        session, lambda: [q(k).collect() for k in keys]
+    )
+    pipeline_cache.reset()
+    metrics.reset()
+    q(keys[0]).collect()  # warm: lower + trace
+    fns_before = len(SA._fn_cache()._fns)
+    lowered_warm = metrics.counter("compile.lowered")
+    got = [q(k).collect() for k in keys]
+    for e, g in zip(expected, got):
+        _assert_group_parity(e, g, ["sv", "count"], [], "g")
+    assert metrics.counter("compile.lowered") == lowered_warm
+    assert len(SA._fn_cache()._fns) == fns_before  # ONE executable
+    assert metrics.counter("scan.path.resident_agg") == len(keys) + 1
+
+
+def test_device_loss_mid_agg_latches_host_and_evicts_pipeline(
+    tmp_path, monkeypatch
+):
+    batch = _source()
+    session, hs, src = _env(tmp_path, batch, ["v", "g"])
+    assert hs.prefetch_index("aidx", ["k", "v", "g"])
+
+    def q():
+        return (
+            session.read.parquet(str(src))
+            .filter(col("k") >= lit(2000))
+            .group_by("g")
+            .agg(agg_sum("v", "sv"), agg_count())
+        )
+
+    expected = _with_compile_off(session, lambda: q().collect())
+    q().collect()  # cache the agg_scan pipeline
+    assert pipeline_cache.snapshot()["kinds"].get("agg_scan") == 1
+
+    real = HbmIndexCache.agg_scan
+    boom = {"armed": True}
+
+    def dying(self, table, predicate, group_by, aggs):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("device lost mid-agg-dispatch")
+        return real(self, table, predicate, group_by, aggs)
+
+    monkeypatch.setattr(HbmIndexCache, "agg_scan", dying)
+    before_drop = metrics.counter("compile.pipeline.dropped_on_device_loss")
+    out = q().collect()  # latches host, stays exact
+    _assert_group_parity(expected, out, ["sv", "count"], [], "g")
+    assert metrics.counter("compile.agg.declined.device") >= 1
+    assert metrics.counter("scan.resident.device_failed") >= 1
+    # ONLY the dispatching pipeline's entry dropped
+    assert (
+        metrics.counter("compile.pipeline.dropped_on_device_loss")
+        == before_drop + 1
+    )
+    assert pipeline_cache.snapshot()["kinds"].get("agg_scan") is None
+    # the table was dropped with the device: the re-lowered pipeline
+    # declines (no_table) and keeps serving host-side, exactly
+    out2 = q().collect()
+    _assert_group_parity(expected, out2, ["sv", "count"], [], "g")
+
+
+def test_device_agg_over_compressed_planes(tmp_path, monkeypatch):
+    """The compressed tier's in-executable decode feeds the segment
+    reductions: packed group/value planes aggregate with exact parity
+    (the _flatten_operands fusion, never a host round trip)."""
+    rng = np.random.default_rng(11)
+    n = 30_000
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 2000, n).astype(np.int64),
+            "v": rng.integers(0, 50, n).astype(np.int64),
+            "g": rng.integers(0, 20, n).astype(np.int64),
+        }
+    )
+    session, hs, src = _env(tmp_path, batch, ["v", "g"])
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_COMPRESSION", "force")
+    assert hs.prefetch_index("aidx", ["k", "v", "g"])
+    table = hbm_cache._tables[0]
+    assert table.tier == "compressed"
+    assert table.columns["g"].pack is not None
+    assert table.columns["v"].pack is not None
+
+    def q():
+        return (
+            session.read.parquet(str(src))
+            .filter(col("k") >= lit(500))
+            .group_by("g")
+            .agg(agg_sum("v", "sv"), agg_count(), agg_max("v", "xv"))
+        )
+
+    off = _with_compile_off(session, lambda: q().collect())
+    metrics.reset()
+    on = q().collect()
+    assert metrics.counter("scan.path.resident_agg") == 1
+    _assert_group_parity(off, on, ["sv", "count", "xv"], [], "g")
+
+
+# ---------------------------------------------------------------------------
+# mesh lowering: scan + agg_scan parity vs interpret
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def mesh():
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(8)
+
+
+def _mesh_env(tmp_path, batch, mesh, included):
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "p0.parquet", batch)
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.INDEX_NUM_BUCKETS: 16,
+        }
+    )
+    session = HyperspaceSession(conf, mesh=mesh)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("midx", ["k"], included)
+    )
+    session.enable_hyperspace()
+    return session, hs, src
+
+
+def test_mesh_scan_pipeline_lowers_with_parity(tmp_path, mesh):
+    batch = _source(30_000, seed=7)
+    session, hs, src = _mesh_env(tmp_path, batch, mesh, ["v"])
+    assert hs.prefetch_index("midx", ["k", "v"])
+    key = int(batch.columns["k"].data[9])
+
+    def q(k):
+        return (
+            session.read.parquet(str(src))
+            .filter(col("k") == lit(int(k)))
+            .select("k", "v")
+        )
+
+    off = _with_compile_off(session, lambda: q(key).collect())
+    pipeline_cache.reset()
+    metrics.reset()
+    on = q(key).collect()
+    assert _sorted_rows(off, ["k", "v"]) == _sorted_rows(on, ["k", "v"])
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("compile.lowered.scan") == 1
+    assert snap.get("compile.fused.dispatches") == 1
+    assert snap.get("scan.path.resident_device_mesh") == 1
+    # a distinct-literal burst shares the one lowered pipeline
+    keys = [int(batch.columns["k"].data[i * 731]) for i in range(6)]
+    for k in keys:
+        q(k).collect()
+    assert metrics.counter("compile.lowered") == 1
+
+
+def test_mesh_agg_scan_pipeline_lowers_with_parity(tmp_path, mesh):
+    batch = _source(30_000, seed=8)
+    session, hs, src = _mesh_env(tmp_path, batch, mesh, ["v", "g"])
+    assert hs.prefetch_index("midx", ["k", "v", "g"])
+
+    def q():
+        return (
+            session.read.parquet(str(src))
+            .filter(col("k") >= lit(2000))
+            .group_by("g")
+            .agg(agg_sum("v", "sv"), agg_count(), agg_min("v", "mv"))
+        )
+
+    off = _with_compile_off(session, lambda: q().collect())
+    pipeline_cache.reset()
+    metrics.reset()
+    on = q().collect()
+    _assert_group_parity(off, on, ["sv", "count", "mv"], [], "g")
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("compile.lowered.agg_scan") == 1
+    assert snap.get("scan.path.resident_agg_mesh") == 1
+    assert snap.get("compile.agg.device") == 1

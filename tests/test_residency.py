@@ -393,6 +393,112 @@ def test_mesh_compressed_parity(tmp_path, monkeypatch):
     assert (np.asarray(counts) == np.asarray(raw_counts)).all()
 
 
+def test_mesh_streaming_rung_parity_and_accounting(tmp_path, monkeypatch):
+    """The mesh ladder accepts the compressed-streaming rung: host-pinned
+    shard matrices staged through a per-device slab pair, counts
+    bit-identical to the raw mesh table, truthful snapshot/decline
+    accounting (hbm.mesh.residency.streaming_declined fires only for a
+    genuine slab-pair-over-budget decline, never unconditionally)."""
+    from hyperspace_tpu.parallel.mesh import make_mesh
+    from tests.e2e_utils import build_index, write_source
+
+    rng = np.random.default_rng(9)
+    n = 400_000
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 5000, n).astype(np.int64),
+            "v": rng.integers(0, 1 << 30, n).astype(np.int64),
+        }
+    )
+    rel = write_source(tmp_path / "src", batch, n_files=2)
+    entry = build_index(
+        "ms", rel, ["k"], ["v"], tmp_path / "idx", num_buckets=16
+    )
+    files = entry.content.files()
+    mesh = make_mesh(8)
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_WINDOW_ROWS", "8192")
+    metrics.reset()
+    table = mesh_cache.prefetch(files, ["k", "v"], mesh)
+    assert table is not None and table.tier == "streaming"
+    snap = mesh_cache.snapshot_residency()
+    assert snap["by_tier"] == {"streaming": 1}
+    row = snap["tables"][0]
+    assert row["windows"] >= 2
+    assert row["mb"] < row["host_mb"]  # slab charge, not the table
+    # a tier that BUILT is not a decline
+    assert metrics.counter("hbm.mesh.residency.streaming_declined") == 0
+
+    pred = (col("k") >= lit(1000)) & (col("k") <= lit(1500))
+    counts = np.asarray(mesh_cache.block_counts(table, pred))
+    assert metrics.counter("residency.stream.windows") == row["windows"]
+    assert metrics.counter("residency.stream.h2d_bytes") > 0
+
+    # ground truth: the raw mesh shards' counts (fresh cache, big budget)
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "4096")
+    mesh_cache.reset()
+    raw = mesh_cache.prefetch(files, ["k", "v"], mesh)
+    assert raw is not None and raw.tier == "resident"
+    raw_counts = np.asarray(mesh_cache.block_counts(raw, pred))
+    nc = raw_counts.shape[1]
+    assert (counts[:, :nc] == raw_counts).all()
+    assert counts[:, nc:].sum() == 0  # pad windows count nothing
+
+    # genuine decline: streaming ON but even the slab pair cannot fit
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "0")
+    mesh_cache.reset()
+    metrics.reset()
+    assert mesh_cache.prefetch(files, ["k", "v"], mesh) is None
+    assert metrics.counter("hbm.mesh.residency.streaming_declined") == 1
+    assert metrics.counter("hbm.mesh.over_budget_refused") >= 1
+
+    # streaming OFF: a knob refusal, never counted as a decline
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_STREAMING", "off")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_COMPRESSION", "off")
+    mesh_cache.reset()
+    metrics.reset()
+    assert mesh_cache.prefetch(files, ["k", "v"], mesh) is None
+    assert metrics.counter("hbm.mesh.residency.streaming_declined") == 0
+    assert metrics.counter("hbm.mesh.over_budget_refused") >= 1
+
+
+def test_mesh_streaming_batch_and_window_generation(tmp_path, monkeypatch):
+    """Batched mesh streaming counts match singles, and the batcher's
+    mesh key folds window_gen so a batch never spans a slab teardown."""
+    from hyperspace_tpu.parallel.mesh import make_mesh
+    from tests.e2e_utils import build_index, write_source
+
+    rng = np.random.default_rng(10)
+    n = 300_000
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 3000, n).astype(np.int64),
+            "v": rng.integers(0, 1 << 30, n).astype(np.int64),
+        }
+    )
+    rel = write_source(tmp_path / "src", batch, n_files=2)
+    entry = build_index(
+        "msb", rel, ["k"], ["v"], tmp_path / "idx", num_buckets=16
+    )
+    files = entry.content.files()
+    mesh = make_mesh(8)
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_RESIDENCY_WINDOW_ROWS", "8192")
+    table = mesh_cache.prefetch(files, ["k", "v"], mesh)
+    assert table is not None and table.tier == "streaming"
+    preds = [col("k") == lit(77), (col("k") >= lit(100)) & (col("k") <= lit(200))]
+    singles = [np.asarray(mesh_cache.block_counts(table, p)) for p in preds]
+    stacked = mesh_cache.block_counts_batch(table, preds)
+    assert stacked is not None
+    for s, b in zip(singles, np.asarray(stacked)):
+        assert (s == b).all()
+    # window generation rides the table for the serve batcher's mesh key
+    gen0 = table.window_gen
+    table.window_gen += 1
+    assert table.window_gen == gen0 + 1
+
+
 # ---------------------------------------------------------------------------
 # join regions: FoR-delta right codes
 # ---------------------------------------------------------------------------
