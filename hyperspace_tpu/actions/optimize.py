@@ -12,19 +12,21 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-import numpy as np
-
 from .. import constants as C
 from ..exceptions import HyperspaceException, NoChangesException
 from ..index.data_manager import IndexDataManager
 from ..index.log_entry import Content, FileIdTracker, IndexLogEntry, LogEntry
 from ..index.log_manager import IndexLogManager
 from ..storage import layout
-from ..storage.columnar import ColumnarBatch
 from ..telemetry import OptimizeActionEvent
 from . import states
 from .base import Action, MaintenanceActionBase
 from .create import CreateActionBase
+
+# host bytes of run-segment rows one compaction group may materialize at
+# once (the group's coalesced segment map); the peak-memory half of the
+# group-size trade — see op()'s grouping comment for the other half
+_GROUP_READ_BUDGET_BYTES = 1 << 30
 
 
 class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
@@ -48,50 +50,22 @@ class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
 
     def _partition_files(self):
         """(files to optimize, run files, untouched files) by bucket and
-        threshold (OptimizeAction.scala:115-133). Multi-bucket RUN files
-        (build finalizeMode=runs) are ALWAYS compacted regardless of size
-        or mode — optimize is the deferred half of their build's write
-        path (the small-file→optimize lifecycle). Cached: validate() and
-        op() share one content-tree walk."""
+        threshold (OptimizeAction.scala:115-133) — ONE copy of the rule,
+        shared with the background compactor (index/compactor.py:
+        partition_compactable). Multi-bucket RUN files (build
+        finalizeMode=runs) are ALWAYS compacted regardless of size or
+        mode — optimize is the deferred half of their build's write path
+        (the small-file→optimize lifecycle). Cached: validate() and op()
+        share one content-tree walk."""
         if self._partition is not None:
             return self._partition
-        threshold = self.conf.optimize_file_size_threshold()
-        by_bucket: Dict[int, List] = {}
-        run_files: List = []
-        for fi in self.previous_entry.content.file_infos():
-            if layout.is_run_file(fi.name):
-                run_files.append(fi)
-            else:
-                by_bucket.setdefault(layout.bucket_of_file(fi.name), []).append(fi)
-        # which buckets actually hold rows in the run files: a footer
-        # read per run (cached) — buckets untouched by any run keep the
-        # single-file skip rule, and empty buckets never reach op()
-        run_buckets: set = set()
-        for fi in run_files:
-            offs = layout.run_bucket_offsets(layout.cached_reader(fi.name).footer)
-            if offs is None:
-                raise HyperspaceException(
-                    f"Run file {fi.name} carries no bucketCounts footer."
-                )
-            run_buckets.update(
-                b for b in range(len(offs) - 1) if offs[b + 1] > offs[b]
-            )
-        to_optimize: Dict[int, List] = {}
-        untouched: List = []
-        for b, files in by_bucket.items():
-            if self.mode == C.OPTIMIZE_MODE_QUICK:
-                small = [f for f in files if f.size < threshold]
-                big = [f for f in files if f.size >= threshold]
-            else:
-                small, big = list(files), []
-            # a single small file still merges when run segments exist
-            # for its bucket; alone it is already compact (:126-131)
-            if len(small) < 2 and b not in run_buckets:
-                untouched.extend(files)
-                continue
-            to_optimize[b] = small
-            untouched.extend(big)
-        self._partition = (to_optimize, run_files, run_buckets, untouched)
+        from ..index.compactor import partition_compactable
+
+        self._partition = partition_compactable(
+            self.previous_entry.content.file_infos(),
+            self.conf.optimize_file_size_threshold(),
+            quick=self.mode == C.OPTIMIZE_MODE_QUICK,
+        )
         return self._partition
 
     def validate(self) -> None:
@@ -115,86 +89,49 @@ class OptimizeAction(Action, CreateActionBase, MaintenanceActionBase):
         prev = self.previous_entry
         to_optimize, run_files, run_buckets, untouched = self._partition_files()
         version_dir = self.next_version_dir()
-        indexed = prev.indexed_columns
+        indexed = list(prev.indexed_columns)
         new_paths: List[str] = []
-        # per-run readers opened once; each contributes its bucket row
-        # ranges to every bucket's merge below
-        run_readers = [layout.TcbReader(fi.name) for fi in run_files]
-        run_offsets = [
-            layout.run_bucket_offsets(r.footer) for r in run_readers
-        ]
-        from ..telemetry.metrics import metrics
+        # the shared runs→compact write path (index/compactor.py): run
+        # segments read through the coalesced planner (one ordered sweep
+        # per run file, not a ranged read per (run, bucket) — ~18k calls
+        # at SF100), sorted parts k-way-merged via the stable
+        # searchsorted tournament, per-bucket merges fanned across the
+        # build pipeline's merge pool, all under compaction.* metrics.
+        # Buckets process in groups sized by a read-bytes budget over the
+        # logged run sizes: each group's segment map materializes its
+        # buckets' run rows at once, so the group size IS the host-memory
+        # peak — while every group pays one sweep per run file, so
+        # smaller groups mean more ranged reads. The budget splits that
+        # trade; at SF100 (~75 MB/bucket) it groups ~14 buckets instead
+        # of holding 64 buckets (~5 GB) resident like one
+        # background-compaction step would if its knob applied here.
+        from ..index.compactor import compact_bucket_group
 
-        # every part that already carries the right footer sort order is a
-        # sorted RUN: the bucket then rebuilds via the stable k-way
-        # searchsorted merge (stream_builder.merge_sorted_runs) instead of
-        # a concat + full lexsort — the same asymptotic win the build's
-        # finalize took, applied to the deferred compaction (at SF100 the
-        # compaction was ~300s of concat+re-sort of already-sorted parts).
-        # Parts without the footer claim (legacy files) keep the re-sort.
-        def compact_bucket(b: int):
-            with metrics.timer("optimize.bucket_read"):
-                parts = []
-                parts_sorted = True
-                for f in to_optimize.get(b, []):
-                    parts.append(layout.read_batch(f.name))
-                    footer = layout.cached_reader(f.name).footer
-                    parts_sorted = parts_sorted and (
-                        footer.get("sortedBy") == list(indexed)
-                    )
-                for reader, offs in zip(run_readers, run_offsets):
-                    if b < len(offs) - 1 and offs[b + 1] > offs[b]:
-                        parts.append(
-                            reader.read(
-                                row_range=(int(offs[b]), int(offs[b + 1]))
-                            )
-                        )
-                        parts_sorted = parts_sorted and (
-                            reader.footer.get("sortedBy") == list(indexed)
-                        )
-                if not parts:  # bucket emptied (e.g. lineage delete)
-                    return None
-            from ..index.stream_builder import merge_sorted_runs, sort_encoding
-
-            with metrics.timer("optimize.bucket_sort"):
-                if parts_sorted:
-                    merged = merge_sorted_runs(parts, list(indexed))
-                else:
-                    # restore per-bucket sort order on the indexed columns
-                    # via the shared order-preserving encodings
-                    # (stream_builder.sort_encoding): strings sort by
-                    # unified dictionary codes, floats by their ordered-int
-                    # encodings — key_repr would sort strings by FNV hash
-                    # and float32 by raw bit pattern (negatives reversed)
-                    merged = (
-                        parts[0]
-                        if len(parts) == 1
-                        else ColumnarBatch.concat(parts)
-                    )
-                    reprs = [sort_encoding(merged.columns[c]) for c in indexed]
-                    order = np.lexsort(list(reversed(reprs)))
-                    merged = merged.take(order)
-            with metrics.timer("optimize.bucket_write"):
-                p = version_dir / layout.bucket_file_name(b)
-                layout.write_batch(
-                    p, merged, sorted_by=list(indexed), bucket=b
-                )
-            return str(p)
-
-        # buckets are independent (disjoint inputs, distinct output
-        # files): compact them across the build pipeline's merge pool
-        from ..parallel.pool import run_parallel
-
+        run_paths = [fi.name for fi in run_files]
+        small = {
+            b: [f.name for f in fis] for b, fis in to_optimize.items()
+        }
+        all_buckets = sorted(set(to_optimize) | run_buckets)
         pipe = self.conf.build_pipeline()
-        results = run_parallel(
-            [
-                lambda b=b: compact_bucket(b)
-                for b in sorted(set(to_optimize) | run_buckets)
-            ],
-            pipe.merge_workers if pipe.enabled else 1,
-            name="optimize-compact",
+        workers = pipe.merge_workers if pipe.enabled else 1
+        run_bytes = sum(fi.size for fi in run_files)
+        est_bucket_bytes = max(run_bytes // max(len(run_buckets), 1), 1)
+        group = int(
+            min(
+                max(workers, _GROUP_READ_BUDGET_BYTES // est_bucket_bytes),
+                max(len(all_buckets), 1),
+            )
         )
-        new_paths.extend(p for p in results if p is not None)
+        for i in range(0, len(all_buckets), group):
+            merged = compact_bucket_group(
+                all_buckets[i : i + group],
+                small,
+                run_paths,
+                version_dir,
+                indexed,
+                workers,
+            )
+            new_paths.extend(p for p in merged.values() if p is not None)
 
         tracker = FileIdTracker()
         new_content = Content.from_leaf_files(new_paths, tracker)

@@ -218,7 +218,15 @@ class RefreshIncrementalAction(RefreshActionBase):
             # preserves row order, so per-bucket counts just shrink.
             del_arr = np.array(sorted(deleted_ids), dtype=np.int64)
             for i, f in enumerate(prev.content.files()):
-                batch = layout.read_batch(f)
+                if layout.is_run_file(f):
+                    # run files read through the coalesced segment
+                    # planner (one ordered sweep, counted and traced) —
+                    # the same IO machinery queries and the background
+                    # compactor use; bucket order IS row order, so the
+                    # batch is byte-identical to a whole-file read
+                    batch = layout.read_run_coalesced(f)
+                else:
+                    batch = layout.read_batch(f)
                 ids = batch.columns[C.DATA_FILE_NAME_ID].data
                 keep = ~np.isin(ids, del_arr)
                 kept = batch.take(np.flatnonzero(keep))
@@ -226,7 +234,7 @@ class RefreshIncrementalAction(RefreshActionBase):
                     continue
                 if layout.is_run_file(f):
                     src_footer = layout.cached_reader(f).footer
-                    offs = layout.run_bucket_offsets(src_footer)
+                    offs = layout.run_offsets_checked(f)
                     counts = [
                         int(keep[int(offs[b]) : int(offs[b + 1])].sum())
                         for b in range(len(offs) - 1)

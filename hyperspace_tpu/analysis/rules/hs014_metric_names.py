@@ -44,10 +44,12 @@ SUBSYSTEM_PREFIXES = frozenset(
     {
         "aggregate",
         "build",
+        "compaction",
         "compile",
         "dist",
         "doctor",
         "hbm",
+        "io",
         "join",
         "lease",
         "mesh",

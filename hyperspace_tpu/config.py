@@ -242,6 +242,60 @@ class HyperspaceConf:
             queue_depth=max(1, int(self.get(C.BUILD_QUEUE_DEPTH, auto.queue_depth))),
         )
 
+    def compaction_enabled(self) -> bool:
+        v = str(self.get(C.INDEX_COMPACTION, C.INDEX_COMPACTION_DEFAULT)).lower()
+        if v not in C.INDEX_COMPACTION_MODES:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"Unknown {C.INDEX_COMPACTION}={v!r}; expected one of "
+                f"{C.INDEX_COMPACTION_MODES}."
+            )
+        return v == C.INDEX_COMPACTION_AUTO
+
+    def compaction_buckets_per_step(self) -> int:
+        return max(
+            1,
+            int(
+                self.get(
+                    C.INDEX_COMPACTION_BUCKETS_PER_STEP,
+                    C.INDEX_COMPACTION_BUCKETS_PER_STEP_DEFAULT,
+                )
+            ),
+        )
+
+    def compaction_interval_seconds(self) -> float:
+        return float(
+            self.get(
+                C.INDEX_COMPACTION_INTERVAL_SECONDS,
+                C.INDEX_COMPACTION_INTERVAL_SECONDS_DEFAULT,
+            )
+        )
+
+    def compaction_max_steps_per_sweep(self) -> int:
+        return max(
+            1,
+            int(
+                self.get(
+                    C.INDEX_COMPACTION_MAX_STEPS_PER_SWEEP,
+                    C.INDEX_COMPACTION_MAX_STEPS_PER_SWEEP_DEFAULT,
+                )
+            ),
+        )
+
+    def segment_io_mode(self) -> str:
+        v = str(
+            self.get(C.STORAGE_SEGMENT_IO, C.STORAGE_SEGMENT_IO_DEFAULT)
+        ).lower()
+        if v not in C.STORAGE_SEGMENT_IO_MODES:
+            from .exceptions import HyperspaceException
+
+            raise HyperspaceException(
+                f"Unknown {C.STORAGE_SEGMENT_IO}={v!r}; expected one of "
+                f"{C.STORAGE_SEGMENT_IO_MODES}."
+            )
+        return v
+
     def serve_tenant_policy(self, tenant: str):
         """The TenantPolicy for ``tenant`` (serve.tenancy): per-tenant
         override keys (``hyperspace.serve.tenant.<name>.weight`` /

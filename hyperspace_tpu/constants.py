@@ -116,6 +116,56 @@ OPTIMIZE_MODE_QUICK = "quick"
 OPTIMIZE_MODE_FULL = "full"
 OPTIMIZE_MODES = (OPTIMIZE_MODE_QUICK, OPTIMIZE_MODE_FULL)
 
+# --- incremental background compaction (index/compactor.py) ------------------
+# The runs layout defers compaction to optimize(); the background
+# compactor closes the gap by compacting run files into per-bucket files
+# bucket-by-bucket LONG before optimize(), prioritized by observed bucket
+# heat, each step committed through the normal operation-log protocol
+# (lease-fenced; snapshot-pinned readers keep serving the old version
+# wholesale). "auto" lets the QueryServer host sweeps the way it hosts
+# the recovery sweep; "off" (the default) keeps compaction an explicit
+# verb (optimize() / Hyperspace.compact_index).
+INDEX_COMPACTION = "hyperspace.index.compaction.enabled"
+INDEX_COMPACTION_AUTO = "auto"
+INDEX_COMPACTION_OFF = "off"
+INDEX_COMPACTION_MODES = (INDEX_COMPACTION_AUTO, INDEX_COMPACTION_OFF)
+INDEX_COMPACTION_DEFAULT = INDEX_COMPACTION_OFF
+# Buckets compacted per committed step. Each step also rewrites the
+# remaining run files minus the compacted buckets (immutable files — the
+# only way rows leave a run), so smaller steps mean earlier per-bucket
+# files for hot buckets but more remainder-rewrite bytes over the whole
+# convergence; bucketsPerStep >= numBuckets degenerates to one
+# optimize()-shaped step. A step materializes its buckets' run rows on
+# the host at once (the group's coalesced segment map), so this knob is
+# also the step's peak-memory bound — size it to rows-per-bucket.
+# optimize() does NOT use this knob: it groups by a read-bytes budget
+# over the logged run sizes (actions/optimize.py).
+INDEX_COMPACTION_BUCKETS_PER_STEP = "hyperspace.index.compaction.bucketsPerStep"
+INDEX_COMPACTION_BUCKETS_PER_STEP_DEFAULT = 64
+# How often a hosting QueryServer's submit path may kick a background
+# compaction sweep (the recovery-sweep throttle pattern). <= 0 disables
+# server-hosted sweeps even when compaction is "auto".
+INDEX_COMPACTION_INTERVAL_SECONDS = "hyperspace.index.compaction.intervalSeconds"
+INDEX_COMPACTION_INTERVAL_SECONDS_DEFAULT = 30.0
+# Steps one hosted sweep may commit per index before yielding (bounded
+# background work per sweep; the next interval continues convergence).
+INDEX_COMPACTION_MAX_STEPS_PER_SWEEP = (
+    "hyperspace.index.compaction.maxStepsPerSweep"
+)
+INDEX_COMPACTION_MAX_STEPS_PER_SWEEP_DEFAULT = 1
+
+# --- segment IO (storage/layout.py planner) ----------------------------------
+# How (run file, bucket) segment reads execute: "planned" (default)
+# merges adjacent/near-adjacent ranges into one ordered sweep per run
+# file fanned across the worker pool; "naive" issues one ranged read per
+# segment — the pre-planner behavior, kept as the A/B lever bench
+# config 17 pulls (HYPERSPACE_TPU_SEGMENT_IO overrides both).
+STORAGE_SEGMENT_IO = "hyperspace.storage.segmentIo"
+STORAGE_SEGMENT_IO_PLANNED = "planned"
+STORAGE_SEGMENT_IO_NAIVE = "naive"
+STORAGE_SEGMENT_IO_MODES = (STORAGE_SEGMENT_IO_PLANNED, STORAGE_SEGMENT_IO_NAIVE)
+STORAGE_SEGMENT_IO_DEFAULT = STORAGE_SEGMENT_IO_PLANNED
+
 # --- refresh -----------------------------------------------------------------
 # (reference: IndexConstants.scala:78-92)
 REFRESH_MODE_INCREMENTAL = "incremental"

@@ -166,11 +166,7 @@ def _bucket_segments(paths: List[str]) -> Dict[int, List[Tuple[str, int, int]]]:
     out: Dict[int, List[Tuple[str, int, int]]] = {}
     for p in paths:  # caller pre-sorts
         if layout.is_run_file(p):
-            offs = layout.run_bucket_offsets(layout.cached_reader(p).footer)
-            if offs is None:
-                raise HyperspaceException(
-                    f"Run file {p} carries no bucketCounts footer."
-                )
+            offs = layout.run_offsets_checked(p)
             for b in range(len(offs) - 1):
                 s, e = int(offs[b]), int(offs[b + 1])
                 if e > s:
@@ -570,31 +566,65 @@ class MeshHbmCache(ResidentCacheBase):
             metrics.incr("hbm.mesh.over_budget_refused")
             return None, False
 
-        def read_seg(path: str, lo: int, hi: int, name: str) -> Column:
-            return readers[path].read([name], row_range=(lo, hi)).columns[name]
-
-        # --- encode phase: host (D, cap) matrices, no uploads yet -----------
-        host_mats: Dict[str, tuple] = {}
-        for name in encodable:
-            present = all(
-                any(m["name"] == name for m in r.footer["columns"])
+        # shard packing reads every (file, bucket) segment of every run —
+        # the third scattered-read site the segment planner coalesces:
+        # ONE ordered sweep per run file (all encodable columns at once)
+        # instead of a ranged read per (segment, column). Per-bucket
+        # files read whole through the same map so read_seg below is a
+        # dict probe either way.
+        seg_by_range: Dict[Tuple[str, int, int], ColumnarBatch] = {}
+        # THE footer-level per-column gate (one copy; the encode loop
+        # below iterates exactly this list): packable columns are the
+        # ones EVERY file carries, with every footer-decidable refusal
+        # (mixed string dtypes, oversized unified vocab) applied BEFORE
+        # the sweep so refused columns cost no IO. Data-dependent
+        # refusals (NaN float64, mismatched int encodings) can only
+        # surface after the read — those columns' sweep bytes are the
+        # price of reading all packable columns in one pass per file.
+        readable = []
+        for c in encodable:
+            metas = [
+                next(
+                    (m for m in r.footer["columns"] if m["name"] == c), None
+                )
                 for r in readers.values()
-            )
-            if not present:
+            ]
+            if any(m is None for m in metas):
                 continue
-            enc: Optional[str] = None
-            vocab = None
-            packed = np.zeros((D, cap), dtype=np.int32)
-            if is_string(dtype_of[name]):
-                metas = [
-                    next(m for m in r.footer["columns"] if m["name"] == name)
-                    for r in readers.values()
-                ]
+            if is_string(dtype_of[c]):
                 if not all(is_string(m["dtype"]) for m in metas):
                     continue
                 if sum(len(m.get("vocab", ())) for m in metas) > _MAX_VOCAB:
                     metrics.incr("hbm.mesh.vocab_too_large_refused")
                     continue
+            readable.append(c)
+        run_paths = [p for p in paths if layout.is_run_file(p)]
+        if run_paths and readable:
+            seg_map = layout.execute_segment_reads(
+                layout.plan_segment_reads(run_paths), columns=readable
+            )
+        else:
+            seg_map = {}
+        if readable:
+            for b, segs in by_bucket.items():
+                for path, lo, hi in segs:
+                    if layout.is_run_file(path):
+                        seg_by_range[(path, lo, hi)] = seg_map[(path, b)]
+                    else:
+                        seg_by_range[(path, lo, hi)] = readers[path].read(
+                            readable, row_range=(lo, hi)
+                        )
+
+        def read_seg(path: str, lo: int, hi: int, name: str) -> Column:
+            return seg_by_range[(path, lo, hi)].columns[name]
+
+        # --- encode phase: host (D, cap) matrices, no uploads yet -----------
+        host_mats: Dict[str, tuple] = {}
+        for name in readable:
+            enc: Optional[str] = None
+            vocab = None
+            packed = np.zeros((D, cap), dtype=np.int32)
+            if is_string(dtype_of[name]):
                 from ..storage.columnar import unify_dictionaries
 
                 flat_segs = [

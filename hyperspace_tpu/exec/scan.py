@@ -491,9 +491,9 @@ def index_scan(
         special: dict = {}
         if pinned is not None and any(layout.is_run_file(f) for f in files):
             with metrics.timer("scan.run_segment_io"):
-                for f in files:
-                    if layout.is_run_file(f):
-                        special[f] = _read_run_segments(f, need, pinned)
+                special = _read_run_segments(
+                    [f for f in files if layout.is_run_file(f)], need, pinned
+                )
         bulk_files = [f for f in files if f not in special]
         with metrics.timer("scan.io_dispatch"):
             bulk = layout.read_batches(bulk_files, columns=need)
@@ -516,31 +516,39 @@ def index_scan(
 
 
 def _read_run_segments(
-    f: Path, need: List[str], pinned: set
-) -> Optional[ColumnarBatch]:
-    """The pinned buckets' row ranges of one run file (None when those
-    buckets hold no rows there) — an equality lookup over a runs-layout
-    index reads ~rows-per-bucket bytes per run, not the whole file."""
-    reader = layout.cached_reader(f)
-    offs = layout.run_bucket_offsets(reader.footer)
-    if offs is None:
-        # matches _group_batches_by_bucket: a run file without its
-        # bucketCounts footer is corrupt — a whole-file fallback here
-        # would duplicate the file into EVERY pinned bucket's group on
-        # the per-bucket distributed call path
-        raise HyperspaceException(
-            f"Run file {f} carries no bucketCounts footer."
+    run_files: List[Path], need: List[str], pinned: set
+) -> dict:
+    """The pinned buckets' row ranges of every run file, read through the
+    coalesced segment planner (layout.plan_segment_reads): ONE ordered
+    sweep per run file instead of one ranged read per (run, bucket) — an
+    equality lookup over a runs-layout index still reads ~rows-per-bucket
+    bytes per run, and a multi-bucket predicate no longer scatters.
+    Returns {file: batch-or-None} (None = those buckets hold no rows
+    there). A run file without its bucketCounts footer raises (the shared
+    layout.run_offsets_checked validation) — a whole-file fallback would
+    duplicate the file into EVERY pinned bucket's group on the per-bucket
+    distributed call path."""
+    plan = layout.plan_segment_reads(run_files, buckets=set(pinned))
+    got = layout.execute_segment_reads(plan, columns=need)
+    out: dict = {f: None for f in run_files}
+    n_segments = 0
+    touched: set = set()
+    for sw in plan:
+        parts = [got[(sw.path, b)] for b, _lo, _hi in sw.segments]
+        n_segments += len(parts)
+        touched.update(b for b, _lo, _hi in sw.segments)
+        match = next(f for f in run_files if str(f) == sw.path)
+        out[match] = (
+            parts[0] if len(parts) == 1 else ColumnarBatch.concat(parts)
         )
-    parts = []
-    for b in sorted(pinned):
-        if 0 <= b < len(offs) - 1 and offs[b + 1] > offs[b]:
-            parts.append(
-                reader.read(need, row_range=(int(offs[b]), int(offs[b + 1])))
-            )
-    if not parts:
-        return None
-    metrics.incr("scan.run_bucket_segments", len(parts))
-    return parts[0] if len(parts) == 1 else ColumnarBatch.concat(parts)
+    if n_segments:
+        metrics.incr("scan.run_bucket_segments", n_segments)
+    if touched and run_files:
+        # the compactor's priority signal: these buckets are hot
+        from .scan_gate import note_bucket_heat
+
+        note_bucket_heat(layout.index_root_of(run_files[0]), touched)
+    return out
 
 
 def _empty_result(

@@ -301,6 +301,44 @@ class ScanGate:
     def reset(self) -> None:
         with self._lock:
             self._state.clear()
+        with _heat_lock:
+            _bucket_heat.clear()
+
+
+# --- bucket heat (the background compactor's priority signal) ----------------
+# Every runs-layout segment read notes which buckets a query touched,
+# keyed by the index root the file set lives under; the incremental
+# compactor (index/compactor.py) compacts the hottest buckets first so
+# the queries actually running become join-competitive earliest. A plain
+# bounded dict, not a metric: HS014 names are static, and the compactor
+# needs the per-bucket ordering, not an aggregate.
+_heat_lock = threading.Lock()
+_bucket_heat: dict = {}  # index root -> {bucket: touch count}
+_HEAT_ROOT_CAP = 64  # roots tracked; oldest-inserted evicted past this
+
+
+def note_bucket_heat(root, buckets) -> None:
+    """Count a query's touch of ``buckets`` under ``root`` (an index
+    directory, or None — ignored). Called from the runs-layout read
+    sites; cheap enough for the per-query path (one lock, k increments)."""
+    if root is None:
+        return
+    root = str(root)
+    with _heat_lock:
+        per = _bucket_heat.get(root)
+        if per is None:
+            if len(_bucket_heat) >= _HEAT_ROOT_CAP:
+                _bucket_heat.pop(next(iter(_bucket_heat)))
+            per = _bucket_heat[root] = {}
+        for b in buckets:
+            b = int(b)
+            per[b] = per.get(b, 0) + 1
+
+
+def bucket_heat(root) -> dict:
+    """A copy of the touch counts for ``root`` (empty when never seen)."""
+    with _heat_lock:
+        return dict(_bucket_heat.get(str(root), ()))
 
 
 _atexit_registered = False

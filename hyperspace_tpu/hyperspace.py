@@ -60,6 +60,23 @@ class Hyperspace:
     def optimize_index(self, name: str, mode: str = C.OPTIMIZE_MODE_QUICK) -> None:
         self._manager.optimize(name, mode)
 
+    def compact_index(self, name: str, max_steps=None) -> dict:
+        """Step ``name`` toward the converged per-bucket layout NOW, one
+        lease-fenced committed increment at a time (index/compactor.py —
+        the explicit verb for the background compactor's procedure;
+        ``hyperspace.index.compaction.enabled=auto`` makes a hosting
+        QueryServer do this continuously). Each step compacts the
+        hottest ``bucketsPerStep`` run-held buckets into per-bucket
+        files; convergence produces exactly ``optimize(quick)``'s
+        layout. Returns {"steps": committed count, "converged": bool}.
+        Unlike ``optimize_index``, readers pinned to the previous
+        snapshot keep serving it wholesale between steps."""
+        from .index.compactor import IndexCompactor
+
+        return IndexCompactor(self.session).compact_index(
+            name, max_steps=max_steps
+        )
+
     def cancel(self, name: str) -> None:
         self._manager.cancel(name)
 
@@ -105,6 +122,7 @@ class Hyperspace:
 
     # camelCase aliases for reference-API parity
     prefetchIndex = prefetch_index
+    compactIndex = compact_index
     createIndex = create_index
     deleteIndex = delete_index
     restoreIndex = restore_index

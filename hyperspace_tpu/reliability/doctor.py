@@ -46,6 +46,13 @@ LATEST_STABLE_BAD = "latest-stable-bad"
 ABANDONED_WRITER = "abandoned-writer"
 STUCK_TRANSIENT = "stuck-transient"
 WRITER_IN_FLIGHT = "writer-in-flight"  # informational
+# the background compactor's increments surface under their own kinds so
+# an operator can tell "a compaction step is running / died" from "a
+# human's optimize/refresh is running / died" — the repair mechanics are
+# identical (auto-rollback via recovery; the torn version dir's litter
+# vacuums through the orphan scan below)
+COMPACTION_IN_FLIGHT = "compaction-in-flight"  # informational
+COMPACTION_ABANDONED = "compaction-abandoned"
 MISSING_DATA_FILE = "missing-data-file"
 ORPHAN_VERSION_DIR = "orphan-version-dir"
 ORPHAN_DATA_FILE = "orphan-data-file"
@@ -57,7 +64,7 @@ STALE_LEASE = "stale-lease"
 # a live writer mid-action, and superseded lease-epoch tombstones (kept
 # for epoch monotonicity; repair garbage-collects them, a scan must not
 # fail a healthy tree over them)
-_INFORMATIONAL = frozenset({WRITER_IN_FLIGHT, STALE_LEASE})
+_INFORMATIONAL = frozenset({WRITER_IN_FLIGHT, COMPACTION_IN_FLIGHT, STALE_LEASE})
 
 
 @dataclass
@@ -246,12 +253,17 @@ def _check_index(index_dir: Path, report: DoctorReport, repair: bool, conf) -> N
         and current_lease is not None
         and current_lease.is_live()
     )
+    is_compaction = (
+        current_lease is not None and current_lease.action == "CompactionStep"
+    )
     if head is not None and head.state not in states.STABLE_STATES:
         if current_lease is not None and current_lease.is_live():
             add(
-                WRITER_IN_FLIGHT,
+                COMPACTION_IN_FLIGHT if is_compaction else WRITER_IN_FLIGHT,
                 log_dir / str(head.id),
-                f"transient head {head.state} under live lease epoch "
+                ("background compaction step" if is_compaction else
+                 f"transient head {head.state}")
+                + f" under live lease epoch "
                 f"{current_lease.epoch} (owner {current_lease.owner})",
                 repairable=False,
             )
@@ -264,9 +276,11 @@ def _check_index(index_dir: Path, report: DoctorReport, repair: bool, conf) -> N
                     conf=conf,
                 )
             add(
-                ABANDONED_WRITER,
+                COMPACTION_ABANDONED if is_compaction else ABANDONED_WRITER,
                 log_dir / str(head.id),
-                f"transient head {head.state}; lease epoch "
+                ("background compaction step died mid-flight" if is_compaction
+                 else f"transient head {head.state}")
+                + f"; lease epoch "
                 f"{current_lease.epoch} expired unreleased (dead writer)",
                 True,
                 repaired,

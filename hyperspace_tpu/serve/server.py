@@ -120,6 +120,13 @@ class ServeConfig:
     # (transient log head + expired lease) so a serving process heals
     # indexes a dead builder left wedged. <= 0 disables.
     recovery_sweep_interval_s: float = 60.0
+    # how often the submit path may kick a background COMPACTION sweep
+    # (index/compactor.py — runs-layout indexes converge toward per-
+    # bucket files while the server keeps serving snapshot-pinned reads).
+    # None = the hyperspace.index.compaction.intervalSeconds conf; <= 0
+    # disables. Sweeps only run at all when the conf family enables
+    # compaction (hyperspace.index.compaction.enabled=auto).
+    compaction_sweep_interval_s: Optional[float] = None
 
 
 class QueryTicket:
@@ -269,6 +276,10 @@ class QueryServer:
         self._recovery_sweeps = 0
         self._recovered_indexes = 0
         self._next_recovery_sweep = 0.0  # monotonic; 0 = sweep on first submit
+        self._compaction_sweeps = 0
+        self._compaction_steps = 0
+        self._next_compaction_sweep = 0.0
+        self._compaction_running = False  # one sweep in flight at a time
         if self.config.autostart:
             self.start()
 
@@ -442,6 +453,10 @@ class QueryServer:
         # indexes whose writer died (the serving process is often the only
         # long-lived process around to notice)
         self._maybe_recovery_sweep()
+        # background compaction, hosted the same way: runs-layout indexes
+        # converge toward per-bucket files while admitted queries keep
+        # serving their pinned snapshots wholesale
+        self._maybe_compaction_sweep()
         ticket = QueryTicket(deadline_at, tenant)
         ticket._server = self
         if self.session.conf.telemetry_tracing_enabled():
@@ -588,6 +603,45 @@ class QueryServer:
         threading.Thread(
             target=self._recovery_sweep, daemon=True, name="hyperspace-serve-recovery"
         ).start()
+
+    def _maybe_compaction_sweep(self) -> None:
+        if not self.session.conf.compaction_enabled():
+            return
+        interval = self.config.compaction_sweep_interval_s
+        if interval is None:
+            interval = self.session.conf.compaction_interval_seconds()
+        if interval is None or interval <= 0:
+            return
+        now = time.monotonic()
+        with self._cond:
+            if now < self._next_compaction_sweep or self._compaction_running:
+                return
+            self._next_compaction_sweep = now + interval
+            self._compaction_running = True
+        threading.Thread(
+            target=self._compaction_sweep,
+            daemon=True,
+            name="hyperspace-serve-compaction",
+        ).start()
+
+    def _compaction_sweep(self) -> None:
+        from ..index.compactor import IndexCompactor
+
+        try:
+            results = IndexCompactor(self.session).sweep()
+        except Exception:  # noqa: BLE001
+            # counted, not raised: a failed sweep must never take down
+            # serving — the next interval retries
+            metrics.incr("serve.compaction_sweep_error")
+            results = {}
+        finally:
+            with self._cond:
+                self._compaction_running = False
+        metrics.incr("serve.compaction_sweep")
+        steps = sum(r.get("steps", 0) for r in results.values())
+        with self._cond:
+            self._compaction_sweeps += 1
+            self._compaction_steps += steps
 
     def _recovery_sweep(self) -> None:
         from ..reliability.recovery import recover_abandoned_indexes
@@ -1071,6 +1125,8 @@ class QueryServer:
             shed_stage = self._shed_stage_locked()
             sweeps = self._recovery_sweeps
             recovered = self._recovered_indexes
+            compaction_sweeps = self._compaction_sweeps
+            compaction_steps = self._compaction_steps
             out = {
                 "submitted": self._submitted,
                 "completed": self._completed,
@@ -1139,6 +1195,13 @@ class QueryServer:
             "server_recovery_sweeps": sweeps,
             "recovered_indexes": recovered,
             **reliability_snapshot(),
+        }
+        # background-compaction surface: THIS server's hosted sweeps and
+        # the steps they committed (the process-wide compaction.* counter
+        # family rides the registry export below)
+        out["compaction"] = {
+            "server_compaction_sweeps": compaction_sweeps,
+            "compaction_steps": compaction_steps,
         }
         out.update(tenancy.latency_percentiles_ms(lats))
         if waits:

@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+from . import constants as C
 from .config import HyperspaceConf
 from .sources.manager import FileBasedSourceProviderManager
 
@@ -132,6 +133,13 @@ class HyperspaceSession:
         from .telemetry.recorder import adopt_conf as adopt_recorder_conf
 
         adopt_recorder_conf(self.conf)
+        # segment-IO mode (hyperspace.storage.segmentIo) adopts the same
+        # way: the planner runs on process-global read paths; validated
+        # through the typed accessor so a value typo raises here
+        if self.conf.contains(C.STORAGE_SEGMENT_IO):
+            from .storage import layout as _layout
+
+            _layout.set_segment_io_default(self.conf.segment_io_mode())
         self.sources = FileBasedSourceProviderManager(self.conf)
         self.catalog = Catalog(self)
         self._hyperspace_enabled = False

@@ -23,8 +23,9 @@ import json
 import os
 import re
 import uuid
+from dataclasses import dataclass
 from pathlib import Path
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -74,6 +75,34 @@ def run_bucket_offsets(footer: Dict[str, Any]) -> Optional[np.ndarray]:
     if counts is None:
         return None
     return np.concatenate([[0], np.cumsum(np.asarray(counts, dtype=np.int64))])
+
+
+def run_offsets_checked(path: str | Path) -> np.ndarray:
+    """``run_bucket_offsets`` through the shared reader cache, raising the
+    canonical corruption error when the footer carries no bucket layout —
+    THE one copy of the "run file without its bucketCounts footer is
+    corrupt" validation every run-segment consumer (the segment planner,
+    the executor's bucket grouping, the mesh shard packer, optimize, the
+    compactor) shares. A silent whole-file fallback would duplicate the
+    file's rows into every bucket's group on the per-bucket call paths."""
+    offs = run_bucket_offsets(cached_reader(path).footer)
+    if offs is None:
+        raise HyperspaceException(
+            f"Run file {path} carries no bucketCounts footer."
+        )
+    return offs
+
+
+def index_root_of(path: str | Path) -> Optional[str]:
+    """The index directory a data file lives under (the parent of its
+    ``v__=k`` version dir), or None for paths outside the versioned
+    layout — the scoping key bucket-heat tracking and cache invalidation
+    agree on."""
+    p = Path(path)
+    for parent in p.parents:
+        if parent.name.startswith(C.INDEX_VERSION_DIRECTORY_PREFIX + "="):
+            return str(parent.parent)
+    return None
 
 
 def bucket_of_file(path: str | Path) -> int:
@@ -389,6 +418,227 @@ def read_batches(
                 out.append(ColumnarBatch(cols))
             return out
     return [read_batch(p, columns) for p in paths]
+
+
+# --- coalesced run-segment IO (the segment-read planner) ---------------------
+# A join/scan side over a runs-layout index needs (run file, bucket) row
+# segments; issuing them point-wise (one ranged read per segment) is the
+# ~18k-scattered-reads wall the SF100 q3/q17 pre-compaction numbers named
+# (ROADMAP). The planner takes the FULL segment set a side needs, groups
+# it per run file, merges adjacent/near-adjacent row ranges, and executes
+# ONE ordered sequential sweep per file through the shared TcbReader
+# handles — fanned across the host worker pool. ``io.segment.*`` counters
+# and per-sweep trace spans make the plan observable; the ``naive`` mode
+# (one read per segment — the pre-planner behavior) is the A/B lever
+# bench config 17 pulls.
+
+# merge ranges whose gap is at most this many rows: reading a small gap
+# through is cheaper than a second seek/ranged request, and the slice
+# step discards the gap rows without copying them
+SEGMENT_COALESCE_GAP_ROWS = 8192
+
+_SEGMENT_IO_DEFAULT = "planned"  # process default; session conf adopts
+
+
+def set_segment_io_default(mode: str) -> None:
+    """Adopt a session conf's ``hyperspace.storage.segmentIo`` value as
+    the process default (the residency-knob adoption pattern: the planner
+    is consulted from process-global read paths, so the last session's
+    conf wins; HYPERSPACE_TPU_SEGMENT_IO overrides both)."""
+    global _SEGMENT_IO_DEFAULT
+    if mode in C.STORAGE_SEGMENT_IO_MODES:
+        _SEGMENT_IO_DEFAULT = mode
+
+
+def segment_io_coalesced() -> bool:
+    v = os.environ.get("HYPERSPACE_TPU_SEGMENT_IO", "").strip().lower()
+    if v in C.STORAGE_SEGMENT_IO_MODES:
+        return v == C.STORAGE_SEGMENT_IO_PLANNED
+    return _SEGMENT_IO_DEFAULT == C.STORAGE_SEGMENT_IO_PLANNED
+
+
+@dataclass
+class SegmentSweep:
+    """One run file's planned read: ``segments`` are the (bucket, row_lo,
+    row_hi) slices the caller needs, lo-ascending (runs are bucket-grouped,
+    so bucket order IS row order); ``ranges`` are the merged [lo, hi) row
+    ranges one ordered sweep reads to cover them."""
+
+    path: str
+    segments: List[Tuple[int, int, int]]
+    ranges: List[Tuple[int, int]]
+
+
+def plan_segment_reads(
+    files: Iterable[str | Path],
+    buckets: Optional[Set[int]] = None,
+    gap_rows: int = SEGMENT_COALESCE_GAP_ROWS,
+) -> List[SegmentSweep]:
+    """Plan the (run file, bucket) segment reads ``buckets`` (None = every
+    bucket) need over the RUN files in ``files`` — non-run files are
+    skipped (callers read those whole). Adjacent and near-adjacent
+    segments merge into one range; a bucket with no rows in a file plans
+    nothing there."""
+    sweeps: List[SegmentSweep] = []
+    for f in files:
+        if not is_run_file(f):
+            continue
+        offs = run_offsets_checked(f)
+        want = (
+            range(len(offs) - 1)
+            if buckets is None
+            else sorted(b for b in buckets if 0 <= b < len(offs) - 1)
+        )
+        segs: List[Tuple[int, int, int]] = []
+        for b in want:
+            lo, hi = int(offs[b]), int(offs[b + 1])
+            if hi > lo:
+                segs.append((b, lo, hi))
+        if not segs:
+            continue
+        ranges: List[List[int]] = []
+        for _b, lo, hi in segs:  # lo-ascending by construction
+            if ranges and lo - ranges[-1][1] <= gap_rows:
+                ranges[-1][1] = hi
+            else:
+                ranges.append([lo, hi])
+        sweeps.append(
+            SegmentSweep(str(f), segs, [(a, b) for a, b in ranges])
+        )
+    return sweeps
+
+
+def _slice_batch(batch: ColumnarBatch, lo: int, hi: int) -> ColumnarBatch:
+    """A zero-copy row-slice view of ``batch`` (columns stay views over
+    the sweep's buffers; vocabs are shared)."""
+    return ColumnarBatch(
+        {
+            name: Column(c.dtype_str, c.data[lo:hi], c.vocab)
+            for name, c in batch.columns.items()
+        }
+    )
+
+
+def _segment_row_bytes(reader: TcbReader, names: List[str]) -> int:
+    total = 0
+    for m in reader.footer["columns"]:
+        if m["name"] not in names:
+            continue
+        dt = CODE_DTYPE if is_string(m["dtype"]) else numpy_dtype(m["dtype"])
+        total += dt.itemsize
+    return total
+
+
+def execute_segment_reads(
+    sweeps: List[SegmentSweep],
+    columns: Optional[Iterable[str]] = None,
+    workers: Optional[int] = None,
+    coalesce: Optional[bool] = None,
+) -> Dict[Tuple[str, int], ColumnarBatch]:
+    """Execute a segment-read plan: one ordered sweep per run file (the
+    merged ranges read front-to-back through the shared reader handles),
+    fanned across the host worker pool, returning the per-(path, bucket)
+    column batches. ``coalesce=False`` (or segment IO mode ``naive``)
+    issues one ranged read per segment instead — the pre-planner
+    behavior the config-17 A/B measures against."""
+    if not sweeps:
+        return {}
+    if coalesce is None:
+        coalesce = segment_io_coalesced()
+    from ..telemetry.metrics import metrics
+    from ..telemetry.trace import span as _span
+
+    names = list(columns) if columns is not None else None
+
+    def sweep_one(sw: SegmentSweep) -> Dict[Tuple[str, int], ColumnarBatch]:
+        reader = cached_reader(sw.path)
+        got: Dict[Tuple[str, int], ColumnarBatch] = {}
+        want = names if names is not None else [
+            m["name"] for m in reader.footer["columns"]
+        ]
+        row_bytes = _segment_row_bytes(reader, want)
+        n_reads = 0
+        nbytes = 0
+        with _span(
+            "io.segment_sweep",
+            file=os.path.basename(sw.path),
+            segments=len(sw.segments),
+            planned_ranges=len(sw.ranges),
+            coalesced=bool(coalesce),
+        ):
+            if coalesce:
+                seg_i = 0
+                for lo, hi in sw.ranges:
+                    block = reader.read(want, row_range=(lo, hi))
+                    n_reads += 1
+                    nbytes += (hi - lo) * row_bytes
+                    while (
+                        seg_i < len(sw.segments)
+                        and sw.segments[seg_i][2] <= hi
+                    ):
+                        b, slo, shi = sw.segments[seg_i]
+                        got[(sw.path, b)] = _slice_batch(
+                            block, slo - lo, shi - lo
+                        )
+                        seg_i += 1
+            else:
+                for b, lo, hi in sw.segments:
+                    got[(sw.path, b)] = reader.read(
+                        want, row_range=(lo, hi)
+                    )
+                    n_reads += 1
+                    nbytes += (hi - lo) * row_bytes
+        metrics.incr("io.segment.ranges", n_reads)
+        metrics.incr("io.segment.coalesced", len(sw.segments) - n_reads)
+        metrics.incr("io.segment.bytes", nbytes)
+        return got
+
+    metrics.incr("io.segment.sweeps", len(sweeps))
+    with metrics.timer("io.segment.sweep_wall"), _span(
+        "io.segment_io", sweeps=len(sweeps)
+    ):
+        if workers is None:
+            workers = min(len(sweeps), os.cpu_count() or 1)
+        if workers <= 1 or len(sweeps) == 1:
+            results = [sweep_one(sw) for sw in sweeps]
+        else:
+            import contextvars
+
+            from ..parallel.pool import run_parallel
+
+            # each worker runs under a copy of the caller's context so
+            # per-sweep spans land in THIS query's trace (the union-side
+            # context-copy discipline)
+            tasks = []
+            for sw in sweeps:
+                ctx = contextvars.copy_context()
+                tasks.append(lambda sw=sw, ctx=ctx: ctx.run(sweep_one, sw))
+            results = run_parallel(tasks, workers, name="segment-io")
+    out: Dict[Tuple[str, int], ColumnarBatch] = {}
+    for r in results:
+        out.update(r)
+    return out
+
+
+def read_run_coalesced(
+    path: str | Path, columns: Optional[Iterable[str]] = None
+) -> ColumnarBatch:
+    """Read one run file whole THROUGH the segment planner (one sweep,
+    one merged range): bucket segments concatenate in bucket order, which
+    is the file's row order — byte-identical to ``read_batch`` but with
+    the sweep counted and traced. The refresh rewrite path uses this so
+    runs-layout maintenance IO rides the same plan/observe machinery as
+    queries."""
+    sweeps = plan_segment_reads([path])
+    if not sweeps:
+        return read_batch(path, columns=columns)
+    got = execute_segment_reads(sweeps, columns=columns)
+    parts = [got[(sweeps[0].path, b)] for b, _lo, _hi in sweeps[0].segments]
+    if len(parts) == 1:
+        return parts[0]
+    # bucket segments of one run share the file's vocab objects, so the
+    # concat re-encode is a no-op rename; order == row order
+    return ColumnarBatch.concat(parts)
 
 
 def prune_by_min_max(

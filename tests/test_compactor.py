@@ -1,0 +1,717 @@
+"""Coalesced segment IO (storage/layout.py planner) + incremental
+background compaction (index/compactor.py).
+
+Parity discipline: the planner must be INVISIBLE in results — every
+planned-sweep read is compared batch-for-batch against the naive
+one-ranged-read-per-segment execution of the same plan, and whole query
+paths (join, scan, refresh, mesh) are compared across the
+``hyperspace.storage.segmentIo`` A/B lever. The compactor must be
+invisible too: convergence produces exactly ``optimize(quick)``'s
+per-bucket content, pinned readers keep answering mid-step, a crash
+mid-step auto-recovers, and a fenced zombie never commits.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import layout, parquet_io
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+from hyperspace_tpu.storage.filesystem import PosixFileSystem
+from hyperspace_tpu.telemetry.metrics import metrics
+
+N = 40_000
+BUCKETS = 8
+
+
+def _source(tmp_path, n=N, n_files=4, seed=5):
+    rng = np.random.default_rng(seed)
+    batch = ColumnarBatch(
+        {
+            "k": Column("int64", rng.integers(0, 100_000, n)),
+            "v": Column("int64", rng.integers(0, 1_000, n)),
+            "s": Column.from_values(
+                np.array([b"aa", b"bb", b"cc"], dtype=object)[
+                    rng.integers(0, 3, n)
+                ]
+            ),
+        }
+    )
+    src = tmp_path / "src"
+    src.mkdir()
+    per = n // n_files
+    for i in range(n_files):
+        parquet_io.write_parquet(
+            src / f"p{i}.parquet",
+            batch.take(np.arange(i * per, min((i + 1) * per, n))),
+        )
+    return src, batch
+
+
+def _session(tmp_path, sub="idx", **over):
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / sub),
+            C.INDEX_NUM_BUCKETS: BUCKETS,
+            C.BUILD_MODE: C.BUILD_MODE_STREAMING,
+            C.BUILD_CHUNK_ROWS: 1 << 13,  # several runs at N=40k
+            C.BUILD_FINALIZE_MODE: C.BUILD_FINALIZE_RUNS,
+            **over,
+        }
+    )
+    session = HyperspaceSession(conf)
+    return session, Hyperspace(session)
+
+
+def _index_files(hs, name):
+    loc = hs.index(name).index_location
+    return sorted(str(p) for p in Path(loc).glob("v__=*/*.tcb"))
+
+
+def _batches_equal(a: ColumnarBatch, b: ColumnarBatch) -> bool:
+    if set(a.columns) != set(b.columns) or a.num_rows != b.num_rows:
+        return False
+    return all(
+        np.array_equal(a.columns[n].data, b.columns[n].data)
+        for n in a.columns
+    )
+
+
+# ---------------------------------------------------------------------------
+# the segment planner
+# ---------------------------------------------------------------------------
+def test_plan_coalesces_and_executes_byte_identical(tmp_path):
+    """Adjacent bucket segments of a run merge into one range per file;
+    the planned sweep returns exactly the batches the naive per-segment
+    execution of the SAME plan returns."""
+    src, _ = _source(tmp_path)
+    session, hs = _session(tmp_path)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v", "s"])
+    )
+    files = _index_files(hs, "ri")
+    assert all(layout.is_run_file(f) for f in files)
+    plan = layout.plan_segment_reads(files)
+    assert len(plan) == len(files)
+    n_segments = sum(len(sw.segments) for sw in plan)
+    n_ranges = sum(len(sw.ranges) for sw in plan)
+    # bucket segments are adjacent within a run: every file collapses to
+    # ONE merged range
+    assert n_ranges == len(files)
+    assert n_segments > n_ranges
+    metrics.reset()
+    planned = layout.execute_segment_reads(plan, coalesce=True)
+    planned_reads = metrics.counter("io.segment.ranges")
+    metrics.reset()
+    naive = layout.execute_segment_reads(plan, coalesce=False)
+    naive_reads = metrics.counter("io.segment.ranges")
+    assert planned_reads == n_ranges
+    assert naive_reads == n_segments
+    assert set(planned) == set(naive)
+    for key in planned:
+        assert _batches_equal(planned[key], naive[key]), key
+    # a pinned subset plans only those buckets' rows
+    some = {1, 4}
+    sub = layout.plan_segment_reads(files, buckets=some)
+    for sw in sub:
+        assert {b for b, _lo, _hi in sw.segments} <= some
+
+
+def test_read_run_coalesced_matches_read_batch(tmp_path):
+    src, _ = _source(tmp_path)
+    session, hs = _session(tmp_path)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+    )
+    for f in _index_files(hs, "ri"):
+        whole = layout.read_batch(f)
+        swept = layout.read_run_coalesced(f)
+        assert _batches_equal(whole, swept), f
+
+
+@pytest.mark.parametrize("shape", ["lookup", "join"])
+def test_segment_io_mode_ab_parity(tmp_path, monkeypatch, shape):
+    """The config-17 A/B lever: the same query under segmentIo=naive and
+    =planned returns identical rows, and planned issues >=
+    len(buckets-touched)/len(files) fewer ranged reads."""
+    src, batch = _source(tmp_path)
+    rng = np.random.default_rng(9)
+    n_r = 10_000
+    right = ColumnarBatch(
+        {
+            "rk": Column("int64", rng.integers(0, 100_000, n_r)),
+            "rv": Column("int64", rng.integers(0, 50, n_r)),
+        }
+    )
+    rsrc = tmp_path / "rsrc"
+    rsrc.mkdir()
+    parquet_io.write_parquet(rsrc / "r0.parquet", right)
+    session, hs = _session(tmp_path)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+    )
+    hs.create_index(
+        session.read.parquet(str(rsrc)), IndexConfig("rj", ["rk"], ["rv"])
+    )
+    key = int(batch.columns["k"].data[N // 3])
+    if shape == "lookup":
+        q = lambda: (  # noqa: E731
+            session.read.parquet(str(src))
+            .filter(col("k") == lit(key))
+            .select("k", "v")
+        )
+    else:
+        q = lambda: (  # noqa: E731
+            session.read.parquet(str(src))
+            .join(session.read.parquet(str(rsrc)), col("k") == col("rk"))
+            .select("v", "rv")
+        )
+    session.enable_hyperspace()
+
+    from hyperspace_tpu.exec.executor import reset_groups_cache
+
+    def run(mode):
+        monkeypatch.setenv("HYPERSPACE_TPU_SEGMENT_IO", mode)
+        reset_groups_cache()  # re-read, don't serve the other mode's groups
+        metrics.reset()
+        out = q().collect()
+        return out, metrics.counter("io.segment.ranges")
+
+    naive_out, naive_reads = run("naive")
+    planned_out, planned_reads = run("planned")
+    monkeypatch.delenv("HYPERSPACE_TPU_SEGMENT_IO")
+    assert naive_out.num_rows == planned_out.num_rows
+    for name in naive_out.columns:
+        assert sorted(naive_out.columns[name].data.tolist()) == sorted(
+            planned_out.columns[name].data.tolist()
+        )
+    # coalescing is real on multi-segment sides (a single pinned bucket
+    # has one segment per file — nothing to merge), and never worse
+    assert 0 < planned_reads <= naive_reads
+    if shape == "join":
+        assert planned_reads < naive_reads
+
+
+def test_refresh_parity_across_segment_io_modes(tmp_path, monkeypatch):
+    """The lineage-delete rewrite reads runs through the planner: the
+    refreshed index answers identically under both IO modes."""
+    outs = {}
+    for mode in ("naive", "planned"):
+        monkeypatch.setenv("HYPERSPACE_TPU_SEGMENT_IO", mode)
+        root = tmp_path / mode
+        root.mkdir()
+        src, batch = _source(root)
+        session, hs = _session(
+            root, **{C.INDEX_LINEAGE_ENABLED: "true"}
+        )
+        hs.create_index(
+            session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+        )
+        (src / "p2.parquet").unlink()
+        hs.refresh_index("ri", C.REFRESH_MODE_INCREMENTAL)
+        key = int(batch.columns["k"].data[5])
+        session.enable_hyperspace()
+        out = (
+            session.read.parquet(str(src))
+            .filter(col("k") == lit(key))
+            .select("k", "v")
+            .to_pandas()
+            .sort_values("v")
+            .reset_index(drop=True)
+        )
+        outs[mode] = out
+    monkeypatch.delenv("HYPERSPACE_TPU_SEGMENT_IO")
+    assert outs["naive"].equals(outs["planned"])
+
+
+def test_mesh_shard_pack_parity_across_segment_io_modes(tmp_path, monkeypatch):
+    """Shard packing (mesh_cache) reads run segments through the planner:
+    the distributed filter answers identically under both IO modes."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    from hyperspace_tpu.exec.distributed import distributed_filter
+    from hyperspace_tpu.exec.executor import Executor
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    src, batch = _source(tmp_path)
+    session, hs = _session(tmp_path)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+    )
+    files = _index_files(hs, "ri")
+    key = int(batch.columns["k"].data[11])
+    pred = col("k") == lit(key)
+    counts = {}
+    for mode in ("naive", "planned"):
+        monkeypatch.setenv("HYPERSPACE_TPU_SEGMENT_IO", mode)
+        batches = [layout.read_batch(f, columns=["k", "v"]) for f in files]
+        by_bucket = Executor._group_batches_by_bucket(files, batches)
+        got = distributed_filter(by_bucket, pred, ["k", "v"], make_mesh(8))
+        counts[mode] = (
+            got.num_rows,
+            sorted(got.columns["v"].data.tolist()),
+        )
+    monkeypatch.delenv("HYPERSPACE_TPU_SEGMENT_IO")
+    assert counts["naive"] == counts["planned"]
+    assert counts["planned"][0] == int((batch.columns["k"].data == key).sum())
+
+
+# ---------------------------------------------------------------------------
+# the incremental compactor
+# ---------------------------------------------------------------------------
+def _content_by_bucket(index_dir):
+    entry = IndexLogManagerImpl(Path(index_dir)).get_latest_stable_log()
+    out = {}
+    for f in entry.content.files():
+        assert not layout.is_run_file(f), f"run survived convergence: {f}"
+        out[layout.bucket_of_file(f)] = layout.read_batch(f)
+    return out
+
+
+def test_compaction_converges_to_optimize_layout(tmp_path):
+    """Steps commit incrementally (pinned readers keep answering between
+    them), and the converged content is bucket-for-bucket row-identical
+    to what one optimize(quick) produces from the same build."""
+    src, batch = _source(tmp_path)
+    per_step = 3
+    session, hs = _session(
+        tmp_path, "a", **{C.INDEX_COMPACTION_BUCKETS_PER_STEP: per_step}
+    )
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+    )
+    key = int(batch.columns["k"].data[7])
+    q = lambda: (  # noqa: E731
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(key))
+        .select("k", "v")
+        .to_pandas()
+        .sort_values("v")
+        .reset_index(drop=True)
+    )
+    session.enable_hyperspace()
+    before = q()
+    first = hs.compact_index("ri", max_steps=1)
+    assert first == {"steps": 1, "converged": False}
+    assert before.equals(q())  # mid-convergence parity
+    rest = hs.compact_index("ri")
+    assert rest["converged"]
+    assert before.equals(q())
+    # convergence is idempotent: nothing left to do
+    assert hs.compact_index("ri") == {"steps": 0, "converged": True}
+
+    session_b, hs_b = _session(tmp_path, "b")
+    hs_b.create_index(
+        session_b.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+    )
+    hs_b.optimize_index("ri")
+    ca = _content_by_bucket(Path(hs.index("ri").index_location))
+    cb = _content_by_bucket(Path(hs_b.index("ri").index_location))
+    assert set(ca) == set(cb)
+    for b in ca:
+        assert _batches_equal(ca[b], cb[b]), f"bucket {b} diverged"
+    assert sum(x.num_rows for x in ca.values()) == N
+
+
+def test_compaction_step_prefers_hot_buckets(tmp_path):
+    """The step's bucket choice is observed heat: buckets queries
+    touched compact first."""
+    from hyperspace_tpu.exec.scan_gate import bucket_heat, note_bucket_heat
+
+    src, _ = _source(tmp_path)
+    session, hs = _session(
+        tmp_path, **{C.INDEX_COMPACTION_BUCKETS_PER_STEP: 2}
+    )
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+    )
+    index_dir = str(Path(hs.index("ri").index_location))
+    hot = [5, 2]
+    for _ in range(3):
+        note_bucket_heat(index_dir, hot)
+    assert set(bucket_heat(index_dir)) == set(hot)
+    hs.compact_index("ri", max_steps=1)
+    entry = IndexLogManagerImpl(Path(index_dir)).get_latest_stable_log()
+    bucket_files = [
+        f for f in entry.content.files() if not layout.is_run_file(f)
+    ]
+    assert sorted(layout.bucket_of_file(f) for f in bucket_files) == sorted(hot)
+    # the remaining runs no longer hold the compacted buckets' rows
+    for f in entry.content.files():
+        if layout.is_run_file(f):
+            offs = layout.run_offsets_checked(f)
+            for b in hot:
+                assert offs[b + 1] == offs[b], (f, b)
+
+
+def test_query_heat_feeds_compactor(tmp_path):
+    """An equality lookup over the runs layout NOTES its pinned buckets —
+    the planner read sites feed the compactor's priority signal."""
+    from hyperspace_tpu.exec.scan_gate import bucket_heat
+
+    src, batch = _source(tmp_path)
+    session, hs = _session(tmp_path)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+    )
+    index_dir = str(Path(hs.index("ri").index_location))
+    session.enable_hyperspace()
+    key = int(batch.columns["k"].data[3])
+    (
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(key))
+        .select("k", "v")
+        .collect()
+    )
+    heat = bucket_heat(index_dir)
+    assert heat and all(v > 0 for v in heat.values())
+
+
+def test_doctor_names_in_flight_and_abandoned_compactions(tmp_path):
+    """doctor() distinguishes a compaction writer from a human's
+    optimize: live lease → informational compaction-in-flight; expired
+    lease → repairable compaction-abandoned whose repair rolls back and
+    vacuums the litter."""
+    from hyperspace_tpu.index.compactor import CompactionStep
+    from hyperspace_tpu.reliability import doctor
+    from hyperspace_tpu.reliability.doctor import (
+        COMPACTION_ABANDONED,
+        COMPACTION_IN_FLIGHT,
+    )
+
+    src, _ = _source(tmp_path)
+    session, hs = _session(tmp_path)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+    )
+    mgr = session.collection_manager
+    index_dir = mgr.path_resolver.get_index_path("ri")
+    action = CompactionStep(
+        session, mgr._existing_log_manager("ri"), mgr._data_manager("ri")
+    )
+
+    seen = {}
+
+    def freeze_mid_op():
+        # the step is mid-flight: transient head + live lease
+        report = doctor(index_dir)
+        seen["mid"] = {i.kind for i in report.issues}
+        raise RuntimeError("operator saw this")
+
+    action.op = freeze_mid_op
+    with pytest.raises(RuntimeError):
+        action.run()
+    assert COMPACTION_IN_FLIGHT in seen["mid"]
+
+    # the writer "dies": its lease expires unreleased
+    import time as _time
+
+    from hyperspace_tpu.reliability import LeaseManager
+
+    lm = LeaseManager(index_dir, PosixFileSystem())
+    rec = lm.current()
+    rec.state = "live"
+    rec.expires_at_ms = int(_time.time() * 1000) - 60_000
+    Path(lm._path_of(rec.epoch)).write_text(rec.to_json(), encoding="utf-8")
+
+    report = doctor(index_dir)
+    assert COMPACTION_ABANDONED in {i.kind for i in report.issues}
+    assert not report.ok
+    doctor(index_dir, repair=True)
+    assert doctor(index_dir).ok
+
+
+def test_crash_mid_compaction_auto_recovers_with_parity(tmp_path):
+    """InjectedCrash at every mutating log-protocol call of a compaction
+    step: a fresh session auto-recovers, queries answer identically, and
+    doctor repairs to a clean tree (the chaos invariant, applied to the
+    new action)."""
+    from hyperspace_tpu.index.collection_manager import IndexCollectionManager
+    from hyperspace_tpu.reliability import (
+        FaultInjectingFileSystem,
+        FaultRule,
+        InjectedCrash,
+        LeaseManager,
+        doctor,
+    )
+    from hyperspace_tpu.reliability.faults import (
+        MUTATING_OPS,
+        RecordingFileSystem,
+    )
+
+    def build(tag):
+        root = tmp_path / tag
+        root.mkdir()
+        src, batch = _source(root)
+        session, hs = _session(
+            root, **{C.INDEX_COMPACTION_BUCKETS_PER_STEP: 3}
+        )
+        hs.create_index(
+            session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+        )
+        return root, src, batch, session, hs
+
+    def faulted(session, fs):
+        mgr = session.collection_manager
+        orig = IndexCollectionManager._log_manager
+
+        def patched(self, name):
+            return IndexLogManagerImpl(
+                self.path_resolver.get_index_path(name),
+                fs=fs,
+                retry_policy=self.conf.retry_policy(),
+            )
+
+        IndexCollectionManager._log_manager = patched
+        return orig
+
+    # enumerate the step's mutating protocol calls on a clean run
+    root, src, batch, session, hs = build("enum")
+    rec = RecordingFileSystem(PosixFileSystem())
+    orig = faulted(session, rec)
+    try:
+        hs.compact_index("ri", max_steps=1)
+    finally:
+        IndexCollectionManager._log_manager = orig
+    points = [i for i, (op, _) in enumerate(rec.ops) if op in MUTATING_OPS]
+    assert len(points) >= 2, points
+
+    for call_index in points:
+        root, src, batch, session, hs = build(f"crash-{call_index}")
+        fault = FaultInjectingFileSystem(
+            PosixFileSystem(), [FaultRule(kind="crash", op="*", after=call_index)]
+        )
+        orig = faulted(session, fault)
+        try:
+            with pytest.raises(InjectedCrash):
+                hs.compact_index("ri", max_steps=1)
+        finally:
+            IndexCollectionManager._log_manager = orig
+        assert fault.dead
+
+        # simulate wall-clock passage: the dead writer's lease expires
+        index_dir = session.collection_manager.path_resolver.get_index_path(
+            "ri"
+        )
+        import time as _time
+
+        lm = LeaseManager(index_dir, PosixFileSystem())
+        lease = lm.current()
+        if lease is not None and not lease.is_terminal:
+            lease.expires_at_ms = int(_time.time() * 1000) - 60_000
+            Path(lm._path_of(lease.epoch)).write_text(
+                lease.to_json(), encoding="utf-8"
+            )
+
+        # a fresh session heals on attach and answers correctly
+        conf2 = HyperspaceConf(
+            {
+                C.INDEX_SYSTEM_PATH: str(root / "idx"),
+                C.INDEX_NUM_BUCKETS: BUCKETS,
+            }
+        )
+        session2 = HyperspaceSession(conf2)
+        hs2 = Hyperspace(session2)
+        hs2.indexes()
+        key = int(batch.columns["k"].data[7])
+        q = lambda s: (  # noqa: E731
+            s.read.parquet(str(src))
+            .filter(col("k") == lit(key))
+            .select("k", "v")
+            .collect()
+        )
+        session2.disable_hyperspace()
+        truth = sorted(q(session2).columns["v"].data.tolist())
+        session2.enable_hyperspace()
+        got = sorted(q(session2).columns["v"].data.tolist())
+        assert got == truth, f"crash@{call_index}: wrong rows"
+        doctor(root / "idx", repair=True)
+        assert doctor(root / "idx").ok, f"crash@{call_index}: litter survived"
+
+
+def test_partition_and_eligibility_cover_small_file_buckets():
+    """optimize(quick) merges >=2 small files in a bucket even with no
+    run rows — the compactor's partition rule and the sweep's metadata
+    eligibility check must agree, or 'converged' lies about matching
+    optimize(quick)'s layout."""
+    from types import SimpleNamespace
+
+    from hyperspace_tpu.index.compactor import partition_compactable
+
+    fi = lambda name, size: SimpleNamespace(name=name, size=size)  # noqa: E731
+    threshold = 1000
+    infos = [
+        fi("b00002-aa.tcb", 5000),  # big: untouched
+        fi("b00003-bb.tcb", 10),  # small pair in bucket 3
+        fi("b00003-cc.tcb", 20),
+        fi("b00004-dd.tcb", 10),  # lone small file: already compact
+    ]
+    to_optimize, run_files, run_buckets, untouched = partition_compactable(
+        infos, threshold, quick=True
+    )
+    assert not run_files and not run_buckets
+    assert set(to_optimize) == {3}
+    assert {f.name for f in untouched} == {"b00002-aa.tcb", "b00004-dd.tcb"}
+
+
+def test_compact_index_refuses_sketch_index_cleanly(tmp_path):
+    """The explicit verb on a data-skipping index is a clean 'ineligible'
+    no-op (the optimize() kind guard), not a bucket-parse crash."""
+    from hyperspace_tpu.index.index_config import DataSkippingIndexConfig
+    from hyperspace_tpu.index.sketches import MinMaxSketch
+
+    src, _ = _source(tmp_path)
+    session, hs = _session(tmp_path)
+    hs.create_index(
+        session.read.parquet(str(src)),
+        DataSkippingIndexConfig("sk", [MinMaxSketch("k")]),
+    )
+    assert hs.compact_index("sk") == {"steps": 0, "converged": False}
+
+
+def test_step_reports_conflict_on_transient_head(tmp_path):
+    """A concurrent writer's transient log head surfaces as 'conflict'
+    (count + retry next sweep), not an exception that would mark every
+    hosted sweep as an error."""
+    from hyperspace_tpu.actions import states
+    from hyperspace_tpu.index.compactor import IndexCompactor
+
+    src, _ = _source(tmp_path)
+    session, hs = _session(tmp_path)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+    )
+    mgr = session.collection_manager
+    log_mgr = mgr._existing_log_manager("ri")
+    # hand-write a transient head, the way a mid-flight writer leaves it
+    head = log_mgr.get_latest_log()
+    head.id += 1
+    head.state = states.OPTIMIZING
+    assert log_mgr.write_log(head.id, head)
+    assert IndexCompactor(session).step("ri") == "conflict"
+    assert metrics.counter("compaction.step_conflict") > 0
+
+
+def test_lease_fencing_refuses_zombie_compactor(tmp_path):
+    """A compactor that stalls past its lease while a recoverer claims
+    the index must NOT commit — check_fenced at end() refuses, and the
+    step surfaces as a conflict, not a corruption."""
+    from hyperspace_tpu.exceptions import ConcurrentModificationException
+    from hyperspace_tpu.index.compactor import CompactionStep
+    from hyperspace_tpu.reliability import LeaseManager
+
+    src, _ = _source(tmp_path)
+    session, hs = _session(tmp_path)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+    )
+    mgr = session.collection_manager
+    index_dir = mgr.path_resolver.get_index_path("ri")
+    log_mgr = mgr._existing_log_manager("ri")
+    stable_before = log_mgr.get_latest_stable_log().id
+    action = CompactionStep(session, log_mgr, mgr._data_manager("ri"))
+    orig_op = action.op
+
+    def op_then_get_fenced():
+        orig_op()
+        # while the zombie slept, recovery force-claimed the index
+        LeaseManager(index_dir, PosixFileSystem()).acquire(
+            duration_s=30.0, force=True
+        ).release()
+
+    action.op = op_then_get_fenced
+    with pytest.raises(ConcurrentModificationException):
+        action.run()
+    # no commit happened: the stable entry is untouched
+    assert log_mgr.get_latest_stable_log().id == stable_before
+
+
+def test_serve_burst_while_compacting_zero_failures(tmp_path):
+    """hyperspace.index.compaction.enabled=auto: a hosting QueryServer
+    drives the index to convergence while a live burst runs — zero
+    failed tickets, every answer correct, stats() reports the sweeps."""
+    import time as _time
+
+    src, batch = _source(tmp_path)
+    session, hs = _session(
+        tmp_path,
+        **{
+            C.INDEX_COMPACTION: C.INDEX_COMPACTION_AUTO,
+            C.INDEX_COMPACTION_INTERVAL_SECONDS: 0.02,
+            C.INDEX_COMPACTION_BUCKETS_PER_STEP: 2,
+            C.INDEX_COMPACTION_MAX_STEPS_PER_SWEEP: 1,
+        },
+    )
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("ri", ["k"], ["v"])
+    )
+    session.enable_hyperspace()
+    keys = [int(k) for k in batch.columns["k"].data[:40]]
+    expected = {}
+    session.disable_hyperspace()
+    for k in set(keys):
+        out = (
+            session.read.parquet(str(src))
+            .filter(col("k") == lit(k))
+            .select("k", "v")
+            .collect()
+        )
+        expected[k] = sorted(out.columns["v"].data.tolist())
+    session.enable_hyperspace()
+
+    server = hs.serve(max_workers=2)
+    mgr = IndexLogManagerImpl(
+        session.collection_manager.path_resolver.get_index_path("ri")
+    )
+
+    def converged():
+        entry = mgr.get_latest_stable_log()
+        return not any(layout.is_run_file(f) for f in entry.content.files())
+
+    try:
+        deadline = _time.monotonic() + 120.0
+        rounds = 0
+        while _time.monotonic() < deadline:
+            tickets = [
+                (
+                    k,
+                    server.submit(
+                        session.read.parquet(str(src))
+                        .filter(col("k") == lit(k))
+                        .select("k", "v")
+                    ),
+                )
+                for k in keys
+            ]
+            for k, t in tickets:
+                out = t.result(timeout=120)
+                assert sorted(out.columns["v"].data.tolist()) == expected[k]
+            rounds += 1
+            if converged():
+                break
+            _time.sleep(0.03)
+        assert converged(), "server never drove the index to convergence"
+        stats = server.stats()
+        assert stats["failed"] == 0
+        assert stats["compaction"]["server_compaction_sweeps"] >= 1
+        assert stats["compaction"]["compaction_steps"] >= 1
+        # post-convergence burst still answers
+        for k, t in [(keys[0], server.submit(
+            session.read.parquet(str(src))
+            .filter(col("k") == lit(keys[0]))
+            .select("k", "v")
+        ))]:
+            out = t.result(timeout=120)
+            assert sorted(out.columns["v"].data.tolist()) == expected[k]
+    finally:
+        server.close()
