@@ -2705,6 +2705,190 @@ def main() -> None:
                 )
         rj["compaction"]["layout_matches_optimize"] = True
 
+    # ---- config 18: device-resident build A/B (per-chunk vs staged) --------
+    # The PR-14 claim (docs/14-build-pipeline.md, device-resident build):
+    # with the engine PINNED device, the staged mode — double-buffered
+    # H2D slab pair + runChunks-deep on-device run merge + async
+    # write-back — must produce BYTE-identical per-bucket index files
+    # and identical query results while paying >= R× fewer blocking D2H
+    # calls, with overlap evidence on the staged side: dispatch (H2D +
+    # kernel) + spill-compute + spill-write busy sums exceed the
+    # pipeline wall (busy sums COUNT overlap; exceeding wall is the
+    # overlap working, the config-13 reading discipline). Gates are
+    # call-count and byte facts, not wall ratios: on a CPU container the
+    # "device" engine is the CPU jax backend, where simulation cost
+    # inverts wall times but the D2H-call arithmetic is invariant.
+    _bd_enabled = os.environ.get("BENCH_BUILD_DEVICE", "1") != "0"
+    if _bd_enabled:
+        from hyperspace_tpu.storage import layout as _layout18
+        from hyperspace_tpu.telemetry.metrics import (
+            build_pipeline_snapshot as _bps18,
+        )
+
+        bd_chunk = int(
+            os.environ.get("BENCH_BUILD_DEV_CHUNK", max(N_ROWS // 16, 1 << 15))
+        )
+        bd_r = int(os.environ.get("BENCH_BUILD_DEV_RUN_CHUNKS", 4))
+        bd_full = N_ROWS // bd_chunk
+        bd_tail = 1 if N_ROWS % bd_chunk else 0
+        # snap R down to a divisor of the full-chunk count so the >= R×
+        # gate is exact call arithmetic at every BENCH_ROWS (a partial
+        # final run would dilute the ratio below R without measuring
+        # anything about the design); at the default geometry (16 full
+        # chunks) the requested R=4 stands
+        while bd_r > 1 and bd_full % bd_r:
+            bd_r -= 1
+        bd_detail = {
+            "rows": N_ROWS,
+            "chunk_rows": bd_chunk,
+            "run_chunks": bd_r,
+            "full_chunks": bd_full,
+            "tail_chunks": bd_tail,
+        }
+        if bd_full < 1:
+            # degenerate smoke geometry (BENCH_ROWS below one full
+            # chunk): every chunk is a tail and routes per-chunk by
+            # design — record the skip instead of failing gates that
+            # would measure nothing
+            bd_detail["skipped"] = "no full chunks at this BENCH_ROWS"
+            extras["build_device"] = bd_detail
+            _bd_enabled = False
+    if _bd_enabled:
+        bd_sessions = {}
+
+        def _bd_build(tag, double_buffer, run_chunks):
+            conf_d = HyperspaceConf(
+                {
+                    C.INDEX_SYSTEM_PATH: str(WORKDIR / f"bd_idx_{tag}"),
+                    C.INDEX_NUM_BUCKETS: N_BUCKETS,
+                    C.BUILD_MODE: C.BUILD_MODE_STREAMING,
+                    C.BUILD_CHUNK_ROWS: bd_chunk,
+                    C.BUILD_ENGINE: "device",
+                    C.BUILD_DEVICE_DOUBLE_BUFFER: double_buffer,
+                    C.BUILD_DEVICE_RUN_CHUNKS: run_chunks,
+                }
+            )
+            s = HyperspaceSession(conf_d)
+            bd_sessions[tag] = s
+            metrics.reset()
+            t0 = time.perf_counter()
+            Hyperspace(s).create_index(
+                s.read.parquet(str(WORKDIR / "lineitem")),
+                # integer keys: a string KEY declines staging by design
+                # (per-chunk vocab codes don't merge); the string payload
+                # column rides along untouched
+                IndexConfig(
+                    "bd_idx", ["l_orderkey"], ["l_partkey", "l_shipmode"]
+                ),
+            )
+            wall = time.perf_counter() - t0
+            snap = metrics.snapshot()
+            cnt = snap["counters"]
+            return {
+                "build_s": round(wall, 3),
+                "rows_per_s": round(N_ROWS / wall),
+                "d2h_calls": cnt.get("build.stream.d2h_calls", 0),
+                "d2h_bytes": cnt.get("build.stream.d2h_bytes", 0),
+                "h2d_bytes": cnt.get("build.stream.h2d_bytes", 0),
+                "staged_chunks": cnt.get("build.device.staged_chunks", 0),
+                "staged_runs": cnt.get("build.device.staged_runs", 0),
+                "slab_rotations": cnt.get("build.device.slab_rotations", 0),
+                "declined": {
+                    k.rsplit(".", 1)[-1]: v
+                    for k, v in cnt.items()
+                    if k.startswith("build.device.staging_declined.")
+                },
+                "dispatch_busy_s": round(
+                    snap["timers_s"].get("build.stream.dispatch", 0.0), 4
+                ),
+                "device_merge_s": round(
+                    snap["timers_s"].get("build.stream.device_merge", 0.0), 4
+                ),
+                "stages": _bps18(),
+            }
+
+        def _bd_bucket_bytes(tag):
+            vdir = WORKDIR / f"bd_idx_{tag}" / "bd_idx" / "v__=0"
+            return {
+                _layout18.bucket_of_file(f): f.read_bytes()
+                for f in sorted(vdir.glob("*.tcb"))
+            }
+
+        bd_detail["per_chunk"] = _bd_build("per_chunk", False, 1)
+        bd_detail["staged"] = _bd_build("staged", True, bd_r)
+        a18, b18 = bd_detail["per_chunk"], bd_detail["staged"]
+        # -- parity gates: byte-identical index, identical query rows --
+        if _bd_bucket_bytes("per_chunk") != _bd_bucket_bytes("staged"):
+            _fail("config18 per-chunk/staged per-bucket byte parity violated")
+        bd_key = int(lineitem.columns["l_orderkey"].data[11])
+        bd_rows = {}
+        for tag, s in bd_sessions.items():
+            s.enable_hyperspace()
+            bd_rows[tag] = (
+                s.read.parquet(str(WORKDIR / "lineitem"))
+                .filter(col("l_orderkey") == bd_key)
+                .select("l_orderkey", "l_partkey", "l_shipmode")
+                .to_pandas()
+                .sort_values(["l_partkey", "l_shipmode"])
+                .reset_index(drop=True)
+            )
+        if not bd_rows["per_chunk"].equals(bd_rows["staged"]):
+            _fail("config18 per-chunk/staged query parity violated")
+        # -- hard gate: >= R× fewer blocking D2H calls -----------------
+        # exact call arithmetic (the design fact): per-chunk pays one
+        # blocking fetch per chunk; staged pays one per run (+ the tail,
+        # which routes per-chunk on both sides and cancels out)
+        expect_a = bd_full + bd_tail
+        expect_b = -(-bd_full // bd_r) + bd_tail
+        if a18["d2h_calls"] != expect_a or b18["d2h_calls"] != expect_b:
+            _fail(
+                f"config18 D2H call counts off: per_chunk "
+                f"{a18['d2h_calls']} (want {expect_a}), staged "
+                f"{b18['d2h_calls']} (want {expect_b})"
+            )
+        full_reduction = bd_full / max(expect_b - bd_tail, 1)
+        bd_detail["d2h_call_reduction_x"] = round(
+            a18["d2h_calls"] / max(b18["d2h_calls"], 1), 2
+        )
+        bd_detail["d2h_call_reduction_full_chunks_x"] = round(
+            full_reduction, 2
+        )
+        if full_reduction < bd_r:
+            _fail(
+                f"config18 full-chunk D2H reduction {full_reduction:.1f}x "
+                f"< runChunks={bd_r}"
+            )
+        if bd_r >= 2 and (
+            b18["staged_chunks"] != bd_full or b18["staged_runs"] < 1
+        ):
+            _fail(
+                f"config18 staged side did not stage: "
+                f"{b18['staged_chunks']} chunks, {b18['staged_runs']} runs "
+                f"(declines: {b18['declined']})"
+            )
+        # -- hard gate: overlap evidence on the staged side ------------
+        st18 = b18["stages"]
+        busy_sum = (
+            b18["dispatch_busy_s"]
+            + st18.get("spill_compute_busy_s", 0.0)
+            + st18.get("spill_write_busy_s", 0.0)
+        )
+        bd_detail["staged_busy_sum_s"] = round(busy_sum, 4)
+        bd_detail["overlap_busy_sum_exceeds_wall"] = bool(
+            busy_sum > st18.get("wall_s", 0.0) > 0
+        )
+        if not bd_detail["overlap_busy_sum_exceeds_wall"]:
+            _fail(
+                f"config18 no overlap evidence: busy sum {busy_sum:.3f}s "
+                f"<= wall {st18.get('wall_s', 0.0):.3f}s"
+            )
+        bd_detail["wall_speedup_x"] = round(
+            a18["build_s"] / b18["build_s"], 3
+        )
+        extras["build_device"] = bd_detail
+        for tag in ("per_chunk", "staged"):
+            shutil.rmtree(WORKDIR / f"bd_idx_{tag}", ignore_errors=True)
+
     # ---- device-kernel microbench (north star evidence) --------------------
     # warm per-kernel device throughput at the bench's shapes, recorded even
     # when end-to-end routing picks host (round-2 verdict missing #2)
@@ -2849,6 +3033,18 @@ def main() -> None:
         compact["whole_plan_hybrid_fused"] = hb16.get("fused_served")
         compact["whole_plan_hybrid_executables"] = hb16.get(
             "new_executables"
+        )
+    bd18 = extras.get("build_device", {})
+    if bd18 and "skipped" not in bd18:
+        # headline device-build gates; phase detail stays in the sidecar
+        compact["build_device_d2h_reduction_x"] = bd18.get(
+            "d2h_call_reduction_x"
+        )
+        compact["build_device_overlap"] = bd18.get(
+            "overlap_busy_sum_exceeds_wall"
+        )
+        compact["build_device_rows_per_s"] = bd18.get("staged", {}).get(
+            "rows_per_s"
         )
     rj17 = extras.get("runs_join", {})
     if rj17:

@@ -77,6 +77,17 @@ BUILD_PIPELINE_ON = "on"
 BUILD_PIPELINE_OFF = "off"
 BUILD_PIPELINE_MODES = (BUILD_PIPELINE_ON, BUILD_PIPELINE_OFF)
 BUILD_PIPELINE_DEFAULT = BUILD_PIPELINE_ON
+# Device-resident streaming build (docs/14-build-pipeline.md): the
+# device engine's steady-state shape. doubleBuffer rotates a fixed pair
+# of host staging slabs under the H2D so chunk k+1's upload overlaps
+# chunk k's kernel; runChunks (R) accumulates R device-sorted chunks in
+# HBM and merges them into ONE spill run on device — R× fewer blocking
+# D2H calls, R× fewer runs for finalize. runChunks=1 is the per-chunk
+# round-trip mode (the bench-18 A side and the byte-parity anchor).
+BUILD_DEVICE_DOUBLE_BUFFER = "hyperspace.index.build.device.doubleBuffer"
+BUILD_DEVICE_DOUBLE_BUFFER_DEFAULT = True
+BUILD_DEVICE_RUN_CHUNKS = "hyperspace.index.build.device.runChunks"
+BUILD_DEVICE_RUN_CHUNKS_DEFAULT = 4
 BUILD_INGEST_WORKERS = "hyperspace.index.build.ingestWorkers"
 BUILD_SPILL_COMPUTE_WORKERS = "hyperspace.index.build.spillComputeWorkers"
 BUILD_SPILL_WRITE_WORKERS = "hyperspace.index.build.spillWriteWorkers"

@@ -89,7 +89,13 @@ _TILE_ELEMS = _MASK_SUBLANES * _LANES
 def _budget_bytes() -> int:
     from .bytecache import env_mb  # malformed env falls back, never raises
 
-    return env_mb("HYPERSPACE_TPU_HBM_BUDGET_MB", 4096)
+    # the device build's staged-run slabs borrow from the SAME physical
+    # HBM (residency.slabs): subtracting the reservation here makes every
+    # budget site — admission, eviction, refusal — see the true headroom.
+    # Reservations are capped at half the budget, so this never goes <= 0.
+    from ..residency.slabs import held_bytes
+
+    return env_mb("HYPERSPACE_TPU_HBM_BUDGET_MB", 4096) - held_bytes()
 
 
 def _min_auto_rows() -> int:

@@ -57,10 +57,18 @@ from typing import Dict, Iterable, Iterator, List, Optional
 import numpy as np
 
 from ..exceptions import HyperspaceException
-from ..parallel.pool import FirstError, WorkerPool, ordered_map, run_parallel
+from ..parallel.pool import (
+    BoundedSlots,
+    FirstError,
+    WorkerPool,
+    ordered_map,
+    run_parallel,
+)
+from ..residency import slabs as slab_budget
 from ..storage import layout
 from ..storage.columnar import Column, ColumnarBatch, is_string
 from ..telemetry.metrics import metrics
+from ..telemetry.trace import add_bytes as _trace_bytes
 from ..utils.memo import bounded_memo_put
 
 SPILL_DIR_NAME = ".spill"
@@ -123,6 +131,40 @@ class BuildPipelineConfig:
             return 1
         return max(1, min(self.spill_compute_workers, os.cpu_count() or 1))
 
+
+@dataclass(frozen=True)
+class DeviceBuildConfig:
+    """The device engine's streaming-mode knobs — the
+    ``hyperspace.index.build.device.*`` family (docs/14-build-pipeline.md,
+    device-resident build).
+
+    ``double_buffer`` rotates a fixed PAIR of host staging slabs under
+    the H2D (the PR-8 streaming-residency slab discipline applied to the
+    build): chunk k+1's bytes stream from a stable, pinnable buffer
+    while chunk k's kernel runs, and the dispatch loop stops allocating
+    per chunk. ``run_chunks`` (R) accumulates R device-sorted chunks in
+    HBM and merges them into ONE spill run with an on-device k-way merge
+    — R× fewer blocking D2H calls, R× fewer runs for finalize to merge.
+
+    ``run_chunks=1`` is the per-chunk round-trip mode — the bench-18 A
+    side and the byte-identical parity anchor; both knobs fold into the
+    engine-probe cache key (mode_token) so a per-chunk verdict never
+    binds a double-buffered run, and vice versa."""
+
+    double_buffer: bool = True
+    run_chunks: int = 4
+
+    @staticmethod
+    def default() -> "DeviceBuildConfig":
+        return DeviceBuildConfig()
+
+    @staticmethod
+    def per_chunk() -> "DeviceBuildConfig":
+        return DeviceBuildConfig(double_buffer=False, run_chunks=1)
+
+    def mode_token(self) -> str:
+        return f"db{int(bool(self.double_buffer))}-r{int(self.run_chunks)}"
+
 # Per-process memo of the auto engine probe's winner ("device" | "host"),
 # keyed by (JAX backend platform, padded chunk capacity). The probe
 # measures the host↔device LINK as much as the kernels — a property of the
@@ -137,26 +179,45 @@ _ENGINE_CACHE: Dict[tuple, str] = {}
 _ENGINE_CACHE_MAX = 64
 
 
-def _engine_cache_key(chunk_capacity: int, host_width: Optional[int] = None) -> tuple:
-    """(platform, capacity, host width) memo key. The platform MUST be
-    derived without initializing the jax backend: cold backend init on a
-    tunneled chip costs seconds, and paying it just to look up a verdict
-    that says "host" would charge every pure-host build the device tax
-    the memo exists to avoid. The configured platform string (env /
-    jax.config) is a faithful proxy — it is what decides which backend
-    WOULD initialize.
+def _engine_cache_key(
+    chunk_capacity: int,
+    host_width: Optional[int] = None,
+    device_mode: Optional[str] = None,
+) -> tuple:
+    """(platform, capacity, host width, device mode) memo key. The
+    platform MUST be derived without initializing the jax backend: cold
+    backend init on a tunneled chip costs seconds, and paying it just to
+    look up a verdict that says "host" would charge every pure-host
+    build the device tax the memo exists to avoid. The configured
+    platform string (env / jax.config) is a faithful proxy — it is what
+    decides which backend WOULD initialize.
 
     ``host_width`` is the build's effective host-sort parallelism
     (BuildPipelineConfig.host_width): the host engine's throughput
     scales with the spill-compute pool while the device engine's does
     not, so a verdict measured at width 1 must not bind a width-16 run —
     the widths get separate slots (and separate persisted entries).
-    ``None`` means "the default pipeline's width on this machine"."""
+    ``None`` means "the default pipeline's width on this machine".
+
+    ``device_mode`` is the DEVICE engine's throughput shape
+    (DeviceBuildConfig.mode_token — double-buffer × runChunks): the
+    host_width lesson applied to the other engine. A per-chunk
+    round-trip verdict must not bind a double-buffered staged run (the
+    staged engine pays 1/R of the D2H the probe measured), and vice
+    versa — the modes get separate slots. ``None`` means the default
+    device mode."""
     from ..ops import configured_platform
 
     if host_width is None:
         host_width = BuildPipelineConfig.default().host_width()
-    return (configured_platform(), chunk_capacity, int(host_width))
+    if device_mode is None:
+        device_mode = DeviceBuildConfig.default().mode_token()
+    return (
+        configured_platform(),
+        chunk_capacity,
+        int(host_width),
+        str(device_mode),
+    )
 
 
 def _probe_cache_path() -> Optional[Path]:
@@ -290,6 +351,209 @@ def merge_sorted_runs(runs: List[ColumnarBatch], key_names: List[str]) -> Column
     return merged.take(order)
 
 
+class _HostSlabPair:
+    """The fixed pair of host staging buffers under the device engine's
+    H2D (the ``doubleBuffer`` knob): the dispatch loop ROTATES slots
+    instead of allocating per chunk, so chunk k+1's bytes stream from a
+    stable — on a real TPU runtime, pinnable — buffer while chunk k's
+    kernel runs. Before a slot is refilled the loop fences on the
+    device work that consumed its previous upload (two chunks back —
+    long finished in steady state, so the fence only ever waits when
+    the host has genuinely outrun the device)."""
+
+    def __init__(self) -> None:
+        self._bufs: List[Optional[Dict[str, np.ndarray]]] = [None, None]
+        self._fences: List[Optional[object]] = [None, None]
+        self._turn = 0
+
+    def stage(self, encoded: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        import jax
+
+        i = self._turn
+        self._turn = 1 - i
+        if self._fences[i] is not None:
+            jax.block_until_ready(self._fences[i])
+            self._fences[i] = None
+        bufs = self._bufs[i]
+        if bufs is None:
+            bufs = {k: np.empty(a.shape, a.dtype) for k, a in encoded.items()}
+            self._bufs[i] = bufs
+        for k, a in encoded.items():
+            np.copyto(bufs[k], a)
+        metrics.incr("build.device.slab_rotations")
+        return bufs
+
+    def fence(self, device_result) -> None:
+        """Arm the JUST-FILLED slot's reuse fence: ``device_result`` is
+        work ordered after the slot's upload (the chunk's kernel
+        output), so its readiness implies the upload buffer is free."""
+        self._fences[1 - self._turn] = device_result
+
+    def drop(self) -> None:
+        self._bufs = [None, None]
+        self._fences = [None, None]
+
+
+class _DeviceRunStager:
+    """Accumulates device-sorted chunks into HBM-resident runs
+    (docs/14-build-pipeline.md, device-resident build): chunk k's packed
+    composite + permutation stay ON DEVICE until ``run_chunks`` chunks
+    have landed — or the run's 63-bit pack budget would overflow, or
+    finalize arrives — then ONE on-device k-way merge produces the run
+    order and ONE non-blocking D2H ships it to the spill stages. The
+    writer guarantees runs never interleave with per-chunk spills: any
+    chunk that cannot stage flushes the pending run FIRST, so run
+    sequence numbers (hence merge-stability tie order, hence the built
+    index bytes) are exactly the serial build's.
+
+    HBM discipline: the worst-case footprint — slab pair + R staged
+    chunks + the merge working set — is RESERVED against the shared
+    residency budget (residency.slabs) before the first chunk stages;
+    no headroom means the build quietly runs the per-chunk path
+    (``build.device.staging_declined.budget``), never an eviction storm
+    mid-serve. In-flight merges additionally hold a device slot
+    (BoundedSlots), the same high-water rule as per-chunk dispatch."""
+
+    # reservation rule: staged planes (12 B/row × R) + merge working set
+    # (stack copies + outputs + tournament temporaries, ~2× the planes)
+    # — one named constant so the charge and the doc stay in one place
+    STAGED_BYTES_PER_ROW = 36
+
+    def __init__(self, writer: "StreamingIndexWriter", device: "DeviceBuildConfig"):
+        self.w = writer
+        self.device = device
+        self.slab = _HostSlabPair() if device.double_buffer else None
+        self.pending: List = []  # ops.build.StagedChunk
+        self.batches: List[ColumnarBatch] = []
+        self.union: Optional[List[tuple]] = None
+        self.seq: Optional[int] = None
+        self._reserved: Optional[bool] = None
+        self._budget_tag = f"build-stager-{id(writer)}-{uuid.uuid4().hex[:6]}"
+
+    def ensure_reserved(self, encoded: Dict[str, np.ndarray]) -> bool:
+        """One all-or-nothing budget reservation per build, sized from
+        the first eligible chunk's real transport widths."""
+        if self._reserved is not None:
+            return self._reserved
+        cap = self.w.chunk_capacity
+        slab_bytes = 2 * sum(int(a.nbytes) for a in encoded.values())
+        staged = self.STAGED_BYTES_PER_ROW * cap * self.device.run_chunks
+        self._reserved = slab_budget.try_reserve(
+            self._budget_tag, slab_bytes + staged
+        )
+        return self._reserved
+
+    def reserve_refused(self) -> bool:
+        """True once the one-per-build reservation has been refused —
+        the writer then declines without re-encoding every chunk."""
+        return self._reserved is False
+
+    def add(self, batch: ColumnarBatch, encoded: Dict[str, np.ndarray],
+            bounds: List[tuple], plan: List[tuple]) -> None:
+        from ..ops.build import stage_chunk_packed
+
+        if self.pending:
+            union = [
+                (min(a, mn), max(b, mx))
+                for (a, b), (mn, mx) in zip(self.union, bounds)
+            ]
+            from ..ops.build import run_pack_plan
+
+            if run_pack_plan(union, self.w.num_buckets) is None:
+                # the union span overflows 63 bits: this run is as wide
+                # as it can get — flush it and start fresh
+                metrics.incr("build.device.run_flush_overflow")
+                self.flush()
+                union = list(bounds)
+        else:
+            union = list(bounds)
+        if not self.pending:
+            # the run's on-disk order slot is its FIRST chunk's ingest
+            # position — reserved now so later per-chunk spills (the
+            # tail, an ineligible chunk) always order after it
+            self.seq = self.w._next_seq()
+        bufs = self.slab.stage(encoded) if self.slab is not None else encoded
+        staged, h2d_bytes = stage_chunk_packed(
+            bufs, batch.schema(), self.w.indexed_cols, self.w.num_buckets, plan
+        )
+        if self.slab is not None:
+            self.slab.fence(staged.packed)
+        metrics.incr("build.stream.h2d_bytes", h2d_bytes)
+        metrics.incr("build.device.staged_chunks")
+        _trace_bytes("h2d_bytes", h2d_bytes)
+        self.union = union
+        self.pending.append(staged)
+        self.batches.append(batch)
+        if len(self.pending) >= self.device.run_chunks:
+            self.flush()
+
+    def flush(self) -> None:
+        """Merge the pending chunks into one sorted run on device and
+        hand its (non-blocking) D2H to the spill stages; the next
+        chunk's kernel overlaps the fetch. No-op when nothing pends."""
+        r = len(self.pending)
+        if r == 0:
+            return
+        from ..ops.build import merge_staged_chunks, run_pack_plan
+
+        w = self.w
+        run_plan = run_pack_plan(self.union, w.num_buckets)
+        assert run_plan is not None  # add() flushed before overflow
+        staged, batches, seq = self.pending, self.batches, self.seq
+        self.pending, self.batches, self.union, self.seq = [], [], None, None
+        # an un-fetched merged run pins HBM exactly like an un-fetched
+        # chunk: same in-flight slot discipline (failure-aware)
+        w._device_slots.acquire()
+        try:
+            t0 = time.perf_counter()
+            order_dev, counts_dev = merge_staged_chunks(
+                staged, run_plan, w.num_buckets
+            )
+            metrics.record_time(
+                "build.stream.device_merge", time.perf_counter() - t0
+            )
+        except BaseException:
+            w._device_slots.release()
+            raise
+        cap = w.chunk_capacity
+        d2h_bytes = 4 * r * cap + 8 * w.num_buckets
+        metrics.incr("build.device.staged_runs")
+        _trace_bytes("d2h_bytes", d2h_bytes)
+
+        def finish(order_dev=order_dev, counts_dev=counts_dev,
+                   batches=batches, d2h_bytes=d2h_bytes):
+            from ..ops.build import _canonicalize_f64
+
+            try:
+                order = np.asarray(order_dev).astype(np.int64, copy=False)
+                counts = np.asarray(counts_dev)[: w.num_buckets].astype(
+                    np.int64, copy=False
+                )
+                metrics.incr("build.stream.d2h_calls")
+                metrics.incr("build.stream.d2h_bytes", d2h_bytes)
+                # gather payloads straight from the R source chunks in
+                # merged order — no materialized concat copy
+                out = ColumnarBatch.gather_concat(batches, order)
+                _canonicalize_f64(out)
+                return out, counts
+            finally:
+                w._device_slots.release()
+
+        w._enqueue_spill(finish, seq=seq)
+
+    def drop(self) -> None:
+        """Abort-path teardown: device references released, budget
+        uncharged. Idempotent."""
+        self.pending = []
+        self.batches = []
+        self.union = None
+        self.seq = None
+        if self.slab is not None:
+            self.slab.drop()
+        slab_budget.release(self._budget_tag)
+        self._reserved = None
+
+
 class StreamingIndexWriter:
     """Accumulates chunks into spilled sorted runs; ``finalize()`` merges
     them into the final per-bucket TCB files.
@@ -310,6 +574,7 @@ class StreamingIndexWriter:
         engine: str = "auto",
         finalize_mode: str = "merge",
         pipeline: Optional[BuildPipelineConfig] = None,
+        device: Optional[DeviceBuildConfig] = None,
     ):
         if chunk_capacity < 1:
             raise HyperspaceException("chunk_capacity must be positive.")
@@ -329,6 +594,7 @@ class StreamingIndexWriter:
         self.extra_meta = extra_meta
         self.mesh = mesh
         self.pipeline = pipeline if pipeline is not None else BuildPipelineConfig.default()
+        self.device = device if device is not None else DeviceBuildConfig.default()
         # chunk engine: device | host | auto (host probe on chunk 0, link
         # check, device compile on chunk 1, device probe on chunk 2, then
         # the measured winner — see _route_engine; constants.BUILD_ENGINE
@@ -357,14 +623,16 @@ class StreamingIndexWriter:
         self._spill_by_seq: Dict[int, tuple] = {}
         self._chunk_seq = 0
         # the DEVICE engine's own in-flight bound: dispatched-but-
-        # unfetched chunks pin padded key buffers + sort temps in HBM,
-        # and extra spill-compute workers buy nothing there (D2H is
-        # serialized on the one link) — without this, the HBM high-water
-        # would scale with the host's core count. 3 preserves the
-        # pre-pipeline bound (fetching N, queued N+1, dispatched N+2).
-        self._device_slots = threading.BoundedSemaphore(
-            DEVICE_INFLIGHT_CHUNKS
-        )
+        # unfetched chunks (and staged-run merges awaiting their fetch)
+        # pin padded key buffers + sort temps in HBM, and extra
+        # spill-compute workers buy nothing there (D2H is serialized on
+        # the one link) — without this, the HBM high-water would scale
+        # with the host's core count. 3 preserves the pre-pipeline bound
+        # (fetching N, queued N+1, dispatched N+2).
+        self._device_slots = BoundedSlots(DEVICE_INFLIGHT_CHUNKS, self._err)
+        # device-resident run staging (docs/14): created on first use so
+        # host-engine builds never pay slab or budget setup
+        self._stager: Optional[_DeviceRunStager] = None
         self._t_first_add: Optional[float] = None
         self._t_pipeline_done: Optional[float] = None
 
@@ -414,7 +682,11 @@ class StreamingIndexWriter:
         return self._decide_winner()
 
     def _cache_key(self) -> tuple:
-        return _engine_cache_key(self.chunk_capacity, self.pipeline.host_width())
+        return _engine_cache_key(
+            self.chunk_capacity,
+            self.pipeline.host_width(),
+            self.device.mode_token(),
+        )
 
     def _host_scale(self) -> float:
         """How much faster than the single-threaded probe measurement the
@@ -506,12 +778,77 @@ class StreamingIndexWriter:
             )
         return "host" if self._probe["winner"] else "device"
 
-    def _acquire_device_slot(self) -> None:
-        """Failure-aware bounded wait for a device in-flight slot: after
-        a pipeline failure the draining pools never release slots, so a
-        plain acquire could park the main thread — poll with the latch."""
-        while not self._device_slots.acquire(timeout=0.05):
-            self._err.check()
+    def _try_stage_chunk(self, batch: ColumnarBatch) -> bool:
+        """Route one chunk into the device run stager if eligible.
+        Ineligible chunks FLUSH any pending run first — runs must never
+        interleave with per-chunk spills, because stability tie order IS
+        the on-disk run order — then return False for the per-chunk
+        path. Every decline is counted (the host tail is never silent,
+        the compile/agg decline discipline applied here)."""
+        if self.device.run_chunks < 2:
+            return False
+        if self.device.run_chunks * self.chunk_capacity > (1 << 31) - 1:
+            # the merged order ships as int32 (4 B/row, matching the
+            # per-chunk permutation): runs beyond 2^31 rows cannot
+            metrics.incr("build.device.staging_declined.width")
+            return False
+        if batch.num_rows != self.chunk_capacity:
+            # the partial tail routes per-chunk (its pad rows would need
+            # a validity operand through the merge); it arrives last, so
+            # flushing first preserves run order
+            self._flush_staged()
+            return False
+        if (
+            self._engine != "device"
+            and _ENGINE_CACHE.get(self._cache_key()) != "device"
+        ):
+            # auto mode mid-probe: chunk 1's pre-verdict device dispatch
+            # must stay the per-chunk compile bearer the probe times
+            return False
+        dtypes = batch.schema()
+        if any(is_string(dtypes[k]) for k in self.indexed_cols):
+            # per-chunk vocab codes are not comparable across chunks —
+            # the host merge re-encodes onto a union vocab, the device
+            # composite cannot
+            metrics.incr("build.device.staging_declined.string_key")
+            self._flush_staged()
+            return False
+        if any(dtypes[k] == "float32" for k in self.indexed_cols):
+            # float32 travels raw (its sort operand is a device-side bit
+            # transform): _packed_minmax never bounds it, so the pack
+            # decline is dtype-static — skip the O(n) encode entirely
+            metrics.incr("build.device.staging_declined.pack")
+            self._flush_staged()
+            return False
+        if self._stager is not None and self._stager.reserve_refused():
+            # the one all-or-nothing budget reservation already refused:
+            # permanent for this build, don't re-encode every chunk
+            metrics.incr("build.device.staging_declined.budget")
+            return False
+        from ..ops.build import run_pack_plan, stage_encode
+
+        encoded, bounds = stage_encode(batch, self.indexed_cols)
+        plan = (
+            None if bounds is None else run_pack_plan(bounds, self.num_buckets)
+        )
+        if plan is None:
+            # this chunk cannot pack to 63 bits (the per-chunk path will
+            # run the multi-operand comparator kernel instead)
+            metrics.incr("build.device.staging_declined.pack")
+            self._flush_staged()
+            return False
+        if self._stager is None:
+            self._stager = _DeviceRunStager(self, self.device)
+        if not self._stager.ensure_reserved(encoded):
+            metrics.incr("build.device.staging_declined.budget")
+            self._flush_staged()
+            return False
+        self._stager.add(batch, encoded, bounds, plan)
+        return True
+
+    def _flush_staged(self) -> None:
+        if self._stager is not None:
+            self._stager.flush()
 
     def _next_seq(self) -> int:
         seq = self._chunk_seq  # main thread only: add_chunk/finalize
@@ -564,15 +901,18 @@ class StreamingIndexWriter:
         )
         metrics.gauge("build.stream.workers.spill_write", pipe.spill_write_workers)
 
-    def _enqueue_spill(self, finish) -> None:
-        """Route one dispatched chunk through the spill stages. Phase
-        split for the throughput story: compute = blocking D2H fetch +
-        decode (device engine) or the host partition+sort (host engine);
-        write = spill-file IO. The stage timers SUM worker busy time, so
-        under the pipeline their sum exceeding wall-clock is the overlap
-        working as designed — they identify the bottleneck stage, not a
-        wall-clock decomposition."""
-        seq = self._next_seq()
+    def _enqueue_spill(self, finish, seq: Optional[int] = None) -> None:
+        """Route one dispatched chunk (or one staged run of chunks)
+        through the spill stages. Phase split for the throughput story:
+        compute = blocking D2H fetch + decode (device engine) or the
+        host partition+sort (host engine); write = spill-file IO. The
+        stage timers SUM worker busy time, so under the pipeline their
+        sum exceeding wall-clock is the overlap working as designed —
+        they identify the bottleneck stage, not a wall-clock
+        decomposition. ``seq`` pins an explicitly reserved order slot
+        (a staged run reserves its first chunk's)."""
+        if seq is None:
+            seq = self._next_seq()
         if not self.pipeline.enabled:
             t0 = time.perf_counter()
             batch, counts = finish()
@@ -633,6 +973,13 @@ class StreamingIndexWriter:
             self._write_pool.abort()
         self._compute_pool = None
         self._write_pool = None
+        if self._stager is not None:
+            # device loss / pipeline failure mid-staging: release the
+            # staged chunks' device references AND the shared HBM budget
+            # charge — a dead build must never keep the serving caches'
+            # budget shrunk (residency.slabs discipline)
+            self._stager.drop()
+            self._stager = None
         self._err = FirstError()  # a reused writer must not re-raise
         shutil.rmtree(self._spill_dir, ignore_errors=True)
         self._finalized = True
@@ -706,9 +1053,24 @@ class StreamingIndexWriter:
                     _ENGINE_CACHE, self._cache_key(), "host", _ENGINE_CACHE_MAX
                 )
                 engine = "host"
+            if engine == "device" and self._try_stage_chunk(batch):
+                # device-resident staging: the chunk's sorted composite
+                # stays in HBM awaiting its run merge — no per-chunk
+                # spill; the stager enqueues one spill per R chunks
+                metrics.incr("build.engine.device")
+                self._chunk_times.append(time.perf_counter() - t0)
+                metrics.record_time(
+                    "build.stream.dispatch", self._chunk_times[-1]
+                )
+                self._err.check()
+                self._rows += batch.num_rows
+                metrics.incr("build.stream.chunks")
+                metrics.incr("build.stream.rows", batch.num_rows)
+                return
             if engine in ("host", "probe-host"):
                 from ..ops.build import build_partition_host
 
+                self._flush_staged()
                 metrics.incr("build.engine.host")
                 if engine == "probe-host":
                     t1 = time.perf_counter()
@@ -737,8 +1099,9 @@ class StreamingIndexWriter:
                 # performs the blocking fetch + decode, overlapping the
                 # next chunk. The slot acquire blocks dispatch when
                 # DEVICE_INFLIGHT_CHUNKS results are already in flight.
+                self._flush_staged()
                 metrics.incr("build.engine.device")
-                self._acquire_device_slot()
+                self._device_slots.acquire()
                 inner = build_partition_single(
                     batch,
                     self.indexed_cols,
@@ -784,7 +1147,15 @@ class StreamingIndexWriter:
             self._pending = []
             self._pending_rows = 0
             self._process_chunk(tail)
+        # a staged run may still pend when the source was an exact
+        # multiple of the chunk capacity (no tail to force the flush)
+        self._flush_staged()
         self._drain_spills()
+        if self._stager is not None:
+            # flushed + drained: nothing pends — drop releases the slab
+            # pair's host buffers and the shared HBM budget reservation
+            self._stager.drop()
+            self._stager = None
         if (
             self._engine == "auto"
             and "device_s" in self._probe
@@ -1006,6 +1377,7 @@ def write_index_data_streaming(
     finalize_mode: str = "merge",
     chunk_tasks: Optional[Iterable] = None,
     pipeline: Optional[BuildPipelineConfig] = None,
+    device: Optional[DeviceBuildConfig] = None,
 ) -> List[Path]:
     """Drive a StreamingIndexWriter over source chunks. A failure
     anywhere tears the pipeline down (no parked workers, no orphan spill
@@ -1036,6 +1408,7 @@ def write_index_data_streaming(
         engine=engine,
         finalize_mode=finalize_mode,
         pipeline=pipe,
+        device=device,
     )
     if chunks is None and chunk_tasks is None:
         raise HyperspaceException(

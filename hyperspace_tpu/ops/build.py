@@ -317,6 +317,13 @@ def build_partition_single(
         for k in key_names
     }
     arrays = {k: jnp.asarray(b) for k, b in host_bufs.items()}
+    if defer:
+        # streaming-writer dispatch: account the link both ways so the
+        # staged path's R-fold D2H reduction is measurable (bench 18)
+        metrics.incr(
+            "build.stream.h2d_bytes",
+            sum(int(b.nbytes) for b in host_bufs.values()),
+        )
     vh = {
         k: jnp.asarray(vocab_hashes(batch.columns[k]))
         for k in key_names
@@ -355,6 +362,13 @@ def build_partition_single(
     def finish() -> Tuple[ColumnarBatch, np.ndarray]:
         counts = np.asarray(counts_dev)[:num_buckets]
         perm = np.asarray(perm_dev)[:n].astype(np.int64, copy=False)
+        if defer:
+            # one blocking device round trip per chunk — the call count
+            # the staged run merge divides by runChunks
+            metrics.incr("build.stream.d2h_calls")
+            metrics.incr(
+                "build.stream.d2h_bytes", 4 * n_pad + 8 * num_buckets
+            )
         out = batch.take(perm)
         for name, col in out.columns.items():
             if col.dtype_str == "float64":
@@ -368,6 +382,235 @@ def build_partition_single(
         return out, counts
 
     return finish if defer else finish()
+
+
+# ---------------------------------------------------------------------------
+# device-resident run staging (docs/14-build-pipeline.md, device build)
+# ---------------------------------------------------------------------------
+def _single_staged_kernel_packed(
+    dtypes_key: tuple, key_names: tuple, num_buckets: int
+):
+    """Run-staging twin of _single_perm_kernel_packed: same fused
+    bucketize + radix pack + single-operand sort, but the sorted packed
+    COMPOSITE stays on device alongside the permutation — the merge
+    operand of the on-device run merge (_staged_merge_fn). Nothing is
+    fetched here; the only D2H the staged path ever pays is the merged
+    run's permutation, one call per ``runChunks`` chunks. Staged chunks
+    are always full-capacity (the tail routes per-chunk), so there is no
+    n_valid operand: every row is real."""
+    cache_key = ("perm-packed-staged", dtypes_key, key_names, num_buckets)
+    fn = _single_kernel_cache.get(cache_key)
+    if fn is not None:
+        return fn
+    dtypes = dict(dtypes_key)
+    keys = list(key_names)
+
+    @jax.jit
+    def kernel(arrays, vh, mins, shifts):
+        bucket = device_bucket_ids(arrays, dtypes, keys, vh, num_buckets)
+        m = bucket.shape[0]
+        iota = lax.iota(jnp.int32, m)
+        packed = bucket.astype(jnp.int64)
+        for i, k in enumerate(keys):
+            enc = _ordered_sort_operand(arrays[k]).astype(jnp.int64)
+            packed = jnp.left_shift(packed, shifts[i].astype(jnp.int64))
+            packed = jnp.bitwise_or(packed, enc - mins[i])
+        packed_sorted, perm = lax.sort([packed, iota], num_keys=1)
+        counts = jnp.bincount(bucket, length=num_buckets)
+        return packed_sorted, perm, counts
+
+    if len(_single_kernel_cache) >= 64:
+        _single_kernel_cache.pop(next(iter(_single_kernel_cache)))
+    _single_kernel_cache[cache_key] = kernel
+    return kernel
+
+
+def _staged_merge_fn(nkeys: int):
+    """The on-device k-way run merge: takes R staged chunks' sorted
+    composites (each packed with its own chunk plan), normalizes them
+    onto ONE run-level plan — unpack with the chunk's mins/shifts,
+    re-bias, re-pack with the run's — and merges via the same stable
+    pairwise searchsorted tournament as the host merge_sorted_orders
+    (adjacent pairs, left run wins ties), entirely in one executable.
+    Chunk and run mins/shifts are DEVICE OPERANDS, so one compiled
+    program serves every run of a given (chunk count, key count) shape.
+    Returns (global row order into the R concatenated original chunks,
+    summed per-bucket counts) — the run's ONLY D2H."""
+    cache_key = ("staged-merge", nkeys)
+    fn = _single_kernel_cache.get(cache_key)
+    if fn is not None:
+        return fn
+
+    @jax.jit
+    def kernel(packed, perms, counts, cmins, cshifts, rmins, rshifts):
+        r, cap = packed.shape
+        rem = packed
+        fields: List = []
+        for i in range(nkeys - 1, -1, -1):
+            s = cshifts[:, i : i + 1].astype(jnp.int64)
+            mask = jnp.left_shift(jnp.int64(1), s) - jnp.int64(1)
+            fields.append(jnp.bitwise_and(rem, mask) + cmins[:, i : i + 1])
+            rem = jnp.right_shift(rem, s)
+        fields.reverse()
+        comp = rem  # what remains above the key fields is the bucket id
+        for i in range(nkeys):
+            comp = jnp.bitwise_or(
+                jnp.left_shift(comp, rshifts[i].astype(jnp.int64)),
+                fields[i] - rmins[i],
+            )
+        base = jnp.arange(r, dtype=jnp.int64)[:, None] * jnp.int64(cap)
+        orig = (base + perms.astype(jnp.int64)).astype(jnp.int32)
+        runs = [(comp[c], orig[c]) for c in range(r)]
+        while len(runs) > 1:
+            nxt = []
+            for j in range(0, len(runs) - 1, 2):
+                ak, ai = runs[j]
+                bk, bi = runs[j + 1]
+                la, lb = ak.shape[0], bk.shape[0]
+                pos_a = jnp.arange(la, dtype=jnp.int32) + jnp.searchsorted(
+                    bk, ak, side="left"
+                ).astype(jnp.int32)
+                pos_b = jnp.arange(lb, dtype=jnp.int32) + jnp.searchsorted(
+                    ak, bk, side="right"
+                ).astype(jnp.int32)
+                mk = (
+                    jnp.zeros(la + lb, ak.dtype)
+                    .at[pos_a]
+                    .set(ak)
+                    .at[pos_b]
+                    .set(bk)
+                )
+                mi = (
+                    jnp.zeros(la + lb, jnp.int32)
+                    .at[pos_a]
+                    .set(ai)
+                    .at[pos_b]
+                    .set(bi)
+                )
+                nxt.append((mk, mi))
+            if len(runs) % 2:
+                nxt.append(runs[-1])
+            runs = nxt
+        _mk, mi = runs[0]
+        return mi, counts.sum(axis=0)
+
+    if len(_single_kernel_cache) >= 64:
+        _single_kernel_cache.pop(next(iter(_single_kernel_cache)))
+    _single_kernel_cache[cache_key] = kernel
+    return kernel
+
+
+class StagedChunk:
+    """One device-resident sorted chunk awaiting its run merge: the
+    packed composite and permutation stay in HBM; the host keeps only
+    the pack plan (for the merge's unpack operands). The HBM footprint
+    is charged up front by the writer's all-or-nothing slab reservation
+    (_DeviceRunStager.ensure_reserved), not per chunk."""
+
+    __slots__ = ("packed", "perm", "counts", "plan")
+
+    def __init__(self, packed, perm, counts, plan):
+        self.packed = packed
+        self.perm = perm
+        self.counts = counts
+        self.plan = plan
+
+
+def stage_encode(
+    batch: ColumnarBatch, key_names: List[str]
+) -> Tuple[Dict[str, np.ndarray], Optional[List[Tuple[int, int]]]]:
+    """Host transport buffers + per-key (min, max) bounds of a full
+    chunk — the staged path's routing input, computed BEFORE any upload
+    so an ineligible chunk never touches the device. ``bounds`` is None
+    when any key declines the 63-bit pack (float32 raw transport,
+    uint64 beyond int64); the encoded buffers are still returned so the
+    per-chunk fallback can reuse them if it wants."""
+    encoded = {k: encode_for_device(batch.columns[k]) for k in key_names}
+    bounds = []
+    for k in key_names:
+        b = _packed_minmax(encoded[k])
+        if b is None:
+            return encoded, None
+        bounds.append(b)
+    return encoded, bounds
+
+
+def run_pack_plan(
+    bounds: List[Tuple[int, int]], num_buckets: int
+) -> Optional[List[Tuple[int, int]]]:
+    """The RUN-level pack plan over accumulated per-chunk bound unions —
+    the same _pack_plan budget rule (and the same bucket ceiling) the
+    per-chunk kernels use, so chunk and run composites carry identical
+    field layouts. None = the union span overflows 63 bits and the
+    pending run must flush before this chunk starts a fresh one."""
+    return _pack_plan(bounds, max(int(num_buckets), 1).bit_length())
+
+
+def stage_chunk_packed(
+    host_bufs: Dict[str, np.ndarray],
+    dtypes: Dict[str, str],
+    key_names: List[str],
+    num_buckets: int,
+    plan: List[Tuple[int, int]],
+) -> Tuple[StagedChunk, int]:
+    """Dispatch one full-capacity chunk through the staged kernel and
+    leave its sorted composite + permutation resident on device.
+    ``host_bufs`` are the chunk's transport buffers — the writer's slab
+    pair slot under doubleBuffer (pre-staged, pinnable, reused every
+    other chunk) or the chunk's own encoded buffers (the
+    doubleBuffer=off A/B leg). Returns the staged handle and the H2D
+    byte count. Caller guarantees: no string key columns, full-capacity
+    chunk, ``plan`` fits 63 bits."""
+    h2d_bytes = 0
+    arrays = {}
+    for k in key_names:
+        buf = host_bufs[k]
+        arrays[k] = jax.device_put(buf)
+        h2d_bytes += int(buf.nbytes)
+    key_dtypes = tuple(sorted((k, dtypes[k]) for k in key_names))
+    mins_dev = jnp.asarray(np.array([mn for mn, _ in plan], dtype=np.int64))
+    shifts_dev = jnp.asarray(np.array([kb for _, kb in plan], dtype=np.int32))
+    kernel = _single_staged_kernel_packed(
+        key_dtypes, tuple(key_names), num_buckets
+    )
+    metrics.incr("build.engine.device_radix")
+    packed, perm, counts = kernel(arrays, {}, mins_dev, shifts_dev)
+    return StagedChunk(packed, perm, counts, plan), h2d_bytes
+
+
+def merge_staged_chunks(
+    staged: List[StagedChunk],
+    run_plan: List[Tuple[int, int]],
+    num_buckets: int,
+):
+    """Dispatch the on-device merge of R staged chunks into one sorted
+    run and issue its D2H NON-BLOCKING (copy_to_host_async where the
+    backend supports it): the bytes ride the link while the next chunk's
+    kernel runs, and the spill-compute worker's blocking fetch finds
+    them already landing. Returns the un-fetched (order, counts) device
+    arrays; order indexes the concatenation of the R original chunks."""
+    nkeys = len(run_plan)
+    packed = jnp.stack([s.packed for s in staged])
+    perms = jnp.stack([s.perm for s in staged])
+    counts = jnp.stack([s.counts for s in staged])
+    cmins = jnp.asarray(
+        np.array([[mn for mn, _ in s.plan] for s in staged], dtype=np.int64)
+    )
+    cshifts = jnp.asarray(
+        np.array([[kb for _, kb in s.plan] for s in staged], dtype=np.int32)
+    )
+    rmins = jnp.asarray(np.array([mn for mn, _ in run_plan], dtype=np.int64))
+    rshifts = jnp.asarray(np.array([kb for _, kb in run_plan], dtype=np.int32))
+    fn = _staged_merge_fn(nkeys)
+    order_dev, counts_dev = fn(
+        packed, perms, counts, cmins, cshifts, rmins, rshifts
+    )
+    for arr in (order_dev, counts_dev):
+        try:
+            arr.copy_to_host_async()
+        except AttributeError:  # backend without async host copies
+            pass
+    return order_dev, counts_dev
 
 
 def _pack_sort_keys(

@@ -69,6 +69,27 @@ class FirstError:
                 raise exc
 
 
+class BoundedSlots:
+    """A bounded in-flight slot counter whose acquire is FAILURE-AWARE:
+    the wait polls the shared :class:`FirstError` latch, so after a
+    pipeline failure (draining pools never release their slots) a
+    producer blocked on a slot re-raises the first error instead of
+    parking forever. The device build engine bounds its HBM high-water
+    with one of these: dispatched-but-unfetched chunks AND in-flight
+    staged-run merges each pin device buffers until their fetch."""
+
+    def __init__(self, n: int, failure: FirstError) -> None:
+        self._sem = threading.BoundedSemaphore(max(1, int(n)))
+        self.failure = failure
+
+    def acquire(self) -> None:
+        while not self._sem.acquire(timeout=0.05):
+            self.failure.check()
+
+    def release(self) -> None:
+        self._sem.release()
+
+
 class WorkerPool:
     """N daemon threads draining a bounded task queue.
 

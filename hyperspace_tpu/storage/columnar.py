@@ -348,6 +348,47 @@ class ColumnarBatch:
                 out[n] = Column(cols[0].dtype_str, np.concatenate([c.data for c in cols]))
         return ColumnarBatch(out)
 
+    @staticmethod
+    def gather_concat(
+        batches: Sequence["ColumnarBatch"], indices: np.ndarray
+    ) -> "ColumnarBatch":
+        """``concat(batches).take(indices)`` without materializing the
+        concatenation: each output row is gathered straight from its
+        source batch, so every row moves ONCE instead of twice. The
+        device build's staged-run fetch gathers R chunks' payloads in
+        merged order this way — at R chunks of millions of rows the
+        saved full-copy pass is the spill-compute stage's margin
+        (docs/14-build-pipeline.md). Byte-identical to concat().take():
+        string dictionaries unify exactly as concat does."""
+        batches = [b for b in batches if b.num_rows > 0] or list(batches[:1])
+        if len(batches) == 1:
+            return batches[0].take(indices)
+        first = batches[0]
+        names = first.column_names
+        for b in batches[1:]:
+            if b.column_names != names or b.schema() != first.schema():
+                raise HyperspaceException(
+                    f"Schema mismatch in gather_concat: {first.schema()} "
+                    f"vs {b.schema()}."
+                )
+        sizes = np.array([b.num_rows for b in batches])
+        ends = np.cumsum(sizes)
+        chunk_ix = np.searchsorted(ends, indices, side="right")
+        local_ix = indices - (ends - sizes)[chunk_ix]
+        masks = [chunk_ix == ci for ci in range(len(batches))]
+        out: Dict[str, Column] = {}
+        for n in names:
+            cols = [b.columns[n] for b in batches]
+            vocab = None
+            if is_string(cols[0].dtype_str):
+                cols = unify_dictionaries(cols)
+                vocab = cols[0].vocab
+            acc = np.empty(len(indices), dtype=cols[0].data.dtype)
+            for c, m in zip(cols, masks):
+                acc[m] = c.data[local_ix[m]]
+            out[n] = Column(cols[0].dtype_str, acc, vocab)
+        return ColumnarBatch(out)
+
     def device_arrays(self, names: Optional[Iterable[str]] = None):
         """Transfer columns to the default JAX device as a dict of
         jax.Arrays (codes for strings). The numeric-only, static-shape

@@ -42,6 +42,10 @@ Env knobs: SCALE_ROWS (60_000_000), SCALE_BUCKETS (128), SCALE_REPEATS (2),
 SCALE_WORKDIR (.bench_scale_workspace), SCALE_KEEP=1 keeps the workspace
 (generated source data is reused across runs automatically when present),
 SCALE_FINALIZE (runs|merge), SCALE_COMPARE_MERGE (1|0),
+SCALE_ENGINE (auto|host|device — pins the chunk engine; =device runs the
+device-resident staged build of docs/14 so the phase timers record the
+R-fold D2H reduction; on a CPU container that engine is the CPU jax
+backend — attribution, not wall time, is what it measures),
 SCALE_PRUNE_OLD_VERSIONS=1 removes version dirs unreferenced by the
 latest entry after optimize (disk headroom for SF100),
 SCALE_COMPILE (on|off — "off" pins hyperspace.compile.mode=off so the
@@ -244,6 +248,14 @@ def main() -> None:
     # a fresh index tree per run: the BUILD is the thing under test
     shutil.rmtree(WORKDIR / "indexes", ignore_errors=True)
     finalize_mode = os.environ.get("SCALE_FINALIZE", C.BUILD_FINALIZE_RUNS)
+    # SCALE_ENGINE pins the chunk engine (host | device | auto). The
+    # default stays auto (routes host on this CPU-pinned bench — the
+    # comparable cross-round artifact); =device exercises the
+    # device-resident staged build (docs/14) so the phase timers show
+    # what the R-fold D2H reduction does to spill-compute occupancy.
+    # On a CPU container the "device" engine is the CPU jax backend —
+    # phase ATTRIBUTION is the fact it records, not wall time.
+    scale_engine = os.environ.get("SCALE_ENGINE", C.BUILD_ENGINE_DEFAULT)
     conf = HyperspaceConf(
         {
             C.INDEX_SYSTEM_PATH: str(WORKDIR / "indexes"),
@@ -251,6 +263,7 @@ def main() -> None:
             C.BUILD_MODE: C.BUILD_MODE_STREAMING,
             C.BUILD_CHUNK_ROWS: 1 << 22,  # 4M-row chunks -> 15 chunks at 60M
             C.BUILD_FINALIZE_MODE: finalize_mode,
+            C.BUILD_ENGINE: scale_engine,
             # SCALE_PIPELINE=off reproduces the pre-pipeline serial build
             C.BUILD_PIPELINE: os.environ.get(
                 "SCALE_PIPELINE", C.BUILD_PIPELINE_DEFAULT
@@ -317,6 +330,20 @@ def main() -> None:
             timers.get("build.stream.pipeline_wall", 0.0), 2
         ),
         "build_pipeline": build_pipeline_snapshot(),
+        # device-resident staging attribution (docs/14): under
+        # SCALE_ENGINE=device these show the R-fold D2H reduction and
+        # where the on-device run merge spends; all-zero on host runs
+        "build_engine_counts": {
+            k.rsplit(".", 1)[-1]: v
+            for k, v in counters.items()
+            if k.startswith("build.engine.")
+        },
+        "build_d2h_calls": counters.get("build.stream.d2h_calls", 0),
+        "build_staged_chunks": counters.get("build.device.staged_chunks", 0),
+        "build_staged_runs": counters.get("build.device.staged_runs", 0),
+        "phase_device_merge_s": round(
+            timers.get("build.stream.device_merge", 0.0), 2
+        ),
     }
     build["build_finalize_mode"] = finalize_mode
     build["build_run_files"] = counters.get("build.stream.run_files", 0)
@@ -757,6 +784,7 @@ def main() -> None:
         # actually ran so artifacts across PRs compare like-for-like
         "scale_compile": scale_compile,
         "scale_hbm": scale_hbm,
+        "scale_engine": scale_engine,
         "scale_pipeline": os.environ.get(
             "SCALE_PIPELINE", C.BUILD_PIPELINE_DEFAULT
         ),

@@ -742,6 +742,8 @@ def test_device_inflight_chunks_bounded(tmp_path, monkeypatch):
 
     monkeypatch.setattr(ops_build, "build_partition_single", wrapped)
     b = sample(8192, seed=71)
+    from hyperspace_tpu.index.stream_builder import DeviceBuildConfig
+
     write_index_data_streaming(
         chunks_of(b, 512),
         ["orderkey"],
@@ -750,6 +752,9 @@ def test_device_inflight_chunks_bounded(tmp_path, monkeypatch):
         chunk_capacity=512,
         engine="device",
         pipeline=pipelined(spill_compute_workers=8, spill_write_workers=2),
+        # per-chunk mode: THIS dispatch path is what the bound protects
+        # (the staged path holds slots per run merge, tested separately)
+        device=DeviceBuildConfig.per_chunk(),
     )
     assert inflight["peak"] <= sb.DEVICE_INFLIGHT_CHUNKS
     assert inflight["peak"] >= 2  # the pipeline did run ahead of the fetch
@@ -828,3 +833,247 @@ def test_create_action_pipeline_off_matches_on(tmp_path):
         results[f"q_{mode}"] = sorted(got.columns["v"].data.tolist())
     assert results["off"] == results["on"]
     assert results["q_off"] == results["q_on"]
+
+
+# ---------------------------------------------------------------------------
+# device-resident run staging (docs/14-build-pipeline.md, device build):
+# double-buffered H2D slab pair + on-device k-way run merge. The parity
+# invariant extends config 13's: the staged path must not change ONE BYTE
+# of the built index vs the per-chunk round trip (runChunks=1), because
+# runs reserve their first chunk's sequence slot and the device merge is
+# stable by chunk order exactly like the host merge is by run order.
+# ---------------------------------------------------------------------------
+from hyperspace_tpu.index.stream_builder import DeviceBuildConfig  # noqa: E402
+from hyperspace_tpu.residency import slabs as slab_budget  # noqa: E402
+
+
+def _int_sample(n=2048, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "orderkey": rng.integers(0, 10**6, n).astype(np.int64),
+            "qty": rng.integers(0, 50, n).astype(np.int32),
+            "price": (rng.random(n) * 1e4).astype(np.float64),
+        },
+        schema={"orderkey": "int64", "qty": "int32", "price": "float64"},
+    )
+
+
+def _bucket_bytes(out_dir):
+    return {
+        p.name.split("-")[0]: p.read_bytes()
+        for p in sorted(out_dir.glob("*.tcb"))
+    }
+
+
+def _staged_build(tmp_path, tag, device, keys=("orderkey", "qty"),
+                  batch=None, pipeline=None, chunk=512):
+    out = tmp_path / tag
+    write_index_data_streaming(
+        chunks_of(batch if batch is not None else _int_sample(2048 + 100), 
+                  chunk),
+        list(keys),
+        8,
+        out,
+        chunk_capacity=chunk,
+        engine="device",
+        pipeline=pipeline or BuildPipelineConfig.serial(),
+        device=device,
+    )
+    return _bucket_bytes(out)
+
+
+def test_probe_cache_key_includes_device_mode():
+    """The host_width lesson applied to the device engine: a per-chunk
+    round-trip verdict must not bind a double-buffered staged run —
+    the modes get separate probe-cache slots (and the default key is
+    the default mode's)."""
+    from hyperspace_tpu.index import stream_builder as sb
+
+    per_chunk = sb._engine_cache_key(
+        512, device_mode=DeviceBuildConfig.per_chunk().mode_token()
+    )
+    staged = sb._engine_cache_key(
+        512, device_mode=DeviceBuildConfig(True, 4).mode_token()
+    )
+    assert per_chunk != staged
+    assert sb._engine_cache_key(512) == sb._engine_cache_key(
+        512, device_mode=DeviceBuildConfig.default().mode_token()
+    )
+    # and a writer's key carries its own mode
+    assert DeviceBuildConfig.per_chunk().mode_token() in map(
+        str, per_chunk
+    )
+
+
+def test_staged_device_build_matches_per_chunk_bytes(tmp_path):
+    """Byte parity per-chunk vs staged (serial AND pipelined), with a
+    partial tail chunk in the stream; the staged side must also pay
+    runChunks-fold fewer blocking D2H calls."""
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    b = _int_sample(4 * 512 + 100, seed=29)
+    metrics.reset()
+    a_bytes = _staged_build(
+        tmp_path, "per_chunk", DeviceBuildConfig.per_chunk(), batch=b
+    )
+    a_calls = metrics.counter("build.stream.d2h_calls")
+    metrics.reset()
+    s_bytes = _staged_build(
+        tmp_path, "staged", DeviceBuildConfig(True, 4), batch=b
+    )
+    s_calls = metrics.counter("build.stream.d2h_calls")
+    assert metrics.counter("build.device.staged_chunks") == 4
+    assert metrics.counter("build.device.staged_runs") == 1
+    assert a_bytes == s_bytes
+    # 4 full chunks: per-chunk pays 4 blocking fetches + 1 tail; the
+    # staged run pays ONE (+ the tail's per-chunk fetch)
+    assert a_calls == 5 and s_calls == 2
+    p_bytes = _staged_build(
+        tmp_path, "staged_pipe", DeviceBuildConfig(True, 4), batch=b,
+        pipeline=pipelined(),
+    )
+    assert p_bytes == a_bytes
+    assert slab_budget.held_bytes() == 0
+
+
+def test_string_key_declines_staging_with_parity(tmp_path):
+    """Per-chunk vocab codes are not comparable across chunks, so a
+    string KEY routes every chunk per-chunk (counted decline) — and the
+    result is still byte-identical to runChunks=1."""
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    b = sample(2048, seed=31)  # has the "flag" string column
+    metrics.reset()
+    s_bytes = _staged_build(
+        tmp_path, "str_staged", DeviceBuildConfig(True, 4),
+        keys=("orderkey", "flag"), batch=b,
+    )
+    assert metrics.counter("build.device.staging_declined.string_key") > 0
+    assert metrics.counter("build.device.staged_chunks") == 0
+    a_bytes = _staged_build(
+        tmp_path, "str_per_chunk", DeviceBuildConfig.per_chunk(),
+        keys=("orderkey", "flag"), batch=b,
+    )
+    assert s_bytes == a_bytes
+
+
+def test_budget_decline_routes_per_chunk(tmp_path, monkeypatch):
+    """No slab-budget headroom: the build quietly runs the per-chunk
+    path (counted), never fails, and leaks no reservation."""
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "0")
+    b = _int_sample(4 * 512, seed=37)
+    metrics.reset()
+    out = _staged_build(
+        tmp_path, "nobudget", DeviceBuildConfig(True, 4), batch=b
+    )
+    assert metrics.counter("build.device.staging_declined.budget") > 0
+    assert metrics.counter("build.device.staged_runs") == 0
+    assert metrics.counter("build.stream.d2h_calls") == 4
+    assert len(out) > 0
+    assert slab_budget.held_bytes() == 0
+
+
+def test_slab_budget_accounting_and_cache_subtraction(monkeypatch):
+    """residency.slabs: all-or-nothing reservation, half-budget cap,
+    idempotent release, and the serving caches see held bytes through
+    exec.hbm_cache._budget_bytes."""
+    from hyperspace_tpu.exec import hbm_cache
+
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "64")
+    base = hbm_cache._budget_bytes()
+    assert base == 64 << 20
+    assert slab_budget.try_reserve("t-a", 10 << 20)
+    assert hbm_cache._budget_bytes() == base - (10 << 20)
+    # over the half-budget cap (32 MB): refused, prior charge intact
+    assert not slab_budget.try_reserve("t-b", 30 << 20)
+    assert slab_budget.held_bytes() == 10 << 20
+    # re-reserving a live tag REPLACES its charge
+    assert slab_budget.try_reserve("t-a", 4 << 20)
+    assert slab_budget.held_bytes() == 4 << 20
+    slab_budget.release("t-a")
+    slab_budget.release("t-a")  # idempotent
+    assert slab_budget.held_bytes() == 0
+    assert hbm_cache._budget_bytes() == base
+
+
+# -- fault injection: device loss at each staged-path phase -----------------
+def test_device_loss_mid_slab_upload_clean_teardown(tmp_path, monkeypatch):
+    from hyperspace_tpu.ops import build as ops_build
+
+    real = ops_build.stage_chunk_packed
+    calls = []
+
+    def dying(*a, **k):
+        calls.append(1)
+        if len(calls) >= 2:
+            raise RuntimeError("device lost mid slab upload")
+        return real(*a, **k)
+
+    monkeypatch.setattr(ops_build, "stage_chunk_packed", dying)
+    with pytest.raises(RuntimeError, match="mid slab upload"):
+        _staged_build(
+            tmp_path, "loss_upload", DeviceBuildConfig(True, 4),
+            batch=_int_sample(4 * 512, seed=41),
+        )
+    assert _no_pool_threads()
+    assert not (tmp_path / "loss_upload" / ".spill").exists()
+    assert slab_budget.held_bytes() == 0
+
+
+def test_device_loss_mid_device_merge_clean_teardown_and_host_parity(
+    tmp_path, monkeypatch
+):
+    from hyperspace_tpu.ops import build as ops_build
+
+    b = _int_sample(4 * 512, seed=43)
+
+    def dying(*a, **k):
+        raise RuntimeError("device lost mid run merge")
+
+    monkeypatch.setattr(ops_build, "merge_staged_chunks", dying)
+    with pytest.raises(RuntimeError, match="mid run merge"):
+        _staged_build(
+            tmp_path, "loss_merge", DeviceBuildConfig(True, 2), batch=b
+        )
+    assert _no_pool_threads()
+    assert not (tmp_path / "loss_merge" / ".spill").exists()
+    assert slab_budget.held_bytes() == 0
+    monkeypatch.undo()
+    # host-engine fallback parity: the same source through the host
+    # engine produces the same index bytes the device path would have
+    host_out = tmp_path / "host_fb"
+    write_index_data_streaming(
+        chunks_of(b, 512), ["orderkey", "qty"], 8, host_out,
+        chunk_capacity=512, engine="host",
+        pipeline=BuildPipelineConfig.serial(),
+    )
+    dev_bytes = _staged_build(
+        tmp_path, "dev_ok", DeviceBuildConfig(True, 2), batch=b
+    )
+    assert _bucket_bytes(host_out) == dev_bytes
+
+
+def test_failure_with_async_d2h_in_flight_clean_teardown(
+    tmp_path, monkeypatch
+):
+    """A spill-write failure while a staged run's non-blocking D2H is
+    still in flight: the FIRST error re-raises on the main thread, the
+    stager's device references and budget charge are dropped, no pool
+    thread parks on the device slot."""
+    from hyperspace_tpu.index import stream_builder as sb
+
+    def dying(*a, **k):
+        raise OSError("spill write died under in-flight D2H")
+
+    monkeypatch.setattr(sb.layout, "write_batch", dying)
+    with pytest.raises(OSError, match="in-flight D2H"):
+        _staged_build(
+            tmp_path, "loss_d2h", DeviceBuildConfig(True, 2),
+            batch=_int_sample(6 * 512, seed=47), pipeline=pipelined(),
+        )
+    assert _no_pool_threads()
+    assert not (tmp_path / "loss_d2h" / ".spill").exists()
+    assert slab_budget.held_bytes() == 0
