@@ -31,7 +31,7 @@ from .core import (
     iter_suppression_markers,
     run_analysis,
 )
-from .reporter import render_json, render_text, summarize
+from .reporter import render_json, render_sarif, render_text, summarize
 
 __all__ = [
     "Finding",
@@ -45,6 +45,7 @@ __all__ = [
     "iter_suppression_markers",
     "run_analysis",
     "render_json",
+    "render_sarif",
     "render_text",
     "summarize",
 ]

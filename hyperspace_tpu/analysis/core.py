@@ -380,6 +380,12 @@ def run_analysis(
         note("project-model", _time.perf_counter() - t0)
         if model_sink is not None:
             model_sink.append(model)
+        t0 = _time.perf_counter()
+        # prebuild the device-value flow so the HS015+ rules share one
+        # fixpoint and its cost shows under its own timings key instead
+        # of inflating whichever rule touches it first
+        model.device_flow()
+        note("device-flow", _time.perf_counter() - t0)
         for rule in project_rules:
             t0 = _time.perf_counter()
             for path, line, col, message in rule.check_project(model):

@@ -1,0 +1,1004 @@
+"""hslint phase 3: device-boundary value flow over the project model.
+
+The PR-7 model resolves WHO calls WHOM; this module resolves WHAT
+crosses the device boundary. It classifies expressions as
+*device-valued* — results of ``jax.*``/``jnp.*`` calls, results of
+calling a jitted callable, values flowing out of functions whose
+inferred return is device-valued — and propagates that classification
+interprocedurally through the resolved call graph (returns forward into
+callers, arguments forward into callee parameters). On top of the
+classification it extracts the four fact families the device-boundary
+rules (HS015-HS019) run on:
+
+* **D2H coercions** — ``float()/int()/bool()`` of a device value,
+  ``np.asarray``/``np.array`` of one, ``.item()``/``.tolist()`` on one:
+  each is an implicit device->host readback;
+* **transfer sites** — ``jax.device_put`` (H2D) and ``jax.device_get``
+  (D2H), plus whether the enclosing function reaches a
+  ``trace.add_bytes`` call (lexically or transitively) — the PR-11
+  byte-tracing discipline;
+* **jit factories** — each ``jax.jit(body)`` site with the body's free
+  variables split into closure-captured factory parameters and the
+  memo-key parameters, the facts behind the structure-keyed-cache
+  discipline (HS016);
+* **x64 facts** — 64-bit ``jnp`` dtype references (including inside
+  nested jit bodies) with their lexical ``enable_x64`` coverage, plus
+  module-level x64 (an ``ensure_x64()`` / ``jax.config.update(
+  "jax_enable_x64", True)`` at import, own module or ancestor package
+  ``__init__``);
+* **decline facts** — whether a function lexically (or transitively)
+  increments a ``…declined…`` metric, the HS018 "no silent tail" seam.
+
+Resolution inherits the project model's contract — conservative, "may
+miss, must not invent": a value the judge cannot classify is host/
+unknown, never device, so every HS015-HS019 finding is anchored on a
+positive classification. Documented blind spots: device arrays stored
+on object ATTRIBUTES (``region.l_codes``) are invisible (no field
+typing); dtypes spelled as strings (``dtype="int64"``) are invisible;
+a D2H laundered through an unresolved helper call is invisible.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import dotted_name, terminal_name
+
+# jax sub-namespaces whose members return HOST values or are infra —
+# calls under these never mint a device array
+_HOST_JAX_PREFIXES = (
+    "jax.config.",
+    "jax.tree_util.",
+    "jax.tree.",
+    "jax.debug.",
+    "jax.profiler.",
+    "jax.sharding.",
+    "jax.errors.",
+    "jax.dtypes.",
+)
+_HOST_JAX_CALLS = {
+    "jax.device_get",  # explicitly a D2H transfer, result is host
+    "jax.devices",
+    "jax.local_devices",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.process_index",
+    "jax.process_count",
+    "jax.default_backend",
+    "jax.make_mesh",
+    "jax.eval_shape",
+    "jax.numpy.iinfo",
+    "jax.numpy.finfo",
+    "jax.numpy.dtype",
+}
+# callables returning a CALLABLE that dispatches to device when invoked
+_JIT_WRAPPERS = {"jax.jit", "jax.pmap", "jax.vmap", "jax.grad"}
+
+_D2H_METHODS = {"item", "tolist"}
+_CAST_NAMES = {"float", "int", "bool"}
+_DTYPE64_ATTRS = {"int64", "float64", "uint64"}
+
+# factory parameters that are STRUCTURAL by convention (shapes, modes,
+# arities, signatures) — legitimately folded into both a jit closure and
+# its memo key. The recompile-hazard check skips them; a value-like
+# parameter hiding behind a structural name is a documented blind spot.
+_STRUCTURAL_PARAM_RE = re.compile(
+    r"^(n|num|len|cap|pad|span|width|height|depth|rank|arity|size|shape"
+    r"|dim|dims|block|blocks|bits|mode|kind|enc|tag|sig|structure|seed"
+    r"|axis|order)(_|\d|$)"
+    r"|_(mode|bits|pad|cap|rows|cols|size|shape|len|count|arity)$"
+    r"|^(use|is|has|with)_"
+)
+
+# the distinguished judgement for "a jitted callable" (calling it
+# dispatches to device); distinct from True = "a device value"
+_JIT = "jit"
+
+
+@dataclass(frozen=True)
+class D2HEvent:
+    """One implicit device->host coercion."""
+
+    line: int
+    col: int
+    kind: str  # "float"|"int"|"bool"|"asarray"|"item"|"tolist"
+    detail: str  # source spelling of the coerced operand
+
+
+@dataclass(frozen=True)
+class TransferEvent:
+    """One explicit H2D/D2H transfer API call."""
+
+    line: int
+    col: int
+    direction: str  # "h2d" | "d2h"
+    api: str  # "device_put" | "device_get"
+
+
+@dataclass(frozen=True)
+class JitFactory:
+    """One ``jax.jit(body)`` site inside a factory function."""
+
+    line: int
+    col: int
+    body: str  # local def / lambda name
+    closure_params: Tuple[str, ...]  # factory params free in the body
+    key_params: Tuple[str, ...]  # factory params folded into the memo key
+    cached: bool  # the jitted fn is stored under that key
+
+
+@dataclass
+class FunctionFlow:
+    """Per-function value-flow facts."""
+
+    qual: str
+    device_return: bool = False
+    returns_jit: bool = False
+    device_params: Set[str] = field(default_factory=set)
+    d2h: List[D2HEvent] = field(default_factory=list)
+    transfers: List[TransferEvent] = field(default_factory=list)
+    traces_bytes: bool = False  # lexical trace.add_bytes call
+    declined_incr: bool = False  # lexical metrics.incr("…declined…")
+    # (line, col, spelling, lexically inside ``with enable_x64``)
+    dtype64: List[Tuple[int, int, str, bool]] = field(default_factory=list)
+    jit_factories: List[JitFactory] = field(default_factory=list)
+
+
+# dep kinds:
+#   ("ret", q)     — device value if q's return is device-valued
+#   ("param", q, n) — device value if q's parameter n is device-valued
+#   ("jit", q)     — IS a jitted callable if q returns one
+#   ("jitcall", q) — device value if q returns a jitted callable (this
+#                    value is the result of CALLING that callable)
+Dep = Tuple
+Judgement = object  # True | None | _JIT | FrozenSet[Dep]
+
+
+def _cont(inner: Judgement) -> Judgement:
+    """A host CONTAINER (tuple/list/set literal) of possibly-device
+    elements. Iterating or passing it is host-free; subscripting or
+    unpacking it recovers the element judgement. Distinct from a device
+    value so that ``for kr in key_reprs`` over a list of arrays is never
+    called a D2H fetch."""
+    return ("cont", inner) if inner is not None else None
+
+
+def _is_cont(j: Judgement) -> bool:
+    return isinstance(j, tuple) and len(j) == 2 and j[0] == "cont"
+
+
+def _elem(j: Judgement) -> Judgement:
+    """Element judgement of a container; identity otherwise."""
+    return j[1] if _is_cont(j) else j
+
+
+def _merge(a: Judgement, b: Judgement) -> Judgement:
+    if _is_cont(a) or _is_cont(b):
+        ia = a[1] if _is_cont(a) else a
+        ib = b[1] if _is_cont(b) else b
+        return _cont(_merge(ia, ib))
+    if a is True or b is True:
+        return True
+    if a is _JIT or b is _JIT:
+        return _JIT
+    deps: Set[Dep] = set()
+    for j in (a, b):
+        if isinstance(j, frozenset):
+            deps |= j
+    return frozenset(deps) if deps else None
+
+
+class DeviceFlow:
+    """The value-flow model: build once per ProjectModel, query per
+    function. ``flows[qual]`` holds every per-function fact; the
+    ``*_reach`` helpers answer the transitive questions."""
+
+    def __init__(self, model):
+        self.model = model
+        self.flows: Dict[str, FunctionFlow] = {}
+        self._module_x64_own: Dict[str, bool] = {}
+        self._pending_events: List[Tuple[str, object, FrozenSet[Dep]]] = []
+        self._ret_deps: Dict[str, Set[Dep]] = {}
+        self._jit_ret_deps: Dict[str, Set[Dep]] = {}
+        self._arg_props: List[Tuple[str, str, FrozenSet[Dep]]] = []
+        self._traced_reach: Optional[Set[str]] = None
+        self._declined_reach: Optional[Set[str]] = None
+        self._x64_covered: Optional[Dict[str, bool]] = None
+        self._build()
+
+    # -- module-level x64 ----------------------------------------------------
+    def module_x64(self, module: str) -> bool:
+        """True when the module (or an ancestor package ``__init__``)
+        flips the global x64 flag at import — every executable traced
+        after that import is 64-bit capable."""
+        if self._module_x64_own.get(module):
+            return True
+        parts = module.split(".")
+        return any(
+            self._module_x64_own.get(".".join(parts[:i]))
+            for i in range(1, len(parts))
+        )
+
+    # -- transitive facts ----------------------------------------------------
+    def traced_reach(self) -> Set[str]:
+        """Quals that lexically call ``add_bytes`` or transitively call
+        a function that does — the set HS019 credits."""
+        if self._traced_reach is None:
+            self._traced_reach = self._reach_closure(
+                {q for q, fl in self.flows.items() if fl.traces_bytes}
+            )
+        return self._traced_reach
+
+    def declined_reach(self) -> Set[str]:
+        """Quals that lexically increment a ``…declined…`` metric or
+        transitively call a function that does."""
+        if self._declined_reach is None:
+            self._declined_reach = self._reach_closure(
+                {q for q, fl in self.flows.items() if fl.declined_incr}
+            )
+        return self._declined_reach
+
+    def _reach_closure(self, seed: Set[str]) -> Set[str]:
+        out = set(seed)
+        changed = True
+        while changed:
+            changed = False
+            for qual, f in self.model.functions.items():
+                if qual in out:
+                    continue
+                if any(
+                    s.callee in out
+                    for s in f.calls
+                    if s.callee is not None
+                ):
+                    out.add(qual)
+                    changed = True
+        return out
+
+    def x64_covered(self) -> Dict[str, bool]:
+        """Greatest-fixpoint x64 coverage per function: covered when the
+        module is globally x64, or EVERY resolved call site reaching the
+        function is lexically inside ``with enable_x64`` / in a covered
+        caller. Functions with no resolved callers are NOT covered (an
+        entry point must establish its own scope)."""
+        if self._x64_covered is not None:
+            return self._x64_covered
+        covered = {}
+        callers = self.model.callers_of()
+        for qual, f in self.model.functions.items():
+            covered[qual] = True  # optimistic start; carve down
+        changed = True
+        while changed:
+            changed = False
+            for qual, f in self.model.functions.items():
+                if not covered[qual]:
+                    continue
+                if self.module_x64(f.module):
+                    continue
+                sites = callers.get(qual, [])
+                ok = bool(sites) and all(
+                    site.x64
+                    or self.module_x64(caller.module)
+                    or covered[caller.qual]
+                    for caller, site in sites
+                )
+                if not ok:
+                    covered[qual] = False
+                    changed = True
+        self._x64_covered = covered
+        return covered
+
+    # -- dump ----------------------------------------------------------------
+    def dump_function(self, qual: str) -> Dict[str, object]:
+        """JSON-ready value-flow facts for one function (the
+        --call-graph-dump extension); {} when nothing interesting."""
+        fl = self.flows.get(qual)
+        if fl is None:
+            return {}
+        out: Dict[str, object] = {}
+        if fl.device_return:
+            out["device_return"] = True
+        if fl.returns_jit:
+            out["returns_jit"] = True
+        if fl.device_params:
+            out["device_params"] = sorted(fl.device_params)
+        if fl.d2h:
+            out["d2h"] = [
+                f"{e.kind}({e.detail})@{e.line}" for e in fl.d2h
+            ]
+        if fl.transfers:
+            out["transfers"] = [
+                f"{t.direction}:{t.api}@{t.line}" for t in fl.transfers
+            ]
+        if fl.traces_bytes:
+            out["traces_bytes"] = True
+        if fl.declined_incr:
+            out["declined_incr"] = True
+        if fl.dtype64:
+            out["dtype64"] = [
+                f"{sp}@{ln}{'(x64)' if x else ''}"
+                for ln, _c, sp, x in fl.dtype64
+            ]
+        if fl.jit_factories:
+            out["jit_factories"] = [
+                {
+                    "body": jf.body,
+                    "line": jf.line,
+                    "closure_params": list(jf.closure_params),
+                    "key_params": list(jf.key_params),
+                    "cached": jf.cached,
+                }
+                for jf in fl.jit_factories
+            ]
+        return out
+
+    # -- build ---------------------------------------------------------------
+    def _build(self) -> None:
+        for name, info in self.model.modules.items():
+            self._module_x64_own[name] = _module_sets_x64(
+                info.ctx.tree, info.aliases
+            )
+        # local pass per function: two sweeps so later-established
+        # device locals are seen by earlier uses (flow-insensitive
+        # within the function, like the lock walker)
+        for qual, f in self.model.functions.items():
+            node = getattr(f, "_node", None)
+            if node is None:
+                continue  # <module> pseudo-functions carry no body node
+            flow = FunctionFlow(qual=qual)
+            self.flows[qual] = flow
+            walker = _FlowWalker(self, f, node, flow)
+            walker.run()
+            self._ret_deps[qual] = walker.ret_deps
+            self._jit_ret_deps[qual] = walker.jit_ret_deps
+            self._pending_events.extend(walker.pending_events)
+            self._arg_props.extend(walker.arg_props)
+        self._fixpoint()
+        # finalize pending (dep-conditioned) events
+        for qual, event, deps in self._pending_events:
+            if self._eval_deps(deps):
+                fl = self.flows[qual]
+                if isinstance(event, D2HEvent):
+                    fl.d2h.append(event)
+                else:
+                    fl.transfers.append(event)
+        for fl in self.flows.values():
+            fl.d2h.sort(key=lambda e: (e.line, e.col))
+            fl.transfers.sort(key=lambda e: (e.line, e.col))
+
+    def _eval_dep(self, dep: Dep) -> bool:
+        kind = dep[0]
+        if kind == "ret":
+            fl = self.flows.get(dep[1])
+            return bool(fl and fl.device_return)
+        if kind in ("jit", "jitcall"):
+            fl = self.flows.get(dep[1])
+            return bool(fl and fl.returns_jit)
+        if kind == "param":
+            fl = self.flows.get(dep[1])
+            return bool(fl and dep[2] in fl.device_params)
+        return False
+
+    def _eval_deps(self, deps: FrozenSet[Dep]) -> bool:
+        return any(self._eval_dep(d) for d in deps)
+
+    def _fixpoint(self) -> None:
+        """Propagate device-ness through returns and call arguments to a
+        fixpoint — the interprocedural half of the model."""
+        changed = True
+        while changed:
+            changed = False
+            for qual, deps in self._ret_deps.items():
+                fl = self.flows[qual]
+                if not fl.device_return and self._eval_deps(
+                    frozenset(deps)
+                ):
+                    fl.device_return = True
+                    changed = True
+            for qual, deps in self._jit_ret_deps.items():
+                fl = self.flows[qual]
+                if not fl.returns_jit and self._eval_deps(frozenset(deps)):
+                    fl.returns_jit = True
+                    changed = True
+            for callee, pname, deps in self._arg_props:
+                fl = self.flows.get(callee)
+                if (
+                    fl is not None
+                    and pname not in fl.device_params
+                    and self._eval_deps(deps)
+                ):
+                    fl.device_params.add(pname)
+                    changed = True
+
+
+def _module_sets_x64(tree: ast.AST, aliases: Dict[str, str]) -> bool:
+    """Import-time global x64: a top-level ``ensure_x64()`` call or
+    ``jax.config.update("jax_enable_x64", True)``."""
+    for node in tree.body:
+        if not (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        if terminal_name(call.func) == "ensure_x64":
+            return True
+        d = dotted_name(call.func, aliases)
+        if (
+            d == "jax.config.update"
+            and call.args
+            and isinstance(call.args[0], ast.Constant)
+            and call.args[0].value == "jax_enable_x64"
+            and not (
+                len(call.args) > 1
+                and isinstance(call.args[1], ast.Constant)
+                and call.args[1].value is False
+            )
+        ):
+            return True
+    return False
+
+
+def param_names(fnnode: ast.AST, is_method: bool) -> List[str]:
+    """Positional parameter names of a def, self/cls stripped for
+    methods — the call-site argument mapping HS016 and the argument
+    propagation both use."""
+    args = fnnode.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if is_method and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _free_names(fnnode: ast.AST) -> Set[str]:
+    """Names a def/lambda loads but does not bind — the closure capture
+    set of a jit body."""
+    bound: Set[str] = set()
+    loads: Set[str] = set()
+    args = fnnode.args
+    for a in (
+        args.posonlyargs
+        + args.args
+        + args.kwonlyargs
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        bound.add(a.arg)
+    body = fnnode.body if isinstance(fnnode.body, list) else [fnnode.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    loads.add(node.id)
+                else:
+                    bound.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(node.name)
+            elif isinstance(node, ast.comprehension):
+                for t in ast.walk(node.target):
+                    if isinstance(t, ast.Name):
+                        bound.add(t.id)
+    return loads - bound
+
+
+class _FlowWalker:
+    """One function's local value-flow pass. Two sweeps: the first only
+    builds the local judgement environment, the second emits facts."""
+
+    def __init__(self, dflow: DeviceFlow, finfo, node: ast.AST, flow):
+        self.dflow = dflow
+        self.model = dflow.model
+        self.f = finfo
+        self.node = node
+        self.flow = flow
+        info = self.model.modules.get(finfo.module)
+        self.aliases = info.aliases if info else {}
+        self.params = set(param_names(node, finfo.cls is not None))
+        self.env: Dict[str, Judgement] = {}
+        self.nested: Dict[str, ast.AST] = {
+            st.name: st
+            for st in ast.walk(node)
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and st is not node
+        }
+        self.callmap = {(s.line, s.col): s for s in finfo.calls}
+        self.ret_deps: Set[Dep] = set()
+        self.jit_ret_deps: Set[Dep] = set()
+        self.pending_events: List[Tuple[str, object, FrozenSet[Dep]]] = []
+        self.arg_props: List[Tuple[str, str, FrozenSet[Dep]]] = []
+        # key-tuple facts for the jit-factory extraction
+        self.tuple_params: Dict[str, Set[str]] = {}  # var -> params in tuple
+        self.cache_key_vars: Set[str] = set()
+        self.emit = False
+        # device params seeded from annotations at the seams
+        for a in node.args.posonlyargs + node.args.args:
+            ann = getattr(a, "annotation", None)
+            if ann is not None:
+                d = dotted_name(ann, self.aliases) or ""
+                if d.startswith("jax.") and (
+                    "Array" in d or "ndarray" in d
+                ):
+                    self.flow.device_params.add(a.arg)
+
+    def run(self) -> None:
+        body = list(getattr(self.node, "body", []))
+        self.emit = False
+        self._stmts(body, False)
+        self.emit = True
+        self._stmts(body, False)
+
+    # -- statements ----------------------------------------------------------
+    def _stmts(self, stmts: List[ast.stmt], x64: bool) -> None:
+        for st in stmts:
+            self._stmt(st, x64)
+
+    def _stmt(self, st: ast.stmt, x64: bool) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested scope: not walked for flow, but 64-bit dtypes
+            # inside it (jit bodies trace later) are attributed here,
+            # and a @jax.jit decorator marks a factory site
+            if self.emit:
+                self._scan_dtype64(st, x64)
+                for dec in st.decorator_list:
+                    if dotted_name(dec, self.aliases) in _JIT_WRAPPERS:
+                        self._note_jit_factory(st, st.name, st.lineno, st.col_offset)
+            return
+        if isinstance(st, ast.Assign):
+            j = self._expr(st.value, x64)
+            for t in st.targets:
+                self._bind(t, j, st.value)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                j = self._expr(st.value, x64)
+                self._bind(st.target, j, st.value)
+            return
+        if isinstance(st, ast.AugAssign):
+            j = self._expr(st.value, x64)
+            if isinstance(st.target, ast.Name):
+                self.env[st.target.id] = _merge(
+                    self.env.get(st.target.id), j
+                )
+            return
+        if isinstance(st, ast.Return):
+            if st.value is not None:
+                j = self._expr(st.value, x64)
+                # returning a container of device values: callers unpack
+                # or subscript it, so the elements' judgement is what the
+                # return carries (container-ness itself does not survive
+                # the call boundary — documented imprecision)
+                j = _elem(j)
+                if j is True:
+                    self.flow.device_return = True
+                elif j is _JIT:
+                    self.flow.returns_jit = True
+                elif isinstance(j, frozenset):
+                    for dep in j:
+                        if dep[0] == "jit":
+                            # returning a value that IS a (conditional)
+                            # jit callable: our return is one too
+                            self.jit_ret_deps.add(dep)
+                        else:
+                            self.ret_deps.add(dep)
+                            # returning the direct result of calling q:
+                            # if q returns a jit callable, so do we
+                            if dep[0] == "ret":
+                                self.jit_ret_deps.add(("jit", dep[1]))
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            j = self._expr(st.iter, x64)
+            if self.emit and not _is_cont(j) and _devicey(j):
+                # iterating a device ARRAY fetches it element-by-element;
+                # iterating a host container of device values is free
+                self._emit_d2h_at(
+                    st.iter.lineno, st.iter.col_offset, "iter", st.iter, j
+                )
+            self._bind(st.target, _elem(j), st.iter)
+            self._stmts(st.body, x64)
+            self._stmts(st.orelse, x64)
+            return
+        if isinstance(st, ast.While):
+            self._expr(st.test, x64)
+            self._stmts(st.body, x64)
+            self._stmts(st.orelse, x64)
+            return
+        if isinstance(st, ast.If):
+            self._expr(st.test, x64)
+            self._stmts(st.body, x64)
+            self._stmts(st.orelse, x64)
+            return
+        if isinstance(st, ast.With):
+            inner_x64 = x64
+            for item in st.items:
+                self._expr(item.context_expr, x64)
+                if _is_x64_ctx(item.context_expr):
+                    inner_x64 = True
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, None, item.context_expr)
+            self._stmts(st.body, inner_x64)
+            return
+        if isinstance(st, ast.Try):
+            self._stmts(st.body, x64)
+            for h in st.handlers:
+                self._stmts(h.body, x64)
+            self._stmts(st.orelse, x64)
+            self._stmts(st.finalbody, x64)
+            return
+        if isinstance(st, ast.Expr):
+            self._expr(st.value, x64)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._expr(child, x64)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child, x64)
+
+    def _bind(self, target: ast.AST, j: Judgement, value: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            # REPLACE, don't merge: ``lo = np.asarray(lo)`` is the
+            # canonical boundary idiom — after the rebind the name is
+            # host-valued. (The second sweep starts from the first
+            # sweep's final env, so loop-carried device values are still
+            # seen at uses textually before their binding.)
+            self.env[target.id] = j
+            # remember tuple literals of params — memo-key candidates
+            if isinstance(value, ast.Tuple):
+                inside = {
+                    n.id
+                    for n in ast.walk(value)
+                    if isinstance(n, ast.Name) and n.id in self.params
+                }
+                if inside:
+                    self.tuple_params[target.id] = (
+                        self.tuple_params.get(target.id, set()) | inside
+                    )
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind(el, _elem(j), value)
+        elif isinstance(target, ast.Subscript):
+            # cache[key] = fn — a cache-key store
+            if isinstance(target.slice, ast.Name):
+                self.cache_key_vars.add(target.slice.id)
+            self._expr(target.value, False)
+
+    # -- expressions ---------------------------------------------------------
+    def _expr(self, node: ast.AST, x64: bool) -> Judgement:
+        if isinstance(node, ast.Call):
+            return self._call(node, x64)
+        if isinstance(node, ast.Name):
+            if node.id in self.env:
+                return self.env[node.id]
+            if node.id in self.params:
+                if node.id in self.flow.device_params:
+                    return True
+                return frozenset({("param", self.f.qual, node.id)})
+            return None
+        if isinstance(node, ast.Attribute):
+            # 64-bit dtype spelling: jnp.int64 / jax.numpy.float64
+            d = dotted_name(node, self.aliases)
+            if (
+                self.emit
+                and node.attr in _DTYPE64_ATTRS
+                and d
+                and d.startswith("jax.numpy.")
+            ):
+                self.flow.dtype64.append(
+                    (node.lineno, node.col_offset, node.attr, x64)
+                )
+            self._expr(node.value, x64)
+            return None  # attribute values: untracked (documented)
+        if isinstance(node, ast.Subscript):
+            j = self._expr(node.value, x64)
+            self._expr(node.slice, x64)
+            return _elem(j)
+        if isinstance(node, (ast.BinOp,)):
+            return _merge(
+                self._expr(node.left, x64), self._expr(node.right, x64)
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self._expr(node.operand, x64)
+        if isinstance(node, ast.BoolOp):
+            j: Judgement = None
+            for v in node.values:
+                j = _merge(j, self._expr(v, x64))
+            return j
+        if isinstance(node, ast.Compare):
+            j = self._expr(node.left, x64)
+            for c in node.comparators:
+                j = _merge(j, self._expr(c, x64))
+            return j
+        if isinstance(node, ast.IfExp):
+            self._expr(node.test, x64)
+            return _merge(
+                self._expr(node.body, x64), self._expr(node.orelse, x64)
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            j = None
+            for el in node.elts:
+                j = _merge(j, self._expr(el, x64))
+            # a literal container OF device values is itself host data:
+            # iterating/passing it moves nothing; unpack/subscript below
+            # recover the element judgement
+            return _cont(_elem(j))
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if k is not None:
+                    self._expr(k, x64)
+            for v in node.values:
+                self._expr(v, x64)
+            return None
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            saved = dict(self.env)
+            for gen in node.generators:
+                gj = self._expr(gen.iter, x64)
+                self._bind(gen.target, _elem(gj), gen.iter)
+                for cond in gen.ifs:
+                    self._expr(cond, x64)
+            j = self._expr(node.elt, x64)
+            self.env = saved
+            # a comprehension builds a host container; its ELEMENTS carry
+            # the elt judgement (recovered on unpack/subscript/iteration)
+            return _cont(_elem(j))
+        if isinstance(node, ast.DictComp):
+            saved = dict(self.env)
+            for gen in node.generators:
+                gj = self._expr(gen.iter, x64)
+                self._bind(gen.target, _elem(gj), gen.iter)
+                for cond in gen.ifs:
+                    self._expr(cond, x64)
+            self._expr(node.key, x64)
+            self._expr(node.value, x64)
+            self.env = saved
+            return None
+        if isinstance(node, ast.Starred):
+            return self._expr(node.value, x64)
+        if isinstance(node, (ast.JoinedStr, ast.FormattedValue)):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._expr(child, x64)
+            return None
+        if isinstance(node, ast.Lambda):
+            return None  # separate scope
+        if isinstance(node, ast.NamedExpr):
+            j = self._expr(node.value, x64)
+            self._bind(node.target, j, node.value)
+            return j
+        if isinstance(node, ast.Await):
+            return self._expr(node.value, x64)
+        if isinstance(node, ast.Constant):
+            return None
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, x64)
+        return None
+
+    def _call(self, call: ast.Call, x64: bool) -> Judgement:
+        func = call.func
+        d = dotted_name(func, self.aliases)
+        arg_js = [self._expr(a, x64) for a in call.args]
+        kw_js = {
+            kw.arg: self._expr(kw.value, x64)
+            for kw in call.keywords
+            if kw.arg is not None
+        }
+        for kw in call.keywords:
+            if kw.arg is None:
+                self._expr(kw.value, x64)
+
+        # explicit transfer APIs
+        if d == "jax.device_put":
+            self._emit_transfer(call, "h2d", "device_put")
+            return True
+        if d == "jax.device_get":
+            self._emit_transfer(call, "d2h", "device_get")
+            return None
+
+        # byte-tracing / decline-metric facts
+        term = terminal_name(func)
+        spelled = d or term or ""
+        if self.emit and (
+            spelled == "add_bytes" or spelled.endswith(".add_bytes")
+        ):
+            self.flow.traces_bytes = True
+        if self.emit and term in ("incr", "counter") and call.args:
+            if _str_contains(call.args[0], "declined"):
+                self.flow.declined_incr = True
+
+        # jit wrapper: factory fact + jit-callable judgement
+        if d in _JIT_WRAPPERS:
+            if self.emit and call.args:
+                body = call.args[0]
+                name = None
+                if isinstance(body, ast.Name) and body.id in self.nested:
+                    name = body.id
+                elif isinstance(body, ast.Lambda):
+                    name = "<lambda>"
+                if name is not None:
+                    self._note_jit_factory(
+                        self.nested[name]
+                        if name in self.nested
+                        else body,
+                        name,
+                        call.lineno,
+                        call.col_offset,
+                    )
+            return _JIT
+
+        # implicit D2H coercions
+        if (
+            isinstance(func, ast.Name)
+            and func.id in _CAST_NAMES
+            and len(call.args) == 1
+            and not call.keywords
+        ):
+            self._emit_d2h(call, func.id, call.args[0], arg_js[0])
+            return None
+        if d in ("numpy.asarray", "numpy.array") and call.args:
+            self._emit_d2h(call, "asarray", call.args[0], arg_js[0])
+            return None
+        if isinstance(func, ast.Attribute) and func.attr in _D2H_METHODS:
+            recv_j = self._expr(func.value, x64)
+            self._emit_d2h(call, func.attr, func.value, recv_j)
+            return None
+
+        # method call on a device value returns a device value
+        if isinstance(func, ast.Attribute):
+            recv_j = self._expr(func.value, x64)
+            if recv_j is True:
+                return True
+            if isinstance(recv_j, frozenset) and recv_j:
+                return recv_j
+
+        # general jax.* / jnp.* call results are device values
+        if d and d.startswith("jax."):
+            if d in _HOST_JAX_CALLS or any(
+                d.startswith(p) for p in _HOST_JAX_PREFIXES
+            ):
+                return None
+            return True
+
+        # calling a local var that holds a jitted callable: the RESULT
+        # is a device value (conditionally, when jit-ness is conditional)
+        if isinstance(func, ast.Name):
+            fj = self.env.get(func.id)
+            if fj is _JIT:
+                return True
+            if isinstance(fj, frozenset):
+                jitdeps = frozenset(
+                    ("jitcall", dp[1]) for dp in fj if dp[0] == "jit"
+                )
+                if jitdeps:
+                    return jitdeps
+
+        # resolved in-package callee: device if its return is; propagate
+        # device arguments into its parameters
+        site = self.callmap.get((call.lineno, call.col_offset))
+        callee = site.callee if site is not None else None
+        if callee is not None:
+            cf = self.model.functions.get(callee)
+            cnode = getattr(cf, "_node", None) if cf else None
+            if self.emit and cnode is not None:
+                pnames = param_names(cnode, cf.cls is not None)
+                for i, j in enumerate(arg_js):
+                    if i < len(pnames) and _devicey(j):
+                        self.arg_props.append(
+                            (callee, pnames[i], _as_deps(j))
+                        )
+                for kwname, j in kw_js.items():
+                    if kwname in pnames and _devicey(j):
+                        self.arg_props.append((callee, kwname, _as_deps(j)))
+            return frozenset({("ret", callee), ("jit", callee)})
+        return None
+
+    # -- fact emission -------------------------------------------------------
+    def _emit_d2h(
+        self, call: ast.Call, kind: str, operand: ast.AST, j: Judgement
+    ) -> None:
+        if not self.emit or not _devicey(j):
+            return
+        self._emit_d2h_at(call.lineno, call.col_offset, kind, operand, j)
+
+    def _emit_d2h_at(
+        self, line: int, col: int, kind: str, operand: ast.AST, j: Judgement
+    ) -> None:
+        detail = _spelling(operand)
+        ev = D2HEvent(line, col, kind, detail)
+        if j is True:
+            self.flow.d2h.append(ev)
+        else:
+            self.pending_events.append((self.f.qual, ev, _as_deps(j)))
+
+    def _emit_transfer(self, call: ast.Call, direction: str, api: str) -> None:
+        if not self.emit:
+            return
+        self.flow.transfers.append(
+            TransferEvent(call.lineno, call.col_offset, direction, api)
+        )
+
+    def _note_jit_factory(
+        self, body: ast.AST, name: str, line: int, col: int
+    ) -> None:
+        free = _free_names(body)
+        closure_params = tuple(sorted(free & self.params))
+        key_params: Set[str] = set()
+        cached = False
+        for var in self.cache_key_vars:
+            if var in self.tuple_params:
+                cached = True
+                key_params |= self.tuple_params[var]
+        self.flow.jit_factories.append(
+            JitFactory(
+                line,
+                col,
+                name,
+                closure_params,
+                tuple(sorted(key_params)),
+                cached,
+            )
+        )
+
+    def _scan_dtype64(self, fnnode: ast.AST, x64: bool) -> None:
+        """64-bit dtype references inside a NESTED def (a jit body
+        traces under the dispatch-site scope; attribute them to the
+        enclosing factory with the def site's lexical x64 flag)."""
+        for node in ast.walk(fnnode):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _DTYPE64_ATTRS
+            ):
+                d = dotted_name(node, self.aliases)
+                if d and d.startswith("jax.numpy."):
+                    self.flow.dtype64.append(
+                        (node.lineno, node.col_offset, node.attr, x64)
+                    )
+
+
+def _devicey(j: Judgement) -> bool:
+    """Possibly a device VALUE. A depset of only ("jit", …) deps is a
+    callable, not array data — not devicey."""
+    return j is True or (
+        isinstance(j, frozenset)
+        and any(dp[0] != "jit" for dp in j)
+    )
+
+
+def _as_deps(j: Judgement) -> FrozenSet[Dep]:
+    if not isinstance(j, frozenset):
+        return frozenset()
+    return frozenset(dp for dp in j if dp[0] != "jit")
+
+
+def _spelling(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)[:40]
+    except (ValueError, RecursionError):
+        return "<expr>"
+
+
+def _is_x64_ctx(expr: ast.AST) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    if terminal_name(expr.func) != "enable_x64":
+        return False
+    if expr.args and isinstance(expr.args[0], ast.Constant):
+        return expr.args[0].value is not False
+    return True
+
+
+def _str_contains(node: ast.AST, needle: str) -> bool:
+    """True when a string literal — including any literal part of an
+    f-string or a ``"lit" + var`` concatenation — contains ``needle``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return needle in node.value
+    if isinstance(node, ast.JoinedStr):
+        return any(
+            isinstance(v, ast.Constant)
+            and isinstance(v.value, str)
+            and needle in v.value
+            for v in node.values
+        )
+    if isinstance(node, ast.BinOp):
+        return _str_contains(node.left, needle) or _str_contains(
+            node.right, needle
+        )
+    return False

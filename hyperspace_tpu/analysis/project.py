@@ -63,6 +63,41 @@ _QUEUEISH_RE = re.compile(r"(queue|_q)$", re.I)
 _FENCE_NAMES = {"fence_chain", "fence_materialize"}
 
 
+def _is_x64_scope(expr: ast.AST) -> bool:
+    """``with enable_x64(...):`` context detection — the lexical 64-bit
+    scope HS017 credits. ``enable_x64(False)`` (the kernels' host-math
+    downshift) is NOT an x64 scope."""
+    if not isinstance(expr, ast.Call):
+        return False
+    if terminal_name(expr.func) != "enable_x64":
+        return False
+    if expr.args and isinstance(expr.args[0], ast.Constant):
+        return expr.args[0].value is not False
+    return True
+
+
+def _const_args(call: ast.Call) -> Tuple[Tuple[object, object], ...]:
+    """Numeric (non-bool) constants bound at a call site, as
+    ``(position-or-keyword, value)`` pairs. Bool/str/None constants are
+    structural by convention (mode flags, names) and excluded — the
+    recompile-storm class HS016 hunts is numeric per-call literals."""
+    out: List[Tuple[object, object]] = []
+    for i, a in enumerate(call.args):
+        if (
+            isinstance(a, ast.Constant)
+            and type(a.value) in (int, float)
+        ):
+            out.append((i, a.value))
+    for kw in call.keywords:
+        if (
+            kw.arg is not None
+            and isinstance(kw.value, ast.Constant)
+            and type(kw.value.value) in (int, float)
+        ):
+            out.append((kw.arg, kw.value.value))
+    return tuple(out)
+
+
 # ---------------------------------------------------------------------------
 # per-function facts
 # ---------------------------------------------------------------------------
@@ -85,6 +120,13 @@ class CallSite:
     line: int
     col: int
     held: Tuple[str, ...]
+    # lexically inside a ``with enable_x64(...)`` region (the 64-bit
+    # executable discipline HS017 checks through the call graph)
+    x64: bool = False
+    # numeric (non-bool) constants bound at this site, as
+    # ``(position-or-keyword, value)`` pairs — the per-call-site-literal
+    # facts HS016's recompile-hazard check reads
+    const_args: Tuple[Tuple[object, object], ...] = ()
 
 
 @dataclass(frozen=True)
@@ -221,6 +263,17 @@ class ProjectModel:
                     self.functions[meth.qual] = meth
         self._mro_cache: Dict[str, List[ClassInfo]] = {}
         self._closure_cache: Dict[str, Dict[str, set]] = {}
+        self._device_flow = None
+
+    def device_flow(self):
+        """The phase-3 value-flow model (analysis/dataflow.py) over this
+        project, built once and shared by HS015-HS019 and the
+        --call-graph-dump artifact."""
+        if self._device_flow is None:
+            from .dataflow import DeviceFlow
+
+            self._device_flow = DeviceFlow(self)
+        return self._device_flow
 
     # -- class resolution ----------------------------------------------------
     def resolve_class(self, dotted: str) -> Optional[ClassInfo]:
@@ -338,9 +391,11 @@ class ProjectModel:
     # -- debug artifact ------------------------------------------------------
     def dump(self) -> Dict[str, object]:
         """JSON-ready call-graph artifact (scripts/lint.py
-        --call-graph-dump): per-function resolved edges, lock events, and
-        the lock inventory — the thing to read when a rule's verdict
-        surprises you."""
+        --call-graph-dump): per-function resolved edges, lock events,
+        the lock inventory, and the phase-3 value-flow facts (device
+        returns/params, D2H coercions, transfer sites, x64 coverage) —
+        the thing to read when a rule's verdict surprises you."""
+        flow = self.device_flow()
         funcs = {}
         for qual, f in sorted(self.functions.items()):
             funcs[qual] = {
@@ -358,6 +413,9 @@ class ProjectModel:
                 ],
                 "blocking": [d for _l, _c, d in f.blocking],
             }
+            vf = flow.dump_function(qual)
+            if vf:
+                funcs[qual]["valueflow"] = vf
         locks = sorted(
             {
                 lid
@@ -728,8 +786,10 @@ class _FunctionWalker:
                     return meth.qual
         return None
 
-    # -- body walk with held-lock tracking -----------------------------------
-    def _body(self, stmts: List[ast.stmt], held: Tuple[str, ...]) -> None:
+    # -- body walk with held-lock and x64-region tracking --------------------
+    def _body(
+        self, stmts: List[ast.stmt], held: Tuple[str, ...], x64: bool = False
+    ) -> None:
         held = tuple(held)
         for st in stmts:
             # lock.acquire()/release() toggling in this statement list
@@ -741,7 +801,8 @@ class _FunctionWalker:
                 ):
                     lid = self._lock_of(f.value)
                     if lid is not None:
-                        self._exprs(st, held)  # the call itself runs held-as-is
+                        # the call itself runs held-as-is
+                        self._exprs(st, held, x64)
                         if f.attr == "acquire":
                             self.f.acquires.append(
                                 Acquire(lid, st.lineno, st.col_offset, held)
@@ -754,8 +815,11 @@ class _FunctionWalker:
                         continue
             if isinstance(st, ast.With):
                 inner = held
+                inner_x64 = x64
                 for item in st.items:
-                    self._exprs(item.context_expr, inner)
+                    self._exprs(item.context_expr, inner, x64)
+                    if _is_x64_scope(item.context_expr):
+                        inner_x64 = True
                     lid = self._lock_of(item.context_expr)
                     if lid is not None:
                         self.f.acquires.append(
@@ -767,35 +831,37 @@ class _FunctionWalker:
                             )
                         )
                         inner = inner + (lid,)
-                self._body(st.body, inner)
+                self._body(st.body, inner, inner_x64)
                 continue
             if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue  # nested def: deferred, its own (unmodeled) scope
             if isinstance(st, (ast.For, ast.AsyncFor)):
-                self._exprs(st.iter, held)
-                self._body(st.body, held)
-                self._body(st.orelse, held)
+                self._exprs(st.iter, held, x64)
+                self._body(st.body, held, x64)
+                self._body(st.orelse, held, x64)
                 continue
             if isinstance(st, ast.While):
-                self._exprs(st.test, held)
-                self._body(st.body, held)
-                self._body(st.orelse, held)
+                self._exprs(st.test, held, x64)
+                self._body(st.body, held, x64)
+                self._body(st.orelse, held, x64)
                 continue
             if isinstance(st, ast.If):
-                self._exprs(st.test, held)
-                self._body(st.body, held)
-                self._body(st.orelse, held)
+                self._exprs(st.test, held, x64)
+                self._body(st.body, held, x64)
+                self._body(st.orelse, held, x64)
                 continue
             if isinstance(st, ast.Try):
-                self._body(st.body, held)
+                self._body(st.body, held, x64)
                 for h in st.handlers:
-                    self._body(h.body, held)
-                self._body(st.orelse, held)
-                self._body(st.finalbody, held)
+                    self._body(h.body, held, x64)
+                self._body(st.orelse, held, x64)
+                self._body(st.finalbody, held, x64)
                 continue
-            self._exprs(st, held)
+            self._exprs(st, held, x64)
 
-    def _exprs(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+    def _exprs(
+        self, node: ast.AST, held: Tuple[str, ...], x64: bool = False
+    ) -> None:
         """Record calls / field accesses / blocking endpoints in one
         statement's expressions (nested def/lambda bodies pruned — they
         run later, outside the lexical lock region)."""
@@ -808,7 +874,7 @@ class _FunctionWalker:
                 ):
                     stack.append(child)
             if isinstance(sub, ast.Call):
-                self._record_call(sub, held)
+                self._record_call(sub, held, x64)
             elif isinstance(sub, ast.Attribute):
                 self._record_access(sub, held)
             elif isinstance(sub, ast.Compare):
@@ -828,10 +894,20 @@ class _FunctionWalker:
         "setdefault",
     }
 
-    def _record_call(self, call: ast.Call, held: Tuple[str, ...]) -> None:
+    def _record_call(
+        self, call: ast.Call, held: Tuple[str, ...], x64: bool = False
+    ) -> None:
         callee, raw = self._resolve_call(call)
         self.f.calls.append(
-            CallSite(callee, raw, call.lineno, call.col_offset, held)
+            CallSite(
+                callee,
+                raw,
+                call.lineno,
+                call.col_offset,
+                held,
+                x64,
+                _const_args(call),
+            )
         )
         term = (
             terminal_name(call.func)

@@ -1,11 +1,21 @@
-"""hslint reporters: human text and machine JSON renderings of findings."""
+"""hslint reporters: human text, machine JSON, and SARIF 2.1.0
+renderings of findings. SARIF is the interchange surface — code-review
+UIs (GitHub code scanning among them) ingest it directly, so
+``scripts/lint.py --format sarif`` turns every HS finding into an inline
+review annotation with no adapter in between."""
 
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Sequence
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
 
 from .core import Finding
+
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
 
 
 def summarize(findings: Sequence[Finding]) -> Dict[str, object]:
@@ -44,3 +54,85 @@ def render_json(findings: Sequence[Finding]) -> str:
         indent=2,
         sort_keys=True,
     )
+
+
+def render_sarif(
+    findings: Sequence[Finding],
+    rules: Optional[Sequence] = None,
+    base: Optional[Path] = None,
+) -> str:
+    """SARIF 2.1.0 document for ``findings``.
+
+    ``rules`` (default: the registry) populates the driver's rule
+    catalog so viewers show each code's description, and every result
+    carries a ``ruleIndex`` into it. Suppressed findings are EMITTED
+    with an ``inSource`` suppression object rather than dropped — SARIF
+    consumers hide them by default but auditors can surface them, which
+    is the same contract as ``--show-suppressed``. Paths are emitted
+    relative to ``base`` (default: the repo root two levels up) with
+    POSIX separators; SARIF columns are 1-based where hslint's are
+    0-based, converted here and nowhere else."""
+    if rules is None:
+        from .rules import REGISTRY
+
+        rules = REGISTRY
+    if base is None:
+        base = Path(__file__).resolve().parent.parent.parent
+    rule_index = {r.code: i for i, r in enumerate(rules)}
+    results = []
+    for f in findings:
+        uri = Path(f.path)
+        try:
+            uri = uri.resolve().relative_to(Path(base).resolve())
+        except ValueError:
+            pass  # outside the base: absolute URI is still valid SARIF
+        result = {
+            "ruleId": f.code,
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": uri.as_posix()},
+                        "region": {
+                            "startLine": f.line,
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if f.code in rule_index:
+            result["ruleIndex"] = rule_index[f.code]
+        if f.suppressed:
+            result["suppressions"] = [{"kind": "inSource"}]
+        results.append(result)
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "hslint",
+                        "informationUri": (
+                            "docs/09-static-analysis.md"
+                        ),
+                        "rules": [
+                            {
+                                "id": r.code,
+                                "name": r.name,
+                                "shortDescription": {
+                                    "text": r.description
+                                },
+                            }
+                            for r in rules
+                        ],
+                    }
+                },
+                "columnKind": "utf16CodeUnits",
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

@@ -26,6 +26,11 @@ from .hs011_interproc_blocking import InterprocBlockingRule
 from .hs012_residency_fence import ResidencyFenceRule
 from .hs013_config_keys import ConfigKeyRule
 from .hs014_metric_names import MetricNameRule
+from .hs015_implicit_d2h import ImplicitD2HRule
+from .hs016_recompile_hazard import RecompileHazardRule
+from .hs017_x64_scope import X64ScopeRule
+from .hs018_uncounted_decline import UncountedDeclineRule
+from .hs019_untraced_transfer import UntracedTransferRule
 
 REGISTRY: List[Rule] = [
     HostSyncRule(),
@@ -42,6 +47,11 @@ REGISTRY: List[Rule] = [
     ResidencyFenceRule(),
     ConfigKeyRule(),
     MetricNameRule(),
+    ImplicitD2HRule(),
+    RecompileHazardRule(),
+    X64ScopeRule(),
+    UncountedDeclineRule(),
+    UntracedTransferRule(),
 ]
 
 __all__ = [
@@ -60,4 +70,9 @@ __all__ = [
     "ResidencyFenceRule",
     "ConfigKeyRule",
     "MetricNameRule",
+    "ImplicitD2HRule",
+    "RecompileHazardRule",
+    "X64ScopeRule",
+    "UncountedDeclineRule",
+    "UntracedTransferRule",
 ]

@@ -1,0 +1,88 @@
+"""HS016 — per-call-site literal folded into a jit closure and cache key.
+
+The recompile-storm class PRs 10 and 12 closed by hand, now enforced:
+a jit FACTORY (a function that builds ``jax.jit(body)`` and memoizes it
+under a key tuple) whose body CLOSES OVER a factory parameter that is
+ALSO part of the memo key compiles one fresh executable per distinct
+value of that parameter. For structural parameters (shapes, modes,
+arities — the things XLA genuinely specializes on) that is the design;
+for VALUE-like parameters it is the ``_counts_fn``-bakes-literals bug:
+every distinct literal at a call site becomes a new trace + compile,
+and a literal-burst workload turns the executable cache into a compile
+treadmill. The structure-keyed discipline instead masks the literal out
+of the key (``_expr_structure`` renders it ``?``) and ships the value
+as a traced operand (the ``lits`` vector).
+
+The finding anchors at the CALL SITE that binds a numeric literal to a
+hazard parameter — that site is the witness that per-call-site literals
+actually reach the closure. A factory whose hazard parameters only ever
+receive runtime values (row counts, device counts) never fires.
+Parameters with structural NAMES (n_*, num_*, cap, bits, mode, shape…)
+are exempt by convention; a value-like parameter hiding behind a
+structural name is a documented blind spot."""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+from ..core import ProjectRule
+from ..dataflow import _STRUCTURAL_PARAM_RE, param_names
+
+
+class RecompileHazardRule(ProjectRule):
+    code = "HS016"
+    name = "jit-recompile-hazard"
+    description = (
+        "a call site binds a numeric literal to a jit-factory parameter "
+        "that is closed over by the jitted body AND folded into its memo "
+        "key — each distinct value compiles a fresh executable; pass it "
+        "as a traced operand instead"
+    )
+
+    def check_project(self, project) -> Iterator[Tuple[str, int, int, str]]:
+        flow = project.device_flow()
+        callers = project.callers_of()
+        for qual, fl in sorted(flow.flows.items()):
+            hazard = set()
+            for jf in fl.jit_factories:
+                if not jf.cached:
+                    continue
+                hazard.update(
+                    p
+                    for p in jf.closure_params
+                    if p in jf.key_params
+                    and not _STRUCTURAL_PARAM_RE.match(p)
+                )
+            if not hazard:
+                continue
+            f = project.functions[qual]
+            node = getattr(f, "_node", None)
+            if node is None:
+                continue
+            pnames = param_names(node, f.cls is not None)
+            seen = set()
+            for caller, site in callers.get(qual, []):
+                for key, val in site.const_args:
+                    pname = (
+                        pnames[key]
+                        if isinstance(key, int) and key < len(pnames)
+                        else key
+                    )
+                    if pname not in hazard:
+                        continue
+                    at = (caller.path, site.line, site.col, pname)
+                    if at in seen:
+                        continue
+                    seen.add(at)
+                    yield (
+                        caller.path,
+                        site.line,
+                        site.col,
+                        f"literal {val!r} is bound to parameter "
+                        f"'{pname}' of jit factory {f.name}(); the "
+                        "jitted body closes over it and the memo key "
+                        "includes it, so each distinct value traces and "
+                        "compiles a fresh executable — mask it from the "
+                        "key structure and pass it as a traced operand "
+                        "(the lits-vector discipline)",
+                    )

@@ -1,0 +1,64 @@
+"""HS017 — 64-bit executable outside an enable_x64 scope.
+
+jax silently narrows ``jnp.int64``/``jnp.float64``/``jnp.uint64`` to
+their 32-bit cousins unless ``jax_enable_x64`` is on when the jit body
+TRACES — and tracing happens at first call, under whatever scope the
+dispatcher established, not where the dtype is spelled. A 64-bit dtype
+reference is therefore only safe when one of three scopes provably
+covers it:
+
+  * LEXICAL — the reference sits inside ``with enable_x64(True)``
+    (``enable_x64(False)`` regions do not count);
+  * MODULE — the module (or an ancestor package ``__init__``) calls
+    ``ensure_x64()`` / ``jax.config.update("jax_enable_x64", True)`` at
+    import, making every later trace 64-bit capable;
+  * CALLERS — every resolved call site reaching the function is itself
+    covered (greatest fixpoint over the call graph; a function nobody
+    resolves to must establish its own scope — an entry point cannot
+    inherit one).
+
+Dtype references inside NESTED defs (jit bodies) are attributed to the
+enclosing factory, because that is the function whose coverage decides
+what the trace sees. Dtypes spelled as strings (``dtype="int64"``) are
+a documented blind spot."""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+from ..core import ProjectRule
+
+
+class X64ScopeRule(ProjectRule):
+    code = "HS017"
+    name = "int64-outside-x64-scope"
+    description = (
+        "a 64-bit jnp dtype traces into an executable with no "
+        "enable_x64 scope established lexically, at module import, or "
+        "by every resolved caller — jax silently narrows it to 32-bit"
+    )
+
+    def check_project(self, project) -> Iterator[Tuple[str, int, int, str]]:
+        flow = project.device_flow()
+        covered = flow.x64_covered()
+        for qual, fl in sorted(flow.flows.items()):
+            if not fl.dtype64:
+                continue
+            f = project.functions[qual]
+            if flow.module_x64(f.module):
+                continue
+            if covered.get(qual):
+                continue
+            for line, col, spelling, lexical in fl.dtype64:
+                if lexical:
+                    continue
+                yield (
+                    f.path,
+                    line,
+                    col,
+                    f"jnp.{spelling} in {f.name}() traces outside any "
+                    "enable_x64 scope — jax narrows it to 32-bit "
+                    "silently; wrap the dispatch in 'with "
+                    "enable_x64(True)' or call ensure_x64() at module "
+                    "import",
+                )

@@ -314,6 +314,7 @@ def prepare_hybrid_predicate(
 
     names = tuple(sorted(predicate.columns()))
     if any(n not in base_columns for n in names):
+        metrics.incr("hbm.delta.declined.columns")
         return None
     hot = [
         n
@@ -334,10 +335,12 @@ def prepare_hybrid_predicate(
 
         bound = expand_f64_predicate(bound, f64_cols)
         if bound is None:
+            metrics.incr("hbm.delta.declined.f64_shape")
             return None
     f32 = {n: "float32" for n in names if base_columns[n].enc == "float32"}
     narrowed = K.narrow_expr_to_i32(bound, f32 or None)
     if narrowed is None:
+        metrics.incr("hbm.delta.declined.narrow")
         return None
     return narrowed, tuple(sorted(narrowed.columns()))
 

@@ -40,6 +40,7 @@ from ..parallel.mesh import owner_of_bucket
 from ..plan.expr import Expr, bind_string_literals, eval_mask
 from ..storage.columnar import Column, ColumnarBatch
 from ..telemetry.metrics import metrics
+from ..telemetry.trace import add_bytes as _trace_bytes
 
 ensure_x64()
 
@@ -169,11 +170,14 @@ def distributed_filter(
     sig = tuple((name, str(packed[name].dtype)) for name in names)
     fn = _dist_mask_fn(mesh, repr(bound), bound, shim, sig)
     sharding = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0], None))
+    h2d = sum(a.nbytes for a in packed.values())
     metrics.incr(
-        "dist.h2d_bytes", sum(a.nbytes for a in packed.values())
+        "dist.h2d_bytes", h2d
     )  # per-query shipping cost the mesh-resident path avoids
+    _trace_bytes("h2d_bytes", h2d)
     dev_arrays = {n: jax.device_put(a, sharding) for n, a in packed.items()}
     mask2d = np.asarray(fn(dev_arrays))
+    _trace_bytes("d2h_bytes", mask2d.nbytes)
     metrics.incr("scan.path.distributed")
 
     # compact per device shard, then map back to concat-order rows
@@ -390,12 +394,13 @@ def distributed_filter_aggregate(
     axis = mesh.axis_names[0]
     sh1 = NamedSharding(mesh, PartitionSpec(axis, None))
     sh3 = NamedSharding(mesh, PartitionSpec(None, axis, None))
-    metrics.incr(
-        "dist.h2d_bytes",
+    h2d = (
         codes2.nbytes
         + vals3.nbytes
-        + sum(v.nbytes for v in packed_pred.values()),
+        + sum(v.nbytes for v in packed_pred.values())
     )
+    metrics.incr("dist.h2d_bytes", h2d)
+    _trace_bytes("h2d_bytes", h2d)
     ints_out, floats_out = fn(
         jax.device_put(codes2, sh1),
         jax.device_put(vals3, sh3),
@@ -403,6 +408,7 @@ def distributed_filter_aggregate(
     )
     ints_out = np.asarray(ints_out)  # (D, 2 + n_vals, cap) int64
     floats_out = np.asarray(floats_out)  # (D, 3*n_vals, cap) float64
+    _trace_bytes("d2h_bytes", ints_out.nbytes + floats_out.nbytes)
     metrics.incr("aggregate.path.distributed")
 
     # merge partial tables on host: rebuild a row-per-(device, group) batch
@@ -623,12 +629,14 @@ def distributed_bucketed_join(
     fn = _dist_join_fn(mesh, cap_l, cap_r)
     sharding = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0], None))
     metrics.incr("dist.h2d_bytes", l2.nbytes + r2.nbytes)
+    _trace_bytes("h2d_bytes", l2.nbytes + r2.nbytes)
     lt2, eq2, r_ord2 = fn(
         jax.device_put(l2, sharding), jax.device_put(r2, sharding)
     )
     lt2 = np.asarray(lt2)
     eq2 = np.asarray(eq2)
     r_ord2 = np.asarray(r_ord2)
+    _trace_bytes("d2h_bytes", lt2.nbytes + eq2.nbytes + r_ord2.nbytes)
     metrics.incr("join.path.distributed")
 
     # expand per device on host; positions are into the device's locally

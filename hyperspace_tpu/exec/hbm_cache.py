@@ -1575,6 +1575,7 @@ class HbmIndexCache(ResidentCacheBase):
                 col_bytes = flat.nbytes + vocab_heap
                 cols[name] = ResidentColumn(dev, dts, enc, col_bytes, vocab)
             nbytes += col_bytes
+        _trace_bytes("h2d_bytes", nbytes)
         try:
             # materializing chain fence: on the tunneled backend
             # block_until_ready acks enqueue, which would close the

@@ -71,6 +71,13 @@ from ..plan.ir import (
 )
 from ..storage.columnar import Column, ColumnarBatch, is_string, numpy_dtype
 from ..telemetry.metrics import metrics
+from ..telemetry.trace import add_bytes as _trace_bytes
+
+# the device legs trace 64-bit lanes (f64 two-plane reprs, int64 sort
+# keys); establish the x64 scope at import, before any jit body traces
+from ..ops import ensure_x64
+
+ensure_x64()
 
 I32_MIN, I32_MAX = -(2**31), 2**31 - 1
 # mesh shards pad both sides to a static per-device capacity; the pads
@@ -518,6 +525,7 @@ def build_join_region(
         metrics.incr(f"{pfx}.join.transfer_error")
         return None, False
     metrics.incr(f"{pfx}.join.h2d_bytes", dev_bytes)
+    _trace_bytes("h2d_bytes", dev_bytes)
     metrics.record_time(f"{pfx}.join.prefetch", time.perf_counter() - t0)
     return (
         JoinRegion(
@@ -665,6 +673,7 @@ def build_mesh_join_region(
         metrics.incr(f"{pfx}.join.transfer_error")
         return None, False
     metrics.incr(f"{pfx}.join.h2d_bytes", dev_bytes)
+    _trace_bytes("h2d_bytes", dev_bytes)
     metrics.record_time(f"{pfx}.join.prefetch", time.perf_counter() - t0)
     return (
         MeshJoinRegion(

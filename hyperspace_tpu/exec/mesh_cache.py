@@ -59,6 +59,7 @@ from ..plan.expr import Expr, eval_mask
 from ..storage import layout
 from ..storage.columnar import Column, ColumnarBatch, is_string
 from ..telemetry.metrics import metrics
+from ..telemetry.trace import add_bytes as _trace_bytes
 from ..telemetry.trace import span as _trace_span
 from .hbm_cache import (
     BLOCK_ROWS,
@@ -813,6 +814,7 @@ class MeshHbmCache(ResidentCacheBase):
             nbytes += col_bytes
         if not cols:
             return None, True
+        _trace_bytes("h2d_bytes", nbytes)
         try:
             # materializing chain fence: on the tunneled backend
             # block_until_ready acks enqueue, which would close the
@@ -1348,6 +1350,9 @@ class MeshHbmCache(ResidentCacheBase):
                 del_mask = jax.device_put(
                     self._lineage_mask(table, dels), sharding
                 )
+            _trace_bytes(
+                "h2d_bytes", sum(c.nbytes for c in cols.values())
+            )
             from ..ops import fence_chain
 
             fence_chain(
@@ -1456,6 +1461,7 @@ class MeshHbmCache(ResidentCacheBase):
             "scan.resident_hybrid.mesh_device", time.perf_counter() - t0
         )
         metrics.incr("scan.resident_mesh.d2h_bytes", int(counts.nbytes))
+        _trace_bytes("d2h_bytes", int(counts.nbytes))
         nb = table.n_blocks
         return counts[:, :nb], counts[:, nb:]
 
@@ -1669,6 +1675,7 @@ class MeshHbmCache(ResidentCacheBase):
         metrics.incr(
             "scan.resident_join.d2h_bytes", sum(int(o.nbytes) for o in outs)
         )
+        _trace_bytes("d2h_bytes", sum(int(o.nbytes) for o in outs))
         return finish_join_agg(region, plan, list(group_by), list(aggs), outs)
 
     # -- the fused scan-aggregate query --------------------------------------
@@ -1742,6 +1749,7 @@ class MeshHbmCache(ResidentCacheBase):
         )
         d2h = sum(int(o.nbytes) for o in outs)
         metrics.incr("scan.resident_mesh.d2h_bytes", d2h)
+        _trace_bytes("d2h_bytes", d2h)
         batch = finish_scan_agg(table, plan, list(group_by), list(aggs), outs)
         metrics.incr("scan.path.resident_agg_mesh")
         return batch, "ok"

@@ -29,6 +29,7 @@ from ..plan.expr import Expr, bounds_for_column, eval_mask, pinned_values
 from ..storage import layout
 from ..storage.columnar import Column, ColumnarBatch
 from ..telemetry.metrics import metrics
+from ..telemetry.trace import add_bytes as _trace_bytes
 from ..telemetry.trace import annotate as _trace_annotate
 from ..telemetry.trace import span as _trace_span
 
@@ -144,6 +145,7 @@ def _device_mask_padded(predicate: Expr, batch: ColumnarBatch) -> np.ndarray:
                 _mask_fn_cache.pop(next(iter(_mask_fn_cache)))  # evict oldest
             _mask_fn_cache[key] = fn
     mask = np.asarray(fn(host_arrays))
+    _trace_bytes("d2h_bytes", mask.nbytes)
     return mask[:n]
 
 

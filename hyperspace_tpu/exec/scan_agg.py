@@ -46,6 +46,12 @@ from ..plan.aggregates import output_dtype
 from ..storage.columnar import Column, ColumnarBatch, numpy_dtype
 from ..telemetry.metrics import metrics
 
+# the partial tables carry int64 count lanes and f64 sum lanes; establish
+# the x64 scope at import, before any jit body traces
+from ..ops import ensure_x64
+
+ensure_x64()
+
 # the same dense-domain rule as aggregate._dense: the executable
 # allocates span+1 segment slots, so a wide key domain over few rows
 # would cost far more than host hashing

@@ -733,7 +733,7 @@ class StreamingIndexWriter:
                 arr = jax.device_put(col.data)
                 arr.block_until_ready()
                 total += col.data.nbytes
-            np.asarray(perm_back)
+            np.asarray(perm_back)  # hslint: disable=HS015 - link probe MEASURES this readback; the timed bytes are the point
             total += sample.num_rows * 4
             link_s = time.perf_counter() - t0
         except Exception:  # noqa: BLE001 - probing must never fail a build
@@ -786,6 +786,7 @@ class StreamingIndexWriter:
         path. Every decline is counted (the host tail is never silent,
         the compile/agg decline discipline applied here)."""
         if self.device.run_chunks < 2:
+            metrics.incr("build.device.staging_declined.disabled")
             return False
         if self.device.run_chunks * self.chunk_capacity > (1 << 31) - 1:
             # the merged order ships as int32 (4 B/row, matching the
@@ -796,6 +797,7 @@ class StreamingIndexWriter:
             # the partial tail routes per-chunk (its pad rows would need
             # a validity operand through the merge); it arrives last, so
             # flushing first preserves run order
+            metrics.incr("build.device.staging_declined.tail")
             self._flush_staged()
             return False
         if (
@@ -804,6 +806,7 @@ class StreamingIndexWriter:
         ):
             # auto mode mid-probe: chunk 1's pre-verdict device dispatch
             # must stay the per-chunk compile bearer the probe times
+            metrics.incr("build.device.staging_declined.probe")
             return False
         dtypes = batch.schema()
         if any(is_string(dtypes[k]) for k in self.indexed_cols):

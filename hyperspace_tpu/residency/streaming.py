@@ -223,6 +223,7 @@ def _upload_window(table, names, w):
         cols[n] = jax.device_put(sl)
         specs.append(wspec)
         nbytes += int(sl.nbytes)
+    _trace_bytes("h2d_bytes", nbytes)  # label at the transfer site
     return cols, tuple(specs), nbytes
 
 
@@ -264,7 +265,6 @@ def _windowed_counts_locked(table, upload, dispatch, jax, out, slots):
         for w in range(table.n_windows):
             cols, specs, up_bytes = slots[w % 2]
             metrics.incr("residency.stream.h2d_bytes", up_bytes)
-            _trace_bytes("h2d_bytes", up_bytes)
             # the slot's upload was dispatched while the PREVIOUS window
             # computed; if it is already on device this wait is ~zero
             # (prefetch hit), else the pipeline stalled on the link
@@ -541,6 +541,7 @@ def _mesh_upload_window(table: MeshStreamingResidentTable, names, w: int):
         cols[n] = jax.device_put(np.ascontiguousarray(sl), sharding)
         specs.append(wspec)
         nbytes += int(sl.nbytes)
+    _trace_bytes("h2d_bytes", nbytes)  # label at the transfer site
     return cols, tuple(specs), nbytes
 
 

@@ -7,7 +7,9 @@ Usage:
     python scripts/lint.py --format json hyperspace_tpu
     python scripts/lint.py --no-project somefile.py   # per-file rules only
     python scripts/lint.py --changed HEAD~1     # full model, report changed
-    python scripts/lint.py --check-suppressions # stale-suppression audit
+    python scripts/lint.py --format sarif > hslint.sarif
+    python scripts/lint.py --check-suppressions --budget 26
+    python scripts/lint.py --no-cache           # force a fresh analysis
     python scripts/lint.py --call-graph-dump cg.json --timings
     python scripts/lint.py --list-rules
 
@@ -39,9 +41,11 @@ from hyperspace_tpu.analysis import (  # noqa: E402
     iter_python_files,
     iter_suppression_markers,
     render_json,
+    render_sarif,
     render_text,
     run_analysis,
 )
+from hyperspace_tpu.analysis import cache as _cache  # noqa: E402
 from hyperspace_tpu.analysis.rules import REGISTRY  # noqa: E402
 
 # the tier-1 surface: what a bare ``python scripts/lint.py`` lints and
@@ -61,7 +65,10 @@ def main(argv=None) -> int:
         + " from the repo root)",
     )
     ap.add_argument(
-        "--format", choices=("text", "json"), default="text", dest="fmt"
+        "--format",
+        choices=("text", "json", "sarif"),
+        default="text",
+        dest="fmt",
     )
     ap.add_argument(
         "--show-suppressed",
@@ -108,6 +115,27 @@ def main(argv=None) -> int:
         "deleted, not inherited); exits 1 when any are stale",
     )
     ap.add_argument(
+        "--budget",
+        type=int,
+        metavar="N",
+        help="with --check-suppressions: fail when more than N "
+        "suppressions exist — the ratchet that keeps 'suppress it' "
+        "from becoming the path of least resistance",
+    )
+    ap.add_argument(
+        "--cache-dir",
+        metavar="DIR",
+        default=str(_REPO_ROOT / ".hslint_cache"),
+        help="finding-cache directory (default: .hslint_cache/ at the "
+        "repo root); a hit skips the whole analysis when neither the "
+        "linted files nor the analyzer changed",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="always run the full analysis (and do not write the cache)",
+    )
+    ap.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog and exit"
     )
     args = ap.parse_args(argv)
@@ -123,6 +151,8 @@ def main(argv=None) -> int:
         print(f"hslint: no such path: {', '.join(missing)}", file=sys.stderr)
         return 2
 
+    if args.budget is not None and not args.check_suppressions:
+        ap.error("--budget only applies to --check-suppressions")
     if not args.project and args.check_suppressions:
         # the audit must see every rule a marker can name — auditing
         # with project rules off would report live HS009+ suppressions
@@ -149,12 +179,37 @@ def main(argv=None) -> int:
     timings: dict = {}
     models: list = []
     t0 = time.perf_counter()
-    findings = run_analysis(
-        [Path(p) for p in paths],
-        project=args.project,
-        timings=timings if args.timings else None,
-        model_sink=models if args.call_graph_dump else None,
+    # cache: a hit replays the stored findings of an identical run.
+    # --call-graph-dump needs the live model, --no-project runs a
+    # different (smaller) finding set than the cached full run, and
+    # --timings measures the analyzer (a replay's timings would be
+    # noise) — all three bypass. The key covers the linted bytes AND the
+    # analyzer sources, so neither a source edit nor a rule edit can
+    # replay stale verdicts.
+    use_cache = (
+        not args.no_cache
+        and args.project
+        and not args.call_graph_dump
+        and not args.timings
     )
+    findings = None
+    key = None
+    if use_cache:
+        key = _cache.cache_key(
+            _cache.file_hashes([Path(p) for p in paths]),
+            _cache.analyzer_signature(),
+            argv=[str(p) for p in paths],
+        )
+        findings = _cache.load(Path(args.cache_dir), key)
+    if findings is None:
+        findings = run_analysis(
+            [Path(p) for p in paths],
+            project=args.project,
+            timings=timings if args.timings else None,
+            model_sink=models if args.call_graph_dump else None,
+        )
+        if use_cache and key is not None:
+            _cache.store(Path(args.cache_dir), key, findings)
     wall = time.perf_counter() - t0
 
     if args.call_graph_dump and models:
@@ -165,7 +220,7 @@ def main(argv=None) -> int:
         print(f"hslint: call graph written to {args.call_graph_dump}")
 
     if args.check_suppressions:
-        return _check_suppressions(paths, findings)
+        return _check_suppressions(paths, findings, args.budget)
 
     if changed is not None:
         findings = [
@@ -174,6 +229,8 @@ def main(argv=None) -> int:
 
     if args.fmt == "json":
         print(render_json(findings))
+    elif args.fmt == "sarif":
+        print(render_sarif(findings, REGISTRY, base=_REPO_ROOT))
     else:
         print(render_text(findings, show_suppressed=args.show_suppressed))
     if args.timings:
@@ -214,10 +271,14 @@ def _changed_files(ref: str) -> "set | None":
     return out
 
 
-def _check_suppressions(paths, findings) -> int:
+def _check_suppressions(paths, findings, budget=None) -> int:
     """Report markers whose codes never fire on their bound line. A bare
     ``disable`` is stale when NO finding lands on its line; a coded
-    marker is stale per code."""
+    marker is stale per code. With ``budget``, additionally fail when
+    the live suppression count exceeds it — tier-1 pins the budget at
+    the audited current count, so every NEW suppression must either
+    retire an old one or raise the pin in the same diff (with the
+    justification that implies)."""
     by_site: dict = {}
     for f in findings:
         by_site.setdefault((str(Path(f.path)), f.line), set()).add(f.code)
@@ -250,6 +311,12 @@ def _check_suppressions(paths, findings) -> int:
     print(
         f"hslint: {checked} suppression(s) audited, {stale} stale"
     )
+    if budget is not None and checked > budget:
+        print(
+            f"hslint: suppression budget exceeded — {checked} > {budget}; "
+            "fix the finding or retire another suppression instead"
+        )
+        return 1
     return 1 if stale else 0
 
 

@@ -1477,3 +1477,578 @@ def test_hs010_mutually_recursive_lock_free_readers_are_flagged():
     }
     got = [f for f in run_project(sources) if f.code == "HS010"]
     assert len(got) == 2  # both cycle members' lock-free reads surface
+
+
+# === phase 3: device-boundary value flow (HS015-HS019) ======================
+#
+# All fixtures go through analyze_project_sources — the rules only see
+# the ProjectModel, so a virtual package is the real entry point. Module
+# placement matters: ``pkg/...`` paths are hot-path (HS015 scope),
+# ``pkg/exec/...`` paths are boundary (HS019 scope).
+
+
+# --- HS015: implicit D2H in a hot path --------------------------------------
+
+
+def test_hs015_fires_on_cast_of_proven_device_value():
+    sources = {
+        "pkg/hot.py": """
+        import jax.numpy as jnp
+
+        def hot(x):
+            dev = jnp.square(x)
+            return float(dev)
+        """
+    }
+    assert codes(run_project(sources), "HS015") == ["HS015"]
+
+
+def test_hs015_interprocedural_device_return():
+    # device-ness crosses the call graph: make() returns a jnp result,
+    # the int() cast two modules away still fires
+    sources = {
+        "pkg/a.py": """
+        import jax.numpy as jnp
+
+        def make(x):
+            return jnp.square(x)
+        """,
+        "pkg/b.py": """
+        from . import a
+
+        def hot(x):
+            return int(a.make(x))
+        """,
+    }
+    assert codes(run_project(sources), "HS015") == ["HS015"]
+
+
+def test_hs015_clean_on_host_values_boundary_and_traced():
+    sources = {
+        # host value: never classified device, must not invent
+        "pkg/host.py": """
+        import numpy as np
+
+        def f(xs):
+            return float(np.max(np.asarray(xs)))
+        """,
+        # boundary module: exec.* is where materializing is the job
+        "pkg/exec/leg.py": """
+        import jax.numpy as jnp
+        from ..tel import add_bytes
+
+        def leg(x):
+            out = float(jnp.square(x))
+            add_bytes("d2h_bytes", 8)
+            return out
+        """,
+        # traced: the D2H is declared and accounted — excused
+        "pkg/traced.py": """
+        import jax.numpy as jnp
+        from .tel import add_bytes
+
+        def declared(x):
+            out = float(jnp.square(x))
+            add_bytes("d2h_bytes", 8)
+            return out
+        """,
+        "pkg/tel.py": """
+        def add_bytes(key, n):
+            pass
+        """,
+    }
+    assert codes(run_project(sources), "HS015") == []
+
+
+def test_hs015_container_of_device_values_iterates_free():
+    # regression for the ops.hashing false positive: a python LIST of
+    # device arrays is host data — iterating it moves nothing
+    sources = {
+        "pkg/lists.py": """
+        import jax.numpy as jnp
+
+        def per_lane(xs):
+            lanes = [jnp.square(x) for x in xs]
+            acc = 0.0
+            for lane in lanes:
+                acc = acc + lane
+            return acc
+        """
+    }
+    assert codes(run_project(sources), "HS015") == []
+
+
+def test_hs015_rebind_to_host_clears_device_judgement():
+    # the canonical boundary idiom: after lo = np.asarray(lo) the name
+    # is host-valued; only the asarray site itself is the readback
+    sources = {
+        "pkg/rebind.py": """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def fetch(x):
+            lo = jnp.square(x)
+            lo = np.asarray(lo)
+            return float(lo)
+        """
+    }
+    assert codes(run_project(sources), "HS015") == ["HS015"]
+
+
+def test_hs015_suppressed():
+    sources = {
+        "pkg/hot.py": """
+        import jax.numpy as jnp
+
+        def hot(x):
+            dev = jnp.square(x)
+            return float(dev)  # hslint: disable=HS015 - fixture
+        """
+    }
+    found = [f for f in run_project(sources) if f.code == "HS015"]
+    assert [f.suppressed for f in found] == [True]
+
+
+# --- HS016: per-call-site literal folded into a jit closure + key -----------
+
+
+_HS016_FACTORY_BAKES_LITERAL = """
+    import jax
+
+    _CACHE = {}
+
+    def counts_fn(lo, n_rows):
+        key = (lo, n_rows)
+        if key not in _CACHE:
+            def body(x):
+                return x + lo
+            _CACHE[key] = jax.jit(body)
+        return _CACHE[key]
+"""
+
+_HS016_FACTORY_TRACED_OPERAND = """
+    import jax
+
+    _CACHE = {}
+
+    def counts_fn(n_rows):
+        key = (n_rows,)
+        if key not in _CACHE:
+            def body(x, lo):
+                return x + lo
+            _CACHE[key] = jax.jit(body)
+        return _CACHE[key]
+"""
+
+
+def test_hs016_fires_at_the_literal_binding_call_site():
+    sources = {
+        "pkg/fac.py": _HS016_FACTORY_BAKES_LITERAL,
+        "pkg/use.py": """
+        from .fac import counts_fn
+
+        def run(x):
+            fn = counts_fn(3, 128)
+            return fn(x)
+        """,
+    }
+    found = [f for f in run_project(sources) if f.code == "HS016"]
+    # lo is the hazard; n_rows is structural by name and exempt
+    assert len(found) == 1
+    assert found[0].path == "pkg/use.py"
+    assert "'lo'" in found[0].message
+
+
+def test_hs016_clean_when_literal_ships_as_traced_operand():
+    # the acceptance flip: mask the literal out of the memo key and pass
+    # it as an operand — same call shape, no per-literal executable
+    sources = {
+        "pkg/fac.py": _HS016_FACTORY_TRACED_OPERAND,
+        "pkg/use.py": """
+        from .fac import counts_fn
+
+        def run(x):
+            fn = counts_fn(128)
+            return fn(x, 3)
+        """,
+    }
+    assert codes(run_project(sources), "HS016") == []
+
+
+def test_hs016_runtime_values_never_fire():
+    # hazard parameters fed from runtime values (not literals) are the
+    # designed use: nothing to specialize per call site
+    sources = {
+        "pkg/fac.py": _HS016_FACTORY_BAKES_LITERAL,
+        "pkg/use.py": """
+        from .fac import counts_fn
+
+        def run(x, bound):
+            fn = counts_fn(bound, 128)
+            return fn(x)
+        """,
+    }
+    assert codes(run_project(sources), "HS016") == []
+
+
+def test_hs016_uncached_factory_is_not_a_hazard():
+    # no memo key, no treadmill: jit re-wrapping per call is wasteful
+    # but recompiles nothing new per literal
+    sources = {
+        "pkg/fac.py": """
+        import jax
+
+        def counts_fn(lo):
+            def body(x):
+                return x + lo
+            return jax.jit(body)
+        """,
+        "pkg/use.py": """
+        from .fac import counts_fn
+
+        def run(x):
+            return counts_fn(3)(x)
+        """,
+    }
+    assert codes(run_project(sources), "HS016") == []
+
+
+def test_hs016_suppressed():
+    sources = {
+        "pkg/fac.py": _HS016_FACTORY_BAKES_LITERAL,
+        "pkg/use.py": """
+        from .fac import counts_fn
+
+        def run(x):
+            fn = counts_fn(3, 128)  # hslint: disable=HS016 - fixture
+            return fn(x)
+        """,
+    }
+    found = [f for f in run_project(sources) if f.code == "HS016"]
+    assert [f.suppressed for f in found] == [True]
+
+
+# --- HS017: 64-bit executable outside an enable_x64 scope -------------------
+
+
+def test_hs017_fires_on_bare_int64_reference():
+    sources = {
+        "pkg/m.py": """
+        import jax.numpy as jnp
+
+        def widen(x):
+            return x.astype(jnp.int64)
+        """
+    }
+    assert codes(run_project(sources), "HS017") == ["HS017"]
+
+
+def test_hs017_lexical_and_module_scopes_are_clean():
+    sources = {
+        # lexical: the reference sits inside with enable_x64(True)
+        "pkg/lex.py": """
+        import jax.numpy as jnp
+        from .compat import enable_x64
+
+        def widen(x):
+            with enable_x64(True):
+                return x.astype(jnp.int64)
+        """,
+        # module: ensure_x64() at import covers every later trace
+        "pkg/mod.py": """
+        import jax.numpy as jnp
+        from .compat import ensure_x64
+
+        ensure_x64()
+
+        def widen(x):
+            return x.astype(jnp.float64)
+        """,
+        "pkg/compat.py": """
+        def enable_x64(on):
+            pass
+
+        def ensure_x64():
+            pass
+        """,
+    }
+    assert codes(run_project(sources), "HS017") == []
+
+
+def test_hs017_enable_x64_false_region_does_not_cover():
+    sources = {
+        "pkg/m.py": """
+        import jax.numpy as jnp
+        from .compat import enable_x64
+
+        def narrow(x):
+            with enable_x64(False):
+                return x.astype(jnp.int64)
+        """,
+        "pkg/compat.py": """
+        def enable_x64(on):
+            pass
+        """,
+    }
+    assert codes(run_project(sources), "HS017") == ["HS017"]
+
+
+def test_hs017_caller_coverage_is_interprocedural():
+    # helper's dtype is covered because EVERY resolved call site sits
+    # inside an enable_x64 region; drop the region and it fires
+    covered = {
+        "pkg/h.py": """
+        import jax.numpy as jnp
+
+        def helper(x):
+            return x.astype(jnp.int64)
+        """,
+        "pkg/entry.py": """
+        from .compat import enable_x64
+        from . import h
+
+        def entry(x):
+            with enable_x64(True):
+                return h.helper(x)
+        """,
+        "pkg/compat.py": """
+        def enable_x64(on):
+            pass
+        """,
+    }
+    assert codes(run_project(covered), "HS017") == []
+    uncovered = dict(covered)
+    uncovered["pkg/entry.py"] = """
+        from . import h
+
+        def entry(x):
+            return h.helper(x)
+        """
+    assert codes(run_project(uncovered), "HS017") == ["HS017"]
+
+
+def test_hs017_suppressed():
+    sources = {
+        "pkg/m.py": """
+        import jax.numpy as jnp
+
+        def widen(x):
+            return x.astype(jnp.int64)  # hslint: disable=HS017 - fixture
+        """
+    }
+    found = [f for f in run_project(sources) if f.code == "HS017"]
+    assert [f.suppressed for f in found] == [True]
+
+
+# --- HS018: eligibility decline with no counter -----------------------------
+
+
+def test_hs018_fires_on_the_silent_tail():
+    sources = {
+        "pkg/gate.py": """
+        from .tel import metrics
+
+        def eligible(batch):
+            if batch is None:
+                metrics.incr("hbm.gate.declined.empty")
+                return None
+            if batch.rows > 1024:
+                return None
+            return batch
+        """,
+        "pkg/tel.py": """
+        class _M:
+            def incr(self, name, n=1):
+                pass
+
+        metrics = _M()
+        """,
+    }
+    found = [f for f in run_project(sources) if f.code == "HS018"]
+    assert len(found) == 1
+    assert found[0].line == 9  # the uncounted rows>1024 return
+
+
+def test_hs018_counted_and_helper_counted_branches_are_clean():
+    sources = {
+        "pkg/gate.py": """
+        from .tel import metrics
+
+        def _decline(reason):
+            metrics.incr("hbm.gate.declined." + reason)
+
+        def eligible(batch):
+            if batch is None:
+                metrics.incr("hbm.gate.declined.empty")
+                return None
+            if batch.rows > 1024:
+                _decline("width")
+                return None
+            return batch
+        """,
+        "pkg/tel.py": """
+        class _M:
+            def incr(self, name, n=1):
+                pass
+
+        metrics = _M()
+        """,
+    }
+    assert codes(run_project(sources), "HS018") == []
+
+
+def test_hs018_functions_without_counters_are_out_of_scope():
+    # the rule enforces self-consistency of functions that OPTED INTO
+    # the discipline; a plain predicate with early returns is not one
+    sources = {
+        "pkg/plain.py": """
+        def eligible(batch):
+            if batch is None:
+                return None
+            return batch
+        """
+    }
+    assert codes(run_project(sources), "HS018") == []
+
+
+def test_hs018_raise_branches_are_loud_enough():
+    sources = {
+        "pkg/gate.py": """
+        from .tel import metrics
+
+        def eligible(batch):
+            if batch is None:
+                metrics.incr("hbm.gate.declined.empty")
+                return None
+            if batch.rows < 0:
+                raise ValueError("negative rows")
+            return batch
+        """,
+        "pkg/tel.py": """
+        class _M:
+            def incr(self, name, n=1):
+                pass
+
+        metrics = _M()
+        """,
+    }
+    assert codes(run_project(sources), "HS018") == []
+
+
+def test_hs018_suppressed():
+    sources = {
+        "pkg/gate.py": """
+        from .tel import metrics
+
+        def eligible(batch):
+            if batch is None:
+                metrics.incr("hbm.gate.declined.empty")
+                return None
+            if batch.rows > 1024:
+                return None  # hslint: disable=HS018 - fixture
+            return batch
+        """,
+        "pkg/tel.py": """
+        class _M:
+            def incr(self, name, n=1):
+                pass
+
+        metrics = _M()
+        """,
+    }
+    found = [f for f in run_project(sources) if f.code == "HS018"]
+    assert [f.suppressed for f in found] == [True]
+
+
+# --- HS019: untraced transfer in exec/residency -----------------------------
+
+
+def test_hs019_fires_on_untraced_device_put_in_exec():
+    sources = {
+        "pkg/exec/leg.py": """
+        import jax
+
+        def upload(arr):
+            return jax.device_put(arr)
+        """
+    }
+    assert codes(run_project(sources), "HS019") == ["HS019"]
+
+
+def test_hs019_clean_when_traced_or_out_of_scope():
+    sources = {
+        # traced lexically: the contract is satisfied
+        "pkg/exec/ok.py": """
+        import jax
+        from ..tel import add_bytes
+
+        def upload(arr):
+            dev = jax.device_put(arr)
+            add_bytes("h2d_bytes", arr.nbytes)
+            return dev
+        """,
+        # traced through a callee: helper-accounts-for-me
+        "pkg/exec/via.py": """
+        import jax
+        from ..tel import add_bytes
+
+        def _account(n):
+            add_bytes("h2d_bytes", n)
+
+        def upload(arr):
+            dev = jax.device_put(arr)
+            _account(arr.nbytes)
+            return dev
+        """,
+        # outside exec/residency this rule does not speak (HS015 does)
+        "pkg/other.py": """
+        import jax
+
+        def upload(arr):
+            return jax.device_put(arr)
+        """,
+        "pkg/tel.py": """
+        def add_bytes(key, n):
+            pass
+        """,
+    }
+    assert codes(run_project(sources), "HS019") == []
+
+
+def test_hs019_scalar_item_is_not_a_bandwidth_event():
+    # .item() is HS001/HS015's beat (latency); HS019 only wants bulk
+    # fetches labeled
+    sources = {
+        "pkg/exec/probe.py": """
+        import jax.numpy as jnp
+
+        def peek(x):
+            return jnp.max(x).item()
+        """
+    }
+    assert codes(run_project(sources), "HS019") == []
+
+
+def test_hs019_one_finding_per_direction_per_function():
+    sources = {
+        "pkg/exec/multi.py": """
+        import jax
+
+        def upload_all(a, b, c):
+            return [jax.device_put(v) for v in (a, b, c)]
+        """
+    }
+    assert codes(run_project(sources), "HS019") == ["HS019"]
+
+
+def test_hs019_suppressed():
+    sources = {
+        "pkg/exec/probe.py": """
+        import jax
+
+        def time_link(arr):
+            return jax.device_put(arr)  # hslint: disable=HS019 - fixture
+        """
+    }
+    found = [f for f in run_project(sources) if f.code == "HS019"]
+    assert [f.suppressed for f in found] == [True]
