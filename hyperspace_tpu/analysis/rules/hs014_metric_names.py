@@ -58,8 +58,10 @@ SUBSYSTEM_PREFIXES = frozenset(
         "query",
         "recovery",
         "residency",
+        "router",
         "scan",
         "serve",
+        "shuffle",
         "storage",
         "telemetry",
         "trace",

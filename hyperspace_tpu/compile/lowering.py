@@ -33,6 +33,11 @@ Shape classes (single-chip AND mesh unless noted):
 * ``join_agg``   — ``[Project]* Aggregate([Project](Join))``: the
   resident aggregate-join arm (single-chip AND mesh — the PR-5/8 fused
   kernels are the lowering targets), host range-fusion fallback.
+* ``join_shuffle`` — ``[Project]* Join`` over bucketed sides with
+  MISMATCHED bucket counts, mesh only: the ICI all-to-all shuffle
+  repartitions the smaller side into the other's bucket space and the
+  co-partitioned SMJ arms serve (distributed/shuffle.py); the planner
+  and every exchange failure decline to the exact host join.
 * ``interpret``  — everything else: the per-operator interpreter.
 
 Lowering is cheap (a shape walk plus counter-free registry probes for
@@ -71,6 +76,7 @@ class Shape:
         "union",
         "agg",
         "inner_projects",
+        "join",
     )
 
     def __init__(
@@ -82,6 +88,7 @@ class Shape:
         union: Optional[Union] = None,
         agg: Optional[Aggregate] = None,
         inner_projects: Optional[List[Project]] = None,
+        join: Optional[Join] = None,
     ):
         self.kind = kind
         self.projects = projects or []
@@ -90,6 +97,7 @@ class Shape:
         self.union = union
         self.agg = agg
         self.inner_projects = inner_projects or []
+        self.join = join
 
 
 def classify_shape(plan: LogicalPlan, mesh=None) -> Shape:
@@ -128,6 +136,22 @@ def classify_shape(plan: LogicalPlan, mesh=None) -> Shape:
             # mesh hybrids keep the interpreter's literal-keyed fused
             # arm — the structure-keyed hybrid batch entry is single-chip
             return Shape("hybrid", projects, node.condition, union=child)
+    if isinstance(node, Join) and mesh is not None:
+        # both sides bucketed but with MISMATCHED bucket counts: the
+        # shuffle-repartition join (distributed/shuffle.py). Metadata
+        # walk only — the executor's shuffle arm re-runs the full
+        # eligibility (key sets, planner economics) per query and
+        # declines to the exact host join identically.
+        from ..exec.executor import bucketed_meta
+
+        lm = bucketed_meta(node.left)
+        rm = bucketed_meta(node.right)
+        if (
+            lm is not None
+            and rm is not None
+            and lm.entry.num_buckets != rm.entry.num_buckets
+        ):
+            return Shape("join_shuffle", projects, join=node)
     return Shape("interpret")
 
 
@@ -187,6 +211,10 @@ def _tier_label(shape: Shape, mesh=None) -> str:
                 )
                 else "host"
             )
+        if shape.kind == "join_shuffle":
+            # mesh presence IS the classification gate; the per-query
+            # economics (planner) may still decline to host
+            return "mesh"
     except Exception:  # noqa: BLE001 - the label is advisory only
         metrics.incr("compile.tier_probe_error")
     return "host"
@@ -237,6 +265,10 @@ def _boundary(plan: LogicalPlan, shape: Shape) -> tuple:
         ),
         "hybrid": "Filter→Union base+delta (one fused dispatch)",
         "join_agg": "Aggregate→Join (resident region dispatch)",
+        "join_shuffle": (
+            "Join (ICI all-to-all repartition → co-partitioned SMJ; "
+            "planner may decline to host)"
+        ),
     }
     lines.append("  device: " + fused_nodes[shape.kind])
     lines.append("  host legs: candidate-block reads + exact predicates")

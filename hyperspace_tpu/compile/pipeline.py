@@ -160,6 +160,9 @@ class CompiledPipeline:
         if self.kind == "join_agg":
             out = self._run_join_agg(shape, executor)
             return _apply_projects(out, shape.projects)
+        if self.kind == "join_shuffle":
+            out = self._run_join_shuffle(shape, executor)
+            return _apply_projects(out, shape.projects)
         raise AssertionError(f"unknown pipeline kind {self.kind!r}")
 
     def _run_scan(self, shape, executor) -> ColumnarBatch:
@@ -383,6 +386,19 @@ class CompiledPipeline:
             + jm.counter("scan.path.resident_join_agg_mesh")
             > 0
         ):
+            metrics.incr("compile.fused.dispatches")
+        return out
+
+
+    def _run_join_shuffle(self, shape, executor) -> ColumnarBatch:
+        """The shuffle-join arm: the executor's whole Join procedure
+        (shuffle eligibility + planner + exchange, exact host join on
+        every decline) as one lowered stage. Whether THIS run actually
+        rode the ICI exchange is read from a scoped child registry —
+        the _run_join_agg attribution rule."""
+        with metrics.scoped() as jm:
+            out = executor._exec_join(shape.join)
+        if jm.counter("scan.path.resident_join_shuffle") > 0:
             metrics.incr("compile.fused.dispatches")
         return out
 

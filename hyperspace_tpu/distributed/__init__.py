@@ -1,0 +1,50 @@
+"""Pod-scale distributed execution (docs/19-distributed-execution.md).
+
+Three layers on top of the single-host mesh story:
+
+* ``shuffle``  — the bucketed ICI all-to-all repartition that lets
+  non-co-partitioned indexes join on-mesh (the query-side twin of the
+  build kernel's exchange);
+* ``planner``  — the movement decision (direct / shuffle-smaller-side /
+  host), memoized per placement + bucket-histogram class and surfaced in
+  explain(verbose);
+* ``router`` + ``fabric`` — the multi-host tier: the serve-front
+  ``QueryRouter`` fans sub-queries to per-host servers and re-merges
+  partials; ``QueryFabric`` is the per-process control-plane handle
+  (DCN init, global mesh, bucket→process placement).
+
+Imports stay lazy here — the subsystem sits above exec/serve and must
+not force JAX initialization on ``import hyperspace_tpu``.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "MovementDecision",
+    "plan_movement",
+    "QueryFabric",
+    "QueryRouter",
+    "RouterTicket",
+    "repartition_by_bucket",
+    "try_shuffle_join",
+]
+
+
+def __getattr__(name):
+    if name in ("MovementDecision", "plan_movement"):
+        from . import planner
+
+        return getattr(planner, name)
+    if name in ("repartition_by_bucket", "try_shuffle_join"):
+        from . import shuffle
+
+        return getattr(shuffle, name)
+    if name in ("QueryRouter", "RouterTicket"):
+        from . import router
+
+        return getattr(router, name)
+    if name == "QueryFabric":
+        from .fabric import QueryFabric
+
+        return QueryFabric
+    raise AttributeError(name)

@@ -1,0 +1,166 @@
+"""Shuffle planner: the inter-chip data-movement decision.
+
+PR-13's segment-read planner decides how a host reads bytes off storage;
+this planner generalizes the same idea to the next link up — how rows
+move BETWEEN chips for a bucketed join. Given the per-bucket row counts
+of the two sides it chooses one of three paths:
+
+* ``direct``  — the sides are co-partitioned (equal ``num_buckets``
+  under the shared ``owner_of_bucket`` placement, parallel.mesh): no
+  movement, the shuffle-free SMJ serves as-is.
+* ``shuffle`` — the sides disagree on bucket count; repartition the
+  SMALLER side into the larger side's bucket space over one ICI
+  all-to-all round (distributed.shuffle), then ride the co-partitioned
+  arms.
+* ``host``    — movement cannot pay for itself (tiny inputs, an empty
+  side) or no mesh is present: decline to the exact host join, exactly
+  like every other mesh arm's fallback.
+
+Decisions are memoized per (placement, bucket-histogram class): the
+placement signature is (left num_buckets, right num_buckets, devices)
+and the histogram class quantizes each side's total and max-bucket row
+count to powers of two — repeat joins over similarly-shaped data reuse
+the decision without rescanning the histograms (the same pow2
+quantization the build uses to keep executables cached). The decision
+is recorded on the active query trace as a ``shuffle.plan`` span, which
+is what explain(verbose) renders as the movement-plan table.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ..parallel.mesh import owner_of_bucket
+from ..telemetry.metrics import metrics
+from ..telemetry.trace import span
+
+__all__ = ["MovementDecision", "plan_movement", "reset_plan_memo"]
+
+
+@dataclass(frozen=True)
+class MovementDecision:
+    """One join's movement plan. ``path`` is direct | shuffle | host;
+    ``moved_side`` names the side the shuffle repartitions (None unless
+    path == shuffle); ``est_moved_bytes`` is the transport estimate the
+    decision weighed (moved rows × planes × 8, the i64 transport)."""
+
+    path: str
+    reason: str
+    moved_side: Optional[str] = None
+    target_num_buckets: int = 0
+    est_moved_bytes: int = 0
+    memo_hit: bool = False
+
+
+# decision memo per (placement signature, histogram class); bounded the
+# way every cross-query memo in the tree is (HS006)
+_PLAN_MEMO: Dict[tuple, MovementDecision] = {}
+_PLAN_MEMO_CAP = 256
+
+
+def reset_plan_memo() -> None:
+    _PLAN_MEMO.clear()
+
+
+def _pow2_class(n: int) -> int:
+    """log2 bucket of a row count — the histogram-class quantizer."""
+    return max(int(n).bit_length(), 0)
+
+
+def _histogram_class(counts: Dict[int, int]) -> tuple:
+    total = sum(counts.values())
+    peak = max(counts.values(), default=0)
+    return (_pow2_class(total), _pow2_class(peak))
+
+
+def _record(decision: MovementDecision, l_rows: int, r_rows: int,
+            l_nb: int, r_nb: int, n_devices: int) -> MovementDecision:
+    """Count the decision and freeze it on the active trace — the ONE
+    record explain(verbose)'s movement-plan section renders from."""
+    metrics.incr(f"shuffle.plan.{decision.path}")
+    if decision.memo_hit:
+        metrics.incr("shuffle.plan.memo_hit")
+    with span(
+        "shuffle.plan",
+        decision=decision.path,
+        reason=decision.reason,
+        moved_side=decision.moved_side or "-",
+        left_buckets=l_nb,
+        right_buckets=r_nb,
+        left_rows=l_rows,
+        right_rows=r_rows,
+        devices=n_devices,
+        est_moved_bytes=decision.est_moved_bytes,
+        memo_hit=decision.memo_hit,
+    ):
+        pass
+    return decision
+
+
+def plan_movement(
+    l_counts: Dict[int, int],
+    r_counts: Dict[int, int],
+    l_num_buckets: int,
+    r_num_buckets: int,
+    n_devices: int,
+    min_shuffle_rows: int,
+    n_payload_planes: int = 2,
+) -> MovementDecision:
+    """Choose direct / shuffle / host for one bucketed join.
+
+    ``l_counts``/``r_counts`` are per-bucket row counts of the loaded
+    sides; ``min_shuffle_rows`` is the executor's distributed-dispatch
+    floor (below it the fixed all_to_all dispatch latency cannot pay —
+    the same economics gate as dist_min_rows); ``n_payload_planes`` is
+    the moved side's column count (each plane transits as i64)."""
+    # the placement rule is consulted through the ONE shared helper so a
+    # future placement change reroutes the planner automatically
+    assert owner_of_bucket(0, n_devices) == 0
+    l_rows = sum(l_counts.values())
+    r_rows = sum(r_counts.values())
+
+    def done(d: MovementDecision) -> MovementDecision:
+        return _record(d, l_rows, r_rows, l_num_buckets, r_num_buckets,
+                       n_devices)
+
+    if l_num_buckets == r_num_buckets:
+        return done(MovementDecision("direct", "co_partitioned"))
+    if n_devices <= 1:
+        return done(MovementDecision("host", "no_mesh"))
+    if l_rows == 0 or r_rows == 0:
+        return done(MovementDecision("host", "empty_side"))
+
+    key = (
+        l_num_buckets,
+        r_num_buckets,
+        n_devices,
+        min_shuffle_rows,
+        n_payload_planes,
+        _histogram_class(l_counts),
+        _histogram_class(r_counts),
+    )
+    hit = _PLAN_MEMO.get(key)
+    if hit is not None:
+        return done(MovementDecision(
+            hit.path, hit.reason, hit.moved_side, hit.target_num_buckets,
+            hit.est_moved_bytes, memo_hit=True,
+        ))
+
+    moved_side = "left" if l_rows <= r_rows else "right"
+    moved_rows = min(l_rows, r_rows)
+    target_nb = r_num_buckets if moved_side == "left" else l_num_buckets
+    est_bytes = moved_rows * n_payload_planes * 8
+    if l_rows + r_rows < min_shuffle_rows:
+        decision = MovementDecision(
+            "host", "below_min_rows", None, 0, est_bytes
+        )
+    else:
+        decision = MovementDecision(
+            "shuffle", f"repartition_{moved_side}", moved_side, target_nb,
+            est_bytes,
+        )
+    if len(_PLAN_MEMO) >= _PLAN_MEMO_CAP:
+        _PLAN_MEMO.pop(next(iter(_PLAN_MEMO)))
+    _PLAN_MEMO[key] = decision
+    return done(decision)

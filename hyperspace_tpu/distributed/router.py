@@ -1,0 +1,409 @@
+"""Multi-host query fabric: a serve-tier front router.
+
+One ``QueryServer`` (serve/server.py) serves one host's devices. A pod
+has many hosts, so this module adds the missing front tier: a
+``QueryRouter`` that speaks the same tenant protocol as the servers it
+fronts (PR-9 — tenant tag, deadline, ticket), fans one logical query out
+as per-host sub-queries, and merges the partial results the way the mesh
+psum path merges per-device partials (exec/distributed.py — count/sum
+re-merge by summation, min/max by re-reduction, avg from sum+count; the
+merge runs through the SAME ``hash_aggregate`` machinery, so int
+aggregates re-merge exactly).
+
+Partitioning is the caller's vocabulary: ``submit`` takes a *builder*
+``build(session, part_index, n_parts) -> DataFrame`` and the router
+instantiates it once per host against that host's session.
+``partition_map()`` derives the canonical host→bucket assignment from
+the op log's ACTIVE index metadata via the ONE shared placement rule
+(parallel.mesh.owner_of_bucket applied at host granularity) for callers
+that partition by bucket.
+
+Routing key: the PR-10 batch fingerprint of every sub-plan (literals
+masked — the burst-shape identity) folded with the exact plan repr and
+tenant. Identical in-flight bursts coalesce onto one fan-out per host
+(``router.coalesced``); distinct literals never share a ticket because
+the exact repr participates.
+
+Degradation ladder (docs/16): a dead or fenced host — closed server,
+ticket failed with ``ServerClosed`` — costs ZERO failed tickets while
+any host survives. The router re-issues the lost partition against a
+surviving host's session (shared storage makes every partition host-leg
+readable from anywhere), counts ``router.host_lost``/``router.retried``,
+and freezes a flight-recorder snapshot for the event.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..parallel.mesh import owner_of_bucket
+from ..plan.aggregates import AggSpec
+from ..plan.ir import Aggregate, LogicalPlan
+from ..serve.server import DEFAULT_TENANT, QueryServer, ServerClosed
+from ..storage.columnar import Column, ColumnarBatch
+from ..telemetry.metrics import metrics
+from ..telemetry.recorder import flight_recorder
+from ..telemetry.trace import span
+
+__all__ = ["QueryRouter", "RouterTicket"]
+
+Builder = Callable[..., "object"]  # build(session, part_index, n_parts) -> DataFrame
+
+
+def _partial_specs(aggs: List[AggSpec]) -> List[AggSpec]:
+    """The per-host partial aggregates for a final spec list. count/sum
+    carry a sum partial (plus a non-NULL count so float NULL re-merges),
+    min/max carry themselves, avg decomposes into sum+count — the same
+    decomposition the mesh partial-merge uses."""
+    out: List[AggSpec] = []
+    for a in aggs:
+        if a.fn == "count":
+            out.append(AggSpec("count", a.column, f"__pc_{a.name}"))
+        elif a.fn in ("sum", "avg"):
+            out.append(AggSpec("sum", a.column, f"__ps_{a.name}"))
+            out.append(AggSpec("count", a.column, f"__pn_{a.name}"))
+        elif a.fn in ("min", "max"):
+            tag = "m" if a.fn == "min" else "M"
+            out.append(AggSpec(a.fn, a.column, f"__p{tag}_{a.name}"))
+        else:
+            raise HyperspaceException(f"Unsupported router aggregate {a.fn}.")
+    return out
+
+
+def _merge_partials(
+    partials: List[ColumnarBatch],
+    group_by: List[str],
+    aggs: List[AggSpec],
+) -> ColumnarBatch:
+    """Re-merge per-host partial aggregates into finals. Runs through
+    hash_aggregate — sums of int64 partials are exact (the partial sums
+    themselves already widened), min/max re-reduce, NULL partials (NaN)
+    are skipped by the standard valid mask and resurface only when the
+    merged non-NULL count is zero. Output rows are canonically ordered
+    by group key so the merged result is deterministic regardless of
+    which host answered first."""
+    from ..exec.aggregate import hash_aggregate
+    from ..storage.columnar import numpy_dtype
+
+    whole = ColumnarBatch.concat(partials)
+    merge_specs: List[AggSpec] = []
+    for a in aggs:
+        if a.fn == "count":
+            merge_specs.append(AggSpec("sum", f"__pc_{a.name}", f"__pc_{a.name}"))
+        elif a.fn in ("sum", "avg"):
+            merge_specs.append(AggSpec("sum", f"__ps_{a.name}", f"__ps_{a.name}"))
+            merge_specs.append(AggSpec("sum", f"__pn_{a.name}", f"__pn_{a.name}"))
+        else:
+            tag = "m" if a.fn == "min" else "M"
+            merge_specs.append(
+                AggSpec(a.fn, f"__p{tag}_{a.name}", f"__p{tag}_{a.name}")
+            )
+    merged = hash_aggregate(whole, group_by, merge_specs)
+
+    out: Dict[str, Column] = {}
+    for g in group_by:
+        out[g] = merged.columns[g]
+    for a in aggs:
+        if a.fn == "count":
+            out[a.name] = Column(
+                "int64", merged.columns[f"__pc_{a.name}"].data.astype(np.int64)
+            )
+        elif a.fn == "sum":
+            col = merged.columns[f"__ps_{a.name}"]
+            s = col.data
+            if col.dtype_str.startswith("float"):
+                nn = merged.columns[f"__pn_{a.name}"].data
+                s = np.where(nn == 0, np.nan, s)
+            out[a.name] = Column(col.dtype_str, s)
+        elif a.fn == "avg":
+            s = merged.columns[f"__ps_{a.name}"].data
+            nn = merged.columns[f"__pn_{a.name}"].data
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out[a.name] = Column(
+                    "float64", s.astype(np.float64) / nn
+                )
+        else:
+            tag = "m" if a.fn == "min" else "M"
+            col = merged.columns[f"__p{tag}_{a.name}"]
+            out[a.name] = Column(col.dtype_str, col.data, col.vocab)
+    result = ColumnarBatch(out)
+    if group_by:
+        order = np.lexsort(
+            [_sort_key(result.columns[g]) for g in reversed(group_by)]
+        )
+        result = result.take(order)
+    metrics.incr("router.merge.agg")
+    return result
+
+
+def _sort_key(col: Column) -> np.ndarray:
+    """int64 ordering key for the canonical group sort (codes are
+    order-preserving for strings; floats ride the ordered-i64 encoding)."""
+    if col.vocab is not None:
+        return col.data.astype(np.int64)
+    if col.data.dtype.kind == "f":
+        from ..ops.floatbits import f64_to_ordered_i64
+
+        return f64_to_ordered_i64(col.data.astype(np.float64))
+    return col.data.astype(np.int64)
+
+
+class RouterTicket:
+    """Handle for one routed query: resolves every host leg, degrades
+    lost hosts, merges partials once, caches the result. The same
+    result()/cancel() surface as the servers' QueryTicket."""
+
+    def __init__(self, router: "QueryRouter", legs, merge):
+        self._router = router
+        self._legs = legs  # [(host, ticket-or-None, part_index)]
+        self._merge = merge  # callable(partials) -> ColumnarBatch
+        self._lock = threading.Lock()
+        self._result: Optional[ColumnarBatch] = None
+        self._error: Optional[BaseException] = None
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None) -> ColumnarBatch:
+        with self._lock:
+            if not self._done:
+                try:
+                    partials = [
+                        self._router._resolve_leg(host, ticket, part, timeout, self)
+                        for host, ticket, part in self._legs
+                    ]
+                    self._result = self._merge(partials)
+                except BaseException as e:
+                    self._error = e
+                self._done = True
+                self._router._retire(self)
+            if self._error is not None:
+                raise self._error
+            return self._result
+
+    def cancel(self) -> bool:
+        ok = True
+        for _, ticket, _ in self._legs:
+            if ticket is not None:
+                ok = bool(ticket.cancel()) and ok
+        return ok
+
+
+class QueryRouter:
+    """Front router over named per-host QueryServers (insertion order is
+    the partition order: host i executes part_index i of n_parts)."""
+
+    def __init__(self, hosts: Dict[str, QueryServer]):
+        if not hosts:
+            raise HyperspaceException("QueryRouter needs at least one host.")
+        self.hosts: Dict[str, QueryServer] = dict(hosts)
+        self._lock = threading.Lock()
+        self._inflight: Dict[tuple, RouterTicket] = {}
+        self._tickets: Dict[int, tuple] = {}
+        self._submitted = 0
+        self._coalesced = 0
+        self._hosts_lost = 0
+
+    # -- partitioning ---------------------------------------------------------
+    def partition_map(self, index_name: Optional[str] = None) -> Dict[str, List[int]]:
+        """host → owned buckets, from the op log's ACTIVE index metadata
+        and the shared placement rule applied at host granularity. With
+        no ``index_name`` the widest (most buckets) ACTIVE index keys the
+        map — the same tie-break the planner's movement target uses."""
+        from ..actions import states
+
+        first = next(iter(self.hosts.values()))
+        entries = first.session.collection_manager.get_indexes(
+            [states.ACTIVE], prefer_stable=True
+        )
+        if index_name is not None:
+            entries = [e for e in entries if e.name == index_name]
+        if not entries:
+            raise HyperspaceException(
+                "No ACTIVE bucketed index to derive a partition map from."
+            )
+        entry = max(entries, key=lambda e: (e.num_buckets, e.name))
+        names = list(self.hosts)
+        owned: Dict[str, List[int]] = {h: [] for h in names}
+        for b in range(entry.num_buckets):
+            owned[names[owner_of_bucket(b, len(names))]].append(b)
+        return owned
+
+    # -- submission -----------------------------------------------------------
+    def submit(
+        self,
+        build: Builder,
+        deadline_s: Optional[float] = None,
+        tenant: str = DEFAULT_TENANT,
+    ) -> RouterTicket:
+        """Fan ``build(session, part_index, n_parts)`` out across every
+        host under ``tenant``'s quotas (the per-host servers enforce
+        admission exactly as if the client had walked up to them). The
+        builder returns each host's FINAL query; an Aggregate top is
+        rewritten to its partial form at dispatch (rewrite_partial) so
+        hosts compute partials and the merge produces the finals."""
+        from ..compile.fingerprint import batch_fingerprint
+
+        names = list(self.hosts)
+        n_parts = len(names)
+        sub_plans: List[Tuple[str, object]] = []
+        for i, host in enumerate(names):
+            server = self.hosts[host]
+            df = build(server.session, i, n_parts)
+            sub_plans.append((host, df))
+
+        digest = hashlib.blake2s()
+        for _, df in sub_plans:
+            digest.update(repr(batch_fingerprint(df.plan)).encode())
+            digest.update(repr(df.plan).encode())
+        key = (tenant, digest.hexdigest())
+        with self._lock:
+            live = self._inflight.get(key)
+            if live is not None:
+                self._coalesced += 1
+                metrics.incr("router.coalesced")
+                return live
+
+        merge = self._merge_fn([df.plan for _, df in sub_plans])
+        legs = []
+        with span("router.fanout", hosts=n_parts, tenant=tenant):
+            for i, (host, df) in enumerate(sub_plans):
+                server = self.hosts[host]
+                if server.closed:
+                    # fenced before dispatch: leg resolves via a surviving
+                    # host later — no failed ticket
+                    self._note_host_lost(host, "closed_at_submit")
+                    legs.append((host, None, i))
+                    continue
+                try:
+                    ticket = server.submit(
+                        self.rewrite_partial(df), deadline_s=deadline_s,
+                        tenant=tenant,
+                    )
+                    metrics.incr("router.subqueries")
+                    legs.append((host, ticket, i))
+                except ServerClosed:
+                    self._note_host_lost(host, "closed_at_submit")
+                    legs.append((host, None, i))
+
+        rt = RouterTicket(
+            self,
+            legs,
+            merge,
+        )
+        rt._build = build  # the degraded path re-instantiates partitions
+        rt._tenant = tenant
+        rt._deadline_s = deadline_s
+        with self._lock:
+            self._inflight[key] = rt
+            self._tickets[id(rt)] = key
+            self._submitted += 1
+        metrics.incr("router.fanout")
+        return rt
+
+    # -- merging --------------------------------------------------------------
+    def _merge_fn(self, plans: List[LogicalPlan]):
+        top = plans[0]
+        if isinstance(top, Aggregate):
+            group_by = list(top.group_by)
+            aggs = list(top.aggs)
+
+            def merge(partials: List[ColumnarBatch]) -> ColumnarBatch:
+                return _merge_partials(partials, group_by, aggs)
+
+            return merge
+
+        def merge(partials: List[ColumnarBatch]) -> ColumnarBatch:
+            metrics.incr("router.merge.concat")
+            return ColumnarBatch.concat(partials)
+
+        return merge
+
+    def rewrite_partial(self, df):
+        """Rewrite a top-level Aggregate DataFrame to its per-host partial
+        form. ``submit``/``_resolve_leg`` apply this at dispatch —
+        builders return the final query and never see partial specs."""
+        plan = df.plan
+        if not isinstance(plan, Aggregate):
+            return df
+        partial = Aggregate(
+            tuple(plan.group_by), tuple(_partial_specs(list(plan.aggs))), plan.child
+        )
+        return type(df)(df.session, partial)
+
+    # -- degradation ----------------------------------------------------------
+    def _note_host_lost(self, host: str, why: str) -> None:
+        with self._lock:
+            self._hosts_lost += 1
+        metrics.incr("router.host_lost")
+        flight_recorder.snapshot(f"router_host_lost: {host} ({why})")
+
+    def _survivors(self, dead: str) -> List[str]:
+        return [h for h, s in self.hosts.items() if h != dead and not s.closed]
+
+    def _resolve_leg(
+        self,
+        host: str,
+        ticket,
+        part_index: int,
+        timeout: Optional[float],
+        rt: RouterTicket,
+    ) -> ColumnarBatch:
+        """One host leg's partial — from its ticket, or re-issued on a
+        surviving host when the home host is gone (shared storage makes
+        the partition readable from any host's session)."""
+        rt_err: Optional[BaseException] = None
+        if ticket is not None:
+            try:
+                return ticket.result(timeout)
+            except ServerClosed as e:
+                self._note_host_lost(host, "closed_in_flight")
+                rt_err = e
+        for alt in self._survivors(host):
+            server = self.hosts[alt]
+            df = self.rewrite_partial(
+                rt._build(server.session, part_index, len(self.hosts))
+            )
+            try:
+                alt_ticket = server.submit(
+                    df, deadline_s=rt._deadline_s, tenant=rt._tenant
+                )
+                metrics.incr("router.retried")
+                metrics.incr("router.subqueries")
+                return alt_ticket.result(timeout)
+            except ServerClosed:
+                self._note_host_lost(alt, "closed_in_flight")
+                continue
+        raise rt_err or ServerClosed(
+            f"no surviving host to serve partition {part_index}."
+        )
+
+    def _retire(self, rt: RouterTicket) -> None:
+        with self._lock:
+            key = self._tickets.pop(id(rt), None)
+            if key is not None and self._inflight.get(key) is rt:
+                del self._inflight[key]
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "QueryRouter":
+        for s in self.hosts.values():
+            if not s.closed:
+                s.start()
+        return self
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        for s in self.hosts.values():
+            s.close(timeout_s)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hosts": {h: (not s.closed) for h, s in self.hosts.items()},
+                "submitted": self._submitted,
+                "coalesced": self._coalesced,
+                "hosts_lost": self._hosts_lost,
+                "inflight": len(self._inflight),
+            }

@@ -40,6 +40,24 @@ from ..storage import layout, parquet_io
 from ..storage.columnar import ColumnarBatch
 
 
+def bucketed_meta(plan: LogicalPlan) -> Optional[IndexScan]:
+    """The bucketed IndexScan a join side would load — metadata only, no
+    I/O. None when the shape isn't bucket-aligned. Module-level because
+    the compile tier's join_shuffle classification walks the same shape
+    (classify_shape is pure and has no executor)."""
+    node = plan
+    while isinstance(node, (Project, Filter)):
+        node = node.children[0]
+    if isinstance(node, IndexScan) and node.use_bucket_spec:
+        return node
+    if isinstance(node, BucketUnion):
+        for c in node.children:
+            idx = bucketed_meta(c)
+            if idx is not None:
+                return idx
+    return None
+
+
 def _has_index_scan(plan: LogicalPlan) -> bool:
     """Whether an IndexScan sits anywhere under ``plan`` — distinguishes
     the hybrid union's index side from its appended-source side."""
@@ -1162,19 +1180,7 @@ class Executor:
         return None
 
     def _bucketed_meta(self, plan: LogicalPlan) -> Optional[IndexScan]:
-        """The bucketed IndexScan a side would load — metadata only, no
-        I/O. None when the shape isn't bucket-aligned."""
-        node = plan
-        while isinstance(node, (Project, Filter)):
-            node = node.children[0]
-        if isinstance(node, IndexScan) and node.use_bucket_spec:
-            return node
-        if isinstance(node, BucketUnion):
-            for c in node.children:
-                idx = self._bucketed_meta(c)
-                if idx is not None:
-                    return idx
-        return None
+        return bucketed_meta(plan)
 
     def _scan_side_by_bucket(self, plan: LogicalPlan):
         """[Project?] over a bucketed source (index scan / hybrid union)."""
@@ -1200,8 +1206,6 @@ class Executor:
         r_meta = self._bucketed_meta(join.right)
         if l_meta is None or r_meta is None:
             return None
-        if l_meta.entry.num_buckets != r_meta.entry.num_buckets:
-            return None
         # Keys must equal the bucketing (indexed) columns as a set; the merge
         # itself runs in *index order* so both sides hash and compare the
         # same tuple order (compatible_pairs guarantees the right index's
@@ -1212,6 +1216,13 @@ class Executor:
             k.lower() for k in r_keys
         }:
             return None
+        if l_meta.entry.num_buckets != r_meta.entry.num_buckets:
+            # not co-partitioned: the sides share no bucket space. On a
+            # mesh the ICI shuffle repartitions the smaller side into the
+            # other's bucket space (distributed/shuffle.py); otherwise —
+            # and whenever the planner or the exchange declines — the
+            # exact host join in _exec_join serves.
+            return self._try_shuffle_join(join, l_keys, r_keys, l_meta, r_meta)
         left = self._scan_side_by_bucket(join.left)
         right = self._scan_side_by_bucket(join.right)
         if left is None or right is None:
@@ -1225,6 +1236,19 @@ class Executor:
             l_by_bucket = _project_groups(l_by_bucket, list(l_project.columns))
         if r_project is not None:
             r_by_bucket = _project_groups(r_by_bucket, list(r_project.columns))
+        # record the movement decision (trivially "direct" here) so
+        # explain(verbose) shows the same plan table for every bucketed
+        # join, co-partitioned or not
+        from ..distributed.planner import plan_movement
+
+        plan_movement(
+            {b: v.num_rows for b, v in l_by_bucket.items()},
+            {b: v.num_rows for b, v in r_by_bucket.items()},
+            l_meta.entry.num_buckets,
+            r_meta.entry.num_buckets,
+            self.mesh.devices.size if self.mesh is not None else 1,
+            self.dist_min_rows,
+        )
         if self.mesh is None:
             # device-resident materializing join: the range walk runs on
             # the resident codes, the gather stays host-side (the mesh
@@ -1260,6 +1284,90 @@ class Executor:
             # no matching buckets (or an empty side): both sides' index
             # data is already loaded, so produce the correctly-shaped empty
             # result here instead of re-executing everything from disk
+            return inner_join(
+                self._empty_side(join.left, l_by_bucket, l_node),
+                self._empty_side(join.right, r_by_bucket, r_node),
+                l_keys,
+                r_keys,
+            )
+        return ColumnarBatch.concat(parts)
+
+    def _try_shuffle_join(
+        self,
+        join: Join,
+        l_keys: List[str],
+        r_keys: List[str],
+        l_meta: IndexScan,
+        r_meta: IndexScan,
+    ) -> Optional[ColumnarBatch]:
+        """Non-co-partitioned bucketed join via the ICI all-to-all
+        shuffle (distributed/shuffle.py): the planner picks the side to
+        repartition into the other's bucket space; after the ONE exchange
+        round both sides are co-partitioned and ride the existing mesh /
+        host join arms. Declines (None) to the exact host join when there
+        is no mesh, the planner votes host, or a device fails
+        mid-exchange."""
+        from ..distributed.planner import plan_movement
+        from ..telemetry.metrics import metrics
+
+        left = self._scan_side_by_bucket(join.left)
+        right = self._scan_side_by_bucket(join.right)
+        if left is None or right is None:
+            metrics.incr("shuffle.declined.side_shape")
+            return None
+        l_by_bucket, l_node, l_project = left
+        r_by_bucket, r_node, r_project = right
+        if l_project is not None:
+            l_by_bucket = _project_groups(l_by_bucket, list(l_project.columns))
+        if r_project is not None:
+            r_by_bucket = _project_groups(r_by_bucket, list(r_project.columns))
+        l_rows = sum(b.num_rows for b in l_by_bucket.values())
+        r_rows = sum(b.num_rows for b in r_by_bucket.values())
+        smaller = l_by_bucket if l_rows <= r_rows else r_by_bucket
+        n_planes = (
+            len(next(iter(smaller.values())).columns) if smaller else 0
+        )
+        decision = plan_movement(
+            {b: v.num_rows for b, v in l_by_bucket.items()},
+            {b: v.num_rows for b, v in r_by_bucket.items()},
+            l_meta.entry.num_buckets,
+            r_meta.entry.num_buckets,
+            self.mesh.devices.size if self.mesh is not None else 1,
+            self.dist_min_rows,
+            n_payload_planes=max(n_planes, 1),
+        )
+        if decision.path != "shuffle":
+            metrics.incr(f"shuffle.declined.{decision.reason}")
+            return None
+        # join keys in the UNMOVED side's index order — that side keeps
+        # its build-time buckets, so the moved side must hash the exact
+        # corresponding key tuple (value-stable hash ⇒ equal keys land in
+        # equal target buckets)
+        if decision.moved_side == "right":
+            l2r = {l.lower(): r for l, r in zip(l_keys, r_keys)}
+            l_keys = list(l_node.entry.indexed_columns)
+            r_keys = [l2r[k.lower()] for k in l_keys]
+        else:
+            r2l = {r.lower(): l for l, r in zip(l_keys, r_keys)}
+            r_keys = list(r_node.entry.indexed_columns)
+            l_keys = [r2l[k.lower()] for k in r_keys]
+        from ..distributed.shuffle import try_shuffle_join
+
+        parts = try_shuffle_join(
+            l_by_bucket,
+            r_by_bucket,
+            l_keys,
+            r_keys,
+            decision.moved_side,
+            decision.target_num_buckets,
+            self.mesh,
+            self.dist_min_rows,
+        )
+        if parts is None:
+            # exchange declined mid-flight (device loss) -> exact host join
+            metrics.incr("shuffle.declined.device_failed")
+            return None
+        if not parts:
             return inner_join(
                 self._empty_side(join.left, l_by_bucket, l_node),
                 self._empty_side(join.right, r_by_bucket, r_node),

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..exceptions import HyperspaceException
+from ..parallel.mesh import owner_of_bucket_array, owner_of_bucket_device
 from ..storage.columnar import Column, ColumnarBatch, is_string
 from ..telemetry.metrics import metrics
 from . import ensure_x64
@@ -830,7 +831,8 @@ def _sharded_build_fn(
     def shard_fn(arrays, valid, vh):
         # local shapes: (shard_rows,)
         bucket = device_bucket_ids(arrays, dtypes, list(key_names), vh, num_buckets)
-        dest = jnp.where(valid, bucket % D, D)  # invalid rows -> out of range
+        # invalid rows -> out of range; placement via the ONE shared rule
+        dest = jnp.where(valid, owner_of_bucket_device(bucket, D), D)
         m = dest.shape[0]
         iota = lax.iota(jnp.int32, m)
         sorted_dest, perm = lax.sort([dest, iota], num_keys=1)
@@ -1069,11 +1071,11 @@ def build_partition_sharded_multihost(
     shard_rows = next_pow2(consensus_max(max(-(-n_local // L), 1)))
     pad_local = shard_rows * L
 
-    host_dest = (
+    host_dest = owner_of_bucket_array(
         bucket_ids_host(
             [key_repr(local_batch.columns[k]) for k in key_names], num_buckets
-        )
-        % D
+        ),
+        D,
     )
     cap = next_pow2(
         consensus_max(_exchange_cap(host_dest, shard_rows, n_local, L, D))
@@ -1177,7 +1179,7 @@ def build_partition_sharded(
     host_bucket = bucket_ids_host(
         [key_repr(batch.columns[k]) for k in key_names], num_buckets
     )
-    host_dest = host_bucket % D
+    host_dest = owner_of_bucket_array(host_bucket, D)
 
     from ..utils.intmath import next_pow2
 

@@ -48,9 +48,29 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def owner_of_bucket(bucket: int, n_devices: int) -> int:
-    """The bucket→device placement rule. Build and query must agree (the
-    analog of the reference's BucketSpec-driven task placement)."""
+    """THE bucket→device placement rule. Build and query must agree (the
+    analog of the reference's BucketSpec-driven task placement) — a
+    silent divergence corrupts joins, so the rule exists exactly once:
+    this scalar form, ``owner_of_bucket_array`` (the vectorized host
+    twin the build's capacity planner and the shuffle planner consume),
+    and ``owner_of_bucket_device`` (the traceable twin inside the
+    all_to_all kernels). All three are the same modular expression."""
     return bucket % n_devices
+
+
+def owner_of_bucket_array(buckets, n_devices: int):
+    """Vectorized host twin of ``owner_of_bucket`` (numpy array in/out).
+    The sharded build's capacity planner and the shuffle planner both
+    route through here so their placement can never drift from the
+    scalar rule."""
+    return buckets % n_devices
+
+
+def owner_of_bucket_device(buckets, n_devices: int):
+    """Device (traceable) twin of ``owner_of_bucket`` for use inside
+    jitted shard_map programs — the build and shuffle all_to_all kernels
+    compute destination devices with this exact expression."""
+    return buckets % n_devices
 
 
 # -- multi-controller (one process per host) ---------------------------------

@@ -203,6 +203,33 @@ def explain_string(
                 buf.write_line(f"Aggregate ran: {where}")
             buf.write_line()
 
+        # inter-chip movement plan (docs/19-distributed-execution.md):
+        # the shuffle planner records every bucketed join's
+        # direct/shuffle/host decision as a "shuffle.plan" span — render
+        # the decision table from the ONE trace record
+        plan_span = None if last_trace is None else last_trace.find("shuffle.plan")
+        if plan_span is not None:
+            lb = plan_span.labels
+            buf.write_line(_BANNER)
+            buf.write_line("Shuffle movement plan (last query):")
+            buf.write_line(_BANNER)
+            buf.write_line(f"Decision: {lb.get('decision')} ({lb.get('reason')})")
+            buf.write_line(
+                f"Buckets: left={lb.get('left_buckets')} "
+                f"right={lb.get('right_buckets')} "
+                f"devices={lb.get('devices')}"
+            )
+            buf.write_line(
+                f"Rows: left={lb.get('left_rows')} right={lb.get('right_rows')}"
+            )
+            if lb.get("decision") == "shuffle":
+                buf.write_line(
+                    f"Moved side: {lb.get('moved_side')} "
+                    f"(~{lb.get('est_moved_bytes')} bytes over ICI)"
+                )
+            buf.write_line(f"Plan memo hit: {lb.get('memo_hit')}")
+            buf.write_line()
+
         # the last query's span tree: where ITS wall time went, stage by
         # stage (admission/queue/plan/compile/dispatch/D2H with tier +
         # fingerprint + byte labels) — the per-query view the SF100 and

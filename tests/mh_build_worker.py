@@ -25,16 +25,21 @@ pid, nproc, coord, out_dir = (
     sys.argv[3],
     sys.argv[4],
 )
-jax.distributed.initialize(coord, num_processes=nproc, process_id=pid)
 
 from pathlib import Path  # noqa: E402
 
 import numpy as np  # noqa: E402
 
-from hyperspace_tpu.ops.build import build_partition_sharded_multihost  # noqa: E402
+from hyperspace_tpu.distributed import QueryFabric  # noqa: E402
 from hyperspace_tpu.storage import layout  # noqa: E402
 from hyperspace_tpu.storage.columnar import Column, ColumnarBatch  # noqa: E402
-from jax.sharding import Mesh  # noqa: E402
+
+# the control plane: one fabric handle per process (DCN init + global
+# mesh + bucket→process placement), replacing the hand-wired
+# jax.distributed.initialize + Mesh construction this worker carried
+fabric = QueryFabric(
+    coordinator_address=coord, num_processes=nproc, process_id=pid
+).connect()
 
 NUM_BUCKETS = 16
 TOTAL = 3000
@@ -57,9 +62,9 @@ local = ColumnarBatch(
     }
 )
 
-mesh = Mesh(np.array(jax.devices()), ("d",))
-per_local, global_counts = build_partition_sharded_multihost(
-    local, ["orderkey"], NUM_BUCKETS, mesh, scratch_dir=Path(out_dir) / ".vocab"
+assert fabric.info()["process_count"] == nproc
+per_local, global_counts = fabric.build_sharded(
+    local, ["orderkey"], NUM_BUCKETS, scratch_dir=Path(out_dir) / ".vocab"
 )
 
 # every process sees the same replicated global counts over the FULL data

@@ -1272,6 +1272,38 @@ def test_hs014_suppressed():
     assert any(f.suppressed and f.code == "HS014" for f in findings)
 
 
+def test_hs014_shuffle_and_router_are_registered_subsystems():
+    """PR 17's distributed tier registered ``shuffle`` and ``router`` as
+    subsystem prefixes — their families pass, near-miss prefixes still
+    fire (registration is exact, not fuzzy)."""
+    src = """
+    from hyperspace_tpu.telemetry.metrics import metrics
+    from hyperspace_tpu.telemetry.trace import span
+
+    def record():
+        metrics.incr("shuffle.rounds")
+        metrics.incr("shuffle.declined.below_min_rows")
+        metrics.incr("router.host_lost")
+        metrics.incr("router.merge.agg")
+        with span("shuffle.plan", decision="shuffle"):
+            pass
+        with span("router.fanout", hosts=2):
+            pass
+    """
+    assert codes(run(src), "HS014") == []
+
+    near_miss = """
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    def record():
+        metrics.incr("shuffler.rounds")
+        metrics.incr("routing.fanout")
+    """
+    got = [f for f in run(near_miss) if f.code == "HS014" and not f.suppressed]
+    assert len(got) == 2
+    assert all("prefix" in f.message for f in got)
+
+
 # --- the project model: call-graph resolution over a synthetic package ------
 
 

@@ -1,0 +1,90 @@
+"""QueryFabric control plane, single-process configuration — the tier-1
+smoke coverage test_multihost.py's xfail reason points at: the SAME
+connect() + placement + build_sharded path its two-process workers ride,
+minus the cross-process DCN rendezvous this container can't complete.
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.distributed import QueryFabric
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.ops.build import build_partition_sharded
+from hyperspace_tpu.parallel.mesh import BUCKET_AXIS, make_mesh, owner_of_bucket
+from hyperspace_tpu.storage.columnar import Column, ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+
+
+@pytest.fixture(scope="module")
+def fabric():
+    return QueryFabric().connect()
+
+
+def test_fabric_requires_connect():
+    f = QueryFabric()
+    assert not f.connected
+    with pytest.raises(HyperspaceException):
+        _ = f.mesh
+
+
+def test_fabric_single_process_build(fabric, tmp_path):
+    """connect() with no coordinator is the single-process fabric: the
+    control plane no-ops, the mesh covers the 8 local devices, and
+    build_sharded equals the plain single-process sharded build."""
+    before = metrics.counter("mesh.fabric.connected")
+    f = QueryFabric().connect()
+    assert metrics.counter("mesh.fabric.connected") == before + 1
+    assert f.connected
+    assert f.mesh.axis_names == (BUCKET_AXIS,)
+    assert f.mesh.devices.size == 8
+    info = f.info()
+    assert info["process_count"] == 1
+    assert info["process_index"] == 0
+
+    rng = np.random.default_rng(29)
+    n, nb = 2500, 16
+    modes = np.array([b"AIR", b"SHIP", b"RAIL"], dtype=object)
+    batch = ColumnarBatch(
+        {
+            "k": Column.from_values(rng.integers(0, 10**9, n).astype(np.int64)),
+            "q": Column.from_values(rng.integers(0, 50, n).astype(np.int64)),
+            "m": Column.from_values(modes[rng.integers(0, 3, n)], "string"),
+        }
+    )
+    per_fabric, counts_fabric = f.build_sharded(
+        batch, ["k"], nb, scratch_dir=tmp_path / ".vocab"
+    )
+    per_plain, counts_plain = build_partition_sharded(batch, ["k"], nb, make_mesh(8))
+    np.testing.assert_array_equal(
+        np.asarray(counts_fabric), np.asarray(counts_plain)
+    )
+    assert int(np.asarray(counts_fabric).sum()) == n
+
+    def rows_by_bucket(per_device):
+        got = {}
+        for dev_batch, bucket_ids in per_device:
+            for b in np.unique(bucket_ids):
+                rows = dev_batch.take(np.flatnonzero(bucket_ids == b))
+                got.setdefault(int(b), []).extend(
+                    zip(rows.columns["k"].data.tolist(),
+                        rows.columns["q"].data.tolist(),
+                        rows.columns["m"].to_values().tolist())
+                )
+        return {b: sorted(v) for b, v in got.items()}
+
+    assert rows_by_bucket(per_fabric) == rows_by_bucket(per_plain)
+
+
+def test_fabric_placement_matches_shared_rule(fabric):
+    """Device/process placement answers come from the ONE owner_of_bucket
+    helper — the fabric must agree with it bucket by bucket."""
+    flat = fabric.mesh.devices.reshape(-1)
+    for b in range(32):
+        dev = fabric.owner_device_of_bucket(b)
+        assert dev == flat[owner_of_bucket(b, flat.size)]
+        assert fabric.owner_process_of_bucket(b) == dev.process_index
+
+
+def test_fabric_local_buckets_cover_all_single_process(fabric):
+    # one process owns every device, hence every bucket
+    assert fabric.local_buckets(16) == list(range(16))

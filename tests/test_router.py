@@ -1,0 +1,249 @@
+"""Multi-host query fabric (hyperspace_tpu.distributed.router): one
+logical query fanned out over per-host QueryServers, partial aggregates
+re-merged bit-identically to single-server execution, coalescing of
+identical in-flight bursts, and the host-loss degradation ladder (a dead
+host costs ZERO failed tickets while any host survives).
+
+Two 'hosts' here are two QueryServers over two sessions sharing the same
+source files and index storage — the shared-storage contract a real pod
+runs on (any partition readable from any host).
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.distributed import QueryRouter
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.aggregates import (
+    agg_avg, agg_count, agg_max, agg_min, agg_sum,
+)
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.serve import QueryServer, ServeConfig
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+from hyperspace_tpu.telemetry.recorder import flight_recorder
+
+N = 24_000
+SPLIT = 10_000  # partition boundary on k: part 0 takes k < SPLIT
+
+
+def _source(n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 20_000, n).astype(np.int64),
+            "v": rng.integers(-500, 1000, n).astype(np.int64),
+            "g": rng.integers(0, 30, n).astype(np.int64),
+        }
+    )
+
+
+@pytest.fixture
+def env(tmp_path):
+    """Two sessions over the SAME files and index log — the two 'hosts'."""
+    batch = _source()
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+
+    def make_session():
+        conf = HyperspaceConf(
+            {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+             C.INDEX_NUM_BUCKETS: 8}
+        )
+        return HyperspaceSession(conf)
+
+    session_a = make_session()
+    hs = Hyperspace(session_a)
+    hs.create_index(
+        session_a.read.parquet(str(src)), IndexConfig("ridx", ["k"], ["v", "g"])
+    )
+    session_a.enable_hyperspace()
+    session_b = make_session()
+    session_b.enable_hyperspace()
+    return session_a, session_b, src, batch
+
+
+def _part_filter(df, part_index, n_parts):
+    assert n_parts == 2
+    if part_index == 0:
+        return df.filter(col("k") < lit(SPLIT))
+    return df.filter(col("k") >= lit(SPLIT))
+
+
+def _agg_builder(src):
+    def build(session, part_index, n_parts):
+        df = _part_filter(session.read.parquet(str(src)), part_index, n_parts)
+        return df.group_by("g").agg(
+            agg_sum("v", "sv"), agg_count(None, "n"), agg_avg("v", "av"),
+            agg_min("v", "mn"), agg_max("v", "mx"),
+        )
+    return build
+
+
+def _canon(batch, group_by=("g",)):
+    order = np.lexsort([batch.columns[g].data for g in reversed(group_by)])
+    return batch.take(order)
+
+
+def _make_router(env, **cfg):
+    session_a, session_b, src, batch = env
+    servers = {
+        "a": QueryServer(session_a, ServeConfig(max_workers=2, **cfg)),
+        "b": QueryServer(session_b, ServeConfig(max_workers=2, **cfg)),
+    }
+    return QueryRouter(servers)
+
+
+def test_router_needs_hosts():
+    with pytest.raises(HyperspaceException):
+        QueryRouter({})
+
+
+def test_router_agg_merge_bit_identical(env):
+    """The acceptance oracle: a router-fronted two-server aggregate must
+    equal the single-server full aggregate BIT-identically (int partial
+    sums re-merge exactly; avg divides the same exact S by the same N)."""
+    session_a, session_b, src, batch = env
+    router = _make_router(env).start()
+    try:
+        before = metrics.counter("router.merge.agg")
+        ticket = router.submit(_agg_builder(src))
+        merged = ticket.result(timeout=120)
+        assert metrics.counter("router.merge.agg") == before + 1
+
+        single = _canon(
+            session_a.read.parquet(str(src)).group_by("g").agg(
+                agg_sum("v", "sv"), agg_count(None, "n"), agg_avg("v", "av"),
+                agg_min("v", "mn"), agg_max("v", "mx"),
+            ).collect()
+        )
+        assert merged.column_names == single.column_names
+        for name in merged.column_names:
+            np.testing.assert_array_equal(
+                merged.columns[name].data, single.columns[name].data,
+                err_msg=name,
+            )
+    finally:
+        router.close()
+
+
+def test_router_concat_merge_non_aggregate(env):
+    session_a, session_b, src, batch = env
+    router = _make_router(env).start()
+    try:
+        def build(session, i, n):
+            return _part_filter(
+                session.read.parquet(str(src)), i, n
+            ).select("k", "v")
+
+        before = metrics.counter("router.merge.concat")
+        got = router.submit(build).result(timeout=120)
+        assert metrics.counter("router.merge.concat") == before + 1
+        exp = session_a.read.parquet(str(src)).select("k", "v").collect()
+        assert got.num_rows == exp.num_rows == N
+        assert sorted(
+            zip(got.columns["k"].data.tolist(), got.columns["v"].data.tolist())
+        ) == sorted(
+            zip(exp.columns["k"].data.tolist(), exp.columns["v"].data.tolist())
+        )
+    finally:
+        router.close()
+
+
+def test_router_coalesces_identical_inflight_bursts(env):
+    """PR-10's batch fingerprint folded into the routing key: the same
+    logical burst in flight coalesces onto ONE fan-out; distinct literals
+    never share a ticket."""
+    session_a, session_b, src, batch = env
+    router = _make_router(env, autostart=False)
+    try:
+        def lookup(key):
+            def build(session, i, n):
+                return _part_filter(
+                    session.read.parquet(str(src)), i, n
+                ).filter(col("g") == lit(key)).select("k", "v")
+            return build
+
+        before = metrics.counter("router.coalesced")
+        t1 = router.submit(lookup(3))
+        t2 = router.submit(lookup(3))  # identical, still queued -> coalesce
+        t3 = router.submit(lookup(4))  # different literal -> own fan-out
+        assert t2 is t1
+        assert t3 is not t1
+        assert metrics.counter("router.coalesced") == before + 1
+        assert router.stats()["coalesced"] == 1
+        router.start()
+        r1 = t1.result(timeout=120)
+        r3 = t3.result(timeout=120)
+        exp1 = (
+            session_a.read.parquet(str(src))
+            .filter(col("g") == lit(3)).select("k", "v").collect()
+        )
+        assert sorted(r1.columns["k"].data.tolist()) == sorted(
+            exp1.columns["k"].data.tolist()
+        )
+        assert r3.num_rows != r1.num_rows or sorted(
+            r3.columns["k"].data.tolist()
+        ) != sorted(r1.columns["k"].data.tolist())
+        # retired on completion: a fresh identical submit fans out anew
+        t4 = router.submit(lookup(3))
+        assert t4 is not t1
+        t4.result(timeout=120)
+    finally:
+        router.close()
+
+
+def test_router_degrades_dead_host_to_survivor(env):
+    """A host dead at fan-out costs ZERO failed tickets: its partition is
+    re-issued against the surviving host's session (shared storage),
+    counted and flight-recorded."""
+    session_a, session_b, src, batch = env
+    router = _make_router(env).start()
+    try:
+        router.hosts["b"].close()
+        flight_recorder.reset()
+        before_lost = metrics.counter("router.host_lost")
+        before_retried = metrics.counter("router.retried")
+        merged = router.submit(_agg_builder(src)).result(timeout=120)
+        assert metrics.counter("router.host_lost") == before_lost + 1
+        assert metrics.counter("router.retried") == before_retried + 1
+        assert router.stats()["hosts_lost"] == 1
+        snaps = flight_recorder.snapshots()
+        assert any(
+            s["reason"].startswith("router_host_lost: b") for s in snaps
+        )
+        single = _canon(
+            session_a.read.parquet(str(src)).group_by("g").agg(
+                agg_sum("v", "sv"), agg_count(None, "n"), agg_avg("v", "av"),
+                agg_min("v", "mn"), agg_max("v", "mx"),
+            ).collect()
+        )
+        for name in merged.column_names:
+            np.testing.assert_array_equal(
+                merged.columns[name].data, single.columns[name].data,
+                err_msg=name,
+            )
+    finally:
+        router.close()
+
+
+def test_partition_map_from_shared_placement(env):
+    session_a, session_b, src, batch = env
+    router = _make_router(env, autostart=False)
+    try:
+        owned = router.partition_map()
+        # 8 buckets over 2 hosts under the b % n rule: even/odd
+        assert owned["a"] == [0, 2, 4, 6]
+        assert owned["b"] == [1, 3, 5, 7]
+        assert owned == router.partition_map(index_name="ridx")
+        with pytest.raises(HyperspaceException):
+            router.partition_map(index_name="nope")
+    finally:
+        router.close()

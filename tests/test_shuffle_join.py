@@ -1,0 +1,321 @@
+"""ICI shuffle join (hyperspace_tpu.distributed): the movement planner,
+the one-round all-to-all repartition, and the end-to-end join of two
+indexes bucketed with DIFFERENT num_buckets — parity against the exact
+host join everywhere, plus the degradation ladder (device loss
+mid-exchange declines to host with a flight-recorder snapshot and zero
+failed queries).
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.distributed.planner import (
+    MovementDecision,
+    plan_movement,
+    reset_plan_memo,
+)
+from hyperspace_tpu.distributed.shuffle import (
+    repartition_by_bucket,
+    try_shuffle_join,
+)
+from hyperspace_tpu.exec.executor import Executor
+from hyperspace_tpu.exec.joins import inner_join
+from hyperspace_tpu.ops.hashing import bucket_ids_host, key_repr
+from hyperspace_tpu.parallel.mesh import make_mesh
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.ir import Join, Scan
+from hyperspace_tpu.plan.rules import apply_hyperspace_rules
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+from hyperspace_tpu.telemetry.recorder import flight_recorder
+from hyperspace_tpu.telemetry.trace import start_trace
+from tests.e2e_utils import assert_row_parity, build_index, write_source
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def split_by_bucket(batch, keys, nb):
+    b = bucket_ids_host([key_repr(batch.columns[k]) for k in keys], nb)
+    return {int(x): batch.take(np.flatnonzero(b == x)) for x in np.unique(b)}
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+def test_planner_direct_when_co_partitioned():
+    d = plan_movement({0: 100}, {1: 100}, 8, 8, 8, 0)
+    assert (d.path, d.reason) == ("direct", "co_partitioned")
+
+
+def test_planner_host_reasons():
+    assert plan_movement({0: 9}, {0: 9}, 8, 16, 1, 0).reason == "no_mesh"
+    assert plan_movement({}, {0: 9}, 8, 16, 8, 0).reason == "empty_side"
+    d = plan_movement({0: 3}, {0: 4}, 8, 16, 8, 1000)
+    assert (d.path, d.reason) == ("host", "below_min_rows")
+
+
+def test_planner_moves_smaller_side_into_other_bucket_space():
+    reset_plan_memo()
+    d = plan_movement({0: 10}, {0: 500}, 8, 16, 8, 0)
+    assert (d.path, d.moved_side, d.target_num_buckets) == ("shuffle", "left", 16)
+    assert d.reason == "repartition_left"
+    assert d.est_moved_bytes == 10 * 2 * 8
+    d = plan_movement({0: 500}, {0: 10}, 8, 16, 8, 0, n_payload_planes=3)
+    assert (d.moved_side, d.target_num_buckets) == ("right", 8)
+    assert d.est_moved_bytes == 10 * 3 * 8
+
+
+def test_planner_memoizes_per_histogram_class():
+    reset_plan_memo()
+    before = metrics.counter("shuffle.plan.memo_hit")
+    first = plan_movement({0: 40, 1: 60}, {0: 900}, 8, 16, 8, 0)
+    assert not first.memo_hit
+    # same placement, same pow2 histogram class -> memo hit
+    again = plan_movement({0: 41, 1: 59}, {0: 901}, 8, 16, 8, 0)
+    assert again.memo_hit and again.path == first.path
+    assert again.moved_side == first.moved_side
+    assert metrics.counter("shuffle.plan.memo_hit") == before + 1
+    # a different device count is a different placement -> miss
+    assert not plan_movement({0: 40, 1: 60}, {0: 900}, 8, 16, 4, 0).memo_hit
+    reset_plan_memo()
+    assert not plan_movement({0: 40, 1: 60}, {0: 900}, 8, 16, 8, 0).memo_hit
+
+
+def test_planner_records_decision_span_and_counter():
+    before = metrics.counter("shuffle.plan.shuffle")
+    with start_trace("query.collect", origin="test") as t:
+        plan_movement({0: 50}, {0: 600}, 8, 16, 8, 0)
+    assert metrics.counter("shuffle.plan.shuffle") == before + 1
+    sp = t.find("shuffle.plan")
+    assert sp is not None
+    assert sp.labels["decision"] == "shuffle"
+    assert sp.labels["moved_side"] == "left"
+    assert sp.labels["left_buckets"] == 8
+    assert sp.labels["right_buckets"] == 16
+
+
+# ---------------------------------------------------------------------------
+# repartition
+# ---------------------------------------------------------------------------
+def sample(n=1800, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 250, n).astype(np.int64),
+            "v": rng.integers(0, 10**6, n).astype(np.int64),
+            "s": rng.choice([b"aa", b"bb", b"cc", b"dd"], n).astype(object),
+            "f": rng.normal(0, 5, n),
+        },
+        {"k": "int64", "v": "int64", "s": "string", "f": "float64"},
+    )
+
+
+def test_repartition_parity_with_host_hash(mesh):
+    """One all-to-all round moves every row to the bucket the host hash
+    assigns it in the TARGET space — including strings (vocab reattached)
+    and floats (ordered-i64 transport round-trips)."""
+    b = sample(seed=17)
+    src = split_by_bucket(b, ["k"], 8)
+    rounds = metrics.counter("shuffle.rounds")
+    moved_rows = metrics.counter("shuffle.rows_moved")
+    out = repartition_by_bucket(src, ["k"], 16, mesh)
+    assert out is not None
+    assert metrics.counter("shuffle.rounds") == rounds + 1
+    assert metrics.counter("shuffle.rows_moved") == moved_rows + b.num_rows
+    assert metrics.counter("shuffle.ici_bytes") > 0
+    exp = split_by_bucket(b, ["k"], 16)
+    assert set(out) == set(exp)
+    for bk in exp:
+        def rows(batch):
+            return sorted(
+                zip(batch.columns["k"].data.tolist(),
+                    batch.columns["v"].data.tolist(),
+                    batch.columns["s"].to_values().tolist(),
+                    batch.columns["f"].data.tolist())
+            )
+        assert rows(out[bk]) == rows(exp[bk]), f"bucket {bk}"
+
+
+def test_repartition_empty_input(mesh):
+    assert repartition_by_bucket({}, ["k"], 16, mesh) == {}
+
+
+def test_try_shuffle_join_parity(mesh):
+    """Left side bucketed at 8, right at 16: repartition left into the
+    right's space, join — rows equal the plain host inner join."""
+    rng = np.random.default_rng(23)
+    left = ColumnarBatch.from_pydict(
+        {"l_k": rng.integers(0, 120, 700).astype(np.int64),
+         "l_v": np.arange(700, dtype=np.int64)}
+    )
+    right = ColumnarBatch.from_pydict(
+        {"r_k": rng.integers(0, 120, 2400).astype(np.int64),
+         "r_v": np.arange(2400, dtype=np.int64)}
+    )
+    lb = split_by_bucket(left, ["l_k"], 8)
+    rb = split_by_bucket(right, ["r_k"], 16)
+    rb = {b: v.take(np.argsort(v.columns["r_k"].data, kind="stable"))
+          for b, v in rb.items()}
+    before = metrics.counter("scan.path.resident_join_shuffle")
+    parts = try_shuffle_join(lb, rb, ["l_k"], ["r_k"], "left", 16, mesh, 0)
+    assert parts is not None
+    assert metrics.counter("scan.path.resident_join_shuffle") == before + 1
+    got = ColumnarBatch.concat(parts)
+    exp = inner_join(left, right, ["l_k"], ["r_k"])
+    assert got.num_rows == exp.num_rows > 0
+    assert sorted(
+        zip(got.columns["l_v"].data.tolist(), got.columns["r_v"].data.tolist())
+    ) == sorted(
+        zip(exp.columns["l_v"].data.tolist(), exp.columns["r_v"].data.tolist())
+    )
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: mismatched-bucket indexes through the executor
+# ---------------------------------------------------------------------------
+def _mismatched_join_env(tmp_path, n_left=2200, n_right=500, seed=31):
+    conf = HyperspaceConf()
+    rng = np.random.default_rng(seed)
+    li = ColumnarBatch.from_pydict(
+        {"l_k": rng.integers(0, 160, n_left).astype(np.int64),
+         "l_q": rng.integers(1, 50, n_left).astype(np.int64)}
+    )
+    orders = ColumnarBatch.from_pydict(
+        {"o_k": (rng.permutation(n_right) % 160).astype(np.int64),
+         "o_t": rng.integers(0, 9000, n_right).astype(np.int64)}
+    )
+    l_rel = write_source(tmp_path / "lineitem", li, n_files=3)
+    o_rel = write_source(tmp_path / "orders", orders, n_files=2)
+    # DIFFERENT bucket counts: no shared bucket space, the co-partitioned
+    # SMJ can't serve — pre-PR this fell all the way to the host join
+    l_entry = build_index("li_idx", l_rel, ["l_k"], ["l_q"], tmp_path / "idx",
+                          num_buckets=16)
+    o_entry = build_index("o_idx", o_rel, ["o_k"], ["o_t"], tmp_path / "idx",
+                          num_buckets=8)
+    jplan = Join(Scan(l_rel), Scan(o_rel), col("l_k") == col("o_k"), "inner")
+    rewritten, applied = apply_hyperspace_rules(jplan, [l_entry, o_entry], conf)
+    assert len(applied) == 2
+    return conf, rewritten
+
+
+def test_executor_shuffle_join_e2e_parity(tmp_path, mesh):
+    conf, rewritten = _mismatched_join_env(tmp_path)
+    single = Executor(conf).execute(rewritten)
+    before_path = metrics.counter("scan.path.resident_join_shuffle")
+    before_rounds = metrics.counter("shuffle.rounds")
+    multi = Executor(conf, mesh=mesh, dist_min_rows=0).execute(rewritten)
+    assert metrics.counter("scan.path.resident_join_shuffle") == before_path + 1
+    # exactly ONE all-to-all round served the whole join
+    assert metrics.counter("shuffle.rounds") == before_rounds + 1
+    assert_row_parity(single, multi)
+    assert multi.num_rows > 0
+
+
+def test_executor_shuffle_join_declines_below_min_rows(tmp_path, mesh):
+    """The planner's economics gate: tiny inputs stay on the exact host
+    join (the same dist_min_rows floor every mesh arm respects)."""
+    conf, rewritten = _mismatched_join_env(tmp_path, seed=37)
+    reset_plan_memo()
+    before = metrics.counter("shuffle.declined.below_min_rows")
+    rounds = metrics.counter("shuffle.rounds")
+    multi = Executor(conf, mesh=mesh, dist_min_rows=10**9).execute(rewritten)
+    assert metrics.counter("shuffle.declined.below_min_rows") == before + 1
+    assert metrics.counter("shuffle.rounds") == rounds  # no exchange paid
+    assert_row_parity(Executor(conf).execute(rewritten), multi)
+
+
+def test_device_loss_mid_all_to_all_degrades_to_host(tmp_path, mesh, monkeypatch):
+    """Fault injection: the jitted exchange dies mid-flight (fenced chip).
+    The query must still answer exactly (host fallback), count the
+    decline, and freeze a flight-recorder snapshot — zero failed
+    queries."""
+    from hyperspace_tpu.distributed import shuffle as shuffle_mod
+
+    conf, rewritten = _mismatched_join_env(tmp_path, seed=41)
+
+    def boom_fn(mesh_, dtypes_sig, cap):
+        def fn(*a, **k):
+            raise RuntimeError("injected: device lost mid all_to_all")
+        return fn
+
+    monkeypatch.setattr(shuffle_mod, "_shuffle_fn", boom_fn)
+    flight_recorder.reset()
+    before_failed = metrics.counter("shuffle.device_failed")
+    before_declined = metrics.counter("shuffle.declined.device_failed")
+    multi = Executor(conf, mesh=mesh, dist_min_rows=0).execute(rewritten)
+    assert metrics.counter("shuffle.device_failed") == before_failed + 1
+    assert metrics.counter("shuffle.declined.device_failed") == before_declined + 1
+    snaps = flight_recorder.snapshots()
+    assert any(s["reason"].startswith("shuffle_device_loss") for s in snaps)
+    # the answer is still exact — the ladder degraded, the query didn't fail
+    assert_row_parity(Executor(conf).execute(rewritten), multi)
+
+
+# ---------------------------------------------------------------------------
+# session level: compile-tier routing + explain(verbose) plan table
+# ---------------------------------------------------------------------------
+def test_session_shuffle_join_explain_and_pipeline(tmp_path, mesh):
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+
+    rng = np.random.default_rng(43)
+    li = ColumnarBatch.from_pydict(
+        {"l_k": rng.integers(0, 100, 2400).astype(np.int64),
+         "l_q": rng.integers(1, 50, 2400).astype(np.int64)}
+    )
+    orders = ColumnarBatch.from_pydict(
+        {"o_k": (rng.permutation(400) % 100).astype(np.int64),
+         "o_t": rng.integers(0, 9000, 400).astype(np.int64)}
+    )
+    lsrc, osrc = tmp_path / "li", tmp_path / "ord"
+    lsrc.mkdir(); osrc.mkdir()
+    parquet_io.write_parquet(lsrc / "p.parquet", li)
+    parquet_io.write_parquet(osrc / "p.parquet", orders)
+    conf = HyperspaceConf(
+        {C.INDEX_SYSTEM_PATH: str(tmp_path / "idx"),
+         C.INDEX_NUM_BUCKETS: 16,
+         C.TPU_DISTRIBUTED_MIN_ROWS: 0}
+    )
+    session = HyperspaceSession(conf, mesh=mesh)
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(str(lsrc)),
+                    IndexConfig("l_idx", ["l_k"], ["l_q"]))
+    session.conf.set(C.INDEX_NUM_BUCKETS, 8)
+    hs.create_index(session.read.parquet(str(osrc)),
+                    IndexConfig("o_idx", ["o_k"], ["o_t"]))
+    session.enable_hyperspace()
+
+    q = session.read.parquet(str(lsrc)).join(
+        session.read.parquet(str(osrc)), col("l_k") == col("o_k")
+    )
+    before = metrics.counter("scan.path.resident_join_shuffle")
+    got = q.collect()
+    assert metrics.counter("scan.path.resident_join_shuffle") == before + 1
+    assert got.num_rows > 0
+
+    # the decision is frozen on the query's trace...
+    sp = session.last_trace.find("shuffle.plan")
+    assert sp is not None and sp.labels["decision"] == "shuffle"
+    # ...and the compile tier routed the plan through the join_shuffle kind
+    assert session.last_trace.meta["pipeline"]["kind"] == "join_shuffle"
+    # ...and explain(verbose) renders the movement-plan table from it
+    text = q.explain(verbose=True)
+    assert "Shuffle movement plan (last query)" in text
+    assert "Decision: shuffle" in text
+    assert "Moved side:" in text
+
+    # parity against a mesh-less session over the same files
+    host_session = HyperspaceSession(
+        HyperspaceConf({C.INDEX_SYSTEM_PATH: str(tmp_path / "idx")})
+    )
+    hq = host_session.read.parquet(str(lsrc)).join(
+        host_session.read.parquet(str(osrc)), col("l_k") == col("o_k")
+    )
+    assert_row_parity(got, hq.collect())
