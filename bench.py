@@ -2942,6 +2942,59 @@ def main() -> None:
                 f"join: {sj19.get('rounds_per_join')}"
             )
 
+    # ---- config 20: chaos serve (failure-domain hardening) -------------
+    # The PR-19 claim: the distributed serving path absorbs a
+    # deterministic host-fault schedule (flap twice, slow window) with
+    # ZERO failed tickets, bit-identical answers, the killed-then-revived
+    # host observably READMITTED through a probation probe, and p99 under
+    # chaos bounded at 3x the fault-free burst. Runs in a subprocess so
+    # the burst's servers/threads can't leak into later configs.
+    if os.environ.get("BENCH_CHAOS_SERVE", "1") != "0":
+        import subprocess
+
+        try:
+            env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+            env.pop("HYPERSPACE_TPU_HBM", None)
+            proc = subprocess.run(
+                [sys.executable, str(REPO / "scripts" / "bench_chaos_serve.py")],
+                capture_output=True,
+                text=True,
+                timeout=600,
+                env=env,
+            )
+            line = (
+                proc.stdout.strip().splitlines()[-1]
+                if proc.stdout.strip()
+                else ""
+            )
+            extras["chaos_serve"] = (
+                json.loads(line)
+                if proc.returncode == 0 and line.startswith("{")
+                else {"error": (proc.stderr or "no output")[-400:]}
+            )
+        except Exception as e:  # noqa: BLE001 - A/B extra must not fail bench
+            extras["chaos_serve"] = {"error": repr(e)[:400]}
+        cs20 = extras["chaos_serve"]
+        if "error" in cs20:
+            _fail(f"config20 chaos serve failed: {cs20['error']}"[:400])
+        if cs20.get("failed_tickets", 1) != 0:
+            _fail(
+                "config20 chaos burst dropped tickets: "
+                f"{cs20.get('failed_tickets')} failed"
+            )
+        if cs20.get("parity") is not True:
+            _fail("config20 chaos serve parity gate failed")
+        if not cs20.get("readmitted", 0) >= 1:
+            _fail(
+                "config20 killed-then-revived host never readmitted "
+                "(router.health.readmitted stayed 0)"
+            )
+        if not cs20.get("p99_ratio", 1e9) <= 3.0:
+            _fail(
+                "config20 chaos p99 inflated past 3x fault-free: "
+                f"ratio {cs20.get('p99_ratio')}"
+            )
+
     # ---- device-kernel microbench (north star evidence) --------------------
     # warm per-kernel device throughput at the bench's shapes, recorded even
     # when end-to-end routing picks host (round-2 verdict missing #2)
@@ -3117,6 +3170,13 @@ def main() -> None:
         compact["shuffle_join_ici_bytes"] = sj19.get("ici_bytes_per_join")
         compact["shuffle_join_parity"] = sj19.get("parity")
         compact["shuffle_join_vs_host_x"] = sj19.get("shuffle_vs_host_x")
+    cs20 = extras.get("chaos_serve", {})
+    if cs20 and "error" not in cs20:
+        # headline failure-domain gates; burst detail stays in the sidecar
+        compact["chaos_serve_failed"] = cs20.get("failed_tickets")
+        compact["chaos_serve_parity"] = cs20.get("parity")
+        compact["chaos_serve_readmitted"] = cs20.get("readmitted")
+        compact["chaos_serve_p99_ratio"] = cs20.get("p99_ratio")
     compact["detail"] = detail_path.name
     line = json.dumps(compact)
     while len(line) > 1900:

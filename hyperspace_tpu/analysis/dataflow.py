@@ -27,7 +27,10 @@ rules (HS015-HS019) run on:
   "jax_enable_x64", True)`` at import, own module or ancestor package
   ``__init__``);
 * **decline facts** — whether a function lexically (or transitively)
-  increments a ``…declined…`` metric, the HS018 "no silent tail" seam.
+  increments a ``…declined…`` metric, the HS018 "no silent tail" seam;
+* **degrade facts** — the wider HS020 seam: whether a function
+  increments ANY degrade-evidence metric (``DEGRADE_NEEDLES`` — lost /
+  retried / hedge / shed / …), the proof a failover branch was counted.
 
 Resolution inherits the project model's contract — conservative, "may
 miss, must not invent": a value the judge cannot classify is host/
@@ -46,6 +49,39 @@ from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 from .core import dotted_name, terminal_name
+
+# metric-name substrings that count as DEGRADE EVIDENCE: a failover or
+# degradation branch that bumps a counter whose name carries any of
+# these is observably counted (HS020). Deliberately broad — the rule's
+# job is to catch SILENT failure branches, not to police naming taste.
+DEGRADE_NEEDLES = (
+    "declined",
+    "degraded",
+    "deferred",
+    "lost",
+    "retr",  # retried / retry / retries
+    "hedge",
+    "failover",
+    "fallback",
+    "shed",
+    "exhausted",
+    "failed",
+    "failure",
+    "rejected",
+    "killed",
+    "crashed",
+    "cancelled",
+    "missed",
+    "probe",
+    "respawn",
+    "revived",
+    "stalled",
+    "recovered",
+    "readmitted",
+    "evicted",
+    "dead",
+    "suspect",
+)
 
 # jax sub-namespaces whose members return HOST values or are infra —
 # calls under these never mint a device array
@@ -142,6 +178,7 @@ class FunctionFlow:
     transfers: List[TransferEvent] = field(default_factory=list)
     traces_bytes: bool = False  # lexical trace.add_bytes call
     declined_incr: bool = False  # lexical metrics.incr("…declined…")
+    degrade_incr: bool = False  # lexical metrics.incr of any degrade-evidence name
     # (line, col, spelling, lexically inside ``with enable_x64``)
     dtype64: List[Tuple[int, int, str, bool]] = field(default_factory=list)
     jit_factories: List[JitFactory] = field(default_factory=list)
@@ -206,6 +243,7 @@ class DeviceFlow:
         self._arg_props: List[Tuple[str, str, FrozenSet[Dep]]] = []
         self._traced_reach: Optional[Set[str]] = None
         self._declined_reach: Optional[Set[str]] = None
+        self._degrade_reach: Optional[Set[str]] = None
         self._x64_covered: Optional[Dict[str, bool]] = None
         self._build()
 
@@ -240,6 +278,17 @@ class DeviceFlow:
                 {q for q, fl in self.flows.items() if fl.declined_incr}
             )
         return self._declined_reach
+
+    def degrade_reach(self) -> Set[str]:
+        """Quals that lexically increment a DEGRADE-EVIDENCE metric
+        (any ``DEGRADE_NEEDLES`` substring — lost/retried/hedge/shed/…)
+        or transitively call a function that does — the set HS020
+        credits a failover branch for reaching."""
+        if self._degrade_reach is None:
+            self._degrade_reach = self._reach_closure(
+                {q for q, fl in self.flows.items() if fl.degrade_incr}
+            )
+        return self._degrade_reach
 
     def _reach_closure(self, seed: Set[str]) -> Set[str]:
         out = set(seed)
@@ -317,6 +366,8 @@ class DeviceFlow:
             out["traces_bytes"] = True
         if fl.declined_incr:
             out["declined_incr"] = True
+        if fl.degrade_incr:
+            out["degrade_incr"] = True
         if fl.dtype64:
             out["dtype64"] = [
                 f"{sp}@{ln}{'(x64)' if x else ''}"
@@ -803,6 +854,10 @@ class _FlowWalker:
         if self.emit and term in ("incr", "counter") and call.args:
             if _str_contains(call.args[0], "declined"):
                 self.flow.declined_incr = True
+            if any(
+                _str_contains(call.args[0], n) for n in DEGRADE_NEEDLES
+            ):
+                self.flow.degrade_incr = True
 
         # jit wrapper: factory fact + jit-callable judgement
         if d in _JIT_WRAPPERS:

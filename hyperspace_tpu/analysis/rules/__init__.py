@@ -31,6 +31,7 @@ from .hs016_recompile_hazard import RecompileHazardRule
 from .hs017_x64_scope import X64ScopeRule
 from .hs018_uncounted_decline import UncountedDeclineRule
 from .hs019_untraced_transfer import UntracedTransferRule
+from .hs020_uncounted_failover import UncountedFailoverRule
 
 REGISTRY: List[Rule] = [
     HostSyncRule(),
@@ -52,6 +53,7 @@ REGISTRY: List[Rule] = [
     X64ScopeRule(),
     UncountedDeclineRule(),
     UntracedTransferRule(),
+    UncountedFailoverRule(),
 ]
 
 __all__ = [
@@ -75,4 +77,5 @@ __all__ = [
     "X64ScopeRule",
     "UncountedDeclineRule",
     "UntracedTransferRule",
+    "UncountedFailoverRule",
 ]

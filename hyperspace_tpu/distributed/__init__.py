@@ -11,7 +11,10 @@ Three layers on top of the single-host mesh story:
 * ``router`` + ``fabric`` — the multi-host tier: the serve-front
   ``QueryRouter`` fans sub-queries to per-host servers and re-merges
   partials; ``QueryFabric`` is the per-process control-plane handle
-  (DCN init, global mesh, bucket→process placement).
+  (DCN init, global mesh, bucket→process placement);
+* ``health``   — the per-host failure-lifecycle state machine (healthy
+  → suspect → dead → probation → readmitted) the router dispatches,
+  hedges, and fails over against.
 
 Imports stay lazy here — the subsystem sits above exec/serve and must
 not force JAX initialization on ``import hyperspace_tpu``.
@@ -20,6 +23,8 @@ not force JAX initialization on ``import hyperspace_tpu``.
 from __future__ import annotations
 
 __all__ = [
+    "HealthDirector",
+    "HealthPolicy",
     "MovementDecision",
     "plan_movement",
     "QueryFabric",
@@ -43,6 +48,10 @@ def __getattr__(name):
         from . import router
 
         return getattr(router, name)
+    if name in ("HealthDirector", "HealthPolicy"):
+        from . import health
+
+        return getattr(health, name)
     if name == "QueryFabric":
         from .fabric import QueryFabric
 

@@ -104,6 +104,37 @@ class QueryFabric:
             if self.owner_process_of_bucket(b) == me
         ]
 
+    # -- serving --------------------------------------------------------------
+    def make_router(
+        self,
+        sessions,
+        serve_config=None,
+        health_policy=None,
+        retry_policy=None,
+        hedging: bool = True,
+    ):
+        """Stand the serve front up over ``{host: session}``: one
+        QueryServer per host session plus the health-directed
+        QueryRouter fronting them — the one assembly path every
+        multi-host serving test, bench config 20, and a real pod share,
+        so the failure-domain wiring (health director, hedges, retry
+        budgets) is never re-plumbed by hand."""
+        from ..serve.server import QueryServer, ServeConfig
+        from .router import QueryRouter
+
+        if not sessions:
+            raise HyperspaceException("make_router needs at least one session.")
+        servers = {
+            name: QueryServer(sess, serve_config or ServeConfig())
+            for name, sess in sessions.items()
+        }
+        return QueryRouter(
+            servers,
+            health_policy=health_policy,
+            retry_policy=retry_policy,
+            hedging=hedging,
+        )
+
     # -- build ---------------------------------------------------------------
     def build_sharded(self, batch, key_names, num_buckets, scratch_dir=None):
         """The multi-controller sharded build, on the fabric's mesh: each

@@ -24,18 +24,38 @@ tenant. Identical in-flight bursts coalesce onto one fan-out per host
 (``router.coalesced``); distinct literals never share a ticket because
 the exact repr participates.
 
-Degradation ladder (docs/16): a dead or fenced host — closed server,
-ticket failed with ``ServerClosed`` — costs ZERO failed tickets while
-any host survives. The router re-issues the lost partition against a
-surviving host's session (shared storage makes every partition host-leg
-readable from anywhere), counts ``router.host_lost``/``router.retried``,
-and freezes a flight-recorder snapshot for the event.
+Failure domains (docs/12 "Distributed failure domains"): dispatch runs
+against the ``HealthDirector`` (distributed/health.py) state machine,
+not the one-way ``closed`` flag —
+
+* a **dead** host's legs are deferred at fan-out and re-issued against
+  survivors (shared storage makes every partition readable from any
+  host's session), at ZERO failed tickets while any host survives;
+* failover runs under the reliability ``RetryPolicy`` deterministic-
+  jitter backoff, every re-submission carries only the REMAINING
+  deadline budget (never the original deadline), and a survivor's
+  ``AdmissionRejected`` is honored for its ``retry_after_s`` instead of
+  stampeding the next host (``router.retry.*``);
+* a **slow** host is hedged: once a leg outlives its host's own tail
+  quantile (``HealthDirector.hedge_delay_s``), the same partition is
+  re-issued on a survivor and the first result wins, the loser's ticket
+  cancelled (``router.hedge.{issued,won,cancelled}``);
+* a **recovered** host is readmitted only through a probation probe leg
+  (the tenancy breaker's half-open discipline at host granularity) —
+  ``router.health.readmitted`` plus a flight-recorder snapshot are the
+  evidence, and ``revive_host`` lets an operator swap a restarted
+  server in for its dead predecessor.
+
+Every lost host freezes a flight-recorder snapshot tagged with the dead
+host AND the surviving placement, so the post-mortem shows where its
+partitions went.
 """
 
 from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -44,15 +64,32 @@ from ..exceptions import HyperspaceException
 from ..parallel.mesh import owner_of_bucket
 from ..plan.aggregates import AggSpec
 from ..plan.ir import Aggregate, LogicalPlan
-from ..serve.server import DEFAULT_TENANT, QueryServer, ServerClosed
+from ..reliability.retry import RetryPolicy
+from ..serve.server import (
+    DEFAULT_TENANT,
+    AdmissionRejected,
+    DeadlineExceeded,
+    QueryServer,
+    ServerClosed,
+)
 from ..storage.columnar import Column, ColumnarBatch
 from ..telemetry.metrics import metrics
 from ..telemetry.recorder import flight_recorder
 from ..telemetry.trace import span
+from .health import HealthDirector, HealthPolicy
 
 __all__ = ["QueryRouter", "RouterTicket"]
 
 Builder = Callable[..., "object"]  # build(session, part_index, n_parts) -> DataFrame
+
+# the failover backoff: quick first retry, bounded tail — leg failover
+# shares the storage tier's deterministic-jitter discipline so a chaos
+# replay reproduces the exact same sleep sequence
+DEFAULT_ROUTER_RETRY = RetryPolicy(
+    max_attempts=3, base_delay_s=0.02, max_delay_s=0.5
+)
+
+_RACE_POLL_S = 0.02  # hedge race poll granularity
 
 
 def _partial_specs(aggs: List[AggSpec]) -> List[AggSpec]:
@@ -160,20 +197,23 @@ class RouterTicket:
 
     def __init__(self, router: "QueryRouter", legs, merge):
         self._router = router
-        self._legs = legs  # [(host, ticket-or-None, part_index)]
+        self._legs = legs  # [(host, ticket-or-None, part_index, is_probe)]
         self._merge = merge  # callable(partials) -> ColumnarBatch
         self._lock = threading.Lock()
         self._result: Optional[ColumnarBatch] = None
         self._error: Optional[BaseException] = None
         self._done = False
+        self._t0 = time.monotonic()  # deadline budget anchors here
 
     def result(self, timeout: Optional[float] = None) -> ColumnarBatch:
         with self._lock:
             if not self._done:
                 try:
                     partials = [
-                        self._router._resolve_leg(host, ticket, part, timeout, self)
-                        for host, ticket, part in self._legs
+                        self._router._resolve_leg(
+                            host, ticket, part, timeout, self, probe
+                        )
+                        for host, ticket, part, probe in self._legs
                     ]
                     self._result = self._merge(partials)
                 except BaseException as e:
@@ -186,7 +226,7 @@ class RouterTicket:
 
     def cancel(self) -> bool:
         ok = True
-        for _, ticket, _ in self._legs:
+        for _, ticket, _, _ in self._legs:
             if ticket is not None:
                 ok = bool(ticket.cancel()) and ok
         return ok
@@ -194,18 +234,33 @@ class RouterTicket:
 
 class QueryRouter:
     """Front router over named per-host QueryServers (insertion order is
-    the partition order: host i executes part_index i of n_parts)."""
+    the partition order: host i executes part_index i of n_parts).
 
-    def __init__(self, hosts: Dict[str, QueryServer]):
+    ``health_policy`` shapes the failure-domain state machine,
+    ``retry_policy`` the failover backoff; ``hedging=False`` disables
+    tail hedges (the A-leg of bench config 20 measures exactly that)."""
+
+    def __init__(
+        self,
+        hosts: Dict[str, QueryServer],
+        health_policy: Optional[HealthPolicy] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        hedging: bool = True,
+    ):
         if not hosts:
             raise HyperspaceException("QueryRouter needs at least one host.")
         self.hosts: Dict[str, QueryServer] = dict(hosts)
+        self.health = HealthDirector(list(self.hosts), policy=health_policy)
+        self._retry_policy = retry_policy or DEFAULT_ROUTER_RETRY
+        self._hedging = bool(hedging)
         self._lock = threading.Lock()
         self._inflight: Dict[tuple, RouterTicket] = {}
         self._tickets: Dict[int, tuple] = {}
         self._submitted = 0
         self._coalesced = 0
         self._hosts_lost = 0
+        self._hedges_issued = 0
+        self._hedges_won = 0
 
     # -- partitioning ---------------------------------------------------------
     def partition_map(self, index_name: Optional[str] = None) -> Dict[str, List[int]]:
@@ -244,7 +299,11 @@ class QueryRouter:
         admission exactly as if the client had walked up to them). The
         builder returns each host's FINAL query; an Aggregate top is
         rewritten to its partial form at dispatch (rewrite_partial) so
-        hosts compute partials and the merge produces the finals."""
+        hosts compute partials and the merge produces the finals.
+
+        Dispatch is health-gated: a known-dead host's leg is deferred to
+        failover without touching it; a dead host whose probation is due
+        gets exactly one leg AS the readmission probe."""
         from ..compile.fingerprint import batch_fingerprint
 
         names = list(self.hosts)
@@ -272,22 +331,52 @@ class QueryRouter:
         with span("router.fanout", hosts=n_parts, tenant=tenant):
             for i, (host, df) in enumerate(sub_plans):
                 server = self.hosts[host]
+                admitted, is_probe = self.health.admit_leg(host)
+                if not admitted:
+                    # known-dead, probation not due: defer straight to
+                    # failover — don't poke a corpse per query
+                    metrics.incr("router.health.deferred")
+                    legs.append((host, None, i, False))
+                    continue
                 if server.closed:
                     # fenced before dispatch: leg resolves via a surviving
                     # host later — no failed ticket
-                    self._note_host_lost(host, "closed_at_submit")
-                    legs.append((host, None, i))
+                    self._host_failed(host, "closed_at_submit", probe=is_probe)
+                    legs.append((host, None, i, False))
                     continue
+                if is_probe and not self._ping_ok(host, server):
+                    legs.append((host, None, i, False))
+                    continue
+                if getattr(df, "session", None) is not server.session:
+                    # the host revived between plan build and dispatch (a
+                    # restarted server is a NEW session): rebuild this
+                    # leg's plan against the session actually serving it
+                    df = build(server.session, i, n_parts)
                 try:
                     ticket = server.submit(
                         self.rewrite_partial(df), deadline_s=deadline_s,
                         tenant=tenant,
                     )
                     metrics.incr("router.subqueries")
-                    legs.append((host, ticket, i))
+                    legs.append((host, ticket, i, is_probe))
                 except ServerClosed:
-                    self._note_host_lost(host, "closed_at_submit")
-                    legs.append((host, None, i))
+                    self._host_failed(host, "closed_at_submit", probe=is_probe)
+                    legs.append((host, None, i, False))
+                except AdmissionRejected:
+                    if is_probe:
+                        # backpressure propagates to the caller by design,
+                        # but the probe slot must not leak with it
+                        self.health.note_failure(
+                            host, "admission_rejected", probe=True
+                        )
+                    raise
+                except Exception:  # noqa: BLE001 - leg must fail over, not fan-out
+                    # an unexpected submit error is leg-local: count it,
+                    # feed the health machine (freeing any probe slot),
+                    # and let the leg re-issue on a survivor
+                    metrics.incr("router.leg.submit_failed")
+                    self.health.note_failure(host, "submit_error", probe=is_probe)
+                    legs.append((host, None, i, False))
 
         rt = RouterTicket(
             self,
@@ -334,15 +423,105 @@ class QueryRouter:
         )
         return type(df)(df.session, partial)
 
+    def _ping_ok(self, host: str, server) -> bool:
+        """The lightweight pre-probe: before spending a real query leg
+        on a probation host, ask its cheap liveness endpoint. A failed
+        ping sends the host straight back to dead without burning
+        anyone's query (hosts without ping — bare duck-typed stand-ins —
+        are probed by the leg itself)."""
+        ping = getattr(server, "ping", None)
+        if ping is None:
+            return True
+        try:
+            ping()
+            return True
+        except ServerClosed:
+            self._host_failed(host, "probe_ping_failed", probe=True)
+            return False
+
     # -- degradation ----------------------------------------------------------
+    def _host_failed(self, host: str, why: str, probe: bool = False) -> None:
+        """An unambiguous host death observed (ServerClosed): record the
+        loss evidence and feed the health state machine."""
+        self._note_host_lost(host, why)
+        self.health.mark_dead(host, why)
+
     def _note_host_lost(self, host: str, why: str) -> None:
         with self._lock:
             self._hosts_lost += 1
+        survivors = self._survivors(host)
         metrics.incr("router.host_lost")
-        flight_recorder.snapshot(f"router_host_lost: {host} ({why})")
+        flight_recorder.snapshot(
+            f"router_host_lost: {host} ({why}) survivors={','.join(survivors) or 'none'}"
+        )
 
     def _survivors(self, dead: str) -> List[str]:
-        return [h for h, s in self.hosts.items() if h != dead and not s.closed]
+        """Hosts eligible to absorb ``dead``'s partitions: open AND not
+        health-dead (a probation host may serve — its leg doubles as the
+        probe)."""
+        return [
+            h
+            for h, s in self.hosts.items()
+            if h != dead and not s.closed and self.health.usable(h)
+        ]
+
+    def revive_host(self, name: str, server: Optional[QueryServer] = None) -> None:
+        """Swap a restarted server in for a dead host (or re-arm the
+        existing entry, e.g. a chaos proxy that revives in place) and
+        make its probation due immediately. The host serves again only
+        after its probe leg succeeds — readmission is earned, not
+        declared."""
+        with self._lock:
+            if server is not None:
+                if name not in self.hosts:
+                    raise HyperspaceException(f"Unknown router host {name!r}.")
+                self.hosts[name] = server
+        metrics.incr("router.health.revive_offered")
+        self.health.note_revived(name)
+
+    def _remaining_s(self, rt: RouterTicket) -> Optional[float]:
+        """The deadline budget LEFT for re-issuing rt's legs: deadline -
+        elapsed, never the original deadline (a retried leg overshooting
+        the caller's deadline was the PR-17 bug). None without a
+        deadline; raises once the budget is spent."""
+        if rt._deadline_s is None:
+            return None
+        rem = rt._deadline_s - (time.monotonic() - rt._t0)
+        if rem <= 0:
+            metrics.incr("router.retry.budget_exhausted")
+            raise DeadlineExceeded(
+                f"retry budget exhausted (deadline {rt._deadline_s:.3f}s spent)."
+            )
+        return rem
+
+    def _leg_wait_s(
+        self, timeout: Optional[float], rt: RouterTicket
+    ) -> Optional[float]:
+        """The tighter of the caller's result() timeout and the remaining
+        deadline budget (None = unbounded). Non-raising: an exhausted
+        budget here surfaces as the server's own DeadlineExceeded."""
+        rem = (
+            None
+            if rt._deadline_s is None
+            else max(rt._deadline_s - (time.monotonic() - rt._t0), 0.001)
+        )
+        if timeout is None:
+            return rem
+        return timeout if rem is None else min(timeout, rem)
+
+    def _sleep_budgeted(self, delay_s: float, rt: RouterTicket) -> None:
+        """Sleep at most ``delay_s``, bounded by the remaining deadline
+        budget and the retry policy's max delay — honoring a survivor's
+        retry_after_s must never itself blow the caller's deadline."""
+        cap = self._retry_policy.max_delay_s
+        rem = (
+            None
+            if rt._deadline_s is None
+            else rt._deadline_s - (time.monotonic() - rt._t0)
+        )
+        d = min(float(delay_s), cap if rem is None else min(rem, cap))
+        if d > 0:
+            time.sleep(d)
 
     def _resolve_leg(
         self,
@@ -351,33 +530,172 @@ class QueryRouter:
         part_index: int,
         timeout: Optional[float],
         rt: RouterTicket,
+        is_probe: bool = False,
     ) -> ColumnarBatch:
-        """One host leg's partial — from its ticket, or re-issued on a
+        """One host leg's partial — from its ticket (hedged once the
+        host outlives its own tail quantile), or re-issued on a
         surviving host when the home host is gone (shared storage makes
         the partition readable from any host's session)."""
-        rt_err: Optional[BaseException] = None
         if ticket is not None:
-            try:
-                return ticket.result(timeout)
-            except ServerClosed as e:
-                self._note_host_lost(host, "closed_in_flight")
-                rt_err = e
+            out = self._await_primary(host, ticket, part_index, timeout, rt, is_probe)
+            if out is not None:
+                return out
+        return self._failover_leg(host, part_index, timeout, rt)
+
+    def _await_primary(
+        self, host, ticket, part_index, timeout, rt, is_probe
+    ) -> Optional[ColumnarBatch]:
+        """Wait on the home host's leg; once its hedge delay lapses,
+        race a duplicate leg on a survivor. Returns None when the leg is
+        LOST (host closed) — the caller then fails over."""
+        t0 = time.monotonic()
+        hedge_delay = self.health.hedge_delay_s(host) if self._hedging else None
+        budget = self._leg_wait_s(timeout, rt)
+        first = hedge_delay if budget is None else (
+            budget if hedge_delay is None else min(hedge_delay, budget)
+        )
+        try:
+            out = ticket.result(first)
+            self.health.note_success(host, time.monotonic() - t0, probe=is_probe)
+            return out
+        except TimeoutError:
+            if hedge_delay is None or (budget is not None and budget <= hedge_delay):
+                raise  # the caller's own wait bound lapsed — not a hedge window
+        except ServerClosed:
+            self._host_failed(host, "closed_in_flight", probe=is_probe)
+            return None
+        return self._race_hedge(host, ticket, part_index, timeout, rt, is_probe, t0)
+
+    def _issue_hedge(self, host, part_index, rt):
+        """The duplicate leg on the first usable survivor. Returns
+        (alt_host, ticket) or (None, None) when nobody can take it —
+        hedging is opportunistic; declining it costs only latency."""
         for alt in self._survivors(host):
             server = self.hosts[alt]
-            df = self.rewrite_partial(
-                rt._build(server.session, part_index, len(self.hosts))
-            )
             try:
-                alt_ticket = server.submit(
-                    df, deadline_s=rt._deadline_s, tenant=rt._tenant
+                remaining = self._remaining_s(rt)
+                df = self.rewrite_partial(
+                    rt._build(server.session, part_index, len(self.hosts))
                 )
-                metrics.incr("router.retried")
+                with span("router.hedge", host=host, alt=alt, part=part_index):
+                    hedge_ticket = server.submit(
+                        df, deadline_s=remaining, tenant=rt._tenant
+                    )
+                with self._lock:
+                    self._hedges_issued += 1
+                metrics.incr("router.hedge.issued")
                 metrics.incr("router.subqueries")
-                return alt_ticket.result(timeout)
+                return alt, hedge_ticket
             except ServerClosed:
-                self._note_host_lost(alt, "closed_in_flight")
-                continue
-        raise rt_err or ServerClosed(
+                self._host_failed(alt, "closed_at_hedge")
+            except AdmissionRejected:
+                # survivor is loaded: a hedge is optional work, never
+                # worth waiting for — decline and keep the primary
+                metrics.incr("router.hedge.declined")
+        return None, None
+
+    def _race_hedge(
+        self, host, primary, part_index, timeout, rt, is_probe, t0
+    ) -> Optional[ColumnarBatch]:
+        """First result between the slow primary and its hedge wins; the
+        loser is cancelled. A primary that loses its hedge counts as a
+        soft health failure (that's how a merely-slow host drifts to
+        suspect). Returns None only when every racer died (→ failover)."""
+        alt, hedge_ticket = self._issue_hedge(host, part_index, rt)
+        budget = self._leg_wait_s(timeout, rt)
+        deadline_at = None if budget is None else t0 + budget
+        # [host, ticket, is_probe, is_primary]
+        entries = [[host, primary, is_probe, True]]
+        if hedge_ticket is not None:
+            entries.append([alt, hedge_ticket, False, False])
+        while entries:
+            for ent in list(entries):
+                h, t, probe, is_primary = ent
+                try:
+                    out = t.result(_RACE_POLL_S)
+                except TimeoutError:
+                    if (
+                        deadline_at is not None
+                        and time.monotonic() > deadline_at
+                    ):
+                        raise TimeoutError("query still in flight")
+                    continue
+                except ServerClosed:
+                    self._host_failed(h, "closed_in_flight", probe=probe)
+                    entries.remove(ent)
+                    continue
+                except BaseException:
+                    # a genuine QUERY failure: the same plan would fail
+                    # anywhere — cancel the other racer and propagate
+                    for other in entries:
+                        if other is not ent:
+                            other[1].cancel()
+                    raise
+                for other in entries:
+                    if other is not ent:
+                        other[1].cancel()
+                        metrics.incr("router.hedge.cancelled")
+                if not is_primary:
+                    with self._lock:
+                        self._hedges_won += 1
+                    metrics.incr("router.hedge.won")
+                    # the primary lost its own hedge: a soft strike —
+                    # consistently slow hosts drift to suspect/dead
+                    self.health.note_failure(host, "lost_hedge", probe=is_probe)
+                self.health.note_success(h, time.monotonic() - t0, probe=probe)
+                return out
+        return None  # every racer died mid-flight
+
+    def _failover_leg(
+        self, host, part_index, timeout, rt
+    ) -> ColumnarBatch:
+        """Re-issue a lost leg on survivors under the RETRY BUDGET:
+        deterministic-jitter backoff between sweeps (seeded by host and
+        partition, so a chaos replay sleeps identically), each
+        re-submission carrying only the remaining deadline, and a
+        survivor's AdmissionRejected honored for its retry_after_s
+        instead of stampeding the next host."""
+        policy = self._retry_policy
+        attempts = max(policy.max_attempts, 1)
+        last_err: Optional[BaseException] = None
+        for attempt in range(1, attempts + 1):
+            for alt in self._survivors(host):
+                server = self.hosts[alt]
+                try:
+                    remaining = self._remaining_s(rt)
+                    df = self.rewrite_partial(
+                        rt._build(server.session, part_index, len(self.hosts))
+                    )
+                    t0 = time.monotonic()
+                    with span(
+                        "router.failover", host=host, alt=alt, part=part_index
+                    ):
+                        alt_ticket = server.submit(
+                            df, deadline_s=remaining, tenant=rt._tenant
+                        )
+                        metrics.incr("router.retried")
+                        metrics.incr("router.subqueries")
+                        out = alt_ticket.result(self._leg_wait_s(timeout, rt))
+                    self.health.note_success(alt, time.monotonic() - t0)
+                    return out
+                except ServerClosed as e:
+                    self._host_failed(alt, "closed_in_flight")
+                    last_err = e
+                except AdmissionRejected as e:
+                    # the survivor said WHEN it has room — wait that out
+                    # (budget-bounded) rather than hammering the next
+                    # host with the same burst
+                    last_err = e
+                    metrics.incr("router.retry.admission_wait")
+                    self._sleep_budgeted(e.retry_after_s, rt)
+            if attempt >= attempts:
+                break
+            metrics.incr("router.retry.backoff")
+            self._sleep_budgeted(
+                policy.delay_for(attempt, seed_key=f"{host}:{part_index}"), rt
+            )
+        metrics.incr("router.retry.exhausted")
+        raise last_err or ServerClosed(
             f"no surviving host to serve partition {part_index}."
         )
 
@@ -405,5 +723,8 @@ class QueryRouter:
                 "submitted": self._submitted,
                 "coalesced": self._coalesced,
                 "hosts_lost": self._hosts_lost,
+                "hedges_issued": self._hedges_issued,
+                "hedges_won": self._hedges_won,
                 "inflight": len(self._inflight),
+                "health": self.health.stats(),
             }

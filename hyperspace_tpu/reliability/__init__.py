@@ -10,11 +10,15 @@ Four pieces, layered over the FileSystem seam and the Action protocol:
                  head + expired lease) and crash-litter sweeping;
 * ``doctor``   — fsck over index directories (log-chain integrity, data
                  presence, orphan reporting/vacuum);
-* ``faults``   — deterministic fault injection for the chaos harness.
+* ``faults``   — deterministic fault injection for the chaos harness;
+* ``chaos``    — the same replayable-schedule discipline one tier up:
+                 scheduled host crash / stall / flap / slow faults at
+                 the serve boundary (bench config 20's FaultPlan).
 
 See docs/12-reliability.md for the protocol walk-through.
 """
 
+from .chaos import ChaosHostProxy, FaultPlan, HostFault
 from .doctor import DoctorReport, Issue, doctor
 from .faults import FaultInjectingFileSystem, FaultRule, InjectedCrash, crash_at
 from .lease import DEFAULT_LEASE_DURATION_S, HeldLease, LeaseManager, LeaseRecord
@@ -33,11 +37,14 @@ from .retry import (
 )
 
 __all__ = [
+    "ChaosHostProxy",
     "DEFAULT_LEASE_DURATION_S",
     "DEFAULT_RETRY_POLICY",
     "DoctorReport",
     "FaultInjectingFileSystem",
+    "FaultPlan",
     "FaultRule",
+    "HostFault",
     "HeldLease",
     "InjectedCrash",
     "Issue",

@@ -324,6 +324,17 @@ class QueryServer:
         for t in workers:
             t.join(timeout_s)
 
+    def ping(self) -> dict:
+        """Lightweight liveness probe (the router's health director
+        calls this before spending a real query on a probation probe):
+        no queue, no planning — just the closed flag and pool size under
+        the lock. Raises ServerClosed on a closed server so probes
+        observe death exactly the way query legs do."""
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("query server is closed.")
+            return {"workers": len(self._workers), "queue_depth": self._depth}
+
     # -- tenancy -------------------------------------------------------------
     def _tenant_locked(self, name: str) -> TenantState:
         t = self._tenants.get(name)
@@ -688,7 +699,7 @@ class QueryServer:
                     # close() won the race since the snapshot above: no
                     # replacement needed, and the ORIGINAL kill cause
                     # must stay the exception this thread dies with
-                    pass
+                    metrics.incr("serve.worker.respawn_declined")
             raise
 
     def _worker_loop_inner(self) -> None:

@@ -1,0 +1,246 @@
+"""Chaos-serve A/B: the same query burst fault-free vs under a
+deterministic host-fault schedule (bench config 20).
+
+Run by bench.py as a subprocess. Two 'hosts' are two QueryServers over
+sessions sharing one set of source files and one index log — the
+shared-storage contract the router's failover rides on. Leg A runs a
+burst through a clean two-host router and records per-query latency.
+Leg B runs the IDENTICAL burst with host b wrapped in a ChaosHostProxy
+under a FaultPlan that flaps it twice (dead → revived → must be
+readmitted through a probation probe → dead again) and injects a slow
+window hedging has to beat.
+
+The claims this config hard-gates (in bench.py):
+
+* zero failed tickets — every query in the chaos burst answers;
+* parity — every chaos-burst answer equals the fault-free oracle;
+* ``readmitted`` >= 1 — the killed-then-revived host observably came
+  back through the probation probe, not by assumption;
+* ``p99_ratio`` <= 3.0 — chaos p99 over fault-free p99 (denominator
+  floored at 50ms so a very fast clean burst cannot make the ratio
+  meaninglessly strict).
+
+Prints ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["HYPERSPACE_TPU_COMPILE_CACHE"] = "off"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from hyperspace_tpu.ops import ensure_x64  # noqa: E402
+
+ensure_x64()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+P99_FLOOR_S = 0.05  # ratio denominator floor: see module docstring
+
+
+def _p99(latencies):
+    xs = sorted(latencies)
+    return xs[min(len(xs) - 1, max(int(len(xs) * 0.99) - 1, 0))]
+
+
+def main() -> None:
+    n_rows = int(os.environ.get("CHAOS_SERVE_ROWS", 48_000))
+    n_queries = int(os.environ.get("CHAOS_SERVE_QUERIES", 36))
+    split = n_rows // 3
+
+    from pathlib import Path
+
+    from hyperspace_tpu import constants as Cns
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.distributed import QueryRouter
+    from hyperspace_tpu.distributed.health import HealthPolicy
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.plan.aggregates import agg_count, agg_max, agg_sum
+    from hyperspace_tpu.plan.expr import col, lit
+    from hyperspace_tpu.reliability.chaos import FaultPlan, HostFault
+    from hyperspace_tpu.reliability.retry import RetryPolicy
+    from hyperspace_tpu.serve import QueryServer, ServeConfig
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+    from hyperspace_tpu.storage.columnar import ColumnarBatch
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    rng = np.random.default_rng(0)
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, n_rows // 2, n_rows).astype(np.int64),
+            "v": rng.integers(-500, 1000, n_rows).astype(np.int64),
+            "g": rng.integers(0, 40, n_rows).astype(np.int64),
+        }
+    )
+    ws = tempfile.mkdtemp(prefix="hs_chaos_serve_")
+    src = Path(ws) / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+
+    def make_session():
+        conf = HyperspaceConf(
+            {Cns.INDEX_SYSTEM_PATH: str(Path(ws) / "indexes"),
+             Cns.INDEX_NUM_BUCKETS: 8}
+        )
+        return HyperspaceSession(conf)
+
+    session_a = make_session()
+    Hyperspace(session_a).create_index(
+        session_a.read.parquet(str(src)), IndexConfig("cidx", ["k"], ["v", "g"])
+    )
+    session_a.enable_hyperspace()
+
+    def builder(session, part_index, n_parts):
+        df = session.read.parquet(str(src))
+        df = (
+            df.filter(col("k") < lit(split))
+            if part_index == 0
+            else df.filter(col("k") >= lit(split))
+        )
+        return df.group_by("g").agg(
+            agg_sum("v", "sv"), agg_count(None, "n"), agg_max("v", "mx")
+        )
+
+    def rows(b):
+        return sorted(
+            zip(
+                b.columns["g"].data.tolist(),
+                b.columns["sv"].data.tolist(),
+                b.columns["n"].data.tolist(),
+                b.columns["mx"].data.tolist(),
+            )
+        )
+
+    oracle = rows(
+        session_a.read.parquet(str(src))
+        .group_by("g")
+        .agg(agg_sum("v", "sv"), agg_count(None, "n"), agg_max("v", "mx"))
+        .collect()
+    )
+
+    health = HealthPolicy(
+        probation_cooldown_s=0.04,
+        hedge_min_samples=4,
+        hedge_min_delay_s=0.02,
+        hedge_max_delay_s=0.25,
+    )
+    retry = RetryPolicy(max_attempts=3, base_delay_s=0.01, max_delay_s=0.1)
+
+    def burst(router, count, warmup=3):
+        """Sequential burst; per-query wall latency; failures COUNTED,
+        not raised — 'zero failed tickets' must be a measurement."""
+        failed = 0
+        lat = []
+        all_parity = True
+        for _ in range(warmup):
+            router.submit(builder).result(timeout=300)
+        for q in range(count):
+            t0 = time.perf_counter()
+            try:
+                got = router.submit(builder).result(timeout=300)
+                lat.append(time.perf_counter() - t0)
+                if rows(got) != oracle:
+                    all_parity = False
+            except Exception as e:  # noqa: BLE001 - counting, not masking
+                failed += 1
+                lat.append(time.perf_counter() - t0)
+                print(f"query {q} failed: {e!r}", file=sys.stderr)
+            time.sleep(0.02)  # let outage/probation clocks advance
+        return failed, lat, all_parity
+
+    # -- leg A: fault-free oracle burst --------------------------------------
+    router_clean = QueryRouter(
+        {
+            "a": QueryServer(session_a, ServeConfig(max_workers=2)),
+            "b": QueryServer(_enabled(make_session()), ServeConfig(max_workers=2)),
+        },
+        health_policy=health,
+        retry_policy=retry,
+    ).start()
+    clean_failed, clean_lat, clean_parity = burst(router_clean, n_queries)
+    router_clean.close()
+
+    # -- leg B: the same burst under the fault schedule ----------------------
+    # flap twice (second death AFTER the readmission the gate demands) and
+    # open a slow window hedging must beat; all three keyed to host b's own
+    # submission counter — replayable by construction
+    plan = FaultPlan(
+        [
+            HostFault("flap", "b", at_query=6, duration_s=0.25),
+            HostFault("slow", "b", at_query=14, delay_s=0.3, times=2),
+            HostFault("flap", "b", at_query=22, duration_s=0.25),
+        ]
+    )
+    readmitted0 = metrics.counter("router.health.readmitted")
+    hedged0 = metrics.counter("router.hedge.issued")
+    won0 = metrics.counter("router.hedge.won")
+    retried0 = metrics.counter("router.retried")
+    chaos_hosts = plan.wrap(
+        {
+            "a": lambda: QueryServer(_enabled(make_session()),
+                                     ServeConfig(max_workers=2)),
+            "b": lambda: QueryServer(_enabled(make_session()),
+                                     ServeConfig(max_workers=2)),
+        }
+    )
+    router_chaos = QueryRouter(
+        chaos_hosts, health_policy=health, retry_policy=retry
+    ).start()
+    chaos_failed, chaos_lat, chaos_parity = burst(router_chaos, n_queries)
+    stats = router_chaos.stats()
+    router_chaos.close()
+
+    clean_p99 = _p99(clean_lat)
+    chaos_p99 = _p99(chaos_lat)
+    b_health = stats["health"]["b"]
+
+    import shutil
+
+    shutil.rmtree(ws, ignore_errors=True)
+    print(
+        json.dumps(
+            {
+                "rows": n_rows,
+                "queries": n_queries,
+                "failed_tickets": int(clean_failed + chaos_failed),
+                "parity": bool(clean_parity and chaos_parity),
+                "clean_p99_s": round(clean_p99, 4),
+                "chaos_p99_s": round(chaos_p99, 4),
+                "p99_ratio": round(chaos_p99 / max(clean_p99, P99_FLOOR_S), 3),
+                "readmitted": int(
+                    metrics.counter("router.health.readmitted") - readmitted0
+                ),
+                "deaths_b": int(b_health["deaths"]),
+                "crashes_injected": int(chaos_hosts["b"].crashes),
+                "revivals": int(chaos_hosts["b"].revivals),
+                "hedges_issued": int(
+                    metrics.counter("router.hedge.issued") - hedged0
+                ),
+                "hedges_won": int(metrics.counter("router.hedge.won") - won0),
+                "failovers": int(metrics.counter("router.retried") - retried0),
+            }
+        )
+    )
+
+
+def _enabled(session):
+    session.enable_hyperspace()
+    return session
+
+
+if __name__ == "__main__":
+    main()

@@ -2084,3 +2084,109 @@ def test_hs019_suppressed():
     }
     found = [f for f in run_project(sources) if f.code == "HS019"]
     assert [f.suppressed for f in found] == [True]
+
+
+# --- HS020: failover/degradation branch with no degrade counter -------------
+
+
+_HS020_TEL = """
+class _M:
+    def incr(self, name, n=1):
+        pass
+
+metrics = _M()
+"""
+
+
+def test_hs020_fires_on_silent_failover_absorption():
+    sources = {
+        "pkg/distributed/router.py": """
+        from ..tel import metrics
+
+        class ServerClosed(Exception):
+            pass
+
+        def resolve(ticket, survivors):
+            try:
+                return ticket.result()
+            except ServerClosed:
+                return survivors[0].retry()
+        """,
+        "pkg/tel.py": _HS020_TEL,
+    }
+    found = [f for f in run_project(sources) if f.code == "HS020"]
+    assert len(found) == 1
+    assert "ServerClosed" in found[0].message
+
+
+def test_hs020_counted_helper_counted_and_reraise_are_clean():
+    sources = {
+        "pkg/distributed/router.py": """
+        from ..tel import metrics
+
+        class ServerClosed(Exception):
+            pass
+
+        class AdmissionRejected(Exception):
+            pass
+
+        def _note_lost(host):
+            metrics.incr("router.host_lost")
+
+        def resolve(ticket, survivors):
+            try:
+                return ticket.result()
+            except ServerClosed:
+                _note_lost("a")  # counts via the helper closure
+                return survivors[0].retry()
+            except TimeoutError:
+                metrics.incr("router.retry.backoff")
+                return None
+            except AdmissionRejected:
+                raise
+        """,
+        "pkg/tel.py": _HS020_TEL,
+    }
+    assert codes(run_project(sources), "HS020") == []
+
+
+def test_hs020_out_of_scope_modules_and_exceptions_are_ignored():
+    # same silent absorption, but neither in distributed/ nor serve/ —
+    # and a non-failure exception inside the scoped tree
+    sources = {
+        "pkg/storage/io.py": """
+        class ServerClosed(Exception):
+            pass
+
+        def read(fs):
+            try:
+                return fs.read()
+            except ServerClosed:
+                return None
+        """,
+        "pkg/serve/util.py": """
+        def parse(s):
+            try:
+                return int(s)
+            except ValueError:
+                return None
+        """,
+    }
+    assert codes(run_project(sources), "HS020") == []
+
+
+def test_hs020_tuple_handlers_and_suppression():
+    sources = {
+        "pkg/serve/client.py": """
+        class AdmissionRejected(Exception):
+            pass
+
+        def call(server):
+            try:
+                return server.submit()
+            except (AdmissionRejected, TimeoutError):  # hslint: disable=HS020 - fixture
+                return None
+        """,
+    }
+    found = [f for f in run_project(sources) if f.code == "HS020"]
+    assert [f.suppressed for f in found] == [True]

@@ -1,0 +1,578 @@
+"""Failure-domain hardening of the router (distributed/health.py +
+reliability/chaos.py): the host state machine (healthy → suspect → dead
+→ probation → readmitted), hedged legs against slow hosts, retry
+budgets (remaining deadline, AdmissionRejected retry_after honored),
+and the deterministic host-tier chaos harness driving it all.
+
+The e2e fixtures mirror tests/test_router.py: two 'hosts' are two
+QueryServers over two sessions sharing the same source files and index
+storage — any partition readable from any host."""
+
+import time
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.distributed import QueryFabric, QueryRouter
+from hyperspace_tpu.distributed.health import (
+    DEAD,
+    HEALTHY,
+    PROBATION,
+    SUSPECT,
+    HealthDirector,
+    HealthPolicy,
+)
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.aggregates import agg_count, agg_sum
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.reliability.chaos import ChaosHostProxy, FaultPlan, HostFault
+from hyperspace_tpu.reliability.retry import RetryPolicy
+from hyperspace_tpu.serve import QueryServer, ServeConfig
+from hyperspace_tpu.serve.server import AdmissionRejected, DeadlineExceeded, ServerClosed
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+from hyperspace_tpu.telemetry.recorder import flight_recorder
+
+N = 16_000
+SPLIT = 8_000
+
+
+# === HealthDirector unit tests (fake clock, no servers) =====================
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _director(**kw):
+    clock = _Clock()
+    policy = HealthPolicy(
+        suspect_after=1, dead_after=2, probation_cooldown_s=10.0, **kw
+    )
+    return HealthDirector(["a", "b"], policy=policy, clock=clock), clock
+
+
+def test_health_lifecycle_dead_probation_readmitted():
+    d, clock = _director()
+    before = metrics.counter("router.health.readmitted")
+    assert d.state("a") == HEALTHY and d.admit_leg("a") == (True, False)
+
+    d.note_failure("a", "lost_hedge")
+    assert d.state("a") == SUSPECT
+    d.note_failure("a", "lost_hedge")
+    assert d.state("a") == DEAD and not d.usable("a")
+    # dead: no legs before the cooldown
+    assert d.admit_leg("a") == (False, False)
+
+    clock.t += 11.0
+    assert d.admit_leg("a") == (True, True)  # this leg IS the probe
+    assert d.state("a") == PROBATION
+    # one probe at a time — the half-open discipline
+    assert d.admit_leg("a") == (False, False)
+
+    d.note_success("a", 0.02, probe=True)
+    assert d.state("a") == HEALTHY and d.usable("a")
+    assert d.stats()["a"]["readmissions"] == 1
+    assert metrics.counter("router.health.readmitted") == before + 1
+    # readmission froze flight-recorder evidence
+    assert any(
+        s["reason"].startswith("router_host_readmitted: a")
+        for s in flight_recorder.snapshots()
+    )
+
+
+def test_health_probe_failure_restarts_the_cooldown():
+    d, clock = _director()
+    d.mark_dead("b", "closed_in_flight")
+    assert d.state("b") == DEAD
+    clock.t += 11.0
+    assert d.admit_leg("b") == (True, True)
+    d.note_failure("b", "closed_in_flight", probe=True)
+    assert d.state("b") == DEAD
+    assert d.stats()["b"]["probe_failures"] == 1
+    # fresh cooldown: not admitted until ANOTHER full probation wait
+    assert d.admit_leg("b") == (False, False)
+    clock.t += 11.0
+    assert d.admit_leg("b") == (True, True)
+
+
+def test_health_success_resets_streak_and_recovers_suspect():
+    d, _ = _director()
+    d.note_failure("a", "x")
+    assert d.state("a") == SUSPECT
+    d.note_success("a", 0.01)
+    assert d.state("a") == HEALTHY
+    # the streak reset: one more failure is suspect again, not dead
+    d.note_failure("a", "x")
+    assert d.state("a") == SUSPECT
+
+
+def test_hedge_delay_is_the_hosts_own_tail_quantile():
+    d, _ = _director(hedge_min_samples=4, hedge_min_delay_s=0.001,
+                     hedge_max_delay_s=0.5)
+    assert d.hedge_delay_s("a") is None  # no evidence, no hedging
+    for lat in (0.010, 0.011, 0.012, 0.200):
+        d.note_success("a", lat)
+    delay = d.hedge_delay_s("a")
+    assert delay == pytest.approx(0.200)  # p95 of 4 samples = the max
+    # clamped by the policy ceiling
+    d2, _ = _director(hedge_min_samples=1, hedge_max_delay_s=0.05)
+    d2.note_success("a", 3.0)
+    assert d2.hedge_delay_s("a") == pytest.approx(0.05)
+
+
+def test_mark_dead_is_idempotent_and_keeps_first_death_time():
+    d, clock = _director()
+    d.mark_dead("a", "one")
+    clock.t += 6.0
+    d.mark_dead("a", "two")  # re-marking must NOT restart the cooldown
+    clock.t += 5.0  # 11s after the FIRST death
+    assert d.admit_leg("a") == (True, True)
+
+
+# === chaos harness unit tests (fake server, deterministic schedule) =========
+
+
+class _FakeTicket:
+    def __init__(self, tag):
+        self.tag = tag
+
+    def done(self):
+        return True
+
+    def result(self, timeout=None):
+        return self.tag
+
+    def cancel(self):
+        return False
+
+
+class _FakeServer:
+    def __init__(self, log):
+        self._closed = False
+        self.log = log
+
+    @property
+    def session(self):
+        return None
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def submit(self, df, deadline_s=None, tenant="default"):
+        if self._closed:
+            raise ServerClosed("fake server closed")
+        self.log.append(df)
+        return _FakeTicket(df)
+
+    def start(self):
+        return self
+
+    def close(self, timeout_s=10.0):
+        self._closed = True
+
+    def ping(self):
+        if self._closed:
+            raise ServerClosed("fake server closed")
+        return {}
+
+
+def test_chaos_crash_fires_at_the_scheduled_submission_and_is_replayable():
+    def run_once():
+        log = []
+        plan = FaultPlan([HostFault("crash", "h", at_query=2)])
+        proxy = ChaosHostProxy("h", lambda: _FakeServer(log), plan.for_host("h"))
+        seen = []
+        for q in range(5):
+            try:
+                proxy.submit(f"q{q}")
+                seen.append("ok")
+            except ServerClosed:
+                seen.append("closed")
+        return seen
+
+    first, second = run_once(), run_once()
+    # submissions 0,1 pass; #2 triggers the crash; a crash is permanent
+    assert first == ["ok", "ok", "closed", "closed", "closed"]
+    assert second == first  # same plan, same sequence — replayable
+
+
+def test_chaos_flap_revives_through_the_factory():
+    log = []
+    made = []
+
+    def factory():
+        s = _FakeServer(log)
+        made.append(s)
+        return s
+
+    plan = FaultPlan([HostFault("flap", "h", at_query=1, duration_s=0.05)])
+    proxy = ChaosHostProxy("h", factory, plan.for_host("h"))
+    proxy.submit("q0")
+    with pytest.raises(ServerClosed):
+        proxy.submit("q1")  # the flap
+    assert proxy.closed
+    time.sleep(0.08)
+    assert not proxy.closed  # lazily revived past the outage...
+    assert len(made) == 2  # ...through a FRESH server, like a restart
+    assert proxy.submit("q2").result() == "q2"
+    assert proxy.revivals == 1
+
+
+def test_chaos_slow_and_stall_withhold_real_results():
+    log = []
+    plan = FaultPlan(
+        [HostFault("slow", "h", at_query=1, delay_s=0.08, times=1)]
+    )
+    proxy = ChaosHostProxy("h", lambda: _FakeServer(log), plan.for_host("h"))
+    assert proxy.submit("q0").result() == "q0"  # before the window: instant
+    t1 = proxy.submit("q1")
+    assert not t1.done()
+    with pytest.raises(TimeoutError):
+        t1.result(timeout=0.01)
+    assert t1.result(timeout=1.0) == "q1"  # the real result, just late
+    assert proxy.submit("q2").result() == "q2"  # times=1: window over
+
+    plan2 = FaultPlan([HostFault("stall", "h", at_query=0, duration_s=0.06)])
+    proxy2 = ChaosHostProxy("h", lambda: _FakeServer(log), plan2.for_host("h"))
+    t = proxy2.submit("s0")
+    assert not t.done()
+    assert t.result(timeout=1.0) == "s0"
+
+
+# === e2e over real servers ==================================================
+
+
+def _source(n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 16_000, n).astype(np.int64),
+            "v": rng.integers(-500, 1000, n).astype(np.int64),
+            "g": rng.integers(0, 20, n).astype(np.int64),
+        }
+    )
+
+
+@pytest.fixture
+def env(tmp_path):
+    batch = _source()
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+
+    def make_session():
+        conf = HyperspaceConf(
+            {C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+             C.INDEX_NUM_BUCKETS: 8}
+        )
+        return HyperspaceSession(conf)
+
+    session_a = make_session()
+    hs = Hyperspace(session_a)
+    hs.create_index(
+        session_a.read.parquet(str(src)), IndexConfig("ridx", ["k"], ["v", "g"])
+    )
+    session_a.enable_hyperspace()
+    session_b = make_session()
+    session_b.enable_hyperspace()
+    return session_a, session_b, src, batch
+
+
+def _agg_builder(src):
+    def build(session, part_index, n_parts):
+        df = session.read.parquet(str(src))
+        df = (
+            df.filter(col("k") < lit(SPLIT))
+            if part_index == 0
+            else df.filter(col("k") >= lit(SPLIT))
+        )
+        return df.group_by("g").agg(agg_sum("v", "sv"), agg_count(None, "n"))
+    return build
+
+
+def _expected(session, src):
+    got = (
+        session.read.parquet(str(src))
+        .group_by("g")
+        .agg(agg_sum("v", "sv"), agg_count(None, "n"))
+        .collect()
+    )
+    return sorted(
+        zip(
+            got.columns["g"].data.tolist(),
+            got.columns["sv"].data.tolist(),
+            got.columns["n"].data.tolist(),
+        )
+    )
+
+
+def _rows(batch):
+    return sorted(
+        zip(
+            batch.columns["g"].data.tolist(),
+            batch.columns["sv"].data.tolist(),
+            batch.columns["n"].data.tolist(),
+        )
+    )
+
+
+def test_router_readmits_flapping_host_with_zero_failed_tickets(env):
+    """The satellite scenario: host b dies mid-burst, is readmitted via
+    a probation probe once its replacement comes up, then dies AGAIN —
+    the burst completes with zero failed tickets and the readmission is
+    observable in metrics, health stats, and the flight recorder."""
+    session_a, session_b, src, batch = env
+    plan = FaultPlan(
+        [
+            HostFault("flap", "b", at_query=1, duration_s=0.2),
+            HostFault("flap", "b", at_query=4, duration_s=0.2),
+        ]
+    )
+    hosts = {
+        "a": QueryServer(session_a, ServeConfig(max_workers=2)),
+        "b": ChaosHostProxy(
+            "b",
+            lambda: QueryServer(session_b, ServeConfig(max_workers=2)),
+            plan.for_host("b"),
+        ),
+    }
+    router = QueryRouter(
+        hosts,
+        health_policy=HealthPolicy(probation_cooldown_s=0.05),
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                                 max_delay_s=0.1),
+    ).start()
+    flight_recorder.reset()
+    before_readmit = metrics.counter("router.health.readmitted")
+    expected = _expected(session_a, src)
+    try:
+        for q in range(14):
+            ticket = router.submit(_agg_builder(src))
+            got = ticket.result(timeout=120)  # any failure fails the test
+            assert _rows(got) == expected, f"query {q} lost rows"
+            time.sleep(0.06)  # let the outage/probation clocks advance
+    finally:
+        stats = router.stats()
+        router.close()
+    assert metrics.counter("router.health.readmitted") >= before_readmit + 1
+    b = stats["health"]["b"]
+    assert b["readmissions"] >= 1
+    assert b["deaths"] >= 2  # died, came back, died again
+    reasons = [s["reason"] for s in flight_recorder.snapshots()]
+    assert any(r.startswith("router_host_dead: b") for r in reasons)
+    assert any(r.startswith("router_host_readmitted: b") for r in reasons)
+    # the dead-host snapshot names the surviving placement (satellite 2)
+    assert any(
+        r.startswith("router_host_lost: b") and "survivors=a" in r
+        for r in reasons
+    )
+
+
+def test_router_hedges_a_slow_host_and_takes_the_first_result(env):
+    """A slow (not dead) host: once its leg outlives the host's own tail
+    quantile the router re-issues it on the survivor and merges the
+    winner — the burst never waits out the injected stall."""
+    session_a, session_b, src, batch = env
+    plan = FaultPlan(
+        [HostFault("slow", "b", at_query=3, delay_s=1.0, times=1)]
+    )
+    hosts = {
+        "a": QueryServer(session_a, ServeConfig(max_workers=2)),
+        "b": ChaosHostProxy(
+            "b",
+            lambda: QueryServer(session_b, ServeConfig(max_workers=2)),
+            plan.for_host("b"),
+        ),
+    }
+    router = QueryRouter(
+        hosts,
+        health_policy=HealthPolicy(
+            hedge_min_samples=2, hedge_min_delay_s=0.01, hedge_max_delay_s=0.1
+        ),
+    ).start()
+    before_issued = metrics.counter("router.hedge.issued")
+    before_won = metrics.counter("router.hedge.won")
+    expected = _expected(session_a, src)
+    try:
+        t0 = time.monotonic()
+        for q in range(5):  # q==3 is the slow one on host b
+            got = router.submit(_agg_builder(src)).result(timeout=120)
+            assert _rows(got) == expected, f"query {q} lost rows"
+        elapsed = time.monotonic() - t0
+    finally:
+        stats = router.stats()
+        router.close()
+    assert metrics.counter("router.hedge.issued") >= before_issued + 1
+    assert metrics.counter("router.hedge.won") >= before_won + 1
+    assert stats["hedges_won"] >= 1
+    # the hedge rescued the burst from the 1s injection
+    assert elapsed < 4.0
+    # losing its own hedge is a soft strike: b drifted toward suspect
+    assert stats["health"]["b"]["state"] in (SUSPECT, HEALTHY)
+
+
+class _RecordingHost:
+    """Duck-typed host wrapper that records the deadline every
+    submission carries — the observability seam for the retry-budget
+    assertions."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.deadlines = []
+
+    @property
+    def session(self):
+        return self.inner.session
+
+    @property
+    def closed(self):
+        return self.inner.closed
+
+    def submit(self, df, deadline_s=None, tenant="default"):
+        self.deadlines.append(deadline_s)
+        return self.inner.submit(df, deadline_s=deadline_s, tenant=tenant)
+
+    def start(self):
+        self.inner.start()
+        return self
+
+    def close(self, timeout_s=10.0):
+        self.inner.close(timeout_s)
+
+
+def test_failover_resubmits_with_the_remaining_deadline_budget(env):
+    """Satellite fix: a re-issued leg carries deadline - elapsed, never
+    the caller's full original deadline."""
+    session_a, session_b, src, batch = env
+    rec = _RecordingHost(QueryServer(session_a, ServeConfig(max_workers=2)))
+    hosts = {
+        "a": rec,
+        "b": QueryServer(session_b, ServeConfig(max_workers=2, autostart=False)),
+    }
+    router = QueryRouter(hosts).start()
+    try:
+        router.hosts["b"].close()
+        ticket = router.submit(_agg_builder(src), deadline_s=30.0)
+        time.sleep(0.4)  # burn budget between fan-out and resolution
+        got = ticket.result(timeout=120)
+        assert _rows(got) == _expected(session_a, src)
+    finally:
+        router.close()
+    # submission 0 = a's own leg (full deadline), 1 = b's failed-over leg
+    assert rec.deadlines[0] == pytest.approx(30.0)
+    assert rec.deadlines[1] is not None and rec.deadlines[1] < 29.7
+    assert rec.deadlines[1] > 0
+
+
+def test_failover_raises_once_the_retry_budget_is_spent(env):
+    session_a, session_b, src, batch = env
+    hosts = {
+        "a": QueryServer(session_a, ServeConfig(max_workers=2)),
+        "b": QueryServer(session_b, ServeConfig(max_workers=2, autostart=False)),
+    }
+    router = QueryRouter(hosts).start()
+    before = metrics.counter("router.retry.budget_exhausted")
+    try:
+        router.hosts["b"].close()
+        ticket = router.submit(_agg_builder(src), deadline_s=0.2)
+        time.sleep(0.35)  # the whole budget is gone before resolution
+        with pytest.raises(DeadlineExceeded):
+            ticket.result(timeout=120)
+    finally:
+        router.close()
+    assert metrics.counter("router.retry.budget_exhausted") == before + 1
+
+
+class _RejectOnceHost(_RecordingHost):
+    """First failover submission is rejected with a retry_after the
+    router must honor; the retry then succeeds."""
+
+    def __init__(self, inner):
+        super().__init__(inner)
+        self.rejections_left = 0
+
+    def submit(self, df, deadline_s=None, tenant="default"):
+        if self.rejections_left > 0:
+            self.rejections_left -= 1
+            raise AdmissionRejected(
+                queue_depth=1, retry_after_s=0.05, tenant=tenant,
+                reason="queue_full",
+            )
+        return super().submit(df, deadline_s=deadline_s, tenant=tenant)
+
+
+def test_failover_honors_admission_retry_after_instead_of_stampeding(env):
+    session_a, session_b, src, batch = env
+    rej = _RejectOnceHost(QueryServer(session_a, ServeConfig(max_workers=2)))
+    hosts = {
+        "a": rej,
+        "b": QueryServer(session_b, ServeConfig(max_workers=2, autostart=False)),
+    }
+    router = QueryRouter(hosts).start()
+    before_wait = metrics.counter("router.retry.admission_wait")
+    before_retried = metrics.counter("router.retried")
+    try:
+        router.hosts["b"].close()
+        ticket = router.submit(_agg_builder(src))
+        rej.rejections_left = 1  # reject exactly the failed-over leg
+        got = ticket.result(timeout=120)
+        assert _rows(got) == _expected(session_a, src)
+    finally:
+        router.close()
+    assert metrics.counter("router.retry.admission_wait") == before_wait + 1
+    assert metrics.counter("router.retried") == before_retried + 1
+
+
+def test_fabric_make_router_stands_up_the_health_directed_front(env):
+    session_a, session_b, src, batch = env
+    router = QueryFabric().make_router(
+        {"a": session_a, "b": session_b},
+        serve_config=ServeConfig(max_workers=2),
+        health_policy=HealthPolicy(probation_cooldown_s=0.05),
+    ).start()
+    try:
+        got = router.submit(_agg_builder(src)).result(timeout=120)
+        assert _rows(got) == _expected(session_a, src)
+        assert set(router.stats()["health"]) == {"a", "b"}
+    finally:
+        router.close()
+
+
+def test_revive_host_swaps_a_restarted_server_in(env):
+    """Operator-path recovery: revive_host offers a fresh server for a
+    dead name; the next fan-out probes it and readmits on success."""
+    session_a, session_b, src, batch = env
+    hosts = {
+        "a": QueryServer(session_a, ServeConfig(max_workers=2)),
+        "b": QueryServer(session_b, ServeConfig(max_workers=2)),
+    }
+    router = QueryRouter(
+        hosts, health_policy=HealthPolicy(probation_cooldown_s=30.0)
+    ).start()
+    expected = _expected(session_a, src)
+    before = metrics.counter("router.health.readmitted")
+    try:
+        router.hosts["b"].close()
+        got = router.submit(_agg_builder(src)).result(timeout=120)
+        assert _rows(got) == expected
+        assert router.health.state("b") == DEAD
+        # a fresh server over the same shared storage, offered by name —
+        # probation is due immediately, despite the 30s cooldown
+        router.revive_host("b", QueryServer(session_b, ServeConfig(max_workers=2)))
+        got = router.submit(_agg_builder(src)).result(timeout=120)
+        assert _rows(got) == expected
+        assert router.health.state("b") == HEALTHY
+        assert metrics.counter("router.health.readmitted") == before + 1
+    finally:
+        router.close()
