@@ -58,6 +58,7 @@ SUBSYSTEM_PREFIXES = frozenset(
         "query",
         "recovery",
         "residency",
+        "result_cache",
         "router",
         "scan",
         "serve",

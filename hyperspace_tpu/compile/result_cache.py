@@ -1,6 +1,6 @@
-"""RESULT cache stub riding the pipeline fingerprint machinery (the
-ROADMAP PR-9 follow-up): memoize FINISHED result tables keyed on
-(value-level plan signature, index-log version token).
+"""RESULT cache riding the pipeline fingerprint machinery: memoize
+FINISHED result tables keyed on (value-level plan signature, index-log
+version token).
 
 Unlike the pipeline cache, results depend on literal VALUES — the key is
 the serve plan cache's ``plan_signature`` (tree string with literals +
@@ -8,45 +8,106 @@ every leaf's file snapshot) plus the full version token, so a hit is
 sound by construction: same literals, same source snapshot, same index
 generation, same conf. Scoped invalidation rides the same version
 tokens PR 9 pins — any create/refresh/optimize/delete changes the token
-and old entries age out of the LRU; ``invalidate(index_root)`` drops a
-rewritten index's entries eagerly (the collection-manager hook).
+and old entries age out; ``invalidate(index_root)`` drops a rewritten
+index's entries eagerly (the collection-manager hook).
 
-Off by default (``hyperspace.compile.resultCache``); bounded by entry
-count AND a per-entry byte ceiling — this is a stub for point lookups
-and small aggregates, not a materialized-view store. Served batches are
-shared objects: ColumnarBatch is treated as immutable everywhere in the
-executor (transforms build new batches), the same contract the serve
-micro-batcher relies on.
+Two policies replaced the PR-10 LRU stub (docs/17):
+
+* **Telemetry-driven admission** (serve/cache_policy): a result enters
+  only when its observed recompute cost × its fingerprint's repeat rate
+  beats its byte cost — callers pass both signals from the query's own
+  trace; cold structures always decline.
+* **GDSF eviction**: priority = clock + (1 + hits) × recompute_cost /
+  bytes, with the classic aging clock (set to each victim's priority) so
+  stale expensive entries cannot squat forever. Cheap-to-recompute bulky
+  entries go first; hot expensive point lookups survive.
+
+The cache's bytes charge against the SAME HBM budget ladder residency
+uses: each instance registers as a ``residency.tiers`` claimant, and the
+hbm-cache eviction ladder sheds claimant bytes BEFORE deltas — cached
+results are the cheapest thing on the ladder to drop.
+
+Pinned-token wholesale semantics: entries under an OLD version token are
+never proactively dropped on token change — a snapshot-pinned reader
+presenting its pinned token still hits them, and a reader on the new
+token simply misses (counted ``stale_miss`` when the same signature
+exists under another token). Served batches are shared objects:
+ColumnarBatch is treated as immutable everywhere in the executor.
 """
 
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from ..telemetry.metrics import metrics
 
 
 class ResultCache:
-    """Bounded LRU: (plan signature, version token) -> (batch, roots)."""
+    """Cost-aware result memo: (plan signature, version token) ->
+    finished batch, GDSF-evicted, byte-budgeted. ``prefix`` names the
+    counter family — the serve-level instance reports under
+    ``compile.result_cache.*``, the router-level one under
+    ``router.result_cache.*``."""
 
-    def __init__(self):
+    def __init__(self, prefix: str = "compile.result_cache"):
+        self._prefix = prefix
         self._lock = threading.Lock()
-        self._results: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # key -> mutable entry dict {batch, roots, nbytes, hits, cost_s,
+        # pri}; plain dict (insertion order only matters for tie-breaks)
+        self._results: Dict[tuple, dict] = {}
+        # signature -> set of full keys (stale_miss detection: same
+        # signature alive under a DIFFERENT token)
+        self._by_sig: Dict[object, set] = {}
+        self._bytes = 0
+        self._clock = 0.0
         self._epoch = 0
 
-    def get(self, key: tuple) -> Optional[object]:
-        with self._lock:
-            hit = self._results.get(key)
-            if hit is not None:
-                self._results.move_to_end(key)
-        if hit is None:
-            metrics.incr("compile.result_cache.miss")
-            return None
-        metrics.incr("compile.result_cache.hit")
-        return hit[0]
+    # -- internals (call with lock held) -------------------------------------
+    def _priority_locked(self, e: dict) -> float:
+        return self._clock + (1 + e["hits"]) * e["cost_s"] / max(
+            e["nbytes"], 1
+        )
 
+    def _drop_locked(self, key: tuple) -> dict:
+        e = self._results.pop(key)
+        self._bytes -= e["nbytes"]
+        sigs = self._by_sig.get(key[0])
+        if sigs is not None:
+            sigs.discard(key)
+            if not sigs:
+                del self._by_sig[key[0]]
+        return e
+
+    def _evict_one_locked(self) -> bool:
+        if not self._results:
+            return False
+        victim = min(self._results, key=lambda k: self._results[k]["pri"])
+        self._clock = self._results[victim]["pri"]
+        self._drop_locked(victim)
+        return True
+
+    # -- lookup ---------------------------------------------------------------
+    def get(self, key: tuple) -> Optional[object]:
+        stale = False
+        with self._lock:
+            e = self._results.get(key)
+            if e is not None:
+                e["hits"] += 1
+                e["pri"] = self._priority_locked(e)
+                batch = e["batch"]
+            else:
+                sigs = self._by_sig.get(key[0])
+                stale = bool(sigs)
+        if e is None:
+            metrics.incr(self._prefix + ".miss")
+            if stale:
+                metrics.incr(self._prefix + ".stale_miss")
+            return None
+        metrics.incr(self._prefix + ".hit")
+        return batch
+
+    # -- admission ------------------------------------------------------------
     def put(
         self,
         key: tuple,
@@ -54,54 +115,140 @@ class ResultCache:
         index_roots: Tuple[str, ...],
         max_entries: int,
         max_bytes: int,
-    ) -> bool:
-        """Memoize ``batch`` (False when it exceeds the byte ceiling)."""
-        from ..exec.bytecache import batch_nbytes
+        cost_s: float = 0.0,
+        repeats: int = 0,
+        byte_rate: int = 1,
+        total_max_bytes: Optional[int] = None,
+        nbytes: Optional[int] = None,
+    ) -> str:
+        """Admission decision for ``batch``: returns ``"admitted"``,
+        ``"declined_cold"`` or ``"declined_bytes"``. ``cost_s`` is the
+        observed recompute wall, ``repeats`` the fingerprint's sighting
+        count in the admission window (cache_policy.AdmissionWindow),
+        ``total_max_bytes`` the cache-wide budget share."""
+        from ..serve.cache_policy import should_admit
 
-        if batch_nbytes(batch) > max_bytes:
-            metrics.incr("compile.result_cache.too_large")
-            return False
+        if nbytes is None:
+            from ..exec.bytecache import batch_nbytes
+
+            nbytes = batch_nbytes(batch)
+        cap = total_max_bytes if total_max_bytes is not None else max_bytes
+        verdict = should_admit(
+            nbytes, cost_s, repeats, byte_rate, min(max_bytes, cap)
+        )
+        if verdict != "admit":
+            metrics.incr(self._prefix + "." + verdict)
+            return verdict
         with self._lock:
-            self._results[key] = (batch, tuple(index_roots))
-            self._results.move_to_end(key)
-            while len(self._results) > max(int(max_entries), 1):
-                self._results.popitem(last=False)
-                metrics.incr("compile.result_cache.evicted")
-        metrics.incr("compile.result_cache.stored")
-        return True
+            old = self._results.get(key)
+            if old is not None:
+                self._drop_locked(key)
+            e = {
+                "batch": batch,
+                "roots": tuple(index_roots),
+                "nbytes": int(nbytes),
+                "hits": 0 if old is None else old["hits"],
+                "cost_s": max(float(cost_s), 0.0),
+            }
+            e["pri"] = self._priority_locked(e)
+            self._results[key] = e
+            self._by_sig.setdefault(key[0], set()).add(key)
+            self._bytes += e["nbytes"]
+            evicted = 0
+            while len(self._results) > max(int(max_entries), 1) or (
+                self._bytes > cap and len(self._results) > 1
+            ):
+                if not self._evict_one_locked():
+                    break
+                evicted += 1
+        if evicted:
+            metrics.incr(self._prefix + ".evicted", evicted)
+        metrics.incr(self._prefix + ".admitted")
+        return "admitted"
 
+    # -- budget claimant protocol (residency.tiers) ---------------------------
+    def held_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def shed(self, nbytes: int) -> int:
+        """Free at least ``nbytes`` by GDSF eviction (the residency
+        ladder's first rung: cached results drop BEFORE deltas). Returns
+        bytes actually freed."""
+        freed = 0
+        evicted = 0
+        with self._lock:
+            while freed < nbytes and self._results:
+                before = self._bytes
+                if not self._evict_one_locked():
+                    break
+                freed += before - self._bytes
+                evicted += 1
+        if evicted:
+            metrics.incr(self._prefix + ".evicted", evicted)
+        return freed
+
+    # -- invalidation ----------------------------------------------------------
     def invalidate(self, index_root: Optional[str] = None) -> int:
         prefix = None
         if index_root is not None:
             prefix = str(index_root).rstrip("/") + "/"
         with self._lock:
             if prefix is None:
-                n = len(self._results)
-                self._results.clear()
+                doomed = list(self._results)
             else:
                 doomed = [
                     k
-                    for k, (_b, roots) in self._results.items()
-                    if any(p.startswith(prefix) for p in roots)
+                    for k, e in self._results.items()
+                    if any(p.startswith(prefix) for p in e["roots"])
                 ]
-                for k in doomed:
-                    del self._results[k]
-                n = len(doomed)
+            for k in doomed:
+                self._drop_locked(k)
+            n = len(doomed)
         if n:
-            metrics.incr("compile.result_cache.invalidated", n)
+            metrics.incr(self._prefix + ".invalidated", n)
         return n
 
     def reset(self) -> None:
         with self._lock:
             self._results.clear()
+            self._by_sig.clear()
+            self._bytes = 0
+            self._clock = 0.0
             self._epoch += 1
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {"entries": len(self._results)}
+            return {
+                "entries": len(self._results),
+                "bytes": self._bytes,
+                "clock": round(self._clock, 9),
+            }
 
 
 result_cache = ResultCache()
+router_result_cache = ResultCache(prefix="router.result_cache")
+
+
+def invalidate_all(index_root: Optional[str] = None) -> int:
+    """Scoped invalidation across BOTH cache levels — the collection
+    manager's one hook: a refresh/optimize/delete of an index drops its
+    serve-level entries AND every router-level entry whose fan-out
+    touched it (either join side)."""
+    return result_cache.invalidate(index_root) + router_result_cache.invalidate(
+        index_root
+    )
+
+
+def budget_share_bytes(share: float) -> int:
+    """The cache-wide byte cap: ``share`` of the SAME env HBM budget the
+    residency ladder divides (docs/13). Shares are clamped by conf to
+    [0, 0.5] — the cache can never claim more than the slab reservation
+    cap."""
+    from ..exec.bytecache import env_mb
+
+    total = env_mb("HYPERSPACE_TPU_HBM_BUDGET_MB", 4096)
+    return max(int(total * float(share)), 1)
 
 
 def result_key(
@@ -126,3 +273,12 @@ def result_roots(optimized_plan) -> Tuple[str, ...]:
     from .fingerprint import index_roots
 
     return index_roots(optimized_plan)
+
+
+# Register both instances on the residency ladder: their bytes charge
+# against the one HBM budget and shed before anything else (tiers is the
+# ladder's home; import is cycle-free — residency never imports compile).
+from ..residency import tiers as _tiers  # noqa: E402
+
+_tiers.register_claimant("result_cache", result_cache)
+_tiers.register_claimant("router_result_cache", router_result_cache)

@@ -496,6 +496,37 @@ class HyperspaceConf:
             )
         )
 
+    def compile_result_cache_window(self) -> int:
+        return max(
+            int(
+                self.get(
+                    C.COMPILE_RESULT_CACHE_WINDOW,
+                    C.COMPILE_RESULT_CACHE_WINDOW_DEFAULT,
+                )
+            ),
+            1,
+        )
+
+    def compile_result_cache_byte_rate(self) -> int:
+        return max(
+            int(
+                self.get(
+                    C.COMPILE_RESULT_CACHE_BYTE_RATE,
+                    C.COMPILE_RESULT_CACHE_BYTE_RATE_DEFAULT,
+                )
+            ),
+            1,
+        )
+
+    def compile_result_cache_budget_share(self) -> float:
+        v = float(
+            self.get(
+                C.COMPILE_RESULT_CACHE_BUDGET_SHARE,
+                C.COMPILE_RESULT_CACHE_BUDGET_SHARE_DEFAULT,
+            )
+        )
+        return min(max(v, 0.0), 0.5)
+
     def telemetry_tracing_enabled(self) -> bool:
         v = str(
             self.get(C.TELEMETRY_TRACING, C.TELEMETRY_TRACING_DEFAULT)

@@ -413,3 +413,20 @@ COMPILE_RESULT_CACHE_ENTRIES_DEFAULT = 64
 # memoizing scans-of-everything would just mirror the page cache).
 COMPILE_RESULT_CACHE_MAX_BYTES = "hyperspace.compile.resultCache.maxResultBytes"
 COMPILE_RESULT_CACHE_MAX_BYTES_DEFAULT = 8 * 1024 * 1024
+# Telemetry-driven admission (docs/17): a result is admitted only when
+# its observed recompute cost times its fingerprint's repeat rate (a
+# sliding window of batch_fingerprints seen at admission) beats its byte
+# cost.  windowSize bounds the repeat-rate window; byteRatePerSec is the
+# exchange rate turning seconds-saved into bytes-worth-caching (a cached
+# byte "pays for itself" when cost_s * repeats * rate >= nbytes).
+COMPILE_RESULT_CACHE_WINDOW = "hyperspace.compile.resultCache.windowSize"
+COMPILE_RESULT_CACHE_WINDOW_DEFAULT = 512
+COMPILE_RESULT_CACHE_BYTE_RATE = "hyperspace.compile.resultCache.byteRatePerSec"
+COMPILE_RESULT_CACHE_BYTE_RATE_DEFAULT = 64 * 1024 * 1024
+# Fraction of the HBM budget ladder the result cache may claim (its
+# bytes charge against the SAME budget residency uses, and shed FIRST —
+# cached results are the cheapest thing on the ladder to drop).
+COMPILE_RESULT_CACHE_BUDGET_SHARE = (
+    "hyperspace.compile.resultCache.budgetShare"
+)
+COMPILE_RESULT_CACHE_BUDGET_SHARE_DEFAULT = 0.05

@@ -56,6 +56,7 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -216,6 +217,13 @@ class RouterTicket:
                         for host, ticket, part, probe in self._legs
                     ]
                     self._result = self._merge(partials)
+                    # fleet-level memo (router.result_cache): best-effort
+                    # admission of the MERGED result — a store failure
+                    # must never fail an already-served query
+                    try:
+                        self._router._store_cached(self, self._result)
+                    except Exception:  # noqa: BLE001 - memo only, counted
+                        metrics.incr("router.result_cache.store_error")
                 except BaseException as e:
                     self._error = e
                 self._done = True
@@ -261,6 +269,16 @@ class QueryRouter:
         self._hosts_lost = 0
         self._hedges_issued = 0
         self._hedges_won = 0
+        # fleet result cache admission window + warm-compile hint book
+        # (structural fingerprint digest -> builder): both keyed by the
+        # PR-10 machine-portable fingerprints, sized by the first host's
+        # conf (hosts of one fleet share conf by construction)
+        from ..serve.cache_policy import AdmissionWindow
+
+        conf = next(iter(self.hosts.values())).session.conf
+        self._rc_window = AdmissionWindow(conf.compile_result_cache_window())
+        self._warm_hints: "OrderedDict[str, tuple]" = OrderedDict()
+        self._warm_hints_max = 64
 
     # -- partitioning ---------------------------------------------------------
     def partition_map(self, index_name: Optional[str] = None) -> Dict[str, List[int]]:
@@ -315,9 +333,11 @@ class QueryRouter:
             sub_plans.append((host, df))
 
         digest = hashlib.blake2s()
+        fp_digest = hashlib.blake2s()
         for _, df in sub_plans:
-            digest.update(repr(batch_fingerprint(df.plan)).encode())
+            fp_digest.update(repr(batch_fingerprint(df.plan)).encode())
             digest.update(repr(df.plan).encode())
+        digest.update(fp_digest.digest())
         key = (tenant, digest.hexdigest())
         with self._lock:
             live = self._inflight.get(key)
@@ -325,6 +345,45 @@ class QueryRouter:
                 self._coalesced += 1
                 metrics.incr("router.coalesced")
                 return live
+
+        # warm-compile hint book: remember how to rebuild this structural
+        # shape so sibling/revived hosts can pre-lower it off the hot
+        # path (offer_warm_hints / revive_host)
+        fp_key = fp_digest.hexdigest()
+        with self._lock:
+            self._warm_hints[fp_key] = (build, tenant)
+            self._warm_hints.move_to_end(fp_key)
+            while len(self._warm_hints) > self._warm_hints_max:
+                self._warm_hints.popitem(last=False)
+
+        # fleet result cache: keyed (every host's value-level plan
+        # signature, every host's FULL version token) — a hit is sound
+        # fleet-wide by the same construction as the serve-level cache,
+        # and repeats cost ZERO fan-out legs. Key computation failing
+        # (e.g. a host mid-restart) just skips caching for this query.
+        rc_key = None
+        rc_roots: Tuple[str, ...] = ()
+        conf0 = next(iter(self.hosts.values())).session.conf
+        if conf0.compile_result_cache_enabled():
+            try:
+                rc_key, rc_roots = self._result_cache_key(sub_plans)
+            except Exception:  # noqa: BLE001 - cache is optional, query is not
+                metrics.incr("router.result_cache.key_error")
+                rc_key = None
+            if rc_key is not None:
+                from ..compile.result_cache import router_result_cache
+
+                with span("result_cache.lookup", level="router"):
+                    cached = router_result_cache.get(rc_key)
+                if cached is not None:
+                    rt = RouterTicket(self, [], lambda _p, _c=cached: _c)
+                    rt._build = build
+                    rt._tenant = tenant
+                    rt._deadline_s = deadline_s
+                    rt._rc_key = None  # already cached: no re-store
+                    with self._lock:
+                        self._submitted += 1
+                    return rt
 
         merge = self._merge_fn([df.plan for _, df in sub_plans])
         legs = []
@@ -386,6 +445,9 @@ class QueryRouter:
         rt._build = build  # the degraded path re-instantiates partitions
         rt._tenant = tenant
         rt._deadline_s = deadline_s
+        rt._rc_key = rc_key
+        rt._rc_roots = rc_roots
+        rt._rc_fp = fp_key
         with self._lock:
             self._inflight[key] = rt
             self._tickets[id(rt)] = key
@@ -422,6 +484,116 @@ class QueryRouter:
             tuple(plan.group_by), tuple(_partial_specs(list(plan.aggs))), plan.child
         )
         return type(df)(df.session, partial)
+
+    # -- fleet result cache ---------------------------------------------------
+    def _result_cache_key(self, sub_plans) -> Tuple[tuple, Tuple[str, ...]]:
+        """The fleet-level memo key: every host's value-level plan
+        signature (literals + leaf file snapshots) plus every host's
+        FULL multi-host version token (index generation + conf + join
+        region versions) — any side's refresh/optimize/delete moves some
+        host's token and the old entry can only stale_miss. The
+        optimizer pass this runs is the same memoized plan-cache walk
+        the per-host submit would do anyway."""
+        from ..compile.result_cache import result_roots
+        from ..serve.plan_cache import plan_signature
+
+        sigs, toks, roots = [], [], []
+        for host, df in sub_plans:
+            server = self.hosts[host]
+            sig = plan_signature(df.plan)
+            plan, token = server.plan_cache.optimized_plan_with_token(
+                df, signature=sig
+            )
+            sigs.append(sig)
+            toks.append(token)
+            roots.extend(result_roots(plan))
+        return (tuple(sigs), tuple(toks)), tuple(dict.fromkeys(roots))
+
+    def _store_cached(self, rt: RouterTicket, result) -> None:
+        """Telemetry-driven admission of one merged result into the
+        fleet cache: repeat rate from the router's own fingerprint
+        window, recompute cost = the whole fan-out + merge wall (what a
+        future hit actually saves the fleet)."""
+        rc_key = getattr(rt, "_rc_key", None)
+        if rc_key is None:
+            return
+        from ..compile.result_cache import (
+            budget_share_bytes,
+            router_result_cache,
+        )
+
+        conf = next(iter(self.hosts.values())).session.conf
+        repeats = self._rc_window.observe(
+            rt._rc_fp, conf.compile_result_cache_window()
+        )
+        router_result_cache.put(
+            rc_key,
+            result,
+            rt._rc_roots,
+            conf.compile_result_cache_entries(),
+            conf.compile_result_cache_max_bytes(),
+            cost_s=time.monotonic() - rt._t0,
+            repeats=repeats,
+            byte_rate=conf.compile_result_cache_byte_rate(),
+            total_max_bytes=budget_share_bytes(
+                conf.compile_result_cache_budget_share()
+            ),
+        )
+
+    # -- warm-compile hints ---------------------------------------------------
+    def offer_warm_hints(self, host: Optional[str] = None) -> Dict[str, int]:
+        """Offer every remembered structural fingerprint to ``host`` (or
+        all hosts): the target rebuilds its partition's plan for the
+        shape and pre-lowers the pipeline through its own compiled-
+        pipeline cache, OFF the query hot path — the next real query of
+        that shape starts from a warm executable. Adoption is honest:
+        ``adopted`` only when a lowering actually ran (an already-warm,
+        latched, or closed host declines)."""
+        with self._lock:
+            hints = list(self._warm_hints.items())
+        names = list(self.hosts)
+        targets = [host] if host is not None else names
+        out = {"offered": 0, "adopted": 0, "declined": 0}
+        for name in targets:
+            server = self.hosts.get(name)
+            if server is None:
+                continue
+            part_index = names.index(name)
+            for _fp, (build, _tenant) in hints:
+                metrics.incr("compile.warm_hint.offered")
+                out["offered"] += 1
+                if self._adopt_warm_hint(server, build, part_index, len(names)):
+                    metrics.incr("compile.warm_hint.adopted")
+                    out["adopted"] += 1
+                else:
+                    metrics.incr("compile.warm_hint.declined")
+                    out["declined"] += 1
+        return out
+
+    def _adopt_warm_hint(self, server, build, part_index, n_parts) -> bool:
+        """One host's pre-lower of one hinted shape. True only when the
+        pipeline cache actually lowered (compile.lowered fired inside
+        the scoped registry) — a cache hit means the host was already
+        warm and the hint declines."""
+        try:
+            if server.closed or server._host_latch.is_set():
+                return False
+            from ..compile.cache import pipeline_cache
+            from ..exec.executor import Executor
+
+            df = self.rewrite_partial(
+                build(server.session, part_index, n_parts)
+            )
+            plan, token = server.plan_cache.optimized_plan_with_token(df)
+            executor = Executor(server.session.conf, mesh=server.session.mesh)
+            with metrics.scoped() as m:
+                pipeline_cache.get_or_lower(
+                    plan, executor, version_token=token
+                )
+                return m.counter("compile.lowered") > 0
+        except Exception:  # noqa: BLE001 - a hint is advice, never an error
+            metrics.incr("compile.warm_hint.adopt_error")
+            return False
 
     def _ping_ok(self, host: str, server) -> bool:
         """The lightweight pre-probe: before spending a real query leg
@@ -478,6 +650,13 @@ class QueryRouter:
                 self.hosts[name] = server
         metrics.incr("router.health.revive_offered")
         self.health.note_revived(name)
+        # warm the newcomer OFF the hot path: a restarted server is a
+        # new session with a cold pipeline cache — offer it every
+        # remembered shape so its probe (and the queries after) start
+        # from warm executables
+        threading.Thread(
+            target=lambda: self.offer_warm_hints(name), daemon=True
+        ).start()
 
     def _remaining_s(self, rt: RouterTicket) -> Optional[float]:
         """The deadline budget LEFT for re-issuing rt's legs: deadline -
@@ -717,6 +896,8 @@ class QueryRouter:
             s.close(timeout_s)
 
     def stats(self) -> dict:
+        from ..compile.result_cache import router_result_cache
+
         with self._lock:
             return {
                 "hosts": {h: (not s.closed) for h, s in self.hosts.items()},
@@ -727,4 +908,6 @@ class QueryRouter:
                 "hedges_won": self._hedges_won,
                 "inflight": len(self._inflight),
                 "health": self.health.stats(),
+                "result_cache": router_result_cache.snapshot(),
+                "warm_hints": len(self._warm_hints),
             }

@@ -93,9 +93,17 @@ def _budget_bytes() -> int:
     # HBM (residency.slabs): subtracting the reservation here makes every
     # budget site — admission, eviction, refusal — see the true headroom.
     # Reservations are capped at half the budget, so this never goes <= 0.
+    # Result-cache claimant bytes (residency.tiers) charge here too —
+    # they are sheddable (the register sites shed them before evicting
+    # any delta), but while held they are real budget occupancy.
     from ..residency.slabs import held_bytes
+    from ..residency.tiers import claimant_bytes
 
-    return env_mb("HYPERSPACE_TPU_HBM_BUDGET_MB", 4096) - held_bytes()
+    return (
+        env_mb("HYPERSPACE_TPU_HBM_BUDGET_MB", 4096)
+        - held_bytes()
+        - claimant_bytes()
+    )
 
 
 def _min_auto_rows() -> int:
@@ -935,6 +943,13 @@ class ResidentCacheBase:
                     + sum(j.nbytes for j in self._joins)
                 )
 
+            if total() > budget:
+                # cached results shed FIRST — cheaper to drop than any
+                # delta (recompute is one query; re-residency a rebuild)
+                from ..residency.tiers import shed_claimants
+
+                shed_claimants(total() - budget)
+                budget = _budget_bytes()
             while total() > budget and self._deltas:
                 dvictim = min(self._deltas, key=lambda d: d.last_used)
                 self._deltas.remove(dvictim)
@@ -1009,6 +1024,12 @@ class ResidentCacheBase:
                 + sum(d.nbytes for d in self._deltas)
                 + sum(j.nbytes for j in self._joins)
             )
+            if total > budget:
+                # cached results shed FIRST (the ladder's cheapest rung)
+                from ..residency.tiers import shed_claimants
+
+                shed_claimants(total - budget)
+                budget = _budget_bytes()
             # evict OTHER deltas first (cheapest to rebuild; a delta is
             # useless without its base, never the other way around) —
             # and never evict a TABLE for a delta: if the tables alone
@@ -1064,10 +1085,16 @@ class ResidentCacheBase:
                     + sum(j.nbytes for j in self._joins)
                 )
 
-            # deltas drain FIRST (cheapest to rebuild), join regions
-            # second (rebuildable from the host groups cache); only then
-            # are LRU base tables sacrificed, each taking its dependent
-            # deltas with it
+            # cached results shed FIRST (the ladder's cheapest rung),
+            # deltas second (cheapest residency to rebuild), join
+            # regions third (rebuildable from the host groups cache);
+            # only then are LRU base tables sacrificed, each taking its
+            # dependent deltas with it
+            if total() > budget:
+                from ..residency.tiers import shed_claimants
+
+                shed_claimants(total() - budget)
+                budget = _budget_bytes()
             while total() > budget and self._deltas:
                 dvictim = min(self._deltas, key=lambda d: d.last_used)
                 self._deltas.remove(dvictim)

@@ -63,12 +63,15 @@ def _invalidate_compiled(index_root) -> None:
     drops on EITHER side's change. The version-token/fingerprint keys
     would miss stale entries naturally; the eager drop keeps the bounded
     caches from pinning dead routing state until LRU pressure finds it.
-    Quick refresh does NOT route here (no index data files change)."""
+    Quick refresh does NOT route here (no index data files change).
+    Result invalidation covers BOTH cache levels (serve-side and the
+    router's fleet cache — result_cache.invalidate_all): a router entry
+    whose fan-out touched either join side drops on that side's change."""
     from ..compile.cache import pipeline_cache
-    from ..compile.result_cache import result_cache
+    from ..compile.result_cache import invalidate_all
 
     pipeline_cache.invalidate(str(index_root))
-    result_cache.invalidate(str(index_root))
+    invalidate_all(str(index_root))
 
 
 class IndexCollectionManager:

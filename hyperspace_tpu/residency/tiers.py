@@ -23,11 +23,64 @@ the codec without multi-GB fixtures).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from ..ops.bitpack import PackSpec
+from ..telemetry.metrics import metrics
 from . import knobs
+
+# --- budget claimants ---------------------------------------------------------
+# Non-residency holders of budget-charged bytes (today: the result
+# caches). A claimant exposes ``held_bytes() -> int`` and
+# ``shed(nbytes) -> int`` (bytes actually freed). Claimant bytes charge
+# against the SAME env HBM budget the caches divide, and the eviction
+# ladder sheds them FIRST — cached results are cheaper to drop than any
+# resident delta or table (recompute is one query; re-residency is a
+# rebuild + upload).
+
+_CLAIMANTS_LOCK = threading.Lock()
+_CLAIMANTS: Dict[str, object] = {}
+
+
+def register_claimant(name: str, claimant: object) -> None:
+    with _CLAIMANTS_LOCK:
+        _CLAIMANTS[name] = claimant
+
+
+def claimant_bytes() -> int:
+    """Total budget-charged bytes held by registered claimants."""
+    with _CLAIMANTS_LOCK:
+        holders = list(_CLAIMANTS.values())
+    total = 0
+    for c in holders:
+        try:
+            total += int(c.held_bytes())
+        except Exception:  # noqa: BLE001 - one claimant must not wedge budget math
+            metrics.incr("residency.claimant.error")
+            continue
+    return total
+
+
+def shed_claimants(nbytes: int) -> int:
+    """Free at least ``nbytes`` of claimant-held budget, cheapest rung
+    first. Returns bytes actually freed (may fall short — the residency
+    caches then continue down their own ladder: deltas, joins, tables)."""
+    if nbytes <= 0:
+        return 0
+    with _CLAIMANTS_LOCK:
+        holders = list(_CLAIMANTS.values())
+    freed = 0
+    for c in holders:
+        if freed >= nbytes:
+            break
+        try:
+            freed += int(c.shed(nbytes - freed))
+        except Exception:  # noqa: BLE001 - one claimant must not wedge eviction
+            metrics.incr("residency.claimant.error")
+            continue
+    return freed
 
 
 @dataclass

@@ -255,6 +255,12 @@ class QueryServer:
         # was written under _cond but read lock-free in three places)
         self._host_latch = threading.Event()
         self._degraded_reason: Optional[str] = None
+        # result-cache admission window: fingerprints seen at admission
+        # decisions, sized by conf (serve/cache_policy — the repeat-rate
+        # signal of the telemetry-driven admission rule)
+        from .cache_policy import AdmissionWindow
+
+        self._rc_window = AdmissionWindow(conf.compile_result_cache_window())
         # serving stats (guarded by _cond's lock)
         self._submitted = 0
         self._completed = 0
@@ -521,13 +527,23 @@ class QueryServer:
             # default): a value-level hit under the SAME pinned token
             # serves the memoized table without touching a worker —
             # sound because the key carries literals, file snapshots,
-            # index generation, and conf (the PR-9 follow-up stub)
+            # index generation, and conf. A snapshot-pinned reader hits
+            # entries of ITS pinned token wholesale (never a newer
+            # epoch): the token is part of the key, and old-token
+            # entries are never proactively dropped on token change.
+            # The device-loss latch BYPASSES the cache — no lookup, no
+            # rc_key so no store — but never poisons it: entries stay,
+            # and un-latching resumes hits (docs/17).
             rc_key = None
-            if rc_enabled:
+            latched = self._consult_device_latch()
+            if rc_enabled and latched:
+                metrics.incr("compile.result_cache.bypass_latched")
+            elif rc_enabled:
                 from ..compile.result_cache import result_cache, result_key
 
                 rc_key = result_key(df.plan, token, signature=signature)
-                cached = result_cache.get(rc_key)
+                with span("result_cache.lookup"):
+                    cached = result_cache.get(rc_key)
                 if cached is not None:
                     metrics.incr("serve.submitted")
                     with self._cond:
@@ -538,9 +554,7 @@ class QueryServer:
                     self._finish(ticket, result=cached)
                     return ticket
             resident = (
-                None
-                if self._consult_device_latch()
-                else batcher.classify(self.session, plan)
+                None if latched else batcher.classify(self.session, plan)
             )
         except Exception as e:  # noqa: BLE001 - planning failure = query failure
             # planning failures (unknown columns, vanished files) belong
@@ -847,28 +861,18 @@ class QueryServer:
             )
         tcm = tr.activate() if tr is not None else contextlib.nullcontext()
         try:
+            t0 = time.monotonic()
             with tcm, span("serve.execute", tenant=req.ticket.tenant):
                 with metrics.scoped() as qm:
                     result = self._run_plan(req)
+            wall_s = time.monotonic() - t0
             req.ticket.metrics = qm.snapshot()
             if req.result_key is not None:
                 # the memo is best-effort: a store failure (bad conf
                 # value, exotic batch) must NEVER convert an already-
                 # successful query into a caller-visible error
                 try:
-                    from ..compile.result_cache import (
-                        result_cache,
-                        result_roots,
-                    )
-
-                    conf = self.session.conf
-                    result_cache.put(
-                        req.result_key,
-                        result,
-                        result_roots(req.plan),
-                        conf.compile_result_cache_entries(),
-                        conf.compile_result_cache_max_bytes(),
-                    )
+                    self._store_result(req, result, wall_s)
                 except Exception:  # noqa: BLE001 - memo only, counted
                     metrics.incr("compile.result_cache.store_error")
             self._finish(req.ticket, result=result)
@@ -877,6 +881,37 @@ class QueryServer:
         except BaseException as e:  # worker being killed: resolve the ticket
             self._finish(req.ticket, error=e)
             raise
+
+    def _store_result(self, req: _Request, result, wall_s: float) -> None:
+        """Telemetry-driven admission (docs/17): observe the query's
+        structural fingerprint in the sliding window, price its observed
+        recompute cost (trace spans when tracing is on, the direct
+        dispatch wall otherwise), and let the cache decide."""
+        from ..compile.fingerprint import batch_fingerprint
+        from ..compile.result_cache import (
+            budget_share_bytes,
+            result_cache,
+            result_roots,
+        )
+        from .cache_policy import recompute_cost_s
+
+        conf = self.session.conf
+        repeats = self._rc_window.observe(
+            batch_fingerprint(req.plan), conf.compile_result_cache_window()
+        )
+        result_cache.put(
+            req.result_key,
+            result,
+            result_roots(req.plan),
+            conf.compile_result_cache_entries(),
+            conf.compile_result_cache_max_bytes(),
+            cost_s=recompute_cost_s(req.ticket.trace, wall_s),
+            repeats=repeats,
+            byte_rate=conf.compile_result_cache_byte_rate(),
+            total_max_bytes=budget_share_bytes(
+                conf.compile_result_cache_budget_share()
+            ),
+        )
 
     def _run_plan(self, req: _Request) -> ColumnarBatch:
         from ..exec.executor import Executor
@@ -1187,10 +1222,14 @@ class QueryServer:
         out["serve_counters"] = serve_snapshot()
         out["plan_cache"] = self.plan_cache.snapshot()
         # whole-plan compilation surface: the compiled-pipeline cache,
-        # the result-cache stub, and the compile.* counter family —
-        # whether bursts are reusing pipelines or re-lowering per query
+        # the result cache, and the compile.* counter family — whether
+        # bursts are reusing pipelines or re-lowering per query
         # (docs/17-plan-compilation.md)
         out["compile"] = _compile_stats()
+        # result-cache surface: occupancy + bytes + the admission/
+        # eviction counter family (telemetry.result_cache_snapshot) —
+        # what the admission policy admitted, declined, and shed
+        out["result_cache"] = _result_cache_stats()
         # join-region surface: what the resident join pipeline holds
         # (regions, bytes, generation) — operators read this next to the
         # serve counters to see whether aggregate-joins are being served
@@ -1262,6 +1301,20 @@ def _compile_stats() -> dict:
         "pipelines": pipeline_cache.snapshot(),
         "results": result_cache.snapshot(),
         **compile_snapshot(),
+    }
+
+
+def _result_cache_stats() -> dict:
+    """Result-cache snapshot for stats(): serve-level + router-level
+    occupancy and the full admission/eviction counter families
+    (telemetry.result_cache_snapshot)."""
+    from ..compile.result_cache import result_cache, router_result_cache
+    from ..telemetry.metrics import result_cache_snapshot
+
+    return {
+        "serve": result_cache.snapshot(),
+        "router": router_result_cache.snapshot(),
+        **result_cache_snapshot(),
     }
 
 

@@ -349,14 +349,47 @@ def compile_snapshot(
         ),
         "result_hits": r.counter("compile.result_cache.hit"),
         "result_misses": r.counter("compile.result_cache.miss"),
-        "result_stored": r.counter("compile.result_cache.stored"),
+        "result_admitted": r.counter("compile.result_cache.admitted"),
         "result_invalidated": r.counter("compile.result_cache.invalidated"),
+        "warm_hints_offered": r.counter("compile.warm_hint.offered"),
+        "warm_hints_adopted": r.counter("compile.warm_hint.adopted"),
+        "warm_hints_declined": r.counter("compile.warm_hint.declined"),
     }
     runs = {
         kind: r.counter(f"compile.run.{kind}")
         for kind in ("scan", "agg_scan", "hybrid", "join_agg", "interpret")
     }
     out["runs"] = {k: v for k, v in runs.items() if v}
+    return out
+
+
+def result_cache_snapshot(
+    registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, object]:
+    """The result-cache counter families in one dict — per cache level
+    (serve-side ``compile.result_cache.*``, fleet-side
+    ``router.result_cache.*``): what telemetry-driven admission admitted
+    or declined (cold structure vs byte economics), what GDSF/budget
+    pressure evicted, hits/misses, and misses that were only stale by
+    version token. Consumed by ``QueryServer.stats()["result_cache"]``
+    and bench config 21 (docs/17-plan-compilation.md)."""
+    r = registry if registry is not None else metrics
+    out: Dict[str, object] = {}
+    for level, prefix in (
+        ("serve", "compile.result_cache"),
+        ("router", "router.result_cache"),
+    ):
+        out[level + "_counters"] = {
+            "hits": r.counter(prefix + ".hit"),
+            "misses": r.counter(prefix + ".miss"),
+            "stale_misses": r.counter(prefix + ".stale_miss"),
+            "admitted": r.counter(prefix + ".admitted"),
+            "declined_cold": r.counter(prefix + ".declined_cold"),
+            "declined_bytes": r.counter(prefix + ".declined_bytes"),
+            "evicted": r.counter(prefix + ".evicted"),
+            "invalidated": r.counter(prefix + ".invalidated"),
+        }
+    out["bypass_latched"] = r.counter("compile.result_cache.bypass_latched")
     return out
 
 

@@ -1,0 +1,271 @@
+"""Result-cache A/B: warm repeat burst vs cache-off, refresh-race
+staleness audit, budget conservation, and fleet-level reuse (bench
+config 21).
+
+Run by bench.py as a subprocess. Four phases over one indexed source:
+
+* **warm burst** — the SAME repeated query, cache-off vs cache-on (two
+  priming executions, then every repeat is a memo hit). The hit path
+  answers at submit (no queue hop, no dispatch), so the burst wall must
+  collapse — bench.py hard-gates the speedup at >= 5x.
+* **refresh race** — full index refreshes commit WHILE a hit burst
+  runs; every answer is compared byte-for-byte against the cache-off
+  oracle. One stale hit (old bytes under a new token) fails the gate.
+* **budget conservation** — serve- and router-level held bytes are
+  sampled after every query; neither may ever exceed the configured
+  share of the ONE HBM budget the residency ladder divides.
+* **fleet reuse** — a two-host router runs the same aggregate three
+  times: cold (declined), repeat (admitted), hit. The hit must cost
+  ZERO fan-out legs (router.subqueries flat). Warm-compile hints are
+  then offered to both hosts over a cold pipeline cache and adoptions
+  counted.
+
+Prints ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from hyperspace_tpu.ops import ensure_x64  # noqa: E402
+
+ensure_x64()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    n_rows = int(os.environ.get("RESULT_CACHE_ROWS", 200_000))
+    n_queries = int(os.environ.get("RESULT_CACHE_QUERIES", 20))
+
+    from pathlib import Path
+
+    from hyperspace_tpu import constants as Cns
+    from hyperspace_tpu.compile.cache import pipeline_cache
+    from hyperspace_tpu.compile.result_cache import (
+        budget_share_bytes,
+        result_cache,
+        router_result_cache,
+    )
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.distributed import QueryRouter
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.plan.aggregates import agg_count, agg_sum
+    from hyperspace_tpu.plan.expr import col, lit
+    from hyperspace_tpu.serve import QueryServer, ServeConfig
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.storage import parquet_io
+    from hyperspace_tpu.storage.columnar import ColumnarBatch
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    rng = np.random.default_rng(0)
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, n_rows // 4, n_rows).astype(np.int64),
+            "v": rng.integers(-500, 1000, n_rows).astype(np.int64),
+            "g": rng.integers(0, 40, n_rows).astype(np.int64),
+        }
+    )
+    ws = tempfile.mkdtemp(prefix="hs_result_cache_")
+    src = Path(ws) / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+
+    def make_session():
+        conf = HyperspaceConf(
+            {
+                Cns.INDEX_SYSTEM_PATH: str(Path(ws) / "indexes"),
+                Cns.INDEX_NUM_BUCKETS: 8,
+                Cns.COMPILE_RESULT_CACHE: Cns.COMPILE_RESULT_CACHE_ON,
+            }
+        )
+        return HyperspaceSession(conf)
+
+    session = make_session()
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("rcx", ["k"], ["v", "g"])
+    )
+    session.enable_hyperspace()
+
+    key = int(batch.columns["k"].data[7])
+
+    def lookup():
+        # the repeated query is a filtered group-by aggregate: enough
+        # recompute per miss that the >= 5x warm-burst gate measures the
+        # memo collapsing real work, not submit-path noise
+        return (
+            session.read.parquet(str(src))
+            .filter(col("k") >= lit(key))
+            .group_by("g")
+            .agg(agg_sum("v", "sv"), agg_count(None, "n"))
+        )
+
+    def rows(b):
+        return sorted(
+            zip(
+                b.columns["g"].data.tolist(),
+                b.columns["sv"].data.tolist(),
+                b.columns["n"].data.tolist(),
+            )
+        )
+
+    share_bytes = budget_share_bytes(
+        session.conf.compile_result_cache_budget_share()
+    )
+    max_serve_held = 0
+    max_router_held = 0
+
+    def sample_held():
+        nonlocal max_serve_held, max_router_held
+        max_serve_held = max(max_serve_held, result_cache.held_bytes())
+        max_router_held = max(max_router_held, router_result_cache.held_bytes())
+
+    # -- phase 1: warm repeat burst, cache-off vs cache-on -------------------
+    server = QueryServer(session, ServeConfig(max_workers=2, batch_max=1))
+    session.conf.set(Cns.COMPILE_RESULT_CACHE, Cns.COMPILE_RESULT_CACHE_OFF)
+    for _ in range(3):  # warm the compile/residency caches off the clock
+        server.submit(lookup()).result(timeout=300)
+    t0 = time.perf_counter()
+    off_results = [
+        server.submit(lookup()).result(timeout=300) for _ in range(n_queries)
+    ]
+    off_s = time.perf_counter() - t0
+    oracle = rows(off_results[0])
+    parity = all(rows(r) == oracle for r in off_results)
+
+    session.conf.set(Cns.COMPILE_RESULT_CACHE, Cns.COMPILE_RESULT_CACHE_ON)
+    for _ in range(2):  # cold sighting declines, the repeat admits
+        server.submit(lookup()).result(timeout=300)
+    hits0 = metrics.counter("compile.result_cache.hit")
+    t0 = time.perf_counter()
+    for _ in range(n_queries):
+        got = server.submit(lookup()).result(timeout=300)
+        parity = parity and rows(got) == oracle
+        sample_held()
+    on_s = time.perf_counter() - t0
+    serve_hits = metrics.counter("compile.result_cache.hit") - hits0
+    warm_speedup = off_s / max(on_s, 1e-9)
+
+    # -- phase 2: refresh race — zero stale results --------------------------
+    inval0 = metrics.counter("compile.result_cache.invalidated")
+    refresh_errors = []
+
+    def refresher():
+        try:
+            for _ in range(2):
+                hs.refresh_index("rcx")
+                time.sleep(0.02)
+        except Exception as e:  # noqa: BLE001 - surfaced via stale gate
+            refresh_errors.append(repr(e))
+
+    t = threading.Thread(target=refresher)
+    t.start()
+    stale = 0
+    for _ in range(24):
+        got = server.submit(lookup()).result(timeout=300)
+        if rows(got) != oracle:
+            stale += 1
+        sample_held()
+    t.join(timeout=300)
+    if t.is_alive() or refresh_errors:
+        stale += 1000  # a wedged or failed refresh fails the gate loudly
+    refresh_invalidations = (
+        metrics.counter("compile.result_cache.invalidated") - inval0
+    )
+    server.close()
+
+    # -- phase 3+4: fleet reuse over the router + warm hints -----------------
+    session_b = make_session()
+    session_b.enable_hyperspace()
+    split = n_rows // 8
+
+    def agg_builder(s, part_index, n_parts):
+        df = s.read.parquet(str(src))
+        df = (
+            df.filter(col("k") < lit(split))
+            if part_index == 0
+            else df.filter(col("k") >= lit(split))
+        )
+        return df.group_by("g").agg(agg_sum("v", "sv"), agg_count(None, "n"))
+
+    def agg_rows(b):
+        return sorted(
+            zip(
+                b.columns["g"].data.tolist(),
+                b.columns["sv"].data.tolist(),
+                b.columns["n"].data.tolist(),
+            )
+        )
+
+    router = QueryRouter(
+        {
+            "a": QueryServer(session, ServeConfig(max_workers=2)),
+            "b": QueryServer(session_b, ServeConfig(max_workers=2)),
+        }
+    ).start()
+    r1 = router.submit(agg_builder).result(timeout=300)  # cold: declined
+    r2 = router.submit(agg_builder).result(timeout=300)  # repeat: admitted
+    sample_held()
+    subq0 = metrics.counter("router.subqueries")
+    fanout0 = metrics.counter("router.fanout")
+    rhits0 = metrics.counter("router.result_cache.hit")
+    r3 = router.submit(agg_builder).result(timeout=300)  # fleet hit
+    sample_held()
+    router_hits = metrics.counter("router.result_cache.hit") - rhits0
+    router_subq_on_hit = metrics.counter("router.subqueries") - subq0
+    router_fanout_on_hit = metrics.counter("router.fanout") - fanout0
+    router_parity = agg_rows(r1) == agg_rows(r2) == agg_rows(r3)
+
+    # warm-compile hints: a cold pipeline cache (revived/restarted
+    # fleet) pre-lowers the remembered shapes off the hot path
+    pipeline_cache.reset()
+    hints = router.offer_warm_hints()
+    router.close()
+
+    import shutil
+
+    shutil.rmtree(ws, ignore_errors=True)
+    print(
+        json.dumps(
+            {
+                "rows": n_rows,
+                "queries": n_queries,
+                "miss_burst_s": round(off_s, 4),
+                "hit_burst_s": round(on_s, 4),
+                "warm_speedup_x": round(warm_speedup, 2),
+                "serve_hits": int(serve_hits),
+                "parity": bool(parity and router_parity),
+                "stale_results": int(stale),
+                "refresh_invalidations": int(refresh_invalidations),
+                "budget_share_bytes": int(share_bytes),
+                "max_serve_held_bytes": int(max_serve_held),
+                "max_router_held_bytes": int(max_router_held),
+                "budget_conserved": bool(
+                    0 < max_serve_held <= share_bytes
+                    and max_router_held <= share_bytes
+                ),
+                "router_hits": int(router_hits),
+                "router_subqueries_on_hit": int(router_subq_on_hit),
+                "router_fanout_on_hit": int(router_fanout_on_hit),
+                "warm_hints_offered": int(hints["offered"]),
+                "warm_hints_adopted": int(hints["adopted"]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

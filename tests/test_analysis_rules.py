@@ -1260,6 +1260,41 @@ def test_hs014_clean_on_wellformed_names_and_nonliterals():
     assert codes(run(src), "HS014") == []
 
 
+def test_hs014_result_cache_prefixes_registered():
+    # the PR-20 counter families: result_cache.* (the lookup span),
+    # compile.result_cache.* and router.result_cache.* ride the already-
+    # registered compile/router namespaces — all clean
+    src = """
+    from hyperspace_tpu.telemetry.metrics import metrics
+    from hyperspace_tpu.telemetry.trace import span
+
+    def record():
+        metrics.incr("compile.result_cache.admitted")
+        metrics.incr("compile.result_cache.declined_cold")
+        metrics.incr("compile.result_cache.declined_bytes")
+        metrics.incr("compile.result_cache.stale_miss")
+        metrics.incr("router.result_cache.hit")
+        metrics.incr("compile.warm_hint.offered")
+        with span("result_cache.lookup", level="router"):
+            pass
+    """
+    assert codes(run(src), "HS014") == []
+
+
+def test_hs014_fires_on_unregistered_cache_prefix():
+    # the negative twin: a near-miss namespace (resultcache, no
+    # underscore) is NOT registered and must fire
+    src = """
+    from hyperspace_tpu.telemetry.metrics import metrics
+
+    def record():
+        metrics.incr("resultcache.lookup.hit")
+    """
+    got = [f for f in run(src) if f.code == "HS014" and not f.suppressed]
+    assert len(got) == 1
+    assert "prefix" in got[0].message
+
+
 def test_hs014_suppressed():
     src = """
     from hyperspace_tpu.telemetry.metrics import metrics

@@ -502,26 +502,35 @@ def test_serve_pinned_snapshot_serves_wholesale_across_refresh(env, tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# RESULT cache stub
+# RESULT cache: telemetry-driven admission
 # ---------------------------------------------------------------------------
-def test_result_cache_serves_repeat_and_invalidates_on_refresh(env):
+def test_result_cache_admission_then_hit_and_invalidate_on_refresh(env):
     session, hs, src, batch = env
     key = int(batch.columns["k"].data[9])
     session.conf.set(C.COMPILE_RESULT_CACHE, C.COMPILE_RESULT_CACHE_ON)
     try:
         server = QueryServer(session, ServeConfig(max_workers=2, batch_max=1))
+        # telemetry-driven admission: the COLD first sighting of this
+        # structural fingerprint declines (a cache can't help a shape
+        # that never repeats); the second sighting admits; the third
+        # query serves from the memo
         first = server.submit(_lookup(session, src, key)).result(timeout=120)
-        assert metrics.counter("compile.result_cache.stored") >= 1
-        hits_before = metrics.counter("compile.result_cache.hit")
+        assert metrics.counter("compile.result_cache.declined_cold") >= 1
+        assert result_cache.snapshot()["entries"] == 0
         second = server.submit(_lookup(session, src, key)).result(timeout=120)
+        assert metrics.counter("compile.result_cache.admitted") >= 1
+        assert server.stats()["compile"]["results"]["entries"] == 1
+        hits_before = metrics.counter("compile.result_cache.hit")
+        third = server.submit(_lookup(session, src, key)).result(timeout=120)
         assert metrics.counter("compile.result_cache.hit") == hits_before + 1
         assert_row_parity(first, second)
-        assert server.stats()["compile"]["results"]["entries"] == 1
+        assert_row_parity(first, third)
+        assert server.stats()["result_cache"]["serve"]["entries"] == 1
 
         hs.refresh_index("cidx")
         assert result_cache.snapshot()["entries"] == 0  # scoped drop
-        third = server.submit(_lookup(session, src, key)).result(timeout=120)
-        assert_row_parity(first, third)
+        fourth = server.submit(_lookup(session, src, key)).result(timeout=120)
+        assert_row_parity(first, fourth)
         server.close()
     finally:
         session.conf.unset(C.COMPILE_RESULT_CACHE)
@@ -534,8 +543,10 @@ def test_result_cache_respects_byte_ceiling(env):
     session.conf.set(C.COMPILE_RESULT_CACHE_MAX_BYTES, 1)
     try:
         server = QueryServer(session, ServeConfig(max_workers=2, batch_max=1))
+        # over the per-entry byte ceiling: declines on BYTES even on the
+        # first (cold) sighting — the ceiling outranks the repeat rule
         server.submit(_lookup(session, src, key)).result(timeout=120)
-        assert metrics.counter("compile.result_cache.too_large") >= 1
+        assert metrics.counter("compile.result_cache.declined_bytes") >= 1
         assert result_cache.snapshot()["entries"] == 0
         server.close()
     finally:

@@ -1,0 +1,552 @@
+"""Result-cache invalidation races (compile/result_cache + serve/
+cache_policy + distributed/router): concurrent refresh/optimize/delete
+against cached hits, pinned-token wholesale semantics, the router-level
+fleet cache dropping on EITHER join side's change, device-loss bypass-
+but-never-poison, and the budget-claimant integration with the
+residency ladder — fault-injection style throughout.
+
+The oracle everywhere is byte parity against the compile-off
+interpreter: a cache may only change counters and latency, never one
+byte of any result, no matter what invalidation races it.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.compile.cache import pipeline_cache
+from hyperspace_tpu.compile.result_cache import (
+    ResultCache,
+    result_cache,
+    router_result_cache,
+)
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.distributed import QueryRouter
+from hyperspace_tpu.exec import executor as EX
+from hyperspace_tpu.exec import joins as J
+from hyperspace_tpu.exec.hbm_cache import hbm_cache
+from hyperspace_tpu.exec.mesh_cache import mesh_cache
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.aggregates import agg_count, agg_sum
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.serve import QueryServer, ServeConfig
+from hyperspace_tpu.serve.cache_policy import AdmissionWindow, should_admit
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.storage import parquet_io
+from hyperspace_tpu.storage.columnar import ColumnarBatch
+from hyperspace_tpu.telemetry.metrics import metrics
+from tests.e2e_utils import assert_row_parity
+
+
+@pytest.fixture(autouse=True)
+def _reset_caches():
+    hbm_cache.reset()
+    mesh_cache.reset()
+    pipeline_cache.reset()
+    result_cache.reset()
+    router_result_cache.reset()
+    EX.reset_groups_cache()
+    J.reset_setup_cache()
+    yield
+    hbm_cache.reset()
+    mesh_cache.reset()
+    pipeline_cache.reset()
+    result_cache.reset()
+    router_result_cache.reset()
+    EX.reset_groups_cache()
+    J.reset_setup_cache()
+
+
+# ---------------------------------------------------------------------------
+# policy units: admission window, decision rule, GDSF, wholesale tokens
+# ---------------------------------------------------------------------------
+def test_admission_window_slides_and_counts_current_sighting():
+    w = AdmissionWindow(2)
+    assert w.observe("a") == 1  # cold: first sighting counts itself
+    assert w.observe("a") == 2
+    assert w.observe("b") == 1  # window [a, b] — the oldest "a" slid out
+    assert w.repeats("a") == 1
+    assert w.observe("a") == 1  # [b, a]: the surviving "a" is this one
+    w.reset()
+    assert w.repeats("a") == 0
+
+
+def test_should_admit_orders_ceiling_cold_then_value():
+    # the per-entry ceiling outranks everything, even a hot fingerprint
+    assert should_admit(10, 100.0, 50, 1 << 20, 9) == "declined_bytes"
+    # a first sighting always declines regardless of cost
+    assert should_admit(10, 100.0, 1, 1 << 20, 1 << 30) == "declined_cold"
+    # repeated but worthless: seconds saved don't cover the bytes
+    assert should_admit(1 << 20, 0.0, 5, 1, 1 << 30) == "declined_bytes"
+    assert should_admit(100, 1.0, 2, 1 << 20, 1 << 30) == "admit"
+
+
+def _put_admitted(rc, key, nbytes, cost_s, **kw):
+    verdict = rc.put(
+        key,
+        object(),
+        kw.pop("roots", ("/ix/a/part.bin",)),
+        kw.pop("max_entries", 16),
+        10**9,
+        cost_s=cost_s,
+        repeats=8,
+        byte_rate=1 << 20,
+        total_max_bytes=10**9,
+        nbytes=nbytes,
+    )
+    assert verdict == "admitted"
+
+
+def test_gdsf_evicts_cheapest_value_density_and_ages_clock():
+    rc = ResultCache()
+    # big-and-cheap vs small-and-expensive: GDSF priority is
+    # cost/bytes, so the bulky cheap entry is the first victim
+    _put_admitted(rc, ("s1", "t"), nbytes=1000, cost_s=0.001)
+    _put_admitted(rc, ("s2", "t"), nbytes=100, cost_s=10.0)
+    _put_admitted(rc, ("s3", "t"), nbytes=100, cost_s=10.0, max_entries=2)
+    assert rc.get(("s1", "t")) is None  # evicted: lowest priority
+    assert rc.get(("s2", "t")) is not None
+    assert rc.get(("s3", "t")) is not None
+    # the aging clock moved to the victim's priority, so future entries
+    # outrank long-dead ones
+    assert rc.snapshot()["clock"] == pytest.approx(0.001 / 1000)
+
+
+def test_pinned_token_wholesale_never_serves_newer_epoch():
+    rc = ResultCache()
+    batch = object()
+    verdict = rc.put(
+        ("sig", ("tok1",)),
+        batch,
+        ("/ix/a/part.bin",),
+        16,
+        10**9,
+        cost_s=1.0,
+        repeats=4,
+        byte_rate=1 << 20,
+        total_max_bytes=10**9,
+        nbytes=64,
+    )
+    assert verdict == "admitted"
+    # a reader on the NEW token misses (counted stale: same signature
+    # alive under another token) — it must never see the old snapshot
+    stale_before = metrics.counter("compile.result_cache.stale_miss")
+    assert rc.get(("sig", ("tok2",))) is None
+    assert (
+        metrics.counter("compile.result_cache.stale_miss")
+        == stale_before + 1
+    )
+    # a snapshot-pinned reader presenting the OLD token still hits it
+    # WHOLESALE: token change alone never drops entries
+    assert rc.get(("sig", ("tok1",))) is batch
+
+
+def test_router_cache_invalidates_on_either_join_side():
+    # a fleet entry anchored to TWO index roots (a join's sides) drops
+    # when EITHER side is rewritten
+    for doomed_root in ("/ix/left", "/ix/right"):
+        _put_admitted(
+            router_result_cache,
+            ("sig", ("ta", "tb")),
+            nbytes=64,
+            cost_s=1.0,
+            roots=("/ix/left/part.bin", "/ix/right/part.bin"),
+        )
+        assert router_result_cache.snapshot()["entries"] == 1
+        assert router_result_cache.invalidate(doomed_root) == 1
+        assert router_result_cache.snapshot()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# budget claimant: result bytes charge the ONE HBM budget, shed first
+# ---------------------------------------------------------------------------
+def test_claimant_bytes_charge_hbm_budget_and_shed_frees():
+    from hyperspace_tpu.exec.hbm_cache import _budget_bytes
+    from hyperspace_tpu.residency.tiers import claimant_bytes
+
+    base = _budget_bytes()
+    _put_admitted(result_cache, ("s", "t"), nbytes=600_000, cost_s=5.0)
+    assert claimant_bytes() == 600_000
+    assert _budget_bytes() == base - 600_000
+    freed = result_cache.shed(1)  # GDSF eviction frees whole entries
+    assert freed == 600_000
+    assert claimant_bytes() == 0
+    assert _budget_bytes() == base
+
+
+def test_register_sheds_cached_results_before_any_delta(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_BUDGET_MB", "1")
+    _put_admitted(result_cache, ("s", "t"), nbytes=600_000, cost_s=5.0)
+    delta = SimpleNamespace(
+        key=("d",), base_key=("t", ("f",)), nbytes=200_000, last_used=0.0
+    )
+    hbm_cache._deltas.append(delta)
+    table = SimpleNamespace(key=("t", ("f",)), nbytes=300_000, last_used=0.0)
+    try:
+        dev_before = metrics.counter("hbm.delta.evicted")
+        # 300k table + 200k delta against (1MiB - 600k claimant): over
+        # budget — the ladder must shed the cached result (cheapest
+        # rung) and KEEP the delta
+        hbm_cache._register(table)
+        assert result_cache.snapshot()["entries"] == 0
+        assert delta in hbm_cache._deltas
+        assert metrics.counter("hbm.delta.evicted") == dev_before
+        assert any(t.key == table.key for t in hbm_cache._tables)
+    finally:
+        hbm_cache._deltas = [d for d in hbm_cache._deltas if d is not delta]
+        hbm_cache._tables = [t for t in hbm_cache._tables if t is not table]
+
+
+# ---------------------------------------------------------------------------
+# serve-level races: refresh/optimize/delete vs cached hits
+# ---------------------------------------------------------------------------
+N_ROWS = 20_000
+
+
+@pytest.fixture
+def senv(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM", "force")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MIN_ROWS", "1")
+    monkeypatch.setenv("HYPERSPACE_TPU_HBM_MAX_BLOCK_FRAC", "1.0")
+    hbm_cache.reset()
+    rng = np.random.default_rng(7)
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 5_000, N_ROWS).astype(np.int64),
+            "v": rng.integers(0, 1000, N_ROWS).astype(np.int64),
+            "g": rng.integers(0, 40, N_ROWS).astype(np.int64),
+        }
+    )
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+    conf = HyperspaceConf(
+        {
+            C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+            C.INDEX_NUM_BUCKETS: 4,
+            C.COMPILE_RESULT_CACHE: C.COMPILE_RESULT_CACHE_ON,
+        }
+    )
+    session = HyperspaceSession(conf)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(src)), IndexConfig("rcx", ["k"], ["v", "g"])
+    )
+    session.enable_hyperspace()
+    assert hs.prefetch_index("rcx")
+    return session, hs, src, batch
+
+
+def _lookup(session, src, key):
+    return (
+        session.read.parquet(str(src))
+        .filter(col("k") == lit(int(key)))
+        .select("k", "v")
+    )
+
+
+def _with_compile_off(session, fn):
+    session.conf.set(C.COMPILE_MODE, C.COMPILE_MODE_OFF)
+    try:
+        return fn()
+    finally:
+        session.conf.unset(C.COMPILE_MODE)
+
+
+def _warm(server, session, src, key):
+    """Two sequential executions: the cold first sighting declines, the
+    second admits — returns the admitted result."""
+    server.submit(_lookup(session, src, key)).result(timeout=120)
+    out = server.submit(_lookup(session, src, key)).result(timeout=120)
+    assert result_cache.snapshot()["entries"] >= 1
+    return out
+
+
+def test_concurrent_refresh_vs_cached_burst_zero_stale(senv):
+    session, hs, src, batch = senv
+    key = int(batch.columns["k"].data[3])
+    expected = _with_compile_off(
+        session, lambda: _lookup(session, src, key).collect()
+    )
+    server = QueryServer(session, ServeConfig(max_workers=2, batch_max=1))
+    try:
+        _warm(server, session, src, key)
+        # refreshes commit WHILE the hit burst runs: every invalidation
+        # races a lookup, and every served result must still be byte-
+        # exact — a stale hit (pre-refresh bytes under a post-refresh
+        # token) or a torn entry would break parity
+        errors = []
+
+        def refresher():
+            try:
+                for _ in range(2):
+                    hs.refresh_index("rcx")
+                    time.sleep(0.01)
+            except Exception as e:  # noqa: BLE001 - reraised via assert
+                errors.append(e)
+
+        t = threading.Thread(target=refresher)
+        t.start()
+        results = [
+            server.submit(_lookup(session, src, key)).result(timeout=120)
+            for _ in range(12)
+        ]
+        t.join(timeout=120)
+        assert not t.is_alive() and not errors
+        for r in results:
+            assert_row_parity(expected, r)
+        # the cache took real traffic through the race: at least one
+        # admission survived to serve and at least one refresh dropped
+        assert metrics.counter("compile.result_cache.invalidated") >= 1
+    finally:
+        server.close()
+
+
+def test_optimize_and_delete_both_drop_cached_entries(senv):
+    session, hs, src, batch = senv
+    key = int(batch.columns["k"].data[11])
+    expected = _with_compile_off(
+        session, lambda: _lookup(session, src, key).collect()
+    )
+    server = QueryServer(session, ServeConfig(max_workers=2, batch_max=1))
+    try:
+        assert_row_parity(expected, _warm(server, session, src, key))
+        hs.optimize_index("rcx")
+        assert result_cache.snapshot()["entries"] == 0  # scoped drop
+        # the fingerprint window survives lifecycle ops: one post-
+        # optimize execution re-admits (its structure is already hot)
+        out = server.submit(_lookup(session, src, key)).result(timeout=120)
+        assert_row_parity(expected, out)
+        assert result_cache.snapshot()["entries"] == 1
+        hs.delete_index("rcx")
+        assert result_cache.snapshot()["entries"] == 0
+        # post-delete queries fall back to the raw scan, still exact
+        out = server.submit(_lookup(session, src, key)).result(timeout=120)
+        assert_row_parity(expected, out)
+    finally:
+        server.close()
+
+
+def test_device_loss_bypasses_cache_without_poisoning(senv, monkeypatch):
+    from hyperspace_tpu.exec import hbm_cache as hc
+
+    session, hs, src, batch = senv
+    key_a = int(batch.columns["k"].data[5])
+    key_b1 = int(batch.columns["k"].data[9])
+    key_b2 = int(batch.columns["k"].data[13])
+    expected_a = _with_compile_off(
+        session, lambda: _lookup(session, src, key_a).collect()
+    )
+    warmer = QueryServer(session, ServeConfig(max_workers=1, batch_max=1))
+    first = _warm(warmer, session, src, key_a)
+    assert_row_parity(expected_a, first)
+    warmer.close()
+    entries_warm = result_cache.snapshot()["entries"]
+
+    # fault injection: the batched device dispatch dies mid-serve — the
+    # server latches host-side (test_failure_injection's wedge pattern)
+    def wedged(self, table, predicates, prepared=None, metric_ns="serve.batch"):
+        raise RuntimeError("device lost mid-dispatch")
+
+    monkeypatch.setattr(hc.HbmIndexCache, "block_counts_batch", wedged)
+    server = QueryServer(
+        session, ServeConfig(max_workers=1, autostart=False)
+    )
+    try:
+        t1 = server.submit(_lookup(session, src, key_b1))
+        t2 = server.submit(_lookup(session, src, key_b2))
+        server.start()
+        assert t1.result(timeout=120).num_rows >= 0
+        assert t2.result(timeout=120).num_rows >= 0
+        assert server.degraded is True
+
+        # latched submissions BYPASS the cache: no lookup (the warm
+        # entry's hit count must not move), no store — but the entries
+        # themselves survive untouched (bypass, never poison)
+        bypass_before = metrics.counter("compile.result_cache.bypass_latched")
+        hits_before = metrics.counter("compile.result_cache.hit")
+        out = server.submit(_lookup(session, src, key_a)).result(timeout=120)
+        assert_row_parity(expected_a, out)  # host engine, still exact
+        assert (
+            metrics.counter("compile.result_cache.bypass_latched")
+            == bypass_before + 1
+        )
+        assert metrics.counter("compile.result_cache.hit") == hits_before
+        assert result_cache.snapshot()["entries"] >= entries_warm
+    finally:
+        server.close()
+
+    # an unlatched server over the same session serves the SAME warm
+    # entry from cache — the device never recovered (the wedge is still
+    # armed), so a hit is the only way this parity can hold
+    healthy = QueryServer(session, ServeConfig(max_workers=1, batch_max=1))
+    try:
+        hits_before = metrics.counter("compile.result_cache.hit")
+        out = healthy.submit(_lookup(session, src, key_a)).result(timeout=120)
+        assert_row_parity(expected_a, out)
+        assert metrics.counter("compile.result_cache.hit") == hits_before + 1
+    finally:
+        healthy.close()
+
+
+# ---------------------------------------------------------------------------
+# router-level: fleet reuse, either-side drops, warm-compile hints
+# ---------------------------------------------------------------------------
+RN = 24_000
+RSPLIT = 10_000
+
+
+@pytest.fixture
+def renv(tmp_path):
+    """Two sessions over the SAME files and index log — the two 'hosts'
+    of the fleet, with the result cache conf-enabled on both."""
+    rng = np.random.default_rng(3)
+    batch = ColumnarBatch.from_pydict(
+        {
+            "k": rng.integers(0, 20_000, RN).astype(np.int64),
+            "v": rng.integers(-500, 1000, RN).astype(np.int64),
+            "g": rng.integers(0, 30, RN).astype(np.int64),
+        }
+    )
+    src = tmp_path / "data"
+    src.mkdir()
+    parquet_io.write_parquet(src / "part-0.parquet", batch)
+
+    def make_session():
+        conf = HyperspaceConf(
+            {
+                C.INDEX_SYSTEM_PATH: str(tmp_path / "indexes"),
+                C.INDEX_NUM_BUCKETS: 8,
+                C.COMPILE_RESULT_CACHE: C.COMPILE_RESULT_CACHE_ON,
+            }
+        )
+        return HyperspaceSession(conf)
+
+    session_a = make_session()
+    hs = Hyperspace(session_a)
+    hs.create_index(
+        session_a.read.parquet(str(src)),
+        IndexConfig("rrx", ["k"], ["v", "g"]),
+    )
+    session_a.enable_hyperspace()
+    session_b = make_session()
+    session_b.enable_hyperspace()
+    return session_a, session_b, src, batch
+
+
+def _part_filter(df, part_index, n_parts):
+    assert n_parts == 2
+    if part_index == 0:
+        return df.filter(col("k") < lit(RSPLIT))
+    return df.filter(col("k") >= lit(RSPLIT))
+
+
+def _agg_builder(src):
+    def build(session, part_index, n_parts):
+        df = _part_filter(session.read.parquet(str(src)), part_index, n_parts)
+        return df.group_by("g").agg(agg_sum("v", "sv"), agg_count(None, "n"))
+
+    return build
+
+
+def _scan_builder(src, key):
+    def build(session, part_index, n_parts):
+        df = _part_filter(session.read.parquet(str(src)), part_index, n_parts)
+        return df.filter(col("k") == lit(int(key))).select("k", "v")
+
+    return build
+
+
+def _make_router(renv):
+    session_a, session_b, src, batch = renv
+    return QueryRouter(
+        {
+            "a": QueryServer(session_a, ServeConfig(max_workers=2)),
+            "b": QueryServer(session_b, ServeConfig(max_workers=2)),
+        }
+    )
+
+
+def test_router_repeat_query_hits_with_zero_fanout_legs(renv):
+    session_a, session_b, src, batch = renv
+    router = _make_router(renv).start()
+    try:
+        build = _agg_builder(src)
+        r1 = router.submit(build).result(timeout=120)  # cold: declined
+        r2 = router.submit(build).result(timeout=120)  # repeat: admitted
+        assert router_result_cache.snapshot()["entries"] == 1
+        subq_before = metrics.counter("router.subqueries")
+        fanout_before = metrics.counter("router.fanout")
+        hits_before = metrics.counter("router.result_cache.hit")
+        r3 = router.submit(build).result(timeout=120)
+        # the fleet hit costs ZERO fan-out legs: no subqueries, no
+        # fanout span, and the merged bytes are identical
+        assert metrics.counter("router.result_cache.hit") == hits_before + 1
+        assert metrics.counter("router.subqueries") == subq_before
+        assert metrics.counter("router.fanout") == fanout_before
+        for name in r1.column_names:
+            np.testing.assert_array_equal(
+                r1.columns[name].data, r3.columns[name].data
+            )
+            np.testing.assert_array_equal(
+                r2.columns[name].data, r3.columns[name].data
+            )
+        assert router.stats()["result_cache"]["entries"] == 1
+    finally:
+        router.close()
+
+
+def test_router_cache_dropped_by_refresh_from_either_host(renv):
+    session_a, session_b, src, batch = renv
+    router = _make_router(renv).start()
+    try:
+        build = _agg_builder(src)
+        expected = router.submit(build).result(timeout=120)
+        router.submit(build).result(timeout=120)
+        assert router_result_cache.snapshot()["entries"] == 1
+        # host B's lifecycle op (same shared index log) must drop the
+        # fleet entry even though host A stored it
+        Hyperspace(session_b).refresh_index("rrx")
+        assert router_result_cache.snapshot()["entries"] == 0
+        out = router.submit(build).result(timeout=120)  # recompute, exact
+        for name in expected.column_names:
+            np.testing.assert_array_equal(
+                expected.columns[name].data, out.columns[name].data
+            )
+        assert router_result_cache.snapshot()["entries"] == 1  # re-admitted
+        # ... and host A's op drops it symmetrically
+        Hyperspace(session_a).optimize_index("rrx")
+        assert router_result_cache.snapshot()["entries"] == 0
+    finally:
+        router.close()
+
+
+def test_router_warm_hints_pre_lower_on_sibling_hosts(renv):
+    session_a, session_b, src, batch = renv
+    key = int(batch.columns["k"].data[17])
+    router = _make_router(renv).start()
+    try:
+        router.submit(_scan_builder(src, key)).result(timeout=120)
+        # cold fleet: both hosts' pipeline entries gone (a revived or
+        # restarted host), the hint book still remembers the shape
+        pipeline_cache.reset()
+        adopted_before = metrics.counter("compile.warm_hint.adopted")
+        out = router.offer_warm_hints()
+        assert out["offered"] >= 2  # the shape offered to BOTH hosts
+        assert out["adopted"] >= 1
+        assert (
+            metrics.counter("compile.warm_hint.adopted")
+            == adopted_before + out["adopted"]
+        )
+        # a second offer finds every host already warm: honest declines,
+        # no re-lowering churn
+        out2 = router.offer_warm_hints()
+        assert out2["adopted"] == 0
+        assert out2["declined"] == out2["offered"]
+    finally:
+        router.close()
